@@ -1,0 +1,42 @@
+# Harness targets mirroring the reference Makefile's test_* form
+# (reference Makefile:38-49: test_serial / test_mpi / test_cuda + get_mnist),
+# plus the real test suite the reference never had.
+
+PY ?= python
+DATA_DIR ?= data/mnist
+CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test_serial test_dp8 test_tpu bench get_mnist clean
+
+# Unit/integration suite (CPU, 8 virtual devices — set in tests/conftest.py).
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# Serial e2e smoke run (twin of `make test_serial`, reference Makefile:38).
+# Uses synthetic data when $(DATA_DIR) has no MNIST IDX files.
+test_serial:
+	$(PY) -m mpi_cuda_cnn_tpu --dataset synthetic --model reference_cnn \
+	  --epochs 2 --num-devices 1
+
+# 8-way data-parallel e2e smoke run (twin of `make test_mpi`'s
+# mpirun -np 8, reference Makefile:44) on a virtual CPU mesh.
+test_dp8:
+	$(CPU8) JAX_PLATFORMS=cpu $(PY) -m mpi_cuda_cnn_tpu --dataset synthetic \
+	  --model reference_cnn --epochs 2
+
+# Same on whatever accelerator is visible (TPU on a TPU VM).
+test_tpu:
+	$(PY) -m mpi_cuda_cnn_tpu --dataset synthetic --model lenet5_relu \
+	  --init he --momentum 0.9 --epochs 2
+
+bench:
+	$(PY) bench.py
+
+# Fetch MNIST as the four IDX files (twin of get_mnist, reference
+# Makefile:24-35). Requires network access.
+get_mnist:
+	mkdir -p $(DATA_DIR)
+	$(PY) scripts/get_mnist.py $(DATA_DIR)
+
+clean:
+	rm -rf __pycache__ */__pycache__ .pytest_cache build dist

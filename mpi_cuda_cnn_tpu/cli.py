@@ -1,0 +1,91 @@
+"""Python CLI — the framework's main entry point.
+
+Keeps the reference's CLI contract (4 positional IDX paths, cnn.c:408-411;
+exit 100 on bad argc, exit 111 on unreadable files) while exposing every
+compiled-in constant of the reference as a flag (utils/config.py). The C
+driver (native/) offers the same surface for the north star's
+`--device=tpu` C-binary form.
+
+    python -m mpi_cuda_cnn_tpu train-images train-labels t10k-images t10k-labels
+    python -m mpi_cuda_cnn_tpu --dataset synthetic --model lenet5_relu --epochs 3
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .data.datasets import get_dataset, load_idx_dataset
+from .data.idx import IdxError
+from .models.presets import get_model
+from .parallel.distributed import initialize_distributed
+from .train.trainer import Trainer
+from .utils.config import Config, parse_args
+from .utils.logging import MetricsLogger, get_logger
+
+
+def _select_device(cfg: Config, log) -> bool:
+    """Honor --device (the north star's `--device=cpu|tpu` switch,
+    BASELINE.json). 'auto' takes whatever JAX picked."""
+    import jax
+
+    if cfg.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif cfg.device == "tpu":
+        if all(d.platform == "cpu" for d in jax.devices()):
+            log.error("--device=tpu requested but no accelerator is visible")
+            return False
+    elif cfg.device != "auto":
+        log.error("unknown --device %r (want auto|tpu|cpu)", cfg.device)
+        return False
+    return True
+
+
+def run(cfg: Config) -> int:
+    log = get_logger()
+    if not _select_device(cfg, log):
+        return 2
+    initialize_distributed()
+
+    try:
+        if cfg.dataset == "idx":
+            ds = load_idx_dataset(
+                "idx",
+                cfg.train_images,
+                cfg.train_labels,
+                cfg.test_images,
+                cfg.test_labels,
+            )
+        else:
+            ds = get_dataset(cfg.dataset, data_dir=cfg.data_dir)
+    except (OSError, IdxError, TypeError) as e:
+        # The reference exits 111 on any file problem (cnn.c:432,440).
+        log.error("data load failed: %s", e)
+        return 111
+    except (KeyError, ValueError) as e:
+        log.error("bad dataset config: %s", e)
+        return 2
+
+    try:
+        model = get_model(cfg.model, input_shape=ds.input_shape)
+    except KeyError as e:
+        log.error("%s", e)
+        return 2
+    log.info("model=%s dataset=%s input=%s", model.name, ds.name, ds.input_shape)
+    trainer = Trainer(model, ds, cfg, metrics=MetricsLogger())
+    result = trainer.train()
+    log.info(
+        "done: epochs=%d acc=%.4f mean_step=%.3fms",
+        result.epochs_run,
+        result.test_accuracy,
+        result.mean_step_ms,
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = parse_args(argv)
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Data subsystem: IDX format IO, dataset registry, input pipelines."""
+
+from .idx import IdxError, read_idx, write_idx
+from .datasets import Dataset, get_dataset, register_dataset, synthetic_stripes
+from .pipeline import normalize_images, one_hot, epoch_batches
+
+__all__ = [
+    "IdxError",
+    "read_idx",
+    "write_idx",
+    "Dataset",
+    "get_dataset",
+    "register_dataset",
+    "synthetic_stripes",
+    "normalize_images",
+    "one_hot",
+    "epoch_batches",
+]

@@ -1,0 +1,184 @@
+"""Dataset registry.
+
+The reference trains on exactly one dataset — MNIST loaded from four IDX
+files given as positional CLI args (cnn.c:406-443). The benchmark configs
+(BASELINE.json) additionally name Fashion-MNIST (same container format) and
+CIFAR-10 (32x32x3 input path). This registry serves all of them from IDX
+files on disk, and provides deterministic synthetic generators of the same
+shapes so every test and benchmark runs without network access.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Callable
+
+import numpy as np
+
+from .idx import read_idx, write_idx
+
+
+@dataclasses.dataclass(frozen=True)
+class Dataset:
+    """An in-memory image-classification dataset.
+
+    images: uint8, (N, H, W) grayscale or (N, H, W, C) color.
+    labels: uint8/int, (N,).
+    """
+
+    name: str
+    train_images: np.ndarray
+    train_labels: np.ndarray
+    test_images: np.ndarray
+    test_labels: np.ndarray
+    num_classes: int
+
+    @property
+    def input_shape(self) -> tuple[int, ...]:
+        hwc = self.train_images.shape[1:]
+        return hwc if len(hwc) == 3 else (*hwc, 1)
+
+    def __post_init__(self):
+        for split in ("train", "test"):
+            imgs = getattr(self, f"{split}_images")
+            labels = getattr(self, f"{split}_labels")
+            if len(imgs) != len(labels):
+                raise ValueError(
+                    f"{self.name}/{split}: {len(imgs)} images vs {len(labels)} labels"
+                )
+
+
+def load_idx_dataset(
+    name: str,
+    train_images: str | Path,
+    train_labels: str | Path,
+    test_images: str | Path,
+    test_labels: str | Path,
+    num_classes: int = 10,
+) -> Dataset:
+    """Load a dataset from four IDX paths — the reference's CLI contract
+    (cnn.c:408-411: train-images train-labels test-images test-labels)."""
+    return Dataset(
+        name=name,
+        train_images=read_idx(train_images),
+        train_labels=read_idx(train_labels),
+        test_images=read_idx(test_images),
+        test_labels=read_idx(test_labels),
+        num_classes=num_classes,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Synthetic data
+# ---------------------------------------------------------------------------
+
+
+def synthetic_stripes(
+    num_train: int = 2000,
+    num_test: int = 500,
+    height: int = 28,
+    width: int = 28,
+    channels: int = 1,
+    num_classes: int = 10,
+    noise: float = 16.0,
+    seed: int = 1234,
+    name: str = "synthetic",
+) -> Dataset:
+    """Learnable synthetic dataset: class k lights up horizontal stripe k.
+
+    Same family of pattern the survey used to validate the C reference
+    (SURVEY.md §4: 500/500 test accuracy after 10 epochs), so convergence
+    tests carry over directly. Images are uint8 with Gaussian noise.
+    """
+    rng = np.random.default_rng(seed)
+    band = height // num_classes
+
+    def make(n: int):
+        labels = rng.integers(0, num_classes, size=n).astype(np.uint8)
+        imgs = rng.normal(32.0, noise, size=(n, height, width, channels))
+        for k in range(num_classes):
+            rows = slice(k * band, (k + 1) * band)
+            imgs[labels == k, rows, :, :] += 160.0
+        imgs = np.clip(imgs, 0, 255).astype(np.uint8)
+        if channels == 1:
+            imgs = imgs[..., 0]
+        return imgs, labels
+
+    train_x, train_y = make(num_train)
+    test_x, test_y = make(num_test)
+    return Dataset(name, train_x, train_y, test_x, test_y, num_classes)
+
+
+def write_synthetic_idx(dirpath: str | Path, ds: Dataset) -> dict[str, Path]:
+    """Materialize a dataset as the four IDX files the CLI contract expects."""
+    dirpath = Path(dirpath)
+    paths = {
+        "train_images": dirpath / "train-images-idx3-ubyte",
+        "train_labels": dirpath / "train-labels-idx1-ubyte",
+        "test_images": dirpath / "t10k-images-idx3-ubyte",
+        "test_labels": dirpath / "t10k-labels-idx1-ubyte",
+    }
+    write_idx(paths["train_images"], ds.train_images)
+    write_idx(paths["train_labels"], ds.train_labels)
+    write_idx(paths["test_images"], ds.test_images)
+    write_idx(paths["test_labels"], ds.test_labels)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: dict[str, Callable[..., Dataset]] = {}
+
+
+def register_dataset(name: str, factory: Callable[..., Dataset]) -> None:
+    _REGISTRY[name] = factory
+
+
+def get_dataset(name: str, data_dir: str | Path | None = None, **kwargs) -> Dataset:
+    """Fetch a dataset by name.
+
+    Known names: mnist, fashion_mnist (IDX files under data_dir),
+    cifar10 (IDX-converted files under data_dir), synthetic,
+    synthetic_cifar. Unknown names raise KeyError listing options.
+    """
+    if name in _REGISTRY:
+        return _REGISTRY[name](data_dir=data_dir, **kwargs)
+    raise KeyError(f"unknown dataset {name!r}; known: {sorted(_REGISTRY)}")
+
+
+def _idx_factory(dataset_name: str, num_classes: int = 10):
+    def factory(data_dir=None, **kwargs):
+        if data_dir is None:
+            raise ValueError(f"{dataset_name} requires data_dir with IDX files")
+        d = Path(data_dir)
+        return load_idx_dataset(
+            dataset_name,
+            d / "train-images-idx3-ubyte",
+            d / "train-labels-idx1-ubyte",
+            d / "t10k-images-idx3-ubyte",
+            d / "t10k-labels-idx1-ubyte",
+            num_classes=num_classes,
+        )
+
+    return factory
+
+
+register_dataset("mnist", _idx_factory("mnist"))
+register_dataset("fashion_mnist", _idx_factory("fashion_mnist"))
+register_dataset("cifar10", _idx_factory("cifar10"))
+register_dataset(
+    "synthetic", lambda data_dir=None, **kw: synthetic_stripes(name="synthetic", **kw)
+)
+register_dataset(
+    "synthetic_cifar",
+    lambda data_dir=None, **kw: synthetic_stripes(
+        name="synthetic_cifar",
+        height=32,
+        width=32,
+        channels=3,
+        **kw,
+    ),
+)

@@ -1,0 +1,61 @@
+"""Input pipeline: normalization, one-hot, batching.
+
+The reference's pipeline is three lines inside the training loop: pick a
+random index with replacement (cnn.c:455), divide pixel bytes by 255
+(cnn.c:457), one-hot the label (cnn.c:462-464). The TPU-idiomatic
+equivalent is whole-epoch permutation batching with static batch shapes —
+per-sample steps would leave the MXU idle (SURVEY.md §7 hard-part (a)).
+
+Everything here is host-side numpy; arrays cross to the device once per
+step (or once per epoch for small datasets) as full batches.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def normalize_images(images: np.ndarray) -> np.ndarray:
+    """uint8 [0,255] -> float32 [0,1], adding a channel axis for grayscale.
+
+    Matches the reference's `x[j] = img[j]/255.0` (cnn.c:457), in f32 rather
+    than double (SURVEY.md §7 hard-part (b)). Output layout is NHWC.
+    """
+    images = np.asarray(images)
+    if images.ndim == 3:
+        images = images[..., None]
+    return images.astype(np.float32) / np.float32(255.0)
+
+
+def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
+    """Labels -> float32 one-hot rows (cnn.c:462-464)."""
+    labels = np.asarray(labels)
+    out = np.zeros((len(labels), num_classes), dtype=np.float32)
+    out[np.arange(len(labels)), labels] = 1.0
+    return out
+
+
+def epoch_batches(
+    images: np.ndarray,
+    labels: np.ndarray,
+    batch_size: int,
+    *,
+    rng: np.random.Generator | None = None,
+    drop_remainder: bool = True,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Yield shuffled (images, labels) batches covering one epoch.
+
+    The reference samples with replacement (cnn.c:455); an epoch permutation
+    is the standard equivalent with identical expected gradient and better
+    coverage. With rng=None the order is sequential (the MPI variant's
+    behavior, cnnmpi.c:469). Static batch shapes: the tail partial batch is
+    dropped by default so every step traces to the same XLA program.
+    """
+    n = len(images)
+    order = np.arange(n) if rng is None else rng.permutation(n)
+    end = n - (n % batch_size) if drop_remainder else n
+    for start in range(0, end, batch_size):
+        idx = order[start : start + batch_size]
+        yield images[idx], labels[idx]

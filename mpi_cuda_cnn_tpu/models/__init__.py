@@ -1,0 +1,17 @@
+"""Models: functional layer API and benchmark presets."""
+
+from .layers import AvgPool, Conv, Dense, Flatten, MaxPool, Sequential
+from .initializers import get_initializer
+from .presets import MODEL_PRESETS, get_model
+
+__all__ = [
+    "Conv",
+    "Dense",
+    "Flatten",
+    "MaxPool",
+    "AvgPool",
+    "Sequential",
+    "get_initializer",
+    "MODEL_PRESETS",
+    "get_model",
+]

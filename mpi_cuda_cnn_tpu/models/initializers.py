@@ -1,0 +1,67 @@
+"""Weight initializers.
+
+The reference initializes every weight as `nrnd() * 0.1` where nrnd is an
+Irwin-Hall(4) approximate normal: `(rnd+rnd+rnd+rnd - 2.0) * 1.724` with
+rnd uniform in [0,1) (cnn.c:46-49; 1.724 ≈ sqrt(3) normalizes the variance
+to ~1). Biases start at zero (calloc, cnn.c:86). All initializers here are
+keyed `jax.random` — identical across processes/devices by construction,
+which fixes the reference's divergent per-rank init (srand(0+rank),
+cnnmpi.c:423, bug SURVEY.md 2.6c).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[[jax.Array, tuple[int, ...], jnp.dtype], jnp.ndarray]
+
+
+def normal(std: float = 0.1) -> Initializer:
+    """Gaussian with fixed std — the reference's effective init (std 0.1)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        return std * jax.random.normal(key, shape, dtype)
+
+    return init
+
+
+def irwin_hall(std: float = 0.1) -> Initializer:
+    """Distribution-exact twin of the reference's nrnd (cnn.c:46-49):
+    sum of four uniforms, shifted and scaled by 1.724."""
+
+    def init(key, shape, dtype=jnp.float32):
+        u = jax.random.uniform(key, (4, *shape), dtype)
+        return std * ((jnp.sum(u, axis=0) - 2.0) * 1.724)
+
+    return init
+
+
+def he_normal() -> Initializer:
+    """Fan-in-scaled Gaussian — what the better presets (LeNet-5/VGG on the
+    ≥99% target) use instead of the reference's flat std."""
+
+    def init(key, shape, dtype=jnp.float32):
+        if len(shape) == 4:  # (kh, kw, Cin, Cout)
+            fan_in = shape[0] * shape[1] * shape[2]
+        else:  # (d_in, d_out)
+            fan_in = shape[0]
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+
+    return init
+
+
+_REGISTRY = {
+    "normal": normal,
+    "irwin_hall": irwin_hall,
+    "he": lambda std=None: he_normal(),
+}
+
+
+def get_initializer(name: str, std: float = 0.1) -> Initializer:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown initializer {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](std) if name != "he" else he_normal()

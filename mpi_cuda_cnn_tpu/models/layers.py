@@ -1,0 +1,210 @@
+"""Functional layer API.
+
+The reference's model is a doubly-linked list of `Layer` structs carrying
+their own buffers (cnn.c:15-43), with three layer kinds: input, conv, full
+(cnn.c:8-12). Here a model is data (a tuple of stateless layer descriptors)
+plus a params pytree; apply is a pure function so it composes with jit,
+grad, vmap, shard_map and checkpointing. Pooling layers are added beyond
+the reference (it downsamples only via stride-2 conv, SURVEY.md 2.10) since
+the benchmark presets (LeNet-5, VGG) need them.
+
+Each layer implements:
+    init(key, in_shape, initializer, dtype) -> (params, out_shape)
+    apply(params, x, backend) -> y
+with in/out shapes per-sample (H, W, C) or (features,); apply operates on
+batched arrays (N, ...). `backend` selects "xla" oracle ops or the Pallas
+TPU kernels (ops/pallas_ops.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import conv2d, dense
+from ..ops.activations import ACTIVATIONS
+
+Params = Any
+
+
+def _apply_activation(name: str | None, x: jnp.ndarray) -> jnp.ndarray:
+    return ACTIVATIONS[name](x)
+
+
+@dataclasses.dataclass(frozen=True)
+class Conv:
+    """2-D convolution + bias + activation.
+
+    Twin of the reference conv layer (Layer_create_conv cnn.c:328-343,
+    forward cnn.c:175-210): square kernel, uniform stride/padding, ReLU
+    fused into the forward. NHWC/HWIO layouts for the TPU.
+    """
+
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    padding: int = 0
+    activation: str | None = "relu"
+
+    def init(self, key, in_shape, initializer, dtype=jnp.float32):
+        h, w, c = in_shape
+        params = {
+            "w": initializer(key, (self.kernel, self.kernel, c, self.features), dtype),
+            "b": jnp.zeros((self.features,), dtype),
+        }
+        oh = (h + 2 * self.padding - self.kernel) // self.stride + 1
+        ow = (w + 2 * self.padding - self.kernel) // self.stride + 1
+        return params, (oh, ow, self.features)
+
+    def apply(self, params, x, backend="xla"):
+        if backend == "pallas":
+            from ..ops.pallas_ops import conv2d_pallas
+
+            y = conv2d_pallas(
+                x, params["w"], stride=self.stride, padding=self.padding
+            ) + params["b"]
+        else:
+            y = conv2d(x, params["w"], stride=self.stride, padding=self.padding)
+            y = y + params["b"]
+        return _apply_activation(self.activation, y)
+
+
+@dataclasses.dataclass(frozen=True)
+class Dense:
+    """Fully-connected + bias + activation (Layer_create_full cnn.c:318-326,
+    forward cnn.c:113-152). Accepts (N, d) or unflattened (N, H, W, C) input
+    — the reference's FC layers read the conv buffer flat the same way."""
+
+    features: int
+    activation: str | None = "tanh"
+
+    def init(self, key, in_shape, initializer, dtype=jnp.float32):
+        d_in = int(jnp.prod(jnp.array(in_shape)))
+        params = {
+            "w": initializer(key, (d_in, self.features), dtype),
+            "b": jnp.zeros((self.features,), dtype),
+        }
+        return params, (self.features,)
+
+    def apply(self, params, x, backend="xla"):
+        x = x.reshape(x.shape[0], -1)
+        if backend == "pallas":
+            from ..ops.pallas_ops import dense_pallas
+
+            y = dense_pallas(x, params["w"], params["b"])
+        else:
+            y = dense(x, params["w"], params["b"])
+        return _apply_activation(self.activation, y)
+
+
+def _pool(x: jnp.ndarray, window: int, stride: int, kind: str) -> jnp.ndarray:
+    """Pooling over NHWC. Non-overlapping windows (stride == window, the only
+    form the presets use) lower to a reshape + reduce, which XLA vectorizes
+    on the VPU and which differentiates cleanly under shard_map; overlapping
+    windows fall back to reduce_window."""
+    n, h, w, c = x.shape
+    if stride == window and h % window == 0 and w % window == 0:
+        r = x.reshape(n, h // window, window, w // window, window, c)
+        return r.max(axis=(2, 4)) if kind == "max" else r.mean(axis=(2, 4))
+    init = -jnp.inf if kind == "max" else 0.0
+    op = jax.lax.max if kind == "max" else jax.lax.add
+    out = jax.lax.reduce_window(
+        x,
+        jnp.array(init, x.dtype),
+        op,
+        (1, window, window, 1),
+        (1, stride, stride, 1),
+        "VALID",
+    )
+    return out if kind == "max" else out / (window * window)
+
+
+@dataclasses.dataclass(frozen=True)
+class MaxPool:
+    """Max pooling. Not present in the reference (SURVEY.md 2.10: stride-2
+    conv is its only downsampler) but required by the LeNet-5/VGG presets
+    named in the north star (BASELINE.json)."""
+
+    window: int = 2
+    stride: int | None = None
+
+    def init(self, key, in_shape, initializer, dtype=jnp.float32):
+        s = self.stride or self.window
+        h, w, c = in_shape
+        return {}, ((h - self.window) // s + 1, (w - self.window) // s + 1, c)
+
+    def apply(self, params, x, backend="xla"):
+        s = self.stride or self.window
+        return _pool(x, self.window, s, "max")
+
+
+@dataclasses.dataclass(frozen=True)
+class AvgPool:
+    """Average pooling (classic LeNet-5 subsampling)."""
+
+    window: int = 2
+    stride: int | None = None
+
+    def init(self, key, in_shape, initializer, dtype=jnp.float32):
+        s = self.stride or self.window
+        h, w, c = in_shape
+        return {}, ((h - self.window) // s + 1, (w - self.window) // s + 1, c)
+
+    def apply(self, params, x, backend="xla"):
+        s = self.stride or self.window
+        return _pool(x, self.window, s, "avg")
+
+
+@dataclasses.dataclass(frozen=True)
+class Flatten:
+    def init(self, key, in_shape, initializer, dtype=jnp.float32):
+        return {}, (int(jnp.prod(jnp.array(in_shape))),)
+
+    def apply(self, params, x, backend="xla"):
+        return x.reshape(x.shape[0], -1)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sequential:
+    """A feed-forward stack — the functional twin of the reference's linked
+    list walked by Layer_setInputs (forward, cnn.c:249-268) and
+    Layer_learnOutputs (backward via jax.grad, cnn.c:284-301).
+
+    The final Dense's activation should be None: the softmax lives in the
+    loss (softmax_cross_entropy), exactly equivalent to the reference's
+    softmax-forward + error-seeding split (SURVEY.md 2.5).
+    """
+
+    layers: tuple
+    input_shape: tuple[int, ...]
+    name: str = "model"
+
+    def init(self, key, initializer, dtype=jnp.float32) -> list[Params]:
+        params = []
+        shape = self.input_shape
+        keys = jax.random.split(key, len(self.layers))
+        for layer, k in zip(self.layers, keys):
+            p, shape = layer.init(k, shape, initializer, dtype)
+            params.append(p)
+        return params
+
+    def apply(self, params: list[Params], x: jnp.ndarray, *,
+              backend: str = "xla", compute_dtype=None) -> jnp.ndarray:
+        """x: (N, H, W, C) -> logits (N, num_classes).
+
+        compute_dtype=bfloat16 casts activations (params are cast per-op by
+        XLA's dot/conv mixed-precision) so matmuls hit the MXU's native
+        bf16 path; logits are returned in f32 for the loss.
+        """
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+        for layer, p in zip(self.layers, params):
+            x = layer.apply(p, x, backend=backend)
+        return x.astype(jnp.float32)
+
+    def num_params(self, params: list[Params]) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,31 @@
+"""Ops: the framework's compute primitives.
+
+Two interchangeable implementations of each hot op:
+
+- `xla` (this package's conv.py/dense.py): `lax.conv_general_dilated` / dot —
+  the correctness oracle, and already MXU-optimal for these shapes.
+- `pallas` (pallas_ops.py): hand-written TPU kernels, the twin of the
+  reference's CUDA kernel surface (CUDAcnn.cu:167-218), wired in via
+  custom_vjp.
+
+Selection is per-model via `models.Sequential(..., backend=...)` or the
+`--use-pallas` flag.
+"""
+
+from .activations import relu, softmax, stable_softmax, tanh
+from .conv import conv2d, conv2d_input_grad, conv2d_kernel_grad
+from .dense import dense
+from .losses import softmax_cross_entropy, squared_error_total
+
+__all__ = [
+    "relu",
+    "tanh",
+    "softmax",
+    "stable_softmax",
+    "conv2d",
+    "conv2d_input_grad",
+    "conv2d_kernel_grad",
+    "dense",
+    "softmax_cross_entropy",
+    "squared_error_total",
+]

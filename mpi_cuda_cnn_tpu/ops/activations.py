@@ -1,0 +1,38 @@
+"""Activations matching the reference's semantics.
+
+Reference definitions (cnn.c:46-57): relu(x)=max(x,0); relu_g(y)=(y>0);
+tanh via libm with tanh_g(y)=1-y^2 — both gradient helpers take the
+*activation value*, which is exactly what reverse-mode AD of these closed
+forms produces, so `jax.grad` over these is the faithful backward.
+Softmax is the max-subtracted stable form (cnn.c:125-143).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def relu(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(x, 0)
+
+
+def tanh(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.tanh(x)
+
+
+def stable_softmax(logits: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Max-subtracted softmax — numerically identical in structure to the
+    reference's loop at cnn.c:125-143 (find max, exp-shift, normalize)."""
+    shifted = logits - jnp.max(logits, axis=axis, keepdims=True)
+    e = jnp.exp(shifted)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+softmax = stable_softmax
+
+ACTIVATIONS = {
+    "relu": relu,
+    "tanh": tanh,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}

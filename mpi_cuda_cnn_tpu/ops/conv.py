@@ -1,0 +1,97 @@
+"""2-D convolution via XLA (`lax.conv_general_dilated`).
+
+The reference implements direct convolution as a 6-deep C loop nest
+(`Layer_feedForw_conv` cnn.c:175-210, backward cnn.c:212-247) and one CUDA
+forward kernel (CUDAcnn.cu:167-195). Semantics reproduced here:
+
+- zero padding via bounds check (cnn.c:191,196)  -> explicit XLA padding
+- stride from the layer config (cnn.c:36-40)     -> window_strides
+- weights shared per (out-ch, in-ch, ky, kx)     -> ordinary conv weights
+- bias per output channel, activation fused      -> handled by the caller
+
+Layouts are TPU-idiomatic NHWC/HWIO (channel minor → lane dimension), not
+the reference's CHW/OIHW. The input/kernel gradient ops below mirror what
+`jax.grad` of conv2d produces; they exist as named primitives so the Pallas
+backward kernels have an oracle to test against (SURVEY.md §7 stage 4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DIMSPEC = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    *,
+    stride: int | tuple[int, int] = 1,
+    padding: int | tuple[int, int] = 0,
+    precision=None,
+) -> jnp.ndarray:
+    """x: (N,H,W,Cin) f32/bf16; w: (kh,kw,Cin,Cout). Returns (N,Ho,Wo,Cout)."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)),
+        dimension_numbers=DIMSPEC,
+        precision=precision,
+    )
+
+
+@partial(jax.jit, static_argnames=("stride", "padding", "input_hw"))
+def conv2d_input_grad(g, w, *, stride, padding, input_hw):
+    """d(loss)/d(input) given cotangent g: transposed conv.
+
+    Named twin of the dx half of the reference's conv backward
+    (cnn.c:228-236: scatter of delta through the kernel into prev errors).
+    """
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    kh, kw = w.shape[0], w.shape[1]
+    ih, iw = input_hw
+    oh, ow = g.shape[1], g.shape[2]
+    # Transposed conv: dilate g by stride, correlate with spatially-flipped,
+    # in/out-transposed kernel, with padding chosen to recover (ih, iw).
+    pad_h = kh - 1 - ph
+    pad_w = kw - 1 - pw
+    extra_h = ih - ((oh - 1) * sh + kh - 2 * ph)
+    extra_w = iw - ((ow - 1) * sw + kw - 2 * pw)
+    w_t = jnp.transpose(w[::-1, ::-1, :, :], (0, 1, 3, 2))
+    return lax.conv_general_dilated(
+        g,
+        w_t,
+        window_strides=(1, 1),
+        padding=((pad_h, pad_h + extra_h), (pad_w, pad_w + extra_w)),
+        lhs_dilation=(sh, sw),
+        dimension_numbers=DIMSPEC,
+    )
+
+
+@partial(jax.jit, static_argnames=("stride", "padding"))
+def conv2d_kernel_grad(x, g, *, stride, padding):
+    """d(loss)/d(kernel) given input x and cotangent g.
+
+    Named twin of the dw half of the reference's conv backward
+    (cnn.c:238-242: u_weights += delta * input patch). Expressed as a
+    conv over the batch dimension (x as NCHW-style lhs with N as channels).
+    """
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    # lhs: treat batch as contraction channel; rhs: cotangent as kernel.
+    return lax.conv_general_dilated(
+        jnp.transpose(x, (3, 1, 2, 0)),      # (Cin, H, W, N)
+        jnp.transpose(g, (1, 2, 0, 3)),      # (Ho, Wo, N, Cout)
+        window_strides=(1, 1),
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(sh, sw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ).transpose(1, 2, 0, 3)                  # (kh, kw, Cin, Cout)

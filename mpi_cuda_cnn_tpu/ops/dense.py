@@ -1,0 +1,22 @@
+"""Dense (fully-connected) op.
+
+The reference's `Layer_feedForw_full` (cnn.c:113-152) is a per-output MAC
+loop over all inputs plus bias, with tanh (hidden) or softmax (output)
+applied by the same function; backward (cnn.c:154-173) accumulates
+u_weights += dnet * x_prev and propagates errors. Here: one batched matmul
+on the MXU; activation/softmax belong to the layer/loss, and backward is
+`jax.grad` (or the Pallas custom_vjp twin).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def dense(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray | None = None,
+          precision=None) -> jnp.ndarray:
+    """x: (N, d_in); w: (d_in, d_out); b: (d_out,)."""
+    y = jnp.dot(x, w, precision=precision)
+    if b is not None:
+        y = y + b
+    return y

@@ -1,0 +1,32 @@
+"""Losses and training metrics.
+
+The reference never writes a loss function: it seeds the backward pass with
+`errors = outputs - onehot` after a softmax forward (cnn.c:284-286 plus the
+`gradients[i]=1` hack at cnn.c:141-142, commented "This isn't right" — the
+two together equal the softmax-CE gradient, SURVEY.md §2.5). Here the loss
+is expressed directly as softmax cross-entropy, whose exact gradient w.r.t.
+logits is that same `softmax(logits) - onehot`.
+
+Its only training-progress metric is the running squared error
+`sum((outputs - onehot)^2)` (Layer_getErrorTotal, cnn.c:275-282), kept here
+for log parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, onehot: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax-CE over the batch. d/dlogits = (softmax - onehot)/N,
+    matching the reference's error seeding divided by batch (the reference
+    divides by batch at update time instead: rate/batch_size, cnn.c:469)."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.sum(onehot * logz, axis=-1))
+
+
+def squared_error_total(probs: jnp.ndarray, onehot: jnp.ndarray) -> jnp.ndarray:
+    """Reference's etotal metric (cnn.c:275-282): sum of squared residuals."""
+    d = probs.astype(jnp.float32) - onehot
+    return jnp.sum(d * d) / probs.shape[0]

@@ -1,0 +1,25 @@
+"""Parallelism: device meshes, SPMD data parallelism, multi-host init.
+
+This package replaces the reference's entire distributed layer — raw MPI
+calls inlined in main() (MPI_Init/Comm_rank/Comm_size/Allreduce/Finalize,
+cnnmpi.c:419-422,490,558) — with JAX SPMD over a named device mesh. The
+per-sample, per-layer blocking MPI_Allreduce of the reference (3.6M
+collectives per epoch at 8 ranks, SURVEY.md §3.2) becomes a single fused
+gradient pmean inside one jitted step, lowered by XLA to ICI all-reduce.
+"""
+
+from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh, local_device_count
+from .dp import dp_shard_batch, make_dp_train_step, replicate
+from .distributed import initialize_distributed, process_info
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "local_device_count",
+    "dp_shard_batch",
+    "make_dp_train_step",
+    "replicate",
+    "initialize_distributed",
+    "process_info",
+]

@@ -1,0 +1,67 @@
+"""Multi-host runtime initialization.
+
+The reference's process management is `mpirun -np 8` + MPI_Init
+(Makefile:44, cnnmpi.c:419). The JAX equivalent for multi-host TPU pods is
+`jax.distributed.initialize()`: each host process joins the same runtime,
+`jax.devices()` becomes the global device list, and XLA routes collectives
+over ICI within a slice and DCN across slices — user training code is
+unchanged (SURVEY.md §5.8).
+
+On a single host (this environment, and the reference's own test setup)
+initialization is a no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from ..utils.logging import get_logger
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessInfo:
+    process_index: int
+    process_count: int
+    local_devices: int
+    global_devices: int
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> ProcessInfo:
+    """Join the multi-host runtime when launched as one process per host.
+
+    With no arguments, relies on the TPU environment's auto-detection
+    (e.g. GCE metadata) and silently stays single-process elsewhere —
+    so the same entry point covers laptop CPU, one TPU VM, and a pod.
+    """
+    log = get_logger()
+    if coordinator_address is not None or _looks_multiprocess():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except Exception as e:  # already initialized or single-process env
+            log.debug("jax.distributed.initialize skipped: %s", e)
+    return process_info()
+
+
+def _looks_multiprocess() -> bool:
+    import os
+
+    return any(k in os.environ for k in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"))
+
+
+def process_info() -> ProcessInfo:
+    return ProcessInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_devices=jax.local_device_count(),
+        global_devices=jax.device_count(),
+    )

@@ -1,0 +1,42 @@
+"""Device mesh construction.
+
+The reference's "mesh" is MPI_COMM_WORLD with contiguous rank sharding
+(cnnmpi.c:456-458). Here: a `jax.sharding.Mesh` with named axes. Only the
+'data' axis is populated by the shipped configs (the reference implements
+only DP, SURVEY.md §2 parallelism checklist), but every entry point takes
+the axis dict so a 'model' axis slots in without API change — the TP/PP
+seam SURVEY.md §7 stage 5 calls for.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def make_mesh(axes: dict[str, int] | None = None, *, devices=None) -> Mesh:
+    """Build a Mesh from an axis-name -> size dict.
+
+    axes=None means {'data': all visible devices} — the twin of the
+    reference's mpirun -np N world (Makefile:44). The axis sizes must
+    multiply to the device count used.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {DATA_AXIS: len(devices)}
+    sizes = list(axes.values())
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {axes} needs {total} devices, have {len(devices)}")
+    dev_array = np.array(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))

@@ -1,0 +1,83 @@
+"""Checkpoint / resume.
+
+The reference has no serialization of any kind — weights live and die in
+process memory, training always restarts from random init (SURVEY.md §5.4).
+This module provides the missing capability as flat `.npz` archives: the
+state pytree is flattened with `jax.tree_util` key paths as array names, so
+checkpoints are a stable, inspectable format independent of Python pickling
+(and of this framework — `np.load` reads them anywhere).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"ckpt_(\d+)\.npz$")
+
+
+def _flatten(state) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save_checkpoint(ckpt_dir: str | Path, state, step: int, *, keep: int = 3) -> Path:
+    """Write state as ckpt_{step}.npz + a small JSON manifest; prune old."""
+    ckpt_dir = Path(ckpt_dir)
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(jax.device_get(state))
+    path = ckpt_dir / f"ckpt_{step}.npz"
+    # Tmp is a dotfile (invisible to the ckpt_*.npz glob), so a crash
+    # between write and rename can't poison later listing; it must still
+    # end in .npz or np.savez appends the suffix itself.
+    tmp = ckpt_dir / f".ckpt_{step}.tmp.npz"
+    np.savez(tmp, **flat)
+    tmp.rename(path)
+    (ckpt_dir / "manifest.json").write_text(
+        json.dumps({"latest_step": step, "keys": sorted(flat)}, indent=2)
+    )
+    for p in _list_checkpoints(ckpt_dir)[:-keep]:
+        p.unlink()
+    return path
+
+
+def _list_checkpoints(ckpt_dir: Path) -> list[Path]:
+    found = [(int(m.group(1)), p) for p in ckpt_dir.glob("ckpt_*.npz")
+             if (m := _STEP_RE.search(p.name))]
+    return [p for _, p in sorted(found)]
+
+
+def latest_checkpoint(ckpt_dir: str | Path) -> Path | None:
+    ckpt_dir = Path(ckpt_dir)
+    if not ckpt_dir.is_dir():
+        return None
+    ckpts = _list_checkpoints(ckpt_dir)
+    return ckpts[-1] if ckpts else None
+
+
+def restore_checkpoint(path: str | Path, state_template):
+    """Restore into the structure of state_template (same pytree as saved).
+
+    The template supplies the pytree structure; arrays come from the
+    archive. Missing or extra keys raise — a resume must be exact.
+    """
+    archive = np.load(Path(path))
+    flat_template = _flatten(state_template)
+    if set(archive.files) != set(flat_template):
+        missing = set(flat_template) - set(archive.files)
+        extra = set(archive.files) - set(flat_template)
+        raise ValueError(f"checkpoint mismatch: missing={missing} extra={extra}")
+    leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+    new_leaves = []
+    for path_keys, leaf in leaves_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path_keys)
+        arr = archive[key]
+        new_leaves.append(np.asarray(arr, dtype=np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

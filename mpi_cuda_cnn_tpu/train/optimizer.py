@@ -1,0 +1,45 @@
+"""Optimizer construction.
+
+The reference's optimizer is hand-rolled SGD: gradients accumulate into
+u_weights/u_biases over 32 samples, then `param -= (rate/32) * u_param`
+(Layer_update cnn.c:303-314, applied at cnn.c:467-469). With a mean loss
+over a batch of 32 that is exactly `sgd(lr=0.1)` on the mean gradient — the
+batch-semantics equivalence SURVEY.md §7 hard-part (a) documents.
+
+Momentum and a cosine schedule are offered beyond the reference because the
+north-star accuracy target (≥99% MNIST, BASELINE.json) needs them; defaults
+keep reference semantics (momentum 0, constant lr).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def make_optimizer(
+    lr: float = 0.1,
+    *,
+    momentum: float = 0.0,
+    schedule: str = "constant",
+    total_steps: int | None = None,
+    warmup_steps: int = 0,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    if schedule == "constant":
+        lr_sched: optax.Schedule | float = lr
+    elif schedule == "cosine":
+        if total_steps is None:
+            raise ValueError("cosine schedule needs total_steps")
+        if warmup_steps:
+            lr_sched = optax.warmup_cosine_decay_schedule(
+                0.0, lr, warmup_steps, total_steps
+            )
+        else:
+            lr_sched = optax.cosine_decay_schedule(lr, total_steps)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+
+    tx = optax.sgd(lr_sched, momentum=momentum or None)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx

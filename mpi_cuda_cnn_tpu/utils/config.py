@@ -1,0 +1,137 @@
+"""Config / flag system.
+
+The reference has no flag system: 4 positional IDX paths (cnn.c:408-412) and
+every hyperparameter compiled in (rate=0.1 cnn.c:446, nepoch=10 cnn.c:448,
+batch_size=32 cnn.c:449, seed 0 cnn.c:413, model shape cnn.c:416-428). This
+module keeps the 4-positional-path CLI form working while exposing all of
+those as flags, plus the TPU-era surface (device, dtype, parallelism,
+checkpointing) the north star requires (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+
+@dataclasses.dataclass
+class Config:
+    # Data: either a registered dataset name, or the reference's 4 IDX paths.
+    dataset: str = "synthetic"
+    data_dir: str | None = None
+    train_images: str | None = None
+    train_labels: str | None = None
+    test_images: str | None = None
+    test_labels: str | None = None
+
+    # Model / training — defaults are the reference's compiled-in constants.
+    model: str = "reference_cnn"  # see models.presets
+    epochs: int = 10              # cnn.c:448
+    lr: float = 0.1               # cnn.c:446
+    batch_size: int = 32          # cnn.c:449 (accumulator period)
+    momentum: float = 0.0
+    lr_schedule: str = "constant"  # constant | cosine
+    seed: int = 0                 # cnn.c:413 srand(0)
+    init: str = "normal"          # normal | irwin_hall (reference nrnd, cnn.c:46-49)
+
+    # Numerics (SURVEY.md §7 hard-part (b)).
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"  # bfloat16 engages the MXU's native path
+
+    # Execution.
+    device: str = "auto"          # auto | tpu | cpu
+    num_devices: int = 0          # 0 = all visible; N = DP over first N
+    mesh_shape: str = "data"      # named mesh axes, e.g. "data" or "data:4,model:2"
+    use_pallas: bool = False      # Pallas kernels instead of lax ops
+    donate: bool = True
+
+    # Aux subsystems.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0     # epochs; 0 = only at end when dir is set
+    resume: bool = False
+    log_every: int = 100          # steps; reference prints every 1000 samples
+    profile_dir: str | None = None
+    eval_every: int = 1           # epochs
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        return cls(**json.loads(text))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_cuda_cnn_tpu",
+        description="TPU-native CNN trainer (capabilities of MPI-CUDA-CNN).",
+    )
+    # The reference contract: exactly 4 positional IDX paths (cnn.c:408-411).
+    p.add_argument("idx_paths", nargs="*", metavar="IDX",
+                   help="train-images train-labels test-images test-labels "
+                        "(the reference CLI form; omit to use --dataset)")
+    defaults = Config()
+    for f in dataclasses.fields(Config):
+        if f.name in ("train_images", "train_labels", "test_images", "test_labels"):
+            continue
+        flag = "--" + f.name.replace("_", "-")
+        default = getattr(defaults, f.name)
+        if f.type == "bool" or isinstance(default, bool):
+            p.add_argument(flag, action=argparse.BooleanOptionalAction, default=default)
+        else:
+            ftype = str if default is None else type(default)
+            p.add_argument(flag, type=ftype, default=default)
+    return p
+
+
+def parse_args(argv: list[str] | None = None) -> Config:
+    ns = build_parser().parse_args(argv)
+    kwargs = vars(ns)
+    idx_paths = kwargs.pop("idx_paths")
+    cfg = Config(**kwargs)
+    if idx_paths:
+        if len(idx_paths) != 4:
+            # The reference exits 100 on bad argc (cnn.c:412) — keep the code.
+            print(
+                "expected 4 IDX paths: train-images train-labels "
+                "test-images test-labels",
+                file=sys.stderr,
+            )
+            raise SystemExit(100)
+        cfg.train_images, cfg.train_labels, cfg.test_images, cfg.test_labels = idx_paths
+        cfg.dataset = "idx"
+    return cfg
+
+
+def parse_mesh_shape(spec: str, total_devices: int) -> dict[str, int]:
+    """Parse "data" / "data:4" / "data:4,model:2" into an axis dict.
+
+    A bare axis name takes all remaining devices. The product must divide
+    total_devices.
+    """
+    axes: dict[str, int] = {}
+    free_axis = None
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, n = part.split(":")
+            axes[name.strip()] = int(n)
+        else:
+            if free_axis is not None:
+                raise ValueError(f"mesh spec {spec!r}: only one unsized axis allowed")
+            free_axis = part
+            axes[part] = -1
+    fixed = 1
+    for n in axes.values():
+        if n > 0:
+            fixed *= n
+    if free_axis is not None:
+        if total_devices % fixed:
+            raise ValueError(f"mesh spec {spec!r} does not divide {total_devices} devices")
+        axes[free_axis] = total_devices // fixed
+    return axes

@@ -1,0 +1,65 @@
+"""Logging and metrics.
+
+The reference's entire observability surface is fprintf(stderr, ...): a
+running squared-error every 1000 steps (cnn.c:470-473) and one final
+"ntests=%d, ncorrect=%d" line (cnn.c:518). We keep those human-readable
+lines (so e2e output is comparable) and add structured JSONL metrics with
+wall-clock timing — the subsystem SURVEY.md §5.5 notes the reference lacks.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+
+_LOGGER_NAME = "mpi_cuda_cnn_tpu"
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(message)s", "%H:%M:%S")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+class MetricsLogger:
+    """Structured metrics: JSONL file sink + human-readable stderr echo."""
+
+    def __init__(self, path: str | Path | None = None, echo: bool = True):
+        self._file = None
+        if path is not None:
+            p = Path(path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            self._file = p.open("a")
+        self._echo = echo
+        self._log = get_logger()
+        self._t0 = time.perf_counter()
+
+    def log(self, event: str, **fields) -> None:
+        record = {"event": event, "t": round(time.perf_counter() - self._t0, 4), **fields}
+        if self._file:
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+        if self._echo:
+            body = " ".join(f"{k}={_fmt(v)}" for k, v in fields.items())
+            self._log.info("%s %s", event, body)
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return v

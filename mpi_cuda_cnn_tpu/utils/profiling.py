@@ -1,0 +1,51 @@
+"""Profiling hooks.
+
+The reference has none (SURVEY.md §5.1: no timers, no NVTX, no cudaEvent).
+Here: a wall-clock step timer that understands JAX async dispatch, and a
+context manager around jax.profiler for device traces viewable in
+TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class StepTimer:
+    """Accumulates per-step wall-clock. call block_until_ready on the step
+    output before stop() — JAX dispatch is async and returns before the TPU
+    finishes."""
+
+    def __init__(self):
+        self.steps = 0
+        self.total_s = 0.0
+        self._t0 = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, n_steps: int = 1) -> float:
+        dt = time.perf_counter() - self._t0
+        self.steps += n_steps
+        self.total_s += dt
+        return dt
+
+    @property
+    def mean_step_ms(self) -> float:
+        return 1000.0 * self.total_s / max(self.steps, 1)
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str | None):
+    """Capture a device trace with jax.profiler when logdir is set."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

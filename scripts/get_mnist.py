@@ -1,0 +1,59 @@
+"""Fetch MNIST as the four IDX files the CLI contract expects.
+
+Twin of the reference's get_mnist target (Makefile:24-35, which pulls a
+Google-Drive zip via gdown). Tries the canonical mirrors; in a network-free
+environment it falls back to writing a synthetic MNIST-shaped dataset so
+every downstream target still runs.
+"""
+
+from __future__ import annotations
+
+import sys
+import urllib.request
+from pathlib import Path
+
+FILES = [
+    "train-images-idx3-ubyte",
+    "train-labels-idx1-ubyte",
+    "t10k-images-idx3-ubyte",
+    "t10k-labels-idx1-ubyte",
+]
+MIRRORS = [
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+]
+
+
+def main(out_dir: str) -> int:
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    ok = True
+    for name in FILES:
+        dest = out / name
+        if dest.exists():
+            continue
+        fetched = False
+        for mirror in MIRRORS:
+            try:
+                print(f"fetching {mirror}{name}.gz", file=sys.stderr)
+                data = urllib.request.urlopen(mirror + name + ".gz", timeout=30).read()
+                import gzip
+
+                dest.write_bytes(gzip.decompress(data))
+                fetched = True
+                break
+            except Exception as e:
+                print(f"  failed: {e}", file=sys.stderr)
+        ok = ok and fetched
+    if not ok:
+        print("network fetch failed; writing synthetic MNIST-shaped data",
+              file=sys.stderr)
+        from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes, write_synthetic_idx
+
+        ds = synthetic_stripes(num_train=60_000, num_test=10_000)
+        write_synthetic_idx(out, ds)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "data/mnist"))

@@ -1,0 +1,39 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Must run before the first `import jax` anywhere in the test process —
+pytest imports conftest.py first, so setting the env here is sufficient
+(SURVEY.md §4: multi-device DP tests runnable without a TPU).
+"""
+
+import os
+
+# Force CPU regardless of ambient JAX_PLATFORMS — the suite must run
+# identically on a TPU VM and a plain CI box; TPU execution is covered by
+# bench.py and the driver's compile checks. This environment pre-imports jax
+# at interpreter startup, so env vars alone are too late: also set the jax
+# config directly (safe — no backend is initialized yet at conftest time).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,58 @@
+"""Checkpoint save/restore round-trip (capability absent from the
+reference, SURVEY.md §5.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+from mpi_cuda_cnn_tpu.models.presets import get_model
+from mpi_cuda_cnn_tpu.train.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+
+
+def _state(seed=0, momentum=0.9):
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(seed), get_initializer("normal"))
+    opt = make_optimizer(0.1, momentum=momentum)
+    return {"params": params, "opt_state": opt.init(params),
+            "step": jnp.asarray(7, jnp.int32)}
+
+
+def test_roundtrip(tmp_path):
+    state = _state()
+    save_checkpoint(tmp_path, state, 7)
+    template = _state(seed=1)  # different values, same structure
+    restored = restore_checkpoint(latest_checkpoint(tmp_path), template)
+    for a, b in zip(jax.tree.leaves(jax.device_get(state)), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_latest_picks_numeric_max(tmp_path):
+    state = _state()
+    for step in (2, 10, 9):
+        save_checkpoint(tmp_path, state, step)
+    assert latest_checkpoint(tmp_path).name == "ckpt_10.npz"
+
+
+def test_prune_keeps_k(tmp_path):
+    state = _state()
+    for step in range(6):
+        save_checkpoint(tmp_path, state, step, keep=3)
+    names = sorted(p.name for p in tmp_path.glob("ckpt_*.npz"))
+    assert names == ["ckpt_3.npz", "ckpt_4.npz", "ckpt_5.npz"]
+
+
+def test_structure_mismatch_raises(tmp_path):
+    save_checkpoint(tmp_path, {"a": jnp.zeros(3)}, 1)
+    with pytest.raises(ValueError, match="mismatch"):
+        restore_checkpoint(latest_checkpoint(tmp_path), {"b": jnp.zeros(3)})
+
+
+def test_no_checkpoint_returns_none(tmp_path):
+    assert latest_checkpoint(tmp_path / "void") is None

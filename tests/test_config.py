@@ -1,0 +1,48 @@
+"""Config/flag-system tests, including the reference CLI contract
+(4 positional paths, exit 100 on wrong count — cnn.c:408-412)."""
+
+import pytest
+
+from mpi_cuda_cnn_tpu.utils.config import Config, parse_args, parse_mesh_shape
+
+
+def test_defaults_are_reference_constants():
+    cfg = Config()
+    assert cfg.lr == 0.1          # cnn.c:446
+    assert cfg.epochs == 10       # cnn.c:448
+    assert cfg.batch_size == 32   # cnn.c:449
+    assert cfg.seed == 0          # cnn.c:413
+
+
+def test_four_positional_paths():
+    cfg = parse_args(["a", "b", "c", "d"])
+    assert cfg.dataset == "idx"
+    assert (cfg.train_images, cfg.train_labels, cfg.test_images, cfg.test_labels) == (
+        "a", "b", "c", "d")
+
+
+def test_wrong_positional_count_exits():
+    with pytest.raises(SystemExit):
+        parse_args(["a", "b"])
+
+
+def test_flags():
+    cfg = parse_args(["--model", "lenet5", "--epochs", "3", "--lr", "0.01",
+                      "--use-pallas", "--compute-dtype", "bfloat16"])
+    assert cfg.model == "lenet5" and cfg.epochs == 3 and cfg.lr == 0.01
+    assert cfg.use_pallas and cfg.compute_dtype == "bfloat16"
+
+
+def test_json_roundtrip():
+    cfg = Config(model="vgg_small", epochs=2)
+    assert Config.from_json(cfg.to_json()) == cfg
+
+
+def test_mesh_spec():
+    assert parse_mesh_shape("data", 8) == {"data": 8}
+    assert parse_mesh_shape("data:4,model:2", 8) == {"data": 4, "model": 2}
+    assert parse_mesh_shape("data,model:2", 8) == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        parse_mesh_shape("data:3,model", 8)  # 3 does not divide 8
+    with pytest.raises(ValueError):
+        parse_mesh_shape("data,model", 8)  # two unsized axes

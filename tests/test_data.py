@@ -1,0 +1,82 @@
+"""Dataset registry + pipeline tests."""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.data.datasets import (
+    get_dataset,
+    load_idx_dataset,
+    synthetic_stripes,
+    write_synthetic_idx,
+)
+from mpi_cuda_cnn_tpu.data.pipeline import epoch_batches, normalize_images, one_hot
+
+
+def test_synthetic_shapes():
+    ds = synthetic_stripes(num_train=100, num_test=20)
+    assert ds.train_images.shape == (100, 28, 28)
+    assert ds.test_labels.shape == (20,)
+    assert ds.input_shape == (28, 28, 1)
+    assert ds.num_classes == 10
+
+
+def test_synthetic_cifar_shape():
+    ds = get_dataset("synthetic_cifar", num_train=10, num_test=4)
+    assert ds.train_images.shape == (10, 32, 32, 3)
+    assert ds.input_shape == (32, 32, 3)
+
+
+def test_synthetic_deterministic():
+    a = synthetic_stripes(num_train=10, num_test=4, seed=7)
+    b = synthetic_stripes(num_train=10, num_test=4, seed=7)
+    np.testing.assert_array_equal(a.train_images, b.train_images)
+
+
+def test_registry_unknown():
+    with pytest.raises(KeyError, match="unknown dataset"):
+        get_dataset("nope")
+
+
+def test_idx_dataset_roundtrip(tmp_path):
+    """The 4-IDX-file CLI contract (cnn.c:408-411) end to end."""
+    ds = synthetic_stripes(num_train=30, num_test=10)
+    paths = write_synthetic_idx(tmp_path, ds)
+    loaded = load_idx_dataset("mnist", *paths.values())
+    np.testing.assert_array_equal(loaded.train_images, ds.train_images)
+    np.testing.assert_array_equal(loaded.test_labels, ds.test_labels)
+
+
+def test_normalize():
+    """uint8 -> [0,1] f32, matching x[j]=img[j]/255.0 (cnn.c:457)."""
+    imgs = np.array([[[0, 128], [255, 51]]], dtype=np.uint8)
+    out = normalize_images(imgs)
+    assert out.shape == (1, 2, 2, 1)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out[0, 1, 0, 0], 1.0)
+    np.testing.assert_allclose(out[0, 0, 1, 0], 128 / 255.0)
+
+
+def test_one_hot():
+    out = one_hot(np.array([1, 0, 9]), 10)
+    assert out.shape == (3, 10)
+    assert out.dtype == np.float32
+    assert out[0, 1] == 1.0 and out[0].sum() == 1.0
+    assert out[2, 9] == 1.0
+
+
+def test_epoch_batches_cover_epoch(rng):
+    x = np.arange(100).reshape(100, 1)
+    y = np.arange(100).reshape(100, 1)
+    seen = []
+    for bx, by in epoch_batches(x, y, 32, rng=rng):
+        assert bx.shape == (32, 1)  # static shapes: tail dropped
+        np.testing.assert_array_equal(bx, by)
+        seen.extend(bx[:, 0].tolist())
+    assert len(seen) == 96
+    assert len(set(seen)) == 96  # a permutation: no repeats
+
+
+def test_epoch_batches_sequential_without_rng():
+    x = np.arange(8).reshape(8, 1)
+    batches = list(epoch_batches(x, x, 4, rng=None))
+    np.testing.assert_array_equal(batches[0][0][:, 0], [0, 1, 2, 3])

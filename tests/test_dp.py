@@ -1,0 +1,120 @@
+"""Data-parallelism tests on the 8-device virtual CPU mesh.
+
+Validates the *intended* semantics of the reference's MPI layer
+(SURVEY.md 2.6): synchronous gradient averaging, synchronized init,
+DP result == single-device result on the same global batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+from mpi_cuda_cnn_tpu.models.presets import get_model
+from mpi_cuda_cnn_tpu.parallel.dp import (
+    dp_shard_batch,
+    make_dp_eval_step,
+    make_dp_train_step,
+    replicate,
+)
+from mpi_cuda_cnn_tpu.parallel.mesh import make_mesh
+from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+from mpi_cuda_cnn_tpu.train.trainer import make_loss_fn
+
+
+def _setup(mesh, batch=16, seed=0):
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(seed), get_initializer("normal"))
+    optimizer = make_optimizer(0.1)
+    state = replicate(
+        {"params": params, "opt_state": optimizer.init(params),
+         "step": jnp.zeros((), jnp.int32)},
+        mesh,
+    )
+    loss_fn = make_loss_fn(model)
+    step = make_dp_train_step(loss_fn, optimizer, mesh, donate=False)
+    rng = np.random.default_rng(42)
+    x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
+    y = np.zeros((batch, 10), np.float32)
+    y[np.arange(batch), rng.integers(0, 10, batch)] = 1
+    return model, state, step, x, jnp.asarray(y), loss_fn
+
+
+def test_mesh_shapes(eight_devices):
+    mesh = make_mesh({"data": 8})
+    assert mesh.shape == {"data": 8}
+    mesh2 = make_mesh({"data": 4, "model": 2})
+    assert mesh2.shape == {"data": 4, "model": 2}
+
+
+def test_dp8_equals_single_device(eight_devices):
+    """8-way DP on a global batch must produce the same updated params as
+    one device on the full batch — the correctness statement the
+    reference's buggy allreduce failed (SURVEY.md 2.6a/b)."""
+    mesh8 = make_mesh({"data": 8})
+    mesh1 = make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+    _, state8, step8, x, y, _ = _setup(mesh8)
+    _, state1, step1, _, _, _ = _setup(mesh1)
+
+    s8, m8 = step8(state8, *dp_shard_batch((x, y), mesh8))
+    s1, m1 = step1(state1, *dp_shard_batch((x, y), mesh1))
+
+    np.testing.assert_allclose(float(m8["loss"]), float(m1["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s8["params"]), jax.tree.leaves(s1["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_dp_grads_are_replicated_after_step(eight_devices):
+    """After pmean every device must hold identical params (the reference
+    never re-synchronized its divergent replicas, bug 2.6c)."""
+    mesh = make_mesh({"data": 8})
+    _, state, step, x, y, _ = _setup(mesh)
+    new_state, _ = step(state, *dp_shard_batch((x, y), mesh))
+    w = new_state["params"][0]["w"]
+    shards = [np.asarray(s.data) for s in w.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_dp_batch_sharding_layout(eight_devices):
+    mesh = make_mesh({"data": 8})
+    x = jnp.zeros((32, 28, 28, 1))
+    xs = dp_shard_batch(x, mesh)
+    assert xs.sharding.spec == P("data")
+    assert xs.addressable_shards[0].data.shape == (4, 28, 28, 1)
+
+
+def test_dp_eval_step(eight_devices):
+    mesh = make_mesh({"data": 8})
+    model, state, _, x, _, _ = _setup(mesh)
+    predict = lambda p, xx: model.apply(p, xx)
+    ev = make_dp_eval_step(predict, mesh)
+    logits = ev(state["params"], dp_shard_batch(x, mesh))
+    ref = model.apply(jax.device_get(state["params"]), x)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_dp_loss_decreases(eight_devices):
+    mesh = make_mesh({"data": 8})
+    _, state, step, x, y, _ = _setup(mesh)
+    batch = dp_shard_batch((x, y), mesh)
+    losses = []
+    for _ in range(10):
+        state, m = step(state, *batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_uneven_batch_rejected(eight_devices):
+    """batch not divisible by data axis must fail loudly, not silently
+    mis-shard (the reference silently truncates its shard bounds,
+    cnnmpi.c:457)."""
+    mesh = make_mesh({"data": 8})
+    _, state, step, *_ = _setup(mesh)
+    x = jnp.zeros((12, 28, 28, 1))
+    y = jnp.zeros((12, 10))
+    with pytest.raises(Exception):
+        jax.block_until_ready(step(state, *dp_shard_batch((x, y), mesh)))

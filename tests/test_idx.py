@@ -1,0 +1,86 @@
+"""IDX parser/writer tests: round-trip + the validation the reference does
+(cnn.c:361-363) + rejection of the truncation its other variants silently
+trained on (SURVEY.md 2.8)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.data.idx import IdxError, read_idx, write_idx
+
+
+def test_roundtrip_images(tmp_path):
+    arr = np.arange(2 * 5 * 4, dtype=np.uint8).reshape(2, 5, 4)
+    p = tmp_path / "imgs.idx"
+    write_idx(p, arr)
+    out = read_idx(p)
+    np.testing.assert_array_equal(arr, out)
+    assert out.dtype == np.uint8
+
+
+def test_roundtrip_labels(tmp_path):
+    arr = np.array([0, 3, 9, 1], dtype=np.uint8)
+    p = tmp_path / "labels.idx"
+    write_idx(p, arr)
+    np.testing.assert_array_equal(arr, read_idx(p))
+
+
+def test_roundtrip_gzip(tmp_path):
+    arr = np.random.default_rng(0).integers(0, 255, (3, 7, 7)).astype(np.uint8)
+    p = tmp_path / "imgs.idx.gz"
+    write_idx(p, arr)
+    with open(p, "rb") as f:
+        assert f.read(2) == b"\x1f\x8b"  # actually gzipped
+    np.testing.assert_array_equal(arr, read_idx(p))
+
+
+@pytest.mark.parametrize("dtype", [np.int8, np.int16, np.int32, np.float32, np.float64])
+def test_roundtrip_other_dtypes(tmp_path, dtype):
+    arr = (np.random.default_rng(1).standard_normal((4, 3)) * 10).astype(dtype)
+    p = tmp_path / "t.idx"
+    write_idx(p, arr)
+    out = read_idx(p)
+    assert out.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(struct.pack(">HBB", 7, 0x08, 1) + struct.pack(">I", 0))
+    with pytest.raises(IdxError, match="magic"):
+        read_idx(p)
+
+
+def test_bad_type_code_rejected(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(struct.pack(">HBB", 0, 0x42, 1) + struct.pack(">I", 0))
+    with pytest.raises(IdxError, match="type"):
+        read_idx(p)
+
+
+def test_truncated_payload_rejected(tmp_path):
+    """The reference's MPI/CUDA variants malloc the payload and never read
+    it (SURVEY.md 2.8) — we must hard-fail instead."""
+    p = tmp_path / "trunc.idx"
+    p.write_bytes(struct.pack(">HBB", 0, 0x08, 2) + struct.pack(">II", 10, 10) + b"\x00" * 5)
+    with pytest.raises(IdxError, match="payload"):
+        read_idx(p)
+
+
+def test_truncated_dims_rejected(tmp_path):
+    p = tmp_path / "trunc.idx"
+    p.write_bytes(struct.pack(">HBB", 0, 0x08, 3) + struct.pack(">I", 1))
+    with pytest.raises(IdxError, match="dimension"):
+        read_idx(p)
+
+
+def test_big_endian_dims(tmp_path):
+    """Dims are big-endian u32 (be32toh in the reference, cnn.c:374)."""
+    p = tmp_path / "be.idx"
+    payload = bytes(range(6))
+    p.write_bytes(struct.pack(">HBB", 0, 0x08, 2) + struct.pack(">II", 2, 3) + payload)
+    out = read_idx(p)
+    assert out.shape == (2, 3)
+    assert out[1, 2] == 5

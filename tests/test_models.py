@@ -1,0 +1,82 @@
+"""Model/layer tests: shapes, param counts (parity with the reference's
+360,810-param net, SURVEY.md 2.10), initializer statistics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+from mpi_cuda_cnn_tpu.models.presets import MODEL_PRESETS, get_model
+
+
+@pytest.mark.parametrize("name", sorted(MODEL_PRESETS))
+def test_presets_init_and_apply(name):
+    model = get_model(name)
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    x = jnp.zeros((2, *model.input_shape), jnp.float32)
+    logits = model.apply(params, x)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_reference_cnn_param_count():
+    """conv1 144+16, conv2 4608+32, fc1 313600+200, fc2 40000+200,
+    out 2000+10 = 360,810 (cnn.c:416-428, SURVEY.md 2.10)."""
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    assert model.num_params(params) == 360_810
+
+
+def test_reference_cnn_feature_shapes():
+    """28x28 -> 14x14x16 -> 7x7x32 via k3 s2 p1 (cnn.c:417-418)."""
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    x = jnp.ones((1, 28, 28, 1))
+    h1 = model.layers[0].apply(params[0], x)
+    assert h1.shape == (1, 14, 14, 16)
+    h2 = model.layers[1].apply(params[1], h1)
+    assert h2.shape == (1, 7, 7, 32)
+
+
+def test_bfloat16_compute_path():
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    x = jnp.ones((2, 28, 28, 1))
+    logits = model.apply(params, x, compute_dtype=jnp.bfloat16)
+    assert logits.dtype == jnp.float32  # logits always f32 for the loss
+    ref = model.apply(params, x)
+    np.testing.assert_allclose(logits, ref, atol=0.15)
+
+
+def test_irwin_hall_init_stats():
+    """nrnd (cnn.c:46-49) twin: mean ~0, std ~0.1, support within
+    +-2*1.724*0.1."""
+    init = get_initializer("irwin_hall", std=0.1)
+    w = np.asarray(init(jax.random.key(0), (200, 200), jnp.float32))
+    assert abs(w.mean()) < 5e-3
+    assert abs(w.std() - 0.1) < 1e-2
+    assert np.abs(w).max() <= 2 * 1.724 * 0.1 + 1e-6
+
+
+def test_normal_init_std():
+    init = get_initializer("normal", std=0.1)
+    w = np.asarray(init(jax.random.key(0), (500, 500), jnp.float32))
+    assert abs(w.std() - 0.1) < 2e-3
+
+
+def test_init_deterministic_across_calls():
+    """Same key -> identical params: the synchronized-init fix for
+    reference bug 2.6c (divergent srand(0+rank), cnnmpi.c:423)."""
+    model = get_model("lenet5")
+    p1 = model.init(jax.random.key(3), get_initializer("he"))
+    p2 = model.init(jax.random.key(3), get_initializer("he"))
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pooling_shapes():
+    model = get_model("lenet5")
+    params = model.init(jax.random.key(0), get_initializer("he"))
+    x = jnp.ones((3, 28, 28, 1))
+    assert model.apply(params, x).shape == (3, 10)

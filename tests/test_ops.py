@@ -1,0 +1,111 @@
+"""Op-level tests: conv/dense forward vs naive numpy (the reference's loop
+semantics, cnn.c:113-247), named gradient ops vs jax.grad, loss gradient ==
+the reference's softmax - onehot error seeding (SURVEY.md 2.5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_cnn_tpu.ops import (
+    conv2d,
+    conv2d_input_grad,
+    conv2d_kernel_grad,
+    dense,
+    softmax_cross_entropy,
+    stable_softmax,
+)
+
+
+def naive_conv2d(x, w, stride, padding):
+    """Direct re-expression of Layer_feedForw_conv's loop nest
+    (cnn.c:175-210): zero padding via bounds check, NHWC/HWIO layouts."""
+    n, h, wd, cin = x.shape
+    kh, kw, _, cout = w.shape
+    oh = (h + 2 * padding - kh) // stride + 1
+    ow = (wd + 2 * padding - kw) // stride + 1
+    out = np.zeros((n, oh, ow, cout), np.float32)
+    for b in range(n):
+        for oy in range(oh):
+            for ox in range(ow):
+                for oc in range(cout):
+                    acc = 0.0
+                    for ky in range(kh):
+                        for kx in range(kw):
+                            iy = oy * stride + ky - padding
+                            ix = ox * stride + kx - padding
+                            if 0 <= iy < h and 0 <= ix < wd:
+                                acc += float(x[b, iy, ix] @ w[ky, kx, :, oc])
+                    out[b, oy, ox, oc] = acc
+    return out
+
+
+def test_conv2d_matches_naive_stride2_pad1():
+    """The reference's exact conv config: k3 s2 p1 (cnn.c:417)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+    w = rng.standard_normal((3, 3, 3, 4)).astype(np.float32)
+    got = np.asarray(conv2d(jnp.asarray(x), jnp.asarray(w), stride=2, padding=1))
+    want = naive_conv2d(x, w, 2, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_matches_naive_stride1_nopad():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 6, 6, 2)).astype(np.float32)
+    w = rng.standard_normal((5, 5, 2, 3)).astype(np.float32)
+    got = np.asarray(conv2d(jnp.asarray(x), jnp.asarray(w)))
+    np.testing.assert_allclose(got, naive_conv2d(x, w, 1, 0), rtol=1e-4, atol=1e-5)
+
+
+def _conv_cfg():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 9, 9, 3)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 3, 3, 5)).astype(np.float32))
+    return x, w, dict(stride=2, padding=1)
+
+
+def test_conv2d_input_grad_matches_autodiff():
+    """The named dx op (twin of cnn.c:228-236) must equal jax.grad."""
+    x, w, cfg = _conv_cfg()
+    f = lambda x_: jnp.sum(conv2d(x_, w, **cfg) ** 2)
+    want = jax.grad(f)(x)
+    g = 2 * conv2d(x, w, **cfg)
+    got = conv2d_input_grad(g, w, input_hw=(9, 9), **cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_kernel_grad_matches_autodiff():
+    """The named dw op (twin of cnn.c:238-242) must equal jax.grad."""
+    x, w, cfg = _conv_cfg()
+    f = lambda w_: jnp.sum(conv2d(x, w_, **cfg) ** 2)
+    want = jax.grad(f)(w)
+    g = 2 * conv2d(x, w, **cfg)
+    got = conv2d_kernel_grad(x, g, **cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_dense():
+    x = jnp.asarray([[1.0, 2.0]])
+    w = jnp.asarray([[1.0, 0.0, 1.0], [0.0, 1.0, 1.0]])
+    b = jnp.asarray([0.5, 0.5, 0.0])
+    np.testing.assert_allclose(np.asarray(dense(x, w, b)), [[1.5, 2.5, 3.0]])
+
+
+def test_softmax_stability():
+    """Max-subtracted form (cnn.c:125-143) survives huge logits."""
+    probs = stable_softmax(jnp.asarray([[1e4, 1e4 - 1.0, 0.0]]))
+    assert np.all(np.isfinite(np.asarray(probs)))
+    np.testing.assert_allclose(float(probs.sum()), 1.0, rtol=1e-6)
+
+
+def test_ce_gradient_is_softmax_minus_onehot():
+    """d(CE)/dlogits == (softmax - onehot)/N — exactly the reference's
+    error seeding errors = outputs - onehot (cnn.c:284-286 + 2.5 hack)."""
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((4, 10)).astype(np.float32))
+    y = np.zeros((4, 10), np.float32)
+    y[np.arange(4), [1, 5, 0, 9]] = 1
+    y = jnp.asarray(y)
+    grad = jax.grad(lambda l: softmax_cross_entropy(l, y))(logits)
+    want = (stable_softmax(logits) - y) / 4
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(want), rtol=1e-5, atol=1e-6)

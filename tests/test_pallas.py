@@ -1,0 +1,119 @@
+"""Pallas kernel parity tests (interpreter mode on the CPU mesh) against
+the XLA oracle ops — the per-op parity strategy of SURVEY.md §7 stage 4."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.ops import conv2d, dense
+from mpi_cuda_cnn_tpu.ops.pallas_ops import conv2d_pallas, dense_pallas
+
+
+def _rand(*shape, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Dense
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m,k,n", [(32, 1568, 200), (5, 7, 3), (128, 128, 128)])
+def test_dense_forward_parity(m, k, n):
+    x, w, b = _rand(m, k), _rand(k, n, seed=1), _rand(n, seed=2)
+    got = dense_pallas(x, w, b)
+    want = dense(x, w, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_dense_grad_parity():
+    x, w, b = _rand(16, 64), _rand(64, 10, seed=1), _rand(10, seed=2)
+
+    def loss_p(x, w, b):
+        return jnp.sum(dense_pallas(x, w, b) ** 2)
+
+    def loss_o(x, w, b):
+        return jnp.sum(dense(x, w, b) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2))(x, w, b)
+    go = jax.grad(loss_o, argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(gp, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Conv
+# ---------------------------------------------------------------------------
+
+CONV_CASES = [
+    # (n, h, w, cin, kh, cout, stride, padding) — first two rows are the
+    # reference's exact conv configs (cnn.c:417-418).
+    (4, 28, 28, 1, 3, 16, 2, 1),
+    (4, 14, 14, 16, 3, 32, 2, 1),
+    (2, 8, 8, 3, 5, 4, 1, 2),
+    (2, 6, 6, 2, 3, 3, 1, 0),
+]
+
+
+@pytest.mark.parametrize("n,h,w,cin,k,cout,stride,pad", CONV_CASES)
+def test_conv_forward_parity(n, h, w, cin, k, cout, stride, pad):
+    x = _rand(n, h, w, cin)
+    wk = _rand(k, k, cin, cout, seed=1)
+    got = conv2d_pallas(x, wk, stride, pad)
+    want = conv2d(x, wk, stride=stride, padding=pad)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("n,h,w,cin,k,cout,stride,pad", CONV_CASES)
+def test_conv_grad_parity(n, h, w, cin, k, cout, stride, pad):
+    x = _rand(n, h, w, cin)
+    wk = _rand(k, k, cin, cout, seed=1)
+
+    def loss_p(x, wk):
+        return jnp.sum(conv2d_pallas(x, wk, stride, pad) ** 2)
+
+    def loss_o(x, wk):
+        return jnp.sum(conv2d(x, wk, stride=stride, padding=pad) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1))(x, wk)
+    go = jax.grad(loss_o, argnums=(0, 1))(x, wk)
+    # atol covers f32 accumulation-order noise on O(1e3)-magnitude sums.
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(go[0]), rtol=1e-4, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(go[1]), rtol=1e-4, atol=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# End to end through the model API
+# ---------------------------------------------------------------------------
+
+
+def test_model_pallas_backend_forward_parity():
+    from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    x = _rand(8, 28, 28, 1)
+    got = model.apply(params, x, backend="pallas")
+    want = model.apply(params, x, backend="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_model_pallas_backend_trains():
+    from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.train.trainer import Trainer
+    from mpi_cuda_cnn_tpu.utils.config import Config
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    ds = synthetic_stripes(num_train=128, num_test=64)
+    cfg = Config(epochs=2, use_pallas=True, eval_every=0, log_every=10**9,
+                 num_devices=1, batch_size=32)
+    t = Trainer(get_model("reference_cnn"), ds, cfg,
+                metrics=MetricsLogger(echo=False))
+    r = t.train()
+    assert r.test_accuracy >= 0.9
