@@ -6,7 +6,14 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_serial test_dp8 test_tpu bench get_mnist clean
+.PHONY: test test_serial test_dp8 test_tpu bench native test_native get_mnist clean
+
+# Native C driver (CPU numerical reference + embedded-JAX TPU path).
+native:
+	$(MAKE) -C native
+
+test_native: native
+	$(MAKE) -C native test
 
 # Unit/integration suite (CPU, 8 virtual devices — set in tests/conftest.py).
 test:

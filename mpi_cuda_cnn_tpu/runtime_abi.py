@@ -1,0 +1,83 @@
+"""Python side of the C ABI (native/tpu_abi.h).
+
+A module-level singleton trainer driven by simple string-in/string-out
+calls, so the embedded-CPython boundary stays trivial: the C driver sends
+one JSON config at init and receives one JSON metrics line per call.
+State (params, optimizer, compiled step) lives here — device-resident for
+the life of the process, unlike the reference's per-call device round
+trips (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+
+_STATE: dict = {}
+
+
+def init(config_json: str) -> str:
+    from .cli import _select_device
+    from .data.datasets import get_dataset, load_idx_dataset
+    from .models.presets import get_model
+    from .train.trainer import Trainer
+    from .utils.config import Config
+    from .utils.logging import MetricsLogger, get_logger
+
+    cfg = Config.from_json(config_json)
+    if not _select_device(cfg, get_logger()):
+        raise RuntimeError(f"device {cfg.device!r} unavailable")
+    if cfg.dataset == "idx":
+        ds = load_idx_dataset(
+            "idx", cfg.train_images, cfg.train_labels,
+            cfg.test_images, cfg.test_labels,
+        )
+    else:
+        ds = get_dataset(cfg.dataset, data_dir=cfg.data_dir)
+    model = get_model(cfg.model, input_shape=ds.input_shape)
+    trainer = Trainer(model, ds, cfg, metrics=MetricsLogger(echo=False))
+    _STATE.update(trainer=trainer, cfg=cfg, epoch=0)
+    return json.dumps({"ok": True, "model": model.name,
+                       "n_params": model.num_params(trainer.state["params"])})
+
+
+def _trainer():
+    if "trainer" not in _STATE:
+        raise RuntimeError("runtime_abi.init() not called")
+    return _STATE["trainer"]
+
+
+def train_epoch() -> str:
+    """Run one epoch via Trainer.run_epoch (the same loop the Python CLI
+    uses — one implementation, one shuffle stream); returns metrics JSON."""
+    t = _trainer()
+    metrics = t.run_epoch(_STATE["epoch"])
+    _STATE["epoch"] += 1
+    metrics["seconds"] = round(metrics["seconds"], 3)
+    return json.dumps(metrics)
+
+
+def evaluate() -> str:
+    ntests, ncorrect = _trainer().evaluate()
+    return json.dumps({"ntests": ntests, "ncorrect": ncorrect})
+
+
+def save(path: str) -> str:
+    from .train.checkpoint import save_checkpoint
+
+    t = _trainer()
+    step = int(jax.device_get(t.state["step"]))
+    out = save_checkpoint(path, jax.device_get(t.state), step)
+    return json.dumps({"path": str(out)})
+
+
+def load(path: str) -> str:
+    from .parallel.dp import replicate
+    from .train.checkpoint import latest_checkpoint, restore_checkpoint
+
+    t = _trainer()
+    ckpt = latest_checkpoint(path) or path
+    host = jax.device_get(t.state)
+    t.state = replicate(restore_checkpoint(ckpt, host), t.mesh)
+    return json.dumps({"restored": str(ckpt)})

@@ -125,6 +125,16 @@ class Trainer:
         )
         self.eval_step = make_dp_eval_step(predict, self.mesh)
         self._eval_batch = self._pick_eval_batch(len(self.test_x), n_data)
+        # One shuffle stream for the whole run, shared by every entry point
+        # (train(), run_epoch() via the C ABI) so batch order is identical
+        # regardless of which driver runs the loop.
+        self._rng = np.random.default_rng(config.seed)
+
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"batch_size {config.batch_size} exceeds train set size "
+                f"{len(self.train_x)}: no full batches"
+            )
 
     @staticmethod
     def _pick_eval_batch(ntest: int, n_data: int, target: int = 2048) -> int:
@@ -134,9 +144,55 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
+    def run_epoch(self, epoch: int) -> dict:
+        """Run one epoch of the jitted step over the whole training set.
+
+        The single implementation behind both the Python CLI loop (train())
+        and the C driver's ABI (runtime_abi.train_epoch) — one shuffle
+        stream (self._rng, seeded once from cfg.seed), one metric scheme.
+        Metric sums accumulate as device scalars: no host sync per step, so
+        dispatch stays async (the reference blocks on every sample by
+        construction; we must not).
+        """
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        running = None
+        nsteps = 0
+        for bx, by in epoch_batches(
+            self.train_x, self.train_y, cfg.batch_size, rng=self._rng
+        ):
+            batch = dp_shard_batch((jnp.asarray(bx), jnp.asarray(by)), self.mesh)
+            self.state, m = self.train_step(self.state, *batch)
+            running = m if running is None else jax.tree.map(jnp.add, running, m)
+            nsteps += 1
+            if nsteps % cfg.log_every == 0:
+                jax.block_until_ready(running)
+                self.metrics.log(
+                    "train",
+                    epoch=epoch,
+                    step=nsteps,
+                    loss=float(running["loss"]) / nsteps,
+                    etotal=float(running["etotal"]) / nsteps,
+                    acc=float(running["acc"]) / nsteps,
+                )
+        jax.block_until_ready(self.state)
+        seconds = time.perf_counter() - t0
+        if nsteps == 0:
+            raise ValueError(
+                f"no full batches: train set of {len(self.train_x)} yields "
+                f"0 batches of {cfg.batch_size}"
+            )
+        return {
+            "epoch": epoch,
+            "steps": nsteps,
+            "loss": float(running["loss"]) / nsteps,
+            "etotal": float(running["etotal"]) / nsteps,
+            "acc": float(running["acc"]) / nsteps,
+            "seconds": seconds,
+        }
+
     def train(self) -> TrainResult:
         cfg = self.cfg
-        rng = np.random.default_rng(cfg.seed)
         start_epoch = 0
 
         if cfg.resume and cfg.checkpoint_dir:
@@ -155,36 +211,11 @@ class Trainer:
 
         with profile_trace(cfg.profile_dir):
             for epoch in range(start_epoch, cfg.epochs):
-                t_epoch = time.perf_counter()
-                # Metric sums accumulate as device scalars — no host sync per
-                # step, so dispatch stays async (the reference blocks on every
-                # sample by construction; we must not).
-                running = None
-                nsteps = 0
                 timer.start()
-                for bx, by in epoch_batches(
-                    self.train_x, self.train_y, cfg.batch_size, rng=rng
-                ):
-                    batch = dp_shard_batch((jnp.asarray(bx), jnp.asarray(by)), self.mesh)
-                    self.state, m = self.train_step(self.state, *batch)
-                    running = m if running is None else jax.tree.map(
-                        jnp.add, running, m
-                    )
-                    nsteps += 1
-                    if nsteps % cfg.log_every == 0:
-                        jax.block_until_ready(running)
-                        self.metrics.log(
-                            "train",
-                            epoch=epoch,
-                            step=nsteps,
-                            loss=float(running["loss"]) / nsteps,
-                            etotal=float(running["etotal"]) / nsteps,
-                            acc=float(running["acc"]) / nsteps,
-                        )
-                jax.block_until_ready(self.state)
-                timer.stop(nsteps)
-                epoch_seconds.append(time.perf_counter() - t_epoch)
-                self.metrics.log("epoch", epoch=epoch, seconds=epoch_seconds[-1])
+                em = self.run_epoch(epoch)
+                timer.stop(em["steps"])
+                epoch_seconds.append(em["seconds"])
+                self.metrics.log("epoch", epoch=epoch, seconds=em["seconds"])
 
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                     ntests, ncorrect = self.evaluate()

@@ -1,0 +1,189 @@
+/* driver.c — the native CLI.
+ *
+ * Keeps the surveyed 4-positional-IDX-path contract and exit codes
+ * (100 bad usage, 111 data errors — SURVEY.md 2.16) and adds the
+ * north star's --device switch (BASELINE.json): the CPU path is the
+ * in-process f32 trainer (ops.c/model.c/train.c, the numerical
+ * reference), the TPU path dispatches through the embedded JAX runtime
+ * (tpu_abi.c).
+ *
+ *   mctpu train-img train-lab test-img test-lab [options]
+ *     --device=cpu|tpu      (default cpu)
+ *     --model=NAME          (default reference_cnn)
+ *     --epochs=N --lr=F --batch=N --seed=N --log-every=N
+ *     --golden-dir=DIR      (cpu only: dump parity fixtures and exit)
+ *     --save=DIR --load=DIR (tpu only: checkpoint round-trip)
+ */
+#include "mct.h"
+#include "tpu_abi.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    const char *paths[4];
+    const char *device, *model, *golden_dir, *save_dir, *load_dir;
+    McTrainCfg tcfg;
+} Args;
+
+static int parse_args(int argc, char **argv, Args *a)
+{
+    memset(a, 0, sizeof(*a));
+    a->device = "cpu";
+    a->model = "reference_cnn";
+    a->tcfg.lr = 0.1f;       /* the surveyed defaults (SURVEY.md §5.6) */
+    a->tcfg.epochs = 10;
+    a->tcfg.batch = 32;
+    a->tcfg.seed = 0;
+    a->tcfg.log_every = 200;
+
+    int npos = 0;
+    for (int i = 1; i < argc; i++) {
+        const char *s = argv[i];
+        if (strncmp(s, "--device=", 9) == 0) a->device = s + 9;
+        else if (strncmp(s, "--model=", 8) == 0) a->model = s + 8;
+        else if (strncmp(s, "--epochs=", 9) == 0) a->tcfg.epochs = atoi(s + 9);
+        else if (strncmp(s, "--lr=", 5) == 0) a->tcfg.lr = (float)atof(s + 5);
+        else if (strncmp(s, "--batch=", 8) == 0) a->tcfg.batch = atoi(s + 8);
+        else if (strncmp(s, "--seed=", 7) == 0) a->tcfg.seed = (uint64_t)atoll(s + 7);
+        else if (strncmp(s, "--log-every=", 12) == 0) a->tcfg.log_every = atoi(s + 12);
+        else if (strncmp(s, "--golden-dir=", 13) == 0) a->golden_dir = s + 13;
+        else if (strncmp(s, "--save=", 7) == 0) a->save_dir = s + 7;
+        else if (strncmp(s, "--load=", 7) == 0) a->load_dir = s + 7;
+        else if (s[0] == '-') {
+            fprintf(stderr, "mct: unknown option %s\n", s);
+            return -1;
+        } else if (npos < 4) {
+            a->paths[npos++] = s;
+        } else {
+            return -1;
+        }
+    }
+    if (a->tcfg.batch < 1 || a->tcfg.epochs < 0 || a->tcfg.lr <= 0.f) {
+        fprintf(stderr, "mct: invalid --batch/--epochs/--lr\n");
+        return -1;
+    }
+    return npos == 4 ? 0 : -1;
+}
+
+/* Append src to dst as a JSON string body (escaping '\' and '"').
+ * Returns 0, or -1 when dst would overflow. */
+static int json_escape_into(char *dst, size_t cap, size_t *pos, const char *src)
+{
+    for (; *src; src++) {
+        if (*pos + 3 >= cap)
+            return -1;
+        if (*src == '"' || *src == '\\')
+            dst[(*pos)++] = '\\';
+        dst[(*pos)++] = *src;
+    }
+    dst[*pos] = '\0';
+    return 0;
+}
+
+static int run_cpu(const Args *a)
+{
+    McDataset ds;
+    int rc = mc_dataset_load(&ds, a->paths);
+    if (rc)
+        return rc;
+
+    McModel m;
+    if (mc_model_build(&m, a->model, ds.h, ds.w, ds.c, ds.n_classes)) {
+        mc_dataset_free(&ds);
+        return 2;
+    }
+    mc_model_init_params(&m, a->tcfg.seed);
+    fprintf(stderr, "mct: model=%s params=%zu device=cpu\n",
+            a->model, m.n_params);
+
+    McTrainCfg cfg = a->tcfg;
+    cfg.golden_dir = a->golden_dir;
+    McResult res = {0};
+    rc = mc_train(&m, &ds, &cfg, &res);
+    if (rc == 0 && !a->golden_dir)
+        fprintf(stderr, "mct: train %.2fs, accuracy %.4f\n",
+                res.train_seconds,
+                res.ntests ? (double)res.ncorrect / res.ntests : 0.0);
+
+    mc_model_free(&m);
+    mc_dataset_free(&ds);
+    return rc ? 1 : 0;
+}
+
+static int run_tpu(const Args *a)
+{
+    char cfg[4096], buf[1024];
+    /* --device=tpu demands an accelerator; --device=jax takes whatever
+     * backend the embedded runtime finds (used to exercise the embedding
+     * without TPU access). */
+    const char *dev = strcmp(a->device, "tpu") == 0 ? "tpu" : "auto";
+    /* Build the JSON config for utils/config.py::Config, escaping paths
+     * and checking for truncation. */
+    size_t pos = 0;
+    const char *keys[4] = {"train_images", "train_labels",
+                           "test_images", "test_labels"};
+    pos += (size_t)snprintf(cfg + pos, sizeof cfg - pos, "{\"dataset\":\"idx\"");
+    for (int i = 0; i < 4; i++) {
+        int nw = snprintf(cfg + pos, sizeof cfg - pos, ",\"%s\":\"", keys[i]);
+        if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
+            goto toolong;
+        pos += (size_t)nw;
+        if (json_escape_into(cfg, sizeof cfg, &pos, a->paths[i]))
+            goto toolong;
+        if (pos + 2 >= sizeof cfg)
+            goto toolong;
+        cfg[pos++] = '"';
+        cfg[pos] = '\0';
+    }
+    {
+        int nw = snprintf(cfg + pos, sizeof cfg - pos,
+                          ",\"model\":\"%s\",\"epochs\":%d,\"lr\":%g,"
+                          "\"batch_size\":%d,\"seed\":%llu,\"device\":\"%s\","
+                          "\"log_every\":1000000000}",
+                          a->model, a->tcfg.epochs, (double)a->tcfg.lr,
+                          a->tcfg.batch, (unsigned long long)a->tcfg.seed, dev);
+        if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
+            goto toolong;
+    }
+
+    if (mct_tpu_init(cfg))
+        return 1;
+    if (a->load_dir && mct_tpu_load(a->load_dir))
+        return 1;
+    for (int e = 0; e < a->tcfg.epochs; e++) {
+        if (mct_tpu_train_epoch(buf, sizeof buf))
+            return 1;
+        fprintf(stderr, "mct[tpu]: %s\n", buf);
+    }
+    if (mct_tpu_eval(buf, sizeof buf))
+        return 1;
+    fprintf(stderr, "mct[tpu]: %s\n", buf);
+    if (a->save_dir && mct_tpu_save(a->save_dir))
+        return 1;
+    mct_tpu_shutdown();
+    return 0;
+toolong:
+    fprintf(stderr, "mct: config too long (paths exceed %zu bytes)\n",
+            sizeof cfg);
+    return 100;
+}
+
+int main(int argc, char **argv)
+{
+    Args a;
+    if (parse_args(argc, argv, &a)) {
+        fprintf(stderr,
+                "usage: mctpu train-images train-labels test-images "
+                "test-labels [--device=cpu|tpu] [--model=NAME] "
+                "[--epochs=N] [--lr=F] [--batch=N] [--seed=N]\n");
+        return 100;   /* the surveyed bad-usage exit code */
+    }
+    if (strcmp(a.device, "tpu") == 0 || strcmp(a.device, "jax") == 0)
+        return run_tpu(&a);
+    if (strcmp(a.device, "cpu") == 0)
+        return run_cpu(&a);
+    fprintf(stderr, "mct: unknown device '%s'\n", a.device);
+    return 100;
+}

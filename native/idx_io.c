@@ -1,0 +1,105 @@
+/* IDX container loading for the native driver.
+ *
+ * Implements the MNIST IDX format as documented in SURVEY.md §3.5
+ * (4-byte header {u16 magic==0, u8 type==0x08, u8 ndims}, big-endian u32
+ * dims, raw payload). Unlike three of the reference's four variants
+ * (which allocate the payload and never read it — SURVEY.md 2.8), a
+ * short read here is a hard error.
+ */
+#include "mct.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define MC_IDX_MAX_DIMS 4
+
+typedef struct {
+    uint32_t dims[MC_IDX_MAX_DIMS];
+    int ndims;
+    uint8_t *data;
+    size_t count;
+} McIdx;
+
+static int idx_load(const char *path, McIdx *out)
+{
+    memset(out, 0, sizeof(*out));
+    FILE *f = fopen(path, "rb");
+    if (!f) {
+        fprintf(stderr, "mct: cannot open %s\n", path);
+        return -1;
+    }
+    uint8_t hdr[4];
+    if (fread(hdr, 1, 4, f) != 4)
+        goto bad;
+    /* magic (2 bytes) must be zero; element type must be unsigned byte */
+    if (hdr[0] != 0 || hdr[1] != 0 || hdr[2] != 0x08)
+        goto bad;
+    out->ndims = hdr[3];
+    if (out->ndims < 1 || out->ndims > MC_IDX_MAX_DIMS)
+        goto bad;
+
+    out->count = 1;
+    for (int d = 0; d < out->ndims; d++) {
+        uint8_t b[4];
+        if (fread(b, 1, 4, f) != 4)
+            goto bad;
+        out->dims[d] = ((uint32_t)b[0] << 24) | ((uint32_t)b[1] << 16) |
+                       ((uint32_t)b[2] << 8) | (uint32_t)b[3];
+        /* overflow-checked product: dims stay consistent with count */
+        if (out->dims[d] && out->count > SIZE_MAX / out->dims[d])
+            goto bad;
+        out->count *= out->dims[d];
+    }
+    out->data = malloc(out->count ? out->count : 1);
+    if (!out->data)
+        goto bad;
+    if (fread(out->data, 1, out->count, f) != out->count) {
+        fprintf(stderr, "mct: truncated payload in %s\n", path);
+        free(out->data);
+        out->data = NULL;
+        fclose(f);
+        return -1;
+    }
+    fclose(f);
+    return 0;
+bad:
+    fprintf(stderr, "mct: bad IDX file %s\n", path);
+    fclose(f);
+    return -1;
+}
+
+int mc_dataset_load(McDataset *ds, const char *const paths[4])
+{
+    McIdx tri, trl, tei, tel;
+    memset(ds, 0, sizeof(*ds));
+    if (idx_load(paths[0], &tri) || idx_load(paths[1], &trl) ||
+        idx_load(paths[2], &tei) || idx_load(paths[3], &tel))
+        return 111;
+
+    if (tri.ndims < 3 || tei.ndims < 3 || trl.ndims != 1 || tel.ndims != 1 ||
+        tri.dims[0] != trl.dims[0] || tei.dims[0] != tel.dims[0]) {
+        fprintf(stderr, "mct: inconsistent dataset shapes\n");
+        return 111;
+    }
+    ds->n_train = (int)tri.dims[0];
+    ds->n_test = (int)tei.dims[0];
+    ds->h = (int)tri.dims[1];
+    ds->w = (int)tri.dims[2];
+    ds->c = tri.ndims == 4 ? (int)tri.dims[3] : 1;
+    ds->n_classes = 10;
+    ds->train_images = tri.data;
+    ds->train_labels = trl.data;
+    ds->test_images = tei.data;
+    ds->test_labels = tel.data;
+    return 0;
+}
+
+void mc_dataset_free(McDataset *ds)
+{
+    free(ds->train_images);
+    free(ds->train_labels);
+    free(ds->test_images);
+    free(ds->test_labels);
+    memset(ds, 0, sizeof(*ds));
+}

@@ -1,0 +1,102 @@
+/* mct.h — shared types for the native driver.
+ *
+ * This is the C side of the framework: a from-scratch f32/NHWC CPU trainer
+ * that serves as the numerical reference for the JAX/TPU path (the
+ * `--device=cpu|tpu` driver the north star asks for, BASELINE.json).
+ * It reimplements the *semantics* documented in SURVEY.md for the
+ * reference trainer (cnn.c) with a different architecture: flat parameter
+ * arena + layer descriptor table instead of a linked list of structs,
+ * NHWC instead of CHW, f32 instead of double, batched minibatch steps
+ * instead of per-sample accumulation.
+ */
+#ifndef MCT_H
+#define MCT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+/* ------------------------------------------------------------------ */
+/* Dataset: images uint8 NHW(C), labels uint8.                         */
+
+typedef struct {
+    uint8_t *train_images, *train_labels, *test_images, *test_labels;
+    int n_train, n_test;
+    int h, w, c;        /* per-image geometry */
+    int n_classes;
+} McDataset;
+
+/* Loads the 4-file IDX contract (train-img train-lab test-img test-lab).
+ * Returns 0 on success, 111 on any file/format problem (the reference's
+ * exit code for data errors). */
+int mc_dataset_load(McDataset *ds, const char *const paths[4]);
+void mc_dataset_free(McDataset *ds);
+
+/* ------------------------------------------------------------------ */
+/* Model: a table of layer descriptors over one contiguous f32 arena.  */
+
+typedef enum { MC_CONV, MC_DENSE, MC_MAXPOOL } McKind;
+typedef enum { MC_ACT_NONE, MC_ACT_RELU, MC_ACT_TANH } McAct;
+
+typedef struct {
+    McKind kind;
+    int k, stride, pad;     /* conv / pool geometry */
+    int units;              /* conv out-channels or dense width */
+    McAct act;
+    /* derived at build time: */
+    int ih, iw, ic;         /* input extent  (dense: ic = flat width) */
+    int oh, ow, oc;         /* output extent (dense: oc = units)      */
+    size_t w_off, b_off;    /* offsets into the parameter arena       */
+    size_t nw, nb;          /* parameter counts                       */
+} McLayer;
+
+#define MC_MAX_LAYERS 32
+
+typedef struct {
+    McLayer layers[MC_MAX_LAYERS];
+    int n_layers;
+    int in_h, in_w, in_c, n_classes;
+    float *params;          /* arena of size n_params */
+    float *grads;           /* same layout            */
+    size_t n_params;
+} McModel;
+
+/* Build a preset ("reference_cnn" or "lenet5_relu") for the given input
+ * geometry. Returns 0 on success. */
+int mc_model_build(McModel *m, const char *preset, int h, int w, int c,
+                   int n_classes);
+void mc_model_init_params(McModel *m, uint64_t seed);
+void mc_model_free(McModel *m);
+
+/* ------------------------------------------------------------------ */
+/* Training.                                                           */
+
+typedef struct {
+    float lr;
+    int epochs, batch;
+    uint64_t seed;
+    int log_every;          /* batches between progress lines */
+    const char *golden_dir; /* when set: dump golden tensors, 1 batch */
+} McTrainCfg;
+
+typedef struct {
+    int ntests, ncorrect;
+    double train_seconds;
+} McResult;
+
+int mc_train(McModel *m, const McDataset *ds, const McTrainCfg *cfg,
+             McResult *out);
+int mc_eval(const McModel *m, const McDataset *ds, int *ncorrect);
+
+/* ------------------------------------------------------------------ */
+/* RNG: xorshift128+ — the driver's documented, reproducible source of
+ * randomness (init + shuffling). Distinct from the Python path's keyed
+ * jax.random; parity testing loads dumped params instead of replaying
+ * RNG streams. */
+
+typedef struct { uint64_t s0, s1; } McRng;
+void mc_rng_seed(McRng *r, uint64_t seed);
+uint64_t mc_rng_next(McRng *r);
+float mc_rng_uniform(McRng *r);              /* [0, 1) */
+float mc_rng_irwin_hall(McRng *r);           /* ~N(0,1), 4-uniform sum */
+
+#endif /* MCT_H */

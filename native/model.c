@@ -1,0 +1,168 @@
+/* model.c — model construction, parameter arena, init RNG. */
+#include "mct.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ------------------------------------------------------------------ */
+/* RNG: xorshift128+ (documented, portable, fast). Irwin-Hall(4)*1.724
+ * matches the distribution family of the framework's "irwin_hall"
+ * initializer (models/initializers.py).                               */
+
+void mc_rng_seed(McRng *r, uint64_t seed)
+{
+    /* splitmix64 expansion of the seed into two nonzero state words */
+    uint64_t z = seed + 0x9E3779B97F4A7C15ull;
+    for (int i = 0; i < 2; i++) {
+        z ^= z >> 30; z *= 0xBF58476D1CE4E5B9ull;
+        z ^= z >> 27; z *= 0x94D049BB133111EBull;
+        z ^= z >> 31;
+        if (i == 0) r->s0 = z | 1; else r->s1 = z | 1;
+        z += 0x9E3779B97F4A7C15ull;
+    }
+}
+
+uint64_t mc_rng_next(McRng *r)
+{
+    uint64_t a = r->s0, b = r->s1;
+    r->s0 = b;
+    a ^= a << 23;
+    a ^= a >> 17;
+    a ^= b ^ (b >> 26);
+    r->s1 = a;
+    return a + b;
+}
+
+float mc_rng_uniform(McRng *r)
+{
+    return (float)((mc_rng_next(r) >> 40) * (1.0 / 16777216.0));
+}
+
+float mc_rng_irwin_hall(McRng *r)
+{
+    float s = mc_rng_uniform(r) + mc_rng_uniform(r) +
+              mc_rng_uniform(r) + mc_rng_uniform(r);
+    return (s - 2.0f) * 1.724f;
+}
+
+/* ------------------------------------------------------------------ */
+
+static McLayer conv(int units, int k, int stride, int pad, McAct act)
+{
+    McLayer l = {0};
+    l.kind = MC_CONV; l.units = units; l.k = k; l.stride = stride;
+    l.pad = pad; l.act = act;
+    return l;
+}
+
+static McLayer dense(int units, McAct act)
+{
+    McLayer l = {0};
+    l.kind = MC_DENSE; l.units = units; l.act = act;
+    return l;
+}
+
+static McLayer maxpool(int k)
+{
+    McLayer l = {0};
+    l.kind = MC_MAXPOOL; l.k = k;
+    return l;
+}
+
+int mc_model_build(McModel *m, const char *preset, int h, int w, int c,
+                   int n_classes)
+{
+    memset(m, 0, sizeof(*m));
+    m->in_h = h; m->in_w = w; m->in_c = c; m->n_classes = n_classes;
+    int n = 0;
+    McLayer *L = m->layers;
+
+    if (strcmp(preset, "reference_cnn") == 0) {
+        /* The surveyed trainer's exact topology (SURVEY.md 2.10). */
+        L[n++] = conv(16, 3, 2, 1, MC_ACT_RELU);
+        L[n++] = conv(32, 3, 2, 1, MC_ACT_RELU);
+        L[n++] = dense(200, MC_ACT_TANH);
+        L[n++] = dense(200, MC_ACT_TANH);
+        L[n++] = dense(n_classes, MC_ACT_NONE);
+    } else if (strcmp(preset, "lenet5_relu") == 0) {
+        L[n++] = conv(32, 5, 1, 2, MC_ACT_RELU);
+        L[n++] = maxpool(2);
+        L[n++] = conv(64, 5, 1, 0, MC_ACT_RELU);
+        L[n++] = maxpool(2);
+        L[n++] = dense(256, MC_ACT_RELU);
+        L[n++] = dense(128, MC_ACT_RELU);
+        L[n++] = dense(n_classes, MC_ACT_NONE);
+    } else {
+        fprintf(stderr, "mct: unknown model preset '%s'\n", preset);
+        return -1;
+    }
+    m->n_layers = n;
+
+    /* Derive geometry and arena offsets. */
+    size_t off = 0;
+    int ih = h, iw = w, ic = c;
+    for (int i = 0; i < n; i++) {
+        McLayer *l = &L[i];
+        l->ih = ih; l->iw = iw; l->ic = ic;
+        switch (l->kind) {
+        case MC_CONV:
+            l->oh = (ih + 2 * l->pad - l->k) / l->stride + 1;
+            l->ow = (iw + 2 * l->pad - l->k) / l->stride + 1;
+            l->oc = l->units;
+            l->nw = (size_t)l->k * l->k * ic * l->oc;
+            l->nb = l->oc;
+            break;
+        case MC_DENSE:
+            l->ic = ih * iw * ic;      /* reads the previous output flat */
+            l->ih = l->iw = 1;
+            l->oh = l->ow = 1;
+            l->oc = l->units;
+            l->nw = (size_t)l->ic * l->oc;
+            l->nb = l->oc;
+            break;
+        case MC_MAXPOOL:
+            l->oh = ih / l->k; l->ow = iw / l->k; l->oc = ic;
+            l->nw = l->nb = 0;
+            break;
+        }
+        l->w_off = off; off += l->nw;
+        l->b_off = off; off += l->nb;
+        ih = l->oh; iw = l->ow; ic = l->oc;
+    }
+    for (int i = 0; i < n; i++) {
+        if (L[i].oc > 4096) {   /* ops.c stack accumulators (MC_MAX_WIDTH) */
+            fprintf(stderr, "mct: layer %d width %d exceeds 4096\n", i, L[i].oc);
+            return -1;
+        }
+    }
+    m->n_params = off;
+    m->params = calloc(off, sizeof(float));
+    m->grads = calloc(off, sizeof(float));
+    if (!m->params || !m->grads)
+        return -1;
+    return 0;
+}
+
+void mc_model_init_params(McModel *m, uint64_t seed)
+{
+    /* Weights ~ IrwinHall * 0.1, biases zero — the init scheme documented
+     * for the surveyed trainer (SURVEY.md 2.2/2.10), drawn from this
+     * driver's own RNG stream. One stream, layer-major: identical across
+     * any number of workers by construction. */
+    McRng rng;
+    mc_rng_seed(&rng, seed);
+    for (int i = 0; i < m->n_layers; i++) {
+        McLayer *l = &m->layers[i];
+        for (size_t j = 0; j < l->nw; j++)
+            m->params[l->w_off + j] = 0.1f * mc_rng_irwin_hall(&rng);
+        /* biases stay zero (calloc) */
+    }
+}
+
+void mc_model_free(McModel *m)
+{
+    free(m->params);
+    free(m->grads);
+    memset(m, 0, sizeof(*m));
+}

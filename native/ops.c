@@ -1,0 +1,244 @@
+/* ops.c — batched f32 NHWC compute kernels for the native CPU path.
+ *
+ * Semantics mirror the framework's JAX ops (and through them the behavior
+ * documented for the reference trainer in SURVEY.md §2.3-2.5): direct
+ * convolution with zero padding, dense MACs, relu/tanh, stable softmax
+ * with cross-entropy seeding d(logits) = (p - onehot)/N. Layouts and
+ * batching are this framework's own (NHWC, minibatch-major).
+ */
+#include "mct.h"
+
+#include <math.h>
+#include <string.h>
+
+/* Forward MACs accumulate in double: this path is the framework's
+ * numerical reference, so its forward must be closer to exact than the
+ * accelerator's f32/bf16 (sequential f32 over a 1568-wide dense layer
+ * already drifts ~1e-2). Widest layer supported on the stack: */
+#define MC_MAX_WIDTH 4096
+
+void mc_conv_fwd(const float *x, const float *w, const float *b, float *y,
+                 int n, int ih, int iw, int ic, int oh, int ow, int oc,
+                 int k, int stride, int pad, McAct act)
+{
+    for (int s = 0; s < n; s++) {
+        const float *xs = x + (size_t)s * ih * iw * ic;
+        float *ys = y + (size_t)s * oh * ow * oc;
+        for (int oy = 0; oy < oh; oy++)
+        for (int ox = 0; ox < ow; ox++) {
+            float *yp = ys + ((size_t)oy * ow + ox) * oc;
+            double acc[MC_MAX_WIDTH];
+            for (int f = 0; f < oc; f++)
+                acc[f] = b[f];
+            for (int ky = 0; ky < k; ky++) {
+                int iy = oy * stride + ky - pad;
+                if (iy < 0 || iy >= ih) continue;
+                for (int kx = 0; kx < k; kx++) {
+                    int ix = ox * stride + kx - pad;
+                    if (ix < 0 || ix >= iw) continue;
+                    const float *xp = xs + ((size_t)iy * iw + ix) * ic;
+                    const float *wp = w + (((size_t)ky * k + kx) * ic) * oc;
+                    for (int ci = 0; ci < ic; ci++) {
+                        double xv = xp[ci];
+                        const float *wc = wp + (size_t)ci * oc;
+                        for (int f = 0; f < oc; f++)
+                            acc[f] += xv * wc[f];
+                    }
+                }
+            }
+            if (act == MC_ACT_RELU)
+                for (int f = 0; f < oc; f++)
+                    yp[f] = acc[f] > 0.0 ? (float)acc[f] : 0.f;
+            else if (act == MC_ACT_TANH)
+                for (int f = 0; f < oc; f++)
+                    yp[f] = (float)tanh(acc[f]);
+            else
+                for (int f = 0; f < oc; f++)
+                    yp[f] = (float)acc[f];
+        }
+    }
+}
+
+/* gy arrives as d(loss)/d(pre-activation) already (caller folds the
+ * activation derivative using the stored activations). */
+void mc_conv_bwd(const float *x, const float *w, const float *gy,
+                 float *gx, float *gw, float *gb,
+                 int n, int ih, int iw, int ic, int oh, int ow, int oc,
+                 int k, int stride, int pad)
+{
+    if (gx)
+        memset(gx, 0, sizeof(float) * (size_t)n * ih * iw * ic);
+    for (int s = 0; s < n; s++) {
+        const float *xs = x + (size_t)s * ih * iw * ic;
+        const float *gs = gy + (size_t)s * oh * ow * oc;
+        float *gxs = gx ? gx + (size_t)s * ih * iw * ic : NULL;
+        for (int oy = 0; oy < oh; oy++)
+        for (int ox = 0; ox < ow; ox++) {
+            const float *gp = gs + ((size_t)oy * ow + ox) * oc;
+            for (int f = 0; f < oc; f++)
+                gb[f] += gp[f];
+            for (int ky = 0; ky < k; ky++) {
+                int iy = oy * stride + ky - pad;
+                if (iy < 0 || iy >= ih) continue;
+                for (int kx = 0; kx < k; kx++) {
+                    int ix = ox * stride + kx - pad;
+                    if (ix < 0 || ix >= iw) continue;
+                    const float *xp = xs + ((size_t)iy * iw + ix) * ic;
+                    float *gxp = gxs ? gxs + ((size_t)iy * iw + ix) * ic : NULL;
+                    float *gwp = gw + (((size_t)ky * k + kx) * ic) * oc;
+                    const float *wp = w + (((size_t)ky * k + kx) * ic) * oc;
+                    for (int ci = 0; ci < ic; ci++) {
+                        float xv = xp[ci];
+                        float acc = 0.f;
+                        float *gwc = gwp + (size_t)ci * oc;
+                        const float *wc = wp + (size_t)ci * oc;
+                        for (int f = 0; f < oc; f++) {
+                            gwc[f] += xv * gp[f];
+                            acc += wc[f] * gp[f];
+                        }
+                        if (gxp)
+                            gxp[ci] += acc;
+                    }
+                }
+            }
+        }
+    }
+}
+
+void mc_dense_fwd(const float *x, const float *w, const float *b, float *y,
+                  int n, int din, int dout, McAct act)
+{
+    for (int s = 0; s < n; s++) {
+        const float *xs = x + (size_t)s * din;
+        float *ys = y + (size_t)s * dout;
+        double acc[MC_MAX_WIDTH];
+        for (int o = 0; o < dout; o++)
+            acc[o] = b[o];
+        for (int i = 0; i < din; i++) {
+            double xv = xs[i];
+            const float *wr = w + (size_t)i * dout;
+            for (int o = 0; o < dout; o++)
+                acc[o] += xv * wr[o];
+        }
+        if (act == MC_ACT_RELU)
+            for (int o = 0; o < dout; o++)
+                ys[o] = acc[o] > 0.0 ? (float)acc[o] : 0.f;
+        else if (act == MC_ACT_TANH)
+            for (int o = 0; o < dout; o++)
+                ys[o] = (float)tanh(acc[o]);
+        else
+            for (int o = 0; o < dout; o++)
+                ys[o] = (float)acc[o];
+    }
+}
+
+void mc_dense_bwd(const float *x, const float *w, const float *gy,
+                  float *gx, float *gw, float *gb,
+                  int n, int din, int dout)
+{
+    if (gx)
+        memset(gx, 0, sizeof(float) * (size_t)n * din);
+    for (int s = 0; s < n; s++) {
+        const float *xs = x + (size_t)s * din;
+        const float *gs = gy + (size_t)s * dout;
+        float *gxs = gx ? gx + (size_t)s * din : NULL;
+        for (int o = 0; o < dout; o++)
+            gb[o] += gs[o];
+        for (int i = 0; i < din; i++) {
+            float xv = xs[i];
+            float *gwr = gw + (size_t)i * dout;
+            const float *wr = w + (size_t)i * dout;
+            float acc = 0.f;
+            for (int o = 0; o < dout; o++) {
+                gwr[o] += xv * gs[o];
+                acc += wr[o] * gs[o];
+            }
+            if (gxs)
+                gxs[i] = acc;
+        }
+    }
+}
+
+/* Non-overlapping max pooling; amax records flat argmax offsets for bwd. */
+void mc_maxpool_fwd(const float *x, float *y, int32_t *amax,
+                    int n, int ih, int iw, int c, int k)
+{
+    int oh = ih / k, ow = iw / k;
+    for (int s = 0; s < n; s++) {
+        const float *xs = x + (size_t)s * ih * iw * c;
+        float *ys = y + (size_t)s * oh * ow * c;
+        int32_t *as = amax + (size_t)s * oh * ow * c;
+        for (int oy = 0; oy < oh; oy++)
+        for (int ox = 0; ox < ow; ox++)
+        for (int ch = 0; ch < c; ch++) {
+            float best = -1e30f;
+            int32_t besti = 0;
+            for (int ky = 0; ky < k; ky++)
+            for (int kx = 0; kx < k; kx++) {
+                int32_t off = (int32_t)(((size_t)(oy * k + ky) * iw +
+                                         (ox * k + kx)) * c + ch);
+                float v = xs[off];
+                if (v > best) { best = v; besti = off; }
+            }
+            size_t oi = ((size_t)oy * ow + ox) * c + ch;
+            ys[oi] = best;
+            as[oi] = besti;
+        }
+    }
+}
+
+void mc_maxpool_bwd(const int32_t *amax, const float *gy, float *gx,
+                    int n, int ih, int iw, int c, int k)
+{
+    int oh = ih / k, ow = iw / k;
+    memset(gx, 0, sizeof(float) * (size_t)n * ih * iw * c);
+    for (int s = 0; s < n; s++) {
+        const float *gs = gy + (size_t)s * oh * ow * c;
+        const int32_t *as = amax + (size_t)s * oh * ow * c;
+        float *gxs = gx + (size_t)s * ih * iw * c;
+        size_t total = (size_t)oh * ow * c;
+        for (size_t i = 0; i < total; i++)
+            gxs[as[i]] += gs[i];
+    }
+}
+
+/* Stable softmax over logits; returns mean CE loss and writes
+ * d(logits) = (p - onehot)/n into glogits. */
+float mc_softmax_ce(const float *logits, const uint8_t *labels,
+                    float *glogits, float *probs_out, int n, int nc)
+{
+    float loss = 0.f;
+    for (int s = 0; s < n; s++) {
+        const float *ls = logits + (size_t)s * nc;
+        float *gs = glogits + (size_t)s * nc;
+        float mx = ls[0];
+        for (int j = 1; j < nc; j++)
+            if (ls[j] > mx) mx = ls[j];
+        float z = 0.f;
+        for (int j = 0; j < nc; j++)
+            z += expf(ls[j] - mx);
+        for (int j = 0; j < nc; j++) {
+            float p = expf(ls[j] - mx) / z;
+            if (probs_out)
+                probs_out[(size_t)s * nc + j] = p;
+            gs[j] = (p - (j == labels[s] ? 1.f : 0.f)) / (float)n;
+            if (j == labels[s])
+                loss += -logf(p > 1e-30f ? p : 1e-30f);
+        }
+    }
+    return loss / (float)n;
+}
+
+/* Fold the activation derivative into gy, using stored activations y:
+ * relu: gy *= (y > 0); tanh: gy *= (1 - y^2) — the activation-value forms
+ * the framework shares with the surveyed reference (SURVEY.md 2.2). */
+void mc_act_bwd(const float *y, float *gy, size_t count, McAct act)
+{
+    if (act == MC_ACT_RELU) {
+        for (size_t i = 0; i < count; i++)
+            if (y[i] <= 0.f) gy[i] = 0.f;
+    } else if (act == MC_ACT_TANH) {
+        for (size_t i = 0; i < count; i++)
+            gy[i] *= 1.f - y[i] * y[i];
+    }
+}

@@ -1,0 +1,84 @@
+/* tpu_abi.c — embedded-CPython implementation of the TPU ABI.
+ *
+ * The TPU twin of the reference's CUDA host wrapper role
+ * (forward_convolution_layer, CUDAcnn.cu:198-218) at the *runtime* level:
+ * instead of per-call cudaMalloc/H2D/D2H round-trips, the device state
+ * lives inside the JAX runtime for the whole run and the C driver only
+ * exchanges small JSON strings across the boundary.
+ */
+#include "tpu_abi.h"
+
+#include <Python.h>
+#include <stdio.h>
+
+static PyObject *g_mod;    /* mpi_cuda_cnn_tpu.runtime_abi */
+
+static int call_str_ret(const char *fn, const char *arg, char *buf, int buflen)
+{
+    if (!g_mod) {
+        fprintf(stderr, "mct: TPU runtime not initialized\n");
+        return -1;
+    }
+    PyObject *r = arg
+        ? PyObject_CallMethod(g_mod, fn, "s", arg)
+        : PyObject_CallMethod(g_mod, fn, NULL);
+    if (!r) {
+        PyErr_Print();
+        return -1;
+    }
+    if (buf && buflen > 0) {
+        const char *s = PyUnicode_Check(r) ? PyUnicode_AsUTF8(r) : "";
+        snprintf(buf, (size_t)buflen, "%s", s ? s : "");
+    }
+    Py_DECREF(r);
+    return 0;
+}
+
+int mct_tpu_init(const char *config_json)
+{
+    if (!Py_IsInitialized()) {
+        /* Honor PYTHONPATH etc. so the venv's site-packages resolve; the
+         * build target and README document the expected environment. */
+        Py_InitializeEx(0);
+    }
+    PyObject *name = PyUnicode_FromString("mpi_cuda_cnn_tpu.runtime_abi");
+    g_mod = PyImport_Import(name);
+    Py_DECREF(name);
+    if (!g_mod) {
+        PyErr_Print();
+        fprintf(stderr,
+                "mct: cannot import mpi_cuda_cnn_tpu.runtime_abi "
+                "(set PYTHONPATH to the repo root)\n");
+        return -1;
+    }
+    return call_str_ret("init", config_json, NULL, 0);
+}
+
+int mct_tpu_train_epoch(char *buf, int buflen)
+{
+    return call_str_ret("train_epoch", NULL, buf, buflen);
+}
+
+int mct_tpu_eval(char *buf, int buflen)
+{
+    return call_str_ret("evaluate", NULL, buf, buflen);
+}
+
+int mct_tpu_save(const char *path)
+{
+    return call_str_ret("save", path, NULL, 0);
+}
+
+int mct_tpu_load(const char *path)
+{
+    return call_str_ret("load", path, NULL, 0);
+}
+
+int mct_tpu_shutdown(void)
+{
+    Py_XDECREF(g_mod);
+    g_mod = NULL;
+    if (Py_IsInitialized())
+        Py_Finalize();
+    return 0;
+}

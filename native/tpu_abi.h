@@ -1,0 +1,36 @@
+/* tpu_abi.h — the stable C ABI between the native driver and the
+ * JAX/TPU runtime (SURVEY.md §7 stage 6: a thin 5-function boundary so
+ * the Python path never depends on the C driver and vice versa).
+ *
+ * Implemented by tpu_abi.c via embedded CPython calling
+ * mpi_cuda_cnn_tpu.runtime_abi. All functions return 0 on success.
+ */
+#ifndef MCT_TPU_ABI_H
+#define MCT_TPU_ABI_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Start the runtime and build model+dataset+trainer from a JSON config
+ * (same schema as utils/config.py::Config). */
+int mct_tpu_init(const char *config_json);
+
+/* Run one training epoch; writes a JSON metrics line into buf. */
+int mct_tpu_train_epoch(char *buf, int buflen);
+
+/* Evaluate; writes {"ntests":N,"ncorrect":M} into buf. */
+int mct_tpu_eval(char *buf, int buflen);
+
+/* Checkpoint save/load. */
+int mct_tpu_save(const char *path);
+int mct_tpu_load(const char *path);
+
+/* Tear down the embedded runtime. */
+int mct_tpu_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MCT_TPU_ABI_H */

@@ -1,0 +1,316 @@
+/* train.c — minibatch training loop, eval, golden-tensor dump.
+ *
+ * Semantics: epoch permutation over the training set, batch-mean
+ * softmax-CE gradient, plain SGD — the batched equivalence of the
+ * surveyed per-sample/accumulate-32 schedule (SURVEY.md §7 hard-part (a)).
+ * Progress lines and the final "ntests=, ncorrect=" line keep the
+ * reference's observable output format (SURVEY.md §5.5).
+ */
+#define _POSIX_C_SOURCE 199309L   /* clock_gettime under -std=c11 */
+
+#include "mct.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+/* forward decls from ops.c */
+void mc_conv_fwd(const float *, const float *, const float *, float *,
+                 int, int, int, int, int, int, int, int, int, int, McAct);
+void mc_conv_bwd(const float *, const float *, const float *,
+                 float *, float *, float *,
+                 int, int, int, int, int, int, int, int, int, int);
+void mc_dense_fwd(const float *, const float *, const float *, float *,
+                  int, int, int, McAct);
+void mc_dense_bwd(const float *, const float *, const float *,
+                  float *, float *, float *, int, int, int);
+void mc_maxpool_fwd(const float *, float *, int32_t *, int, int, int, int, int);
+void mc_maxpool_bwd(const int32_t *, const float *, float *,
+                    int, int, int, int, int);
+float mc_softmax_ce(const float *, const uint8_t *, float *, float *, int, int);
+void mc_act_bwd(const float *, float *, size_t, McAct);
+
+typedef struct {
+    float *acts[MC_MAX_LAYERS + 1];  /* acts[0] = input batch */
+    int32_t *amax[MC_MAX_LAYERS];
+    float *ga, *gb_buf;              /* ping-pong activation grads */
+    size_t max_act;
+    int batch;
+} McWork;
+
+static size_t layer_out_count(const McLayer *l, int n)
+{
+    return (size_t)n * l->oh * l->ow * l->oc;
+}
+
+static int work_alloc(McWork *w, const McModel *m, int batch)
+{
+    memset(w, 0, sizeof(*w));
+    w->batch = batch;
+    size_t in_count = (size_t)batch * m->in_h * m->in_w * m->in_c;
+    w->acts[0] = malloc(in_count * sizeof(float));
+    w->max_act = in_count;
+    for (int i = 0; i < m->n_layers; i++) {
+        size_t c = layer_out_count(&m->layers[i], batch);
+        w->acts[i + 1] = malloc(c * sizeof(float));
+        if (m->layers[i].kind == MC_MAXPOOL)
+            w->amax[i] = malloc(c * sizeof(int32_t));
+        if (c > w->max_act)
+            w->max_act = c;
+        if (!w->acts[i + 1])
+            return -1;
+    }
+    w->ga = malloc(w->max_act * sizeof(float));
+    w->gb_buf = malloc(w->max_act * sizeof(float));
+    return (w->acts[0] && w->ga && w->gb_buf) ? 0 : -1;
+}
+
+static void work_free(McWork *w, const McModel *m)
+{
+    for (int i = 0; i <= m->n_layers; i++)
+        free(w->acts[i]);
+    for (int i = 0; i < m->n_layers; i++)
+        free(w->amax[i]);
+    free(w->ga);
+    free(w->gb_buf);
+}
+
+static void forward(const McModel *m, McWork *w, int n)
+{
+    for (int i = 0; i < m->n_layers; i++) {
+        const McLayer *l = &m->layers[i];
+        const float *x = w->acts[i];
+        float *y = w->acts[i + 1];
+        switch (l->kind) {
+        case MC_CONV:
+            mc_conv_fwd(x, m->params + l->w_off, m->params + l->b_off, y,
+                        n, l->ih, l->iw, l->ic, l->oh, l->ow, l->oc,
+                        l->k, l->stride, l->pad, l->act);
+            break;
+        case MC_DENSE:
+            mc_dense_fwd(x, m->params + l->w_off, m->params + l->b_off, y,
+                         n, l->ic, l->oc, l->act);
+            break;
+        case MC_MAXPOOL:
+            mc_maxpool_fwd(x, y, w->amax[i], n, l->ih, l->iw, l->ic, l->k);
+            break;
+        }
+    }
+}
+
+/* w->ga must hold d(loss)/d(logits) on entry; fills m->grads. */
+static void backward(const McModel *m, McWork *w, int n)
+{
+    float *gy = w->ga, *gx = w->gb_buf;
+    for (int i = m->n_layers - 1; i >= 0; i--) {
+        const McLayer *l = &m->layers[i];
+        const float *x = w->acts[i];
+        const float *y = w->acts[i + 1];
+        float *gx_out = i > 0 ? gx : NULL;
+        switch (l->kind) {
+        case MC_CONV:
+            mc_act_bwd(y, gy, layer_out_count(l, n), l->act);
+            mc_conv_bwd(x, m->params + l->w_off, gy, gx_out,
+                        m->grads + l->w_off, m->grads + l->b_off,
+                        n, l->ih, l->iw, l->ic, l->oh, l->ow, l->oc,
+                        l->k, l->stride, l->pad);
+            break;
+        case MC_DENSE:
+            mc_act_bwd(y, gy, layer_out_count(l, n), l->act);
+            mc_dense_bwd(x, m->params + l->w_off, gy, gx_out,
+                         m->grads + l->w_off, m->grads + l->b_off,
+                         n, l->ic, l->oc);
+            break;
+        case MC_MAXPOOL:
+            if (gx_out)
+                mc_maxpool_bwd(w->amax[i], gy, gx_out,
+                               n, l->ih, l->iw, l->ic, l->k);
+            break;
+        }
+        float *t = gy; gy = gx; gx = t;  /* ping-pong */
+    }
+}
+
+static void normalize_batch(const McDataset *ds, const uint8_t *images,
+                            const int *order, int start, int n, float *out)
+{
+    size_t px = (size_t)ds->h * ds->w * ds->c;
+    for (int s = 0; s < n; s++) {
+        const uint8_t *src = images + (size_t)order[start + s] * px;
+        float *dst = out + (size_t)s * px;
+        for (size_t j = 0; j < px; j++)
+            dst[j] = (float)src[j] / 255.0f;
+    }
+}
+
+static void sgd_step(McModel *m, float lr)
+{
+    for (size_t j = 0; j < m->n_params; j++) {
+        m->params[j] -= lr * m->grads[j];
+        m->grads[j] = 0.f;
+    }
+}
+
+static int dump_f32(const char *dir, const char *name, const float *p,
+                    size_t count)
+{
+    char path[1024];
+    snprintf(path, sizeof path, "%s/%s", dir, name);
+    FILE *f = fopen(path, "wb");
+    if (!f) return -1;
+    size_t wr = fwrite(p, sizeof(float), count, f);
+    fclose(f);
+    return wr == count ? 0 : -1;
+}
+
+static int golden_dump(McModel *m, const McDataset *ds, const McTrainCfg *cfg,
+                       McWork *w)
+{
+    /* One deterministic batch (first cfg->batch samples, in order):
+     * dump params, inputs, labels, logits, loss, grads — the parity
+     * fixtures tests/test_golden_c.py replays through the JAX ops. */
+    const char *dir = cfg->golden_dir;
+    int n = cfg->batch <= ds->n_train ? cfg->batch : ds->n_train;
+    int *order = malloc(sizeof(int) * n);
+    for (int i = 0; i < n; i++) order[i] = i;
+    normalize_batch(ds, ds->train_images, order, 0, n, w->acts[0]);
+    forward(m, w, n);
+    const McLayer *last = &m->layers[m->n_layers - 1];
+    float loss = mc_softmax_ce(w->acts[m->n_layers], ds->train_labels,
+                               w->ga, NULL, n, last->oc);
+    backward(m, w, n);
+
+    char path[1024];
+    int rc = 0;
+    /* Per-layer activations, for layerwise parity checks/debugging. */
+    for (int i = 0; i < m->n_layers; i++) {
+        char nm[64];
+        snprintf(nm, sizeof nm, "act_%d.f32", i);
+        rc |= dump_f32(dir, nm, w->acts[i + 1],
+                       layer_out_count(&m->layers[i], n));
+    }
+    rc |= dump_f32(dir, "params.f32", m->params, m->n_params);
+    rc |= dump_f32(dir, "batch_x.f32", w->acts[0],
+                   (size_t)n * ds->h * ds->w * ds->c);
+    rc |= dump_f32(dir, "logits.f32", w->acts[m->n_layers],
+                   (size_t)n * last->oc);
+    rc |= dump_f32(dir, "grads.f32", m->grads, m->n_params);
+    snprintf(path, sizeof path, "%s/batch_y.u8", dir);
+    FILE *f = fopen(path, "wb");
+    if (f) { fwrite(ds->train_labels, 1, n, f); fclose(f); } else rc = -1;
+    snprintf(path, sizeof path, "%s/meta.txt", dir);
+    f = fopen(path, "w");
+    if (f) {
+        fprintf(f, "loss %.9g\nn_params %zu\nbatch %d\nh %d\nw %d\nc %d\n",
+                (double)loss, m->n_params, n, ds->h, ds->w, ds->c);
+        fclose(f);
+    } else rc = -1;
+    free(order);
+    return rc;
+}
+
+int mc_eval(const McModel *m, const McDataset *ds, int *ncorrect)
+{
+    enum { EB = 256 };
+    McWork w;
+    if (work_alloc(&w, m, EB))
+        return -1;
+    const McLayer *last = &m->layers[m->n_layers - 1];
+    int order[EB];
+    int good = 0;
+    for (int start = 0; start < ds->n_test; start += EB) {
+        int n = ds->n_test - start < EB ? ds->n_test - start : EB;
+        for (int i = 0; i < n; i++) order[i] = start + i;
+        normalize_batch(ds, ds->test_images, order, 0, n, w.acts[0]);
+        forward(m, &w, n);
+        const float *logits = w.acts[m->n_layers];
+        for (int s = 0; s < n; s++) {
+            const float *ls = logits + (size_t)s * last->oc;
+            int arg = 0;
+            for (int j = 1; j < last->oc; j++)
+                if (ls[j] > ls[arg]) arg = j;
+            if (arg == ds->test_labels[start + s])
+                good++;
+        }
+    }
+    work_free(&w, m);
+    *ncorrect = good;
+    return 0;
+}
+
+int mc_train(McModel *m, const McDataset *ds, const McTrainCfg *cfg,
+             McResult *out)
+{
+    if (cfg->batch < 1 || cfg->batch > ds->n_train) {
+        fprintf(stderr, "mct: batch %d invalid for %d train samples\n",
+                cfg->batch, ds->n_train);
+        return -1;
+    }
+    McWork w;
+    if (work_alloc(&w, m, cfg->batch))
+        return -1;
+
+    if (cfg->golden_dir) {
+        int rc = golden_dump(m, ds, cfg, &w);
+        work_free(&w, m);
+        return rc;
+    }
+
+    int *order = malloc(sizeof(int) * ds->n_train);
+    for (int i = 0; i < ds->n_train; i++)
+        order[i] = i;
+    McRng rng;
+    mc_rng_seed(&rng, cfg->seed ^ 0xA5A5A5A5u);
+    const McLayer *last = &m->layers[m->n_layers - 1];
+    uint8_t *batch_labels = malloc(cfg->batch);
+
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+
+    int nbatches = ds->n_train / cfg->batch;
+    for (int epoch = 0; epoch < cfg->epochs; epoch++) {
+        /* Fisher-Yates epoch permutation */
+        for (int i = ds->n_train - 1; i > 0; i--) {
+            int j = (int)(mc_rng_next(&rng) % (uint64_t)(i + 1));
+            int t = order[i]; order[i] = order[j]; order[j] = t;
+        }
+        double running = 0.0;
+        for (int b = 0; b < nbatches; b++) {
+            normalize_batch(ds, ds->train_images, order, b * cfg->batch,
+                            cfg->batch, w.acts[0]);
+            for (int s = 0; s < cfg->batch; s++)
+                batch_labels[s] = ds->train_labels[order[b * cfg->batch + s]];
+            forward(m, &w, cfg->batch);
+            running += mc_softmax_ce(w.acts[m->n_layers], batch_labels,
+                                     w.ga, NULL, cfg->batch, last->oc);
+            backward(m, &w, cfg->batch);
+            sgd_step(m, cfg->lr);
+            if (cfg->log_every && (b + 1) % cfg->log_every == 0) {
+                fprintf(stderr, "epoch %d batch %d/%d loss %.5f\n",
+                        epoch, b + 1, nbatches, running / (b + 1));
+            }
+        }
+        fprintf(stderr, "epoch %d done, mean loss %.5f\n",
+                epoch, running / nbatches);
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+
+    int good = 0;
+    if (mc_eval(m, ds, &good))
+        return -1;
+    /* The reference's one benchmark line (SURVEY.md §3.4). */
+    fprintf(stderr, "ntests=%d, ncorrect=%d\n", ds->n_test, good);
+
+    if (out) {
+        out->ntests = ds->n_test;
+        out->ncorrect = good;
+        out->train_seconds = (double)(t1.tv_sec - t0.tv_sec) +
+                             1e-9 * (double)(t1.tv_nsec - t0.tv_nsec);
+    }
+    free(order);
+    free(batch_labels);
+    work_free(&w, m);
+    return 0;
+}
