@@ -9,7 +9,9 @@ faster than the reference).
 Training config mirrors the reference loop semantics: its exact model
 (cnn.c:416-428), batch 32 == its accumulator period, lr 0.1, SGD — on
 60,000 MNIST-shaped samples (synthetic stripes; no network access for real
-MNIST, and identical compute per step either way).
+MNIST, and identical compute per step either way). Runs the real product
+path: Trainer with the scanned-epoch SPMD program (HBM-resident dataset,
+one device dispatch per epoch).
 
 Prints exactly one JSON line on stdout.
 """
@@ -23,52 +25,29 @@ REFERENCE_EPOCH_S = 99.0  # BASELINE.md: serial C, ~1.65 ms/sample x 60k
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
     from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
-    from mpi_cuda_cnn_tpu.data.pipeline import epoch_batches, normalize_images, one_hot
-    from mpi_cuda_cnn_tpu.models.initializers import get_initializer
     from mpi_cuda_cnn_tpu.models.presets import get_model
-    from mpi_cuda_cnn_tpu.parallel.dp import dp_shard_batch, make_dp_train_step, replicate
-    from mpi_cuda_cnn_tpu.parallel.mesh import DATA_AXIS, make_mesh
-    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
-    from mpi_cuda_cnn_tpu.train.trainer import make_loss_fn
+    from mpi_cuda_cnn_tpu.train.trainer import Trainer
+    from mpi_cuda_cnn_tpu.utils.config import Config
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
 
-    batch_size = 32
     ds = synthetic_stripes(num_train=60_000, num_test=32)
-
-    mesh = make_mesh({DATA_AXIS: 1}, devices=jax.devices()[:1])
-    model = get_model("reference_cnn")
-    params = model.init(jax.random.key(0), get_initializer("normal"))
-    optimizer = make_optimizer(0.1)
-    state = replicate(
-        {"params": params, "opt_state": optimizer.init(params),
-         "step": jnp.zeros((), jnp.int32)},
-        mesh,
+    cfg = Config(
+        model="reference_cnn",
+        epochs=1,
+        batch_size=32,   # cnn.c:449 accumulator period
+        lr=0.1,          # cnn.c:446
+        eval_every=0,
+        log_every=10**9,  # single scan dispatch per epoch
+        num_devices=1,
     )
-    step = make_dp_train_step(make_loss_fn(model), optimizer, mesh)
+    trainer = Trainer(
+        get_model("reference_cnn"), ds, cfg, metrics=MetricsLogger(echo=False)
+    )
 
-    train_x = normalize_images(ds.train_images)
-    train_y = one_hot(ds.train_labels, ds.num_classes)
-
-    import numpy as np
-
-    rng = np.random.default_rng(0)
-    batches = [
-        dp_shard_batch((jnp.asarray(bx), jnp.asarray(by)), mesh)
-        for bx, by in epoch_batches(train_x, train_y, batch_size, rng=rng)
-    ]
-
-    # Warmup: compile + a few steady-state steps.
-    for bx, by in batches[:10]:
-        state, m = step(state, bx, by)
-    jax.block_until_ready((state, m))
-
+    trainer.run_epoch(0)  # warmup: stages the dataset + compiles the scan
     t0 = time.perf_counter()
-    for bx, by in batches:
-        state, m = step(state, bx, by)
-    jax.block_until_ready((state, m))
+    trainer.run_epoch(1)
     epoch_s = time.perf_counter() - t0
 
     print(json.dumps({

@@ -17,16 +17,29 @@ from typing import Iterator
 import numpy as np
 
 
+# The normalization contract (cnn.c:457): pixel byte / 255 -> [0,1] float.
+# Shared by the host path (normalize_images) and the on-device scan body
+# (parallel/dp.py make_dp_scan_epoch); test_scan_matches_per_batch_loop
+# asserts the two stay equivalent.
+PIXEL_SCALE = 255.0
+
+
+def ensure_channel_axis(images: np.ndarray) -> np.ndarray:
+    """(N,H,W) grayscale -> (N,H,W,1); NHWC input passes through."""
+    images = np.asarray(images)
+    if images.ndim == 3:
+        images = images[..., None]
+    return images
+
+
 def normalize_images(images: np.ndarray) -> np.ndarray:
     """uint8 [0,255] -> float32 [0,1], adding a channel axis for grayscale.
 
     Matches the reference's `x[j] = img[j]/255.0` (cnn.c:457), in f32 rather
     than double (SURVEY.md §7 hard-part (b)). Output layout is NHWC.
     """
-    images = np.asarray(images)
-    if images.ndim == 3:
-        images = images[..., None]
-    return images.astype(np.float32) / np.float32(255.0)
+    images = ensure_channel_axis(images)
+    return images.astype(np.float32) / np.float32(PIXEL_SCALE)
 
 
 def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:

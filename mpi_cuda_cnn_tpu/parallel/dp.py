@@ -24,9 +24,11 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..data.pipeline import PIXEL_SCALE
 from .mesh import DATA_AXIS
 
 TrainState = dict[str, Any]  # {"params": pytree, "opt_state": pytree, "step": i32}
@@ -43,20 +45,10 @@ def dp_shard_batch(batch, mesh, axis: str = DATA_AXIS):
     return jax.device_put(batch, NamedSharding(mesh, P(axis)))
 
 
-def make_dp_train_step(
-    loss_fn: Callable,
-    optimizer: optax.GradientTransformation,
-    mesh,
-    *,
-    axis: str = DATA_AXIS,
-    donate: bool = True,
-):
-    """Build the jitted DP train step.
-
-    loss_fn(params, x, y) -> (scalar loss, aux dict); x/y are the
-    per-device shard inside shard_map. Returns step(state, x, y) ->
-    (state, metrics) with state replicated and batches sharded on `axis`.
-    """
+def _make_step_body(loss_fn: Callable, optimizer, axis: str):
+    """The per-step SPMD body shared by the one-batch step and the scanned
+    epoch: local grads, ONE fused gradient all-reduce, identical update on
+    every device."""
 
     def step(state: TrainState, x, y):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -77,6 +69,25 @@ def make_dp_train_step(
                      "step": state["step"] + 1}
         return new_state, {"loss": loss, **aux}
 
+    return step
+
+
+def make_dp_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    *,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Build the jitted DP train step.
+
+    loss_fn(params, x, y) -> (scalar loss, aux dict); x/y are the
+    per-device shard inside shard_map. Returns step(state, x, y) ->
+    (state, metrics) with state replicated and batches sharded on `axis`.
+    """
+    step = _make_step_body(loss_fn, optimizer, axis)
+
     # check_vma=False: collective typing stays classic/explicit (local grads
     # until the pmean above). Also required for Pallas interpreter-mode
     # kernels, which cannot evaluate under the varying-axes tracer.
@@ -84,6 +95,51 @@ def make_dp_train_step(
         step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_dp_scan_epoch(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    num_classes: int,
+    *,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Build a jitted many-steps-per-dispatch trainer: the whole (chunk of
+    an) epoch is ONE `lax.scan` over a batch-index permutation, with the raw
+    uint8 training set resident in HBM.
+
+    The reference pays a host round-trip per sample (cnn.c:451-474); the
+    per-batch jit step still pays one dispatch per batch, which dominates at
+    this model size. Here the host sends only an int32 permutation per
+    epoch; normalization (cnn.c:457) and one-hot (cnn.c:462-464) happen
+    on-device inside the scan body, so HBM holds pixels as uint8.
+
+    epoch_fn(state, images_u8, labels_i32, perm) -> (state, metric_sums)
+      images: (N,H,W,C) uint8, replicated.  labels: (N,) int32, replicated.
+      perm:   (nsteps, batch) int32, batch dim sharded on `axis`.
+      metric_sums: metrics summed over the scanned steps.
+    """
+    step = _make_step_body(loss_fn, optimizer, axis)
+
+    def epoch(state: TrainState, images, labels, perm):
+        def body(state, idx):
+            x = images[idx].astype(jnp.float32) / jnp.float32(PIXEL_SCALE)
+            y = jax.nn.one_hot(labels[idx], num_classes, dtype=jnp.float32)
+            return step(state, x, y)
+
+        state, metrics = jax.lax.scan(body, state, perm)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+
+    sharded = jax.shard_map(
+        epoch,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, axis)),
         out_specs=(P(), P()),
         check_vma=False,
     )

@@ -47,6 +47,9 @@ class Config:
     mesh_shape: str = "data"      # named mesh axes, e.g. "data" or "data:4,model:2"
     use_pallas: bool = False      # Pallas kernels instead of lax ops
     donate: bool = True
+    scan: bool = True             # many-steps-per-dispatch epochs (lax.scan
+                                  # over an HBM-resident dataset); off =
+                                  # one dispatch per batch
 
     # Aux subsystems.
     checkpoint_dir: str | None = None

@@ -34,7 +34,8 @@ def get_logger() -> logging.Logger:
 class MetricsLogger:
     """Structured metrics: JSONL file sink + human-readable stderr echo."""
 
-    def __init__(self, path: str | Path | None = None, echo: bool = True):
+    def __init__(self, path: str | Path | None = None, echo: bool = True,
+                 capture: bool = False):
         self._file = None
         if path is not None:
             p = Path(path)
@@ -43,9 +44,14 @@ class MetricsLogger:
         self._echo = echo
         self._log = get_logger()
         self._t0 = time.perf_counter()
+        # In-memory record list, opt-in (unbounded — long-lived trainers
+        # should leave it off and use the JSONL sink).
+        self.rows: list[dict] | None = [] if capture else None
 
     def log(self, event: str, **fields) -> None:
         record = {"event": event, "t": round(time.perf_counter() - self._t0, 4), **fields}
+        if self.rows is not None:
+            self.rows.append(record)
         if self._file:
             self._file.write(json.dumps(record) + "\n")
             self._file.flush()
