@@ -27,9 +27,11 @@ test_serial:
 
 # 8-way data-parallel e2e smoke run (twin of `make test_mpi`'s
 # mpirun -np 8, reference Makefile:44) on a virtual CPU mesh.
+# --device cpu (not the JAX_PLATFORMS env var): a pre-registered TPU
+# plugin can intercept the env-var path; the in-process config is reliable.
 test_dp8:
-	$(CPU8) JAX_PLATFORMS=cpu $(PY) -m mpi_cuda_cnn_tpu --dataset synthetic \
-	  --model reference_cnn --epochs 2
+	$(CPU8) $(PY) -m mpi_cuda_cnn_tpu --dataset synthetic \
+	  --model reference_cnn --epochs 2 --device cpu
 
 # Same on whatever accelerator is visible (TPU on a TPU VM).
 test_tpu:

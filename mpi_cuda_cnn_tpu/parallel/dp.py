@@ -45,6 +45,13 @@ def dp_shard_batch(batch, mesh, axis: str = DATA_AXIS):
     return jax.device_put(batch, NamedSharding(mesh, P(axis)))
 
 
+def dp_shard_perm(perm, mesh, axis: str = DATA_AXIS):
+    """Place a (nsteps, batch) permutation on the mesh with the batch dim
+    sharded — the host-side twin of make_dp_scan_epoch's perm in_spec
+    (P(None, axis)); keep the two in sync here, in one place."""
+    return jax.device_put(perm, NamedSharding(mesh, P(None, axis)))
+
+
 def _make_step_body(loss_fn: Callable, optimizer, axis: str):
     """The per-step SPMD body shared by the one-batch step and the scanned
     epoch: local grads, ONE fused gradient all-reduce, identical update on

@@ -25,6 +25,7 @@ from ..models.initializers import get_initializer
 from ..ops import softmax_cross_entropy, squared_error_total, stable_softmax
 from ..parallel.dp import (
     dp_shard_batch,
+    dp_shard_perm,
     make_dp_eval_step,
     make_dp_scan_epoch,
     make_dp_train_step,
@@ -98,9 +99,9 @@ class Trainer:
         backend = "pallas" if config.use_pallas else "xla"
         self.loss_fn = make_loss_fn(model, backend=backend, compute_dtype=compute_dtype)
 
-        # Normalized host copies are built lazily (_host_train_data): the
-        # default scanned path stages raw uint8 on device and never needs
-        # the float32 host materialization.
+        # Normalized host copies are built lazily (train_x/train_y
+        # properties): the default scanned path stages raw uint8 on device
+        # and never needs the float32 host materialization.
         self._train_x = None
         self._train_y = None
         self.num_train = len(dataset.train_images)
@@ -250,9 +251,6 @@ class Trainer:
         nsteps = self.steps_per_epoch
         order = self._rng.permutation(self.num_train)[: nsteps * b]
         perm = order.reshape(nsteps, b).astype(np.int32)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        perm_sharding = NamedSharding(self.mesh, P(None, DATA_AXIS))
 
         # log_every <= 0 means logging off -> the whole epoch is one scan.
         # A shorter tail chunk costs one extra (cached thereafter) compile.
@@ -261,13 +259,15 @@ class Trainer:
         totals = None
         done = 0
         for start in range(0, nsteps, chunk):
-            rows = jax.device_put(perm[start : start + chunk], perm_sharding)
+            rows = dp_shard_perm(perm[start : start + chunk], self.mesh)
             self.state, sums = self._scan_epoch_fn(
                 self.state, self._dev_images, self._dev_labels, rows
             )
             totals = sums if totals is None else jax.tree.map(jnp.add, totals, sums)
             done += len(perm[start : start + chunk])
-            if log_chunks:
+            # Parity with the loop path: log only at exact multiples of
+            # log_every (a short tail chunk trains but does not log).
+            if log_chunks and done % cfg.log_every == 0:
                 jax.block_until_ready(totals)
                 self.metrics.log(
                     "train",

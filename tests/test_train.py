@@ -96,16 +96,18 @@ def test_scan_matches_per_batch_loop(ds):
 
 
 def test_scan_chunked_logging(ds):
-    """log_every smaller than steps-per-epoch chunks the scan and still
-    produces per-chunk train metrics."""
+    """log_every smaller than steps-per-epoch chunks the scan and produces
+    train metric rows exactly at multiples of log_every — the same rows the
+    per-batch loop path emits (the short tail chunk trains but never logs)."""
     cfg = Config(epochs=1, eval_every=0, log_every=5, num_devices=1)
     metrics = MetricsLogger(echo=False, capture=True)
     t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=metrics)
     em = t.run_epoch(0)
-    assert em["steps"] == 512 // 32
+    nsteps = 512 // 32
+    assert em["steps"] == nsteps
     train_rows = [r for r in metrics.rows if r["event"] == "train"]
-    assert len(train_rows) == (512 // 32 + 4) // 5
-    assert train_rows[-1]["step"] == 512 // 32
+    assert [r["step"] for r in train_rows] == [5, 10, 15]  # == loop path
+    assert len(train_rows) == nsteps // 5
 
 
 def test_bfloat16_training(ds):
