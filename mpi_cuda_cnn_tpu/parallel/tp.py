@@ -1,0 +1,165 @@
+"""Tensor parallelism over the 'model' mesh axis.
+
+The reference has NO tensor parallelism — every rank holds all 360,810
+params (full buffers per rank, cnnmpi.c:93-103; SURVEY.md §2 parallelism
+checklist: "TP: absent"). This module fills the seam SURVEY.md §7 stage 5
+left open ("a 'model' axis seam") the idiomatic TPU way: GSPMD. Instead of
+hand-writing sharded matmuls + collectives (the Megatron/NCCL pattern a GPU
+port would translate), we
+
+- assign each parameter a PartitionSpec over the ('data', 'model') mesh:
+  output-feature sharding for Conv kernels (kh,kw,cin,cout -> shard cout)
+  and Dense kernels (d_in,features -> shard features), biases to match,
+  small heads (features not divisible by the axis) replicated;
+- place the train state with those shardings once at init;
+- jit the *plain* train step: XLA's sharding propagation derives every
+  collective — all-gathers where a sharded layer output feeds the next
+  layer, the gradient all-reduce over 'data' from the batch-mean loss, and
+  reduce-scatters for the sharded gradients. Collectives ride ICI by mesh
+  construction.
+
+This composes with DP transparently: a Mesh("data": N, "model": M) runs
+N-way data parallelism and M-way tensor parallelism from the same step
+function with zero code difference (the pure-DP path in dp.py keeps the
+explicit shard_map/psum formulation as the readable SPMD reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+TrainState = dict[str, Any]
+
+
+def tp_param_specs(model, mesh, axis: str = MODEL_AXIS) -> list[dict]:
+    """PartitionSpec pytree (same structure as model.init's params) sharding
+    each layer's output features over `axis`.
+
+    A layer whose feature count does not divide the axis size is replicated
+    (the classifier head: 10 classes over an 8-way axis); parameterless
+    layers (pools, flatten) get empty specs.
+    """
+    n = mesh.shape.get(axis, 1)
+    specs: list[dict] = []
+    for layer in model.layers:
+        features = getattr(layer, "features", None)
+        if features is None:
+            specs.append({})
+        elif n > 1 and features % n == 0:
+            ndim_w = 4 if hasattr(layer, "kernel") else 2  # Conv HWIO / Dense
+            specs.append({"w": P(*([None] * (ndim_w - 1)), axis), "b": P(axis)})
+        else:
+            specs.append({"w": P(), "b": P()})
+    return specs
+
+
+def shard_params(params, model, mesh, axis: str = MODEL_AXIS):
+    """Place params on the mesh per tp_param_specs. The replicated-init +
+    shard step replaces the reference's per-rank full copies."""
+    specs = tp_param_specs(model, mesh, axis)
+    return jax.device_put(
+        params,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )
+
+
+def make_tp_state(model, params, optimizer, mesh, axis: str = MODEL_AXIS) -> TrainState:
+    """Build the train state with TP-sharded params. The optimizer state is
+    created FROM the sharded params, so its zeros_like buffers (momentum
+    etc.) inherit the same shardings leaf-for-leaf."""
+    params = shard_params(params, model, mesh, axis)
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
+        ),
+    }
+
+
+def _step_body(loss_fn: Callable, optimizer):
+    """The one train-step body both TP entry points jit (the GSPMD twin of
+    dp._make_step_body — but with NO explicit collective: the batch-mean
+    loss over the 'data'-sharded batch lowers to partial sums + an ICI
+    all-reduce, the intent of the reference's MPI_Allreduce,
+    cnnmpi.c:490)."""
+
+    def step(state: TrainState, x, y):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], x, y
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        return new_state, {"loss": loss, **aux}
+
+    return step
+
+
+def make_tp_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    donate: bool = True,
+):
+    """The GSPMD train step: a plain jitted step over sharded inputs.
+
+    Params sharded on 'model' make XLA partition the matmuls and insert
+    the activation all-gathers. Shardings flow from the input arrays —
+    callers place state via make_tp_state and batches via shard_batch_2d.
+    """
+    step = _step_body(loss_fn, optimizer)
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_tp_scan_epoch(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    num_classes: int,
+    *,
+    donate: bool = True,
+):
+    """Scanned-epoch twin of dp.make_dp_scan_epoch for the GSPMD path:
+    lax.scan over a batch-index permutation with the uint8 dataset
+    device-resident; normalization/one-hot on device (cnn.c:457,462-464)."""
+    from ..data.pipeline import PIXEL_SCALE
+
+    step = _step_body(loss_fn, optimizer)
+
+    def epoch(state: TrainState, images, labels, perm):
+        def body(state, idx):
+            x = images[idx].astype(jnp.float32) / jnp.float32(PIXEL_SCALE)
+            y = jax.nn.one_hot(labels[idx], num_classes, dtype=jnp.float32)
+            return step(state, x, y)
+
+        state, metrics = jax.lax.scan(body, state, perm)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+
+    return jax.jit(epoch, donate_argnums=(0,) if donate else ())
+
+
+def shard_batch_2d(batch, mesh, axis: str = DATA_AXIS):
+    """Shard a host batch's leading dim over 'data' (replicated over
+    'model'): every model-group works on the same samples."""
+    return jax.device_put(batch, NamedSharding(mesh, P(axis)))
+
+
+def make_tp_eval_step(predict_fn: Callable):
+    """GSPMD eval: jit the plain forward; shardings flow from the arrays."""
+    return jax.jit(predict_fn)

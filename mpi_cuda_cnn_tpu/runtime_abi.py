@@ -73,11 +73,12 @@ def save(path: str) -> str:
 
 
 def load(path: str) -> str:
-    from .parallel.dp import replicate
     from .train.checkpoint import latest_checkpoint, restore_checkpoint
 
     t = _trainer()
     ckpt = latest_checkpoint(path) or path
     host = jax.device_get(t.state)
-    t.state = replicate(restore_checkpoint(ckpt, host), t.mesh)
+    # place_state keeps the live shardings (TP model-axis shards included);
+    # a bare replicate() here would silently de-shard a TP run.
+    t.place_state(restore_checkpoint(ckpt, host))
     return json.dumps({"restored": str(ckpt)})

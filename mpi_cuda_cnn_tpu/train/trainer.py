@@ -31,7 +31,13 @@ from ..parallel.dp import (
     make_dp_train_step,
     replicate,
 )
-from ..parallel.mesh import DATA_AXIS, make_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from ..parallel.tp import (
+    make_tp_eval_step,
+    make_tp_scan_epoch,
+    make_tp_state,
+    make_tp_train_step,
+)
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer, profile_trace
 from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
@@ -122,24 +128,36 @@ class Trainer:
         init = get_initializer(config.init)
         param_dtype = jnp.dtype(config.param_dtype)
         params = model.init(jax.random.key(config.seed), init, dtype=param_dtype)
-        opt_state = self.optimizer.init(params)
-        self.state = replicate(
-            {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)},
-            self.mesh,
+        predict = lambda params, x: model.apply(
+            params, x, backend=backend, compute_dtype=compute_dtype
         )
-
-        self.train_step = make_dp_train_step(
-            self.loss_fn, self.optimizer, self.mesh, donate=config.donate
-        )
+        self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
+        if self.n_model > 1:
+            # Tensor(+data) parallel: GSPMD path — params sharded on the
+            # 'model' axis, plain jitted step, XLA inserts the collectives
+            # (parallel/tp.py). The reference has no TP at all (SURVEY.md
+            # §2 checklist).
+            self.state = make_tp_state(model, params, self.optimizer, self.mesh)
+            self.train_step = make_tp_train_step(
+                self.loss_fn, self.optimizer, donate=config.donate
+            )
+            self.eval_step = make_tp_eval_step(predict)
+        else:
+            opt_state = self.optimizer.init(params)
+            self.state = replicate(
+                {"params": params, "opt_state": opt_state,
+                 "step": jnp.zeros((), jnp.int32)},
+                self.mesh,
+            )
+            self.train_step = make_dp_train_step(
+                self.loss_fn, self.optimizer, self.mesh, donate=config.donate
+            )
+            self.eval_step = make_dp_eval_step(predict, self.mesh)
         # Scanned-epoch path: built lazily on first use (run_epoch), since
         # it stages the uint8 training set into device memory.
         self._scan_epoch_fn = None
         self._dev_images = None
         self._dev_labels = None
-        predict = lambda params, x: model.apply(
-            params, x, backend=backend, compute_dtype=compute_dtype
-        )
-        self.eval_step = make_dp_eval_step(predict, self.mesh)
         self._eval_batch = self._pick_eval_batch(len(self.test_x), n_data)
         # One shuffle stream for the whole run, shared by every entry point
         # (train(), run_epoch() via the C ABI) so batch order is identical
@@ -173,6 +191,14 @@ class Trainer:
         return self._train_y
 
     # ------------------------------------------------------------------
+
+    def place_state(self, host_state) -> None:
+        """Install a host-side state pytree (e.g. a restored checkpoint)
+        with the SAME shardings the live state uses — replicated on the DP
+        path, model-axis-sharded on the TP path. Checkpoints store full
+        arrays, so restore must re-place, not just replicate."""
+        shardings = jax.tree.map(lambda a: a.sharding, self.state)
+        self.state = jax.device_put(host_state, shardings)
 
     def run_epoch(self, epoch: int) -> dict:
         """Run one epoch of the jitted step over the whole training set.
@@ -234,10 +260,16 @@ class Trainer:
         self._dev_labels = replicate(
             jnp.asarray(self.ds.train_labels, jnp.int32), self.mesh
         )
-        self._scan_epoch_fn = make_dp_scan_epoch(
-            self.loss_fn, self.optimizer, self.mesh, self.ds.num_classes,
-            donate=self.cfg.donate,
-        )
+        if self.n_model > 1:
+            self._scan_epoch_fn = make_tp_scan_epoch(
+                self.loss_fn, self.optimizer, self.ds.num_classes,
+                donate=self.cfg.donate,
+            )
+        else:
+            self._scan_epoch_fn = make_dp_scan_epoch(
+                self.loss_fn, self.optimizer, self.mesh, self.ds.num_classes,
+                donate=self.cfg.donate,
+            )
 
     def _run_epoch_scanned(self, epoch: int) -> dict:
         """Scanned epoch: one device dispatch per `log_every` steps (one per
@@ -296,7 +328,7 @@ class Trainer:
             ckpt = latest_checkpoint(cfg.checkpoint_dir)
             if ckpt is not None:
                 host_state = jax.device_get(self.state)
-                self.state = replicate(restore_checkpoint(ckpt, host_state), self.mesh)
+                self.place_state(restore_checkpoint(ckpt, host_state))
                 start_epoch = int(jax.device_get(self.state["step"])) // max(
                     self.steps_per_epoch, 1
                 )
