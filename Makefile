@@ -14,6 +14,7 @@ native:
 
 test_native: native
 	$(MAKE) -C native test
+	$(MAKE) -C native test_abi
 
 # Unit/integration suite (CPU, 8 virtual devices — set in tests/conftest.py).
 test:

@@ -116,9 +116,12 @@ static int run_tpu(const Args *a)
 {
     char cfg[4096], buf[1024];
     /* --device=tpu demands an accelerator; --device=jax takes whatever
-     * backend the embedded runtime finds (used to exercise the embedding
-     * without TPU access). */
-    const char *dev = strcmp(a->device, "tpu") == 0 ? "tpu" : "auto";
+     * backend the embedded runtime finds; --device=jax-cpu pins the
+     * embedded runtime to CPU (exercises the full C<->JAX boundary
+     * deterministically, with no accelerator required). */
+    const char *dev = strcmp(a->device, "tpu") == 0 ? "tpu"
+                    : strcmp(a->device, "jax-cpu") == 0 ? "cpu"
+                    : "auto";
     /* Build the JSON config for utils/config.py::Config, escaping paths
      * and checking for truncation. */
     size_t pos = 0;
@@ -180,7 +183,8 @@ int main(int argc, char **argv)
                 "[--epochs=N] [--lr=F] [--batch=N] [--seed=N]\n");
         return 100;   /* the surveyed bad-usage exit code */
     }
-    if (strcmp(a.device, "tpu") == 0 || strcmp(a.device, "jax") == 0)
+    if (strcmp(a.device, "tpu") == 0 || strcmp(a.device, "jax") == 0 ||
+        strcmp(a.device, "jax-cpu") == 0)
         return run_tpu(&a);
     if (strcmp(a.device, "cpu") == 0)
         return run_cpu(&a);
