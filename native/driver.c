@@ -8,11 +8,13 @@
  * (tpu_abi.c).
  *
  *   mctpu train-img train-lab test-img test-lab [options]
- *     --device=cpu|tpu      (default cpu)
+ *     --device=cpu|tpu|jax|jax-cpu  (default cpu; jax = embedded runtime
+ *                           on whatever backend it finds, jax-cpu = the
+ *                           same pinned to CPU for accelerator-free tests)
  *     --model=NAME          (default reference_cnn)
  *     --epochs=N --lr=F --batch=N --seed=N --log-every=N
  *     --golden-dir=DIR      (cpu only: dump parity fixtures and exit)
- *     --save=DIR --load=DIR (tpu only: checkpoint round-trip)
+ *     --save=DIR --load=DIR (embedded runtime only: checkpoint round-trip)
  */
 #include "mct.h"
 #include "tpu_abi.h"
@@ -179,8 +181,9 @@ int main(int argc, char **argv)
     if (parse_args(argc, argv, &a)) {
         fprintf(stderr,
                 "usage: mctpu train-images train-labels test-images "
-                "test-labels [--device=cpu|tpu] [--model=NAME] "
-                "[--epochs=N] [--lr=F] [--batch=N] [--seed=N]\n");
+                "test-labels [--device=cpu|tpu|jax|jax-cpu] [--model=NAME] "
+                "[--epochs=N] [--lr=F] [--batch=N] [--seed=N] "
+                "[--save=DIR] [--load=DIR]\n");
         return 100;   /* the surveyed bad-usage exit code */
     }
     if (strcmp(a.device, "tpu") == 0 || strcmp(a.device, "jax") == 0 ||

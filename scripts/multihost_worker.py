@@ -1,0 +1,89 @@
+"""One process of a multi-host DP training demo.
+
+The multi-host twin of the reference's `mpirun -np 8` world (Makefile:44):
+each process calls `jax.distributed.initialize` (the MPI_Init replacement,
+cnnmpi.c:419), after which `jax.devices()` is the GLOBAL device list and
+the ordinary DP train step runs unchanged — collectives cross process
+boundaries via the runtime (ICI/DCN on a real pod; TCP here on CPU).
+
+Usage (one line per "host"):
+    python scripts/multihost_worker.py <pid> <nproc> <coordinator> [devs_per_proc]
+
+Every process feeds the SAME global batch (the reference's every-rank-
+loads-the-full-dataset pattern, cnnmpi.c:426-454, made correct); the
+printed loss must therefore be identical on every process.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    coordinator = sys.argv[3]
+    devs = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+
+    import jax
+
+    # In-process CPU selection (the env-var path can be intercepted by a
+    # pre-registered TPU plugin — same reason as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devs}"
+    )
+    from mpi_cuda_cnn_tpu.parallel.distributed import initialize_distributed
+
+    info = initialize_distributed(
+        coordinator_address=coordinator, num_processes=nproc, process_id=pid
+    )
+    assert info.process_count == nproc, info
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.parallel.dp import (
+        dp_shard_batch,
+        make_dp_train_step,
+        replicate,
+    )
+    from mpi_cuda_cnn_tpu.parallel.mesh import make_mesh
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+    from mpi_cuda_cnn_tpu.train.trainer import make_loss_fn
+
+    mesh = make_mesh()  # all GLOBAL devices on the data axis
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    optimizer = make_optimizer(0.1)
+    state = replicate(
+        {"params": params, "opt_state": optimizer.init(params),
+         "step": jnp.zeros((), jnp.int32)},
+        mesh,
+    )
+    step = make_dp_train_step(make_loss_fn(model), optimizer, mesh, donate=False)
+
+    batch = 2 * info.global_devices
+    rng = np.random.default_rng(7)  # same seed everywhere -> same batch
+    x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
+    y = np.zeros((batch, 10), np.float32)
+    y[np.arange(batch), rng.integers(0, 10, batch)] = 1.0
+    xs, ys = dp_shard_batch((x, jnp.asarray(y)), mesh)
+
+    state, metrics = step(state, xs, ys)
+    jax.block_until_ready(metrics)
+    print(
+        f"MHOK pid={info.process_index} procs={info.process_count} "
+        f"gdev={info.global_devices} loss={float(metrics['loss']):.6f}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Multi-host distributed-backend test: 2 real processes, one global mesh.
+
+The reference's multi-process story was `mpirun -np 8` on one host with
+per-sample MPI_Allreduce (Makefile:44, cnnmpi.c:490) and was never tested
+multi-node (SURVEY.md §4). Here two OS processes join one JAX runtime via
+`jax.distributed.initialize` (parallel/distributed.py) and run the SAME DP
+train step the single-host path uses, over a global 8-device CPU mesh —
+the collective crosses the process boundary, and both processes must see
+the identical loss.
+"""
+
+import re
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = REPO / "scripts" / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_step():
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(pid), "2", coord, "4"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        for p in procs:  # no orphans on timeout/assert: a stalled worker
+            if p.poll() is None:  # would otherwise hold the port for 300s+
+                p.kill()
+                p.wait()
+
+    losses = []
+    for pid, out in enumerate(outs):
+        m = re.search(
+            rf"MHOK pid={pid} procs=2 gdev=8 loss=([0-9.]+)", out
+        )
+        assert m, f"no MHOK line from pid {pid}: {out}"
+        losses.append(float(m.group(1)))
+    assert losses[0] == losses[1]  # one global step, one loss
