@@ -8,13 +8,18 @@ collectives per epoch at 8 ranks, SURVEY.md §3.2) becomes a single fused
 gradient pmean inside one jitted step, lowered by XLA to ICI all-reduce.
 """
 
-from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh, local_device_count
+from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, make_mesh, local_device_count
 from .dp import dp_shard_batch, make_dp_train_step, replicate
 from .distributed import initialize_distributed, process_info
+from .pp import make_pipeline_plan, make_pp_state, make_pp_train_step
 
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
+    "make_pipeline_plan",
+    "make_pp_state",
+    "make_pp_train_step",
     "make_mesh",
     "local_device_count",
     "dp_shard_batch",

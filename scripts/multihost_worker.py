@@ -32,10 +32,19 @@ def main() -> int:
     # In-process CPU selection (the env-var path can be intercepted by a
     # pre-registered TPU plugin — same reason as tests/conftest.py).
     jax.config.update("jax_platforms", "cpu")
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={devs}"
+    # Replace (don't append to) any inherited device-count flag — e.g. the
+    # one tests/conftest.py exports — so XLA never sees two conflicting
+    # occurrences.
+    import re
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
     )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={devs}"
+    ).strip()
     from mpi_cuda_cnn_tpu.parallel.distributed import initialize_distributed
 
     info = initialize_distributed(
