@@ -47,9 +47,12 @@ def dp_shard_batch(batch, mesh, axis: str = DATA_AXIS):
 
 def dp_shard_perm(perm, mesh, axis: str = DATA_AXIS):
     """Place a (nsteps, batch) permutation on the mesh with the batch dim
-    sharded — the host-side twin of make_dp_scan_epoch's perm in_spec
-    (P(None, axis)); keep the two in sync here, in one place."""
-    return jax.device_put(perm, NamedSharding(mesh, P(None, axis)))
+    sharded — the host-side twin of the scan-epoch perm in_specs
+    (P(None, axis)); keep the two in sync here, in one place. On a mesh
+    without the data axis (e.g. pipe-only PP), the perm is replicated,
+    matching pp.make_pp_scan_epoch's P(None) spec."""
+    spec = P(None, axis) if axis in mesh.axis_names else P(None)
+    return jax.device_put(perm, NamedSharding(mesh, spec))
 
 
 def _make_step_body(loss_fn: Callable, optimizer, axis: str):

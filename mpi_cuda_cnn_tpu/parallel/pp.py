@@ -305,23 +305,10 @@ def microbatch(x, y, num_microbatches: int):
     return split(x), split(y)
 
 
-def make_pp_train_step(
-    plan: PipelinePlan,
-    optimizer: optax.GradientTransformation,
-    mesh,
-    state: TrainState,
-    *,
-    donate: bool = True,
-):
-    """Build the jitted PP(+DP) train step.
-
-    step(state, x_mb, y_mb) -> (state, metrics); x_mb (M, mb, H, W, C) and
-    y_mb (M, mb, C) placed via pp_shard_batch. Metrics match the DP/TP
-    steps' {loss, etotal, acc} means, so the Trainer can treat all three
-    parallel modes uniformly.
-    """
+def _make_step_body(plan: PipelinePlan, optimizer, has_data: bool):
+    """The per-device PP(+DP) train-step body shared by the one-batch step
+    and the scanned epoch (the PP twin of dp._make_step_body)."""
     local_loss = _make_local_loss(plan)
-    has_data = DATA_AXIS in mesh.axis_names
 
     def step(state: TrainState, x_mb, y_mb):
         (loss, (etot, acc)), grads = jax.value_and_grad(
@@ -345,12 +332,80 @@ def make_pp_train_step(
                      "step": state["step"] + 1}
         return new_state, {"loss": loss, "etotal": etot, "acc": acc}
 
+    return step
+
+
+def make_pp_train_step(
+    plan: PipelinePlan,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    state: TrainState,
+    *,
+    donate: bool = True,
+):
+    """Build the jitted PP(+DP) train step.
+
+    step(state, x_mb, y_mb) -> (state, metrics); x_mb (M, mb, H, W, C) and
+    y_mb (M, mb, C) placed via pp_shard_batch. Metrics match the DP/TP
+    steps' {loss, etotal, acc} means, so the Trainer can treat all three
+    parallel modes uniformly.
+    """
+    step = _make_step_body(plan, optimizer, DATA_AXIS in mesh.axis_names)
     specs = _state_specs(state, plan.n_stages)
     bspec = _batch_spec(mesh)
     sharded = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(specs, bspec, bspec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_pp_scan_epoch(
+    plan: PipelinePlan,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    state: TrainState,
+    num_classes: int,
+    num_microbatches: int,
+    *,
+    donate: bool = True,
+):
+    """Scanned-epoch twin of dp.make_dp_scan_epoch for the pipelined path:
+    lax.scan over a batch-index permutation with the uint8 dataset
+    device-resident; each scan step microbatches its batch and runs the
+    GPipe schedule.
+
+    epoch_fn(state, images_u8, labels_i32, perm) -> (state, metric_sums);
+    perm (nsteps, local_batch) with the batch dim sharded over 'data'
+    (dp.dp_shard_perm places it); local_batch must be a multiple of
+    num_microbatches.
+    """
+    from ..data.pipeline import PIXEL_SCALE
+
+    has_data = DATA_AXIS in mesh.axis_names
+    step = _make_step_body(plan, optimizer, has_data)
+    M = num_microbatches
+
+    def epoch(state: TrainState, images, labels, perm):
+        def body(state, idx):
+            x = images[idx].astype(jnp.float32) / jnp.float32(PIXEL_SCALE)
+            y = jax.nn.one_hot(labels[idx], num_classes, dtype=jnp.float32)
+            x_mb = x.reshape((M, -1) + x.shape[1:])
+            y_mb = y.reshape((M, -1) + y.shape[1:])
+            return step(state, x_mb, y_mb)
+
+        state, metrics = jax.lax.scan(body, state, perm)
+        return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+
+    specs = _state_specs(state, plan.n_stages)
+    perm_spec = P(None, DATA_AXIS) if has_data else P(None)
+    sharded = jax.shard_map(
+        epoch,
+        mesh=mesh,
+        in_specs=(specs, P(), P(), perm_spec),
         out_specs=(specs, P()),
         check_vma=False,
     )

@@ -31,7 +31,16 @@ from ..parallel.dp import (
     make_dp_train_step,
     replicate,
 )
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, make_mesh
+from ..parallel.pp import (
+    make_pipeline_plan,
+    make_pp_forward,
+    make_pp_scan_epoch,
+    make_pp_state,
+    make_pp_train_step,
+    microbatch,
+    pp_shard_batch,
+)
 from ..parallel.tp import (
     make_tp_eval_step,
     make_tp_scan_epoch,
@@ -132,7 +141,35 @@ class Trainer:
             params, x, backend=backend, compute_dtype=compute_dtype
         )
         self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
-        if self.n_model > 1:
+        self.n_pipe = self.mesh.shape.get(PIPE_AXIS, 1)
+        self._pp_M = 1  # microbatches per step; >1 only on the PP path
+        if self.n_pipe > 1 and self.n_model > 1:
+            raise ValueError(
+                "mesh combines 'pipe' and 'model' axes; TP x PP is not "
+                "supported — use pipe+data or model+data"
+            )
+        if self.n_pipe > 1:
+            # Pipeline(+data) parallel: stage-sharded params, GPipe
+            # microbatch schedule (parallel/pp.py). Beyond the reference,
+            # which runs layers sequentially in one process (cnn.c:255-267).
+            self._pp_M = config.num_microbatches or self.n_pipe
+            if config.batch_size % (self._pp_M * n_data):
+                raise ValueError(
+                    f"batch_size {config.batch_size} not divisible by "
+                    f"num_microbatches x data-axis ({self._pp_M} x {n_data})"
+                )
+            self._pp_plan = make_pipeline_plan(
+                model, self.n_pipe, backend=backend
+            )
+            self.state = make_pp_state(
+                self._pp_plan, params, self.optimizer, self.mesh
+            )
+            self.train_step = make_pp_train_step(
+                self._pp_plan, self.optimizer, self.mesh, self.state,
+                donate=config.donate,
+            )
+            self.eval_step = make_pp_forward(self._pp_plan, self.mesh)
+        elif self.n_model > 1:
             # Tensor(+data) parallel: GSPMD path — params sharded on the
             # 'model' axis, plain jitted step, XLA inserts the collectives
             # (parallel/tp.py). The reference has no TP at all (SURVEY.md
@@ -158,7 +195,9 @@ class Trainer:
         self._scan_epoch_fn = None
         self._dev_images = None
         self._dev_labels = None
-        self._eval_batch = self._pick_eval_batch(len(self.test_x), n_data)
+        self._eval_batch = self._pick_eval_batch(
+            len(self.test_x), n_data * self._pp_M
+        )
         # One shuffle stream for the whole run, shared by every entry point
         # (train(), run_epoch() via the C ABI) so batch order is identical
         # regardless of which driver runs the loop.
@@ -171,10 +210,21 @@ class Trainer:
             )
 
     @staticmethod
-    def _pick_eval_batch(ntest: int, n_data: int, target: int = 2048) -> int:
+    def _pick_eval_batch(ntest: int, granularity: int, target: int = 2048) -> int:
+        """Largest eval batch <= target divisible by `granularity` (the
+        data-axis size, times the microbatch count on the PP path)."""
         b = min(target, ntest)
-        b -= b % n_data
-        return max(b, n_data)
+        b -= b % granularity
+        return max(b, granularity)
+
+    def _place_batch(self, bx, by):
+        """Put one host batch on the mesh in the layout the active train
+        step expects: (M, mb, ...) microbatches for PP, a flat sharded
+        batch otherwise."""
+        bx, by = jnp.asarray(bx), jnp.asarray(by)
+        if self.n_pipe > 1:
+            return pp_shard_batch(microbatch(bx, by, self._pp_M), self.mesh)
+        return dp_shard_batch((bx, by), self.mesh)
 
     @property
     def train_x(self):
@@ -219,7 +269,7 @@ class Trainer:
         for bx, by in epoch_batches(
             self.train_x, self.train_y, cfg.batch_size, rng=self._rng
         ):
-            batch = dp_shard_batch((jnp.asarray(bx), jnp.asarray(by)), self.mesh)
+            batch = self._place_batch(bx, by)
             self.state, m = self.train_step(self.state, *batch)
             running = m if running is None else jax.tree.map(jnp.add, running, m)
             nsteps += 1
@@ -260,7 +310,12 @@ class Trainer:
         self._dev_labels = replicate(
             jnp.asarray(self.ds.train_labels, jnp.int32), self.mesh
         )
-        if self.n_model > 1:
+        if self.n_pipe > 1:
+            self._scan_epoch_fn = make_pp_scan_epoch(
+                self._pp_plan, self.optimizer, self.mesh, self.state,
+                self.ds.num_classes, self._pp_M, donate=self.cfg.donate,
+            )
+        elif self.n_model > 1:
             self._scan_epoch_fn = make_tp_scan_epoch(
                 self.loss_fn, self.optimizer, self.ds.num_classes,
                 donate=self.cfg.donate,
@@ -391,7 +446,10 @@ class Trainer:
         Returns (ntests, ncorrect). Pads the tail batch; padding rows are
         excluded from the count."""
         if params is None:
-            params = self.state["params"]
+            params = (
+                self.state["flat_params"] if self.n_pipe > 1
+                else self.state["params"]
+            )
         n = len(self.test_x)
         b = self._eval_batch
         ncorrect = 0
@@ -401,8 +459,16 @@ class Trainer:
             if valid < b:
                 pad = np.zeros((b - valid, *chunk.shape[1:]), chunk.dtype)
                 chunk = np.concatenate([chunk, pad])
-            x = dp_shard_batch(jnp.asarray(chunk), self.mesh)
-            logits = jax.device_get(self.eval_step(params, x))
+            if self.n_pipe > 1:
+                x_mb = jnp.asarray(chunk).reshape(
+                    (self._pp_M, -1) + chunk.shape[1:]
+                )
+                logits = jax.device_get(
+                    self.eval_step(params, pp_shard_batch(x_mb, self.mesh))
+                ).reshape(b, -1)
+            else:
+                x = dp_shard_batch(jnp.asarray(chunk), self.mesh)
+                logits = jax.device_get(self.eval_step(params, x))
             pred = np.argmax(logits[:valid], axis=-1)
             ncorrect += int((pred == self.test_labels[start : start + valid]).sum())
         return n, ncorrect

@@ -44,7 +44,10 @@ class Config:
     # Execution.
     device: str = "auto"          # auto | tpu | cpu
     num_devices: int = 0          # 0 = all visible; N = DP over first N
-    mesh_shape: str = "data"      # named mesh axes, e.g. "data" or "data:4,model:2"
+    mesh_shape: str = "data"      # named mesh axes: "data", "data:4,model:2",
+                                  # "pipe:4", "pipe:2,data:2", ...
+    num_microbatches: int = 0     # pipeline microbatches per step; 0 = auto
+                                  # (= pipe-axis size when PP is active)
     use_pallas: bool = False      # Pallas kernels instead of lax ops
     donate: bool = True
     scan: bool = True             # many-steps-per-dispatch epochs (lax.scan
