@@ -19,12 +19,13 @@ Prints exactly one JSON line on stdout.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 REFERENCE_EPOCH_S = 99.0  # BASELINE.md: serial C, ~1.65 ms/sample x 60k
 
 
-def main() -> None:
+def _run() -> None:
     from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
     from mpi_cuda_cnn_tpu.models.presets import get_model
     from mpi_cuda_cnn_tpu.train.trainer import Trainer
@@ -56,6 +57,23 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": round(REFERENCE_EPOCH_S / epoch_s, 2),
     }))
+
+
+def main() -> None:
+    # The TPU tunnel in this environment occasionally drops a remote-compile
+    # RPC mid-body (jaxlib surfaces it as a generic runtime error, so the
+    # except is deliberately broad); a retry re-hits the compile cache and
+    # succeeds. Deterministic failures cost two extra runs, then propagate.
+    attempts = 3
+    for attempt in range(1, attempts + 1):
+        try:
+            _run()
+            return
+        except Exception as exc:  # noqa: BLE001
+            if attempt == attempts:
+                raise
+            print(f"bench attempt {attempt} failed: {exc!r}", file=sys.stderr)
+            time.sleep(5.0)
 
 
 if __name__ == "__main__":

@@ -168,6 +168,73 @@ class Flatten:
 
 
 @dataclasses.dataclass(frozen=True)
+class Residual:
+    """Residual block: y = act(body(x) + shortcut(x)).
+
+    Beyond the reference (its model topology is a doubly-linked list,
+    cnn.c:15-43, which can only express straight-line stacks); included so
+    the preset registry covers a modern conv family. The shortcut is the
+    identity when the body preserves shape, otherwise a 1x1 strided
+    projection conv (He et al. option B). The body's last layer should have
+    activation=None — the block activation applies after the add.
+    """
+
+    body: tuple
+    activation: str | None = "relu"
+
+    def init(self, key, in_shape, initializer, dtype=jnp.float32):
+        keys = jax.random.split(key, len(self.body) + 1)
+        body_params = []
+        shape = in_shape
+        for layer, k in zip(self.body, keys[:-1]):
+            p, shape = layer.init(k, shape, initializer, dtype)
+            body_params.append(p)
+        params: dict[str, Any] = {"body": body_params}
+        if shape != in_shape:
+            stride = self._proj_stride(in_shape, shape)
+            proj = Conv(shape[-1], kernel=1, stride=stride, padding=0,
+                        activation=None)
+            params["proj"], _ = proj.init(keys[-1], in_shape, initializer, dtype)
+        return params, shape
+
+    @staticmethod
+    def _proj_stride(in_shape, out_shape) -> int:
+        """Stride s such that a 1x1 VALID conv maps (h,w) -> (oh,ow), i.e.
+        (h-1)//s+1 == oh for both dims; odd dims (7 -> 4 at s=2) included."""
+        h, w, _ = in_shape
+        oh, ow, _ = out_shape
+        for s in range(1, h + 1):
+            if (h - 1) // s + 1 == oh and (w - 1) // s + 1 == ow:
+                return s
+        raise ValueError(
+            f"Residual body maps {in_shape} -> {out_shape}, which a 1x1 "
+            "strided projection cannot match"
+        )
+
+    def apply(self, params, x, backend="xla"):
+        y = x
+        for layer, p in zip(self.body, params["body"]):
+            y = layer.apply(p, y, backend=backend)
+        if "proj" in params:
+            stride = self._proj_stride(x.shape[1:], y.shape[1:])
+            proj = Conv(y.shape[-1], kernel=1, stride=stride, padding=0,
+                        activation=None)
+            x = proj.apply(params["proj"], x, backend=backend)
+        return _apply_activation(self.activation, y + x)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalAvgPool:
+    """Spatial global average -> (N, C). Standard ResNet head."""
+
+    def init(self, key, in_shape, initializer, dtype=jnp.float32):
+        return {}, (in_shape[-1],)
+
+    def apply(self, params, x, backend="xla"):
+        return x.mean(axis=(1, 2))
+
+
+@dataclasses.dataclass(frozen=True)
 class Sequential:
     """A feed-forward stack — the functional twin of the reference's linked
     list walked by Layer_setInputs (forward, cnn.c:249-268) and

@@ -8,11 +8,22 @@
   route (SURVEY.md §7 hard-part (f)).
 - cifar3conv: the 3-conv-layer CIFAR-10 config.
 - vgg_small: VGG-style conv blocks on CIFAR-10 (stress conv kernels).
+- resnet8: small CIFAR-10 ResNet — beyond BASELINE.json; exercises the
+  non-sequential (Residual) topology path.
 """
 
 from __future__ import annotations
 
-from .layers import AvgPool, Conv, Dense, Flatten, MaxPool, Sequential
+from .layers import (
+    AvgPool,
+    Conv,
+    Dense,
+    Flatten,
+    GlobalAvgPool,
+    MaxPool,
+    Residual,
+    Sequential,
+)
 
 MNIST_SHAPE = (28, 28, 1)
 CIFAR_SHAPE = (32, 32, 3)
@@ -106,12 +117,42 @@ def vgg_small() -> Sequential:
     )
 
 
+def resnet8() -> Sequential:
+    """8-layer CIFAR-10 ResNet (3 residual stages over a conv stem).
+
+    A second conv model family beyond the reference's straight-line nets —
+    exercises the non-sequential topology path (Residual/GlobalAvgPool).
+    """
+
+    def block(c, stride=1):
+        return Residual(
+            body=(
+                Conv(c, kernel=3, stride=stride, padding=1, activation="relu"),
+                Conv(c, kernel=3, stride=1, padding=1, activation=None),
+            ),
+        )
+
+    return Sequential(
+        name="resnet8",
+        input_shape=CIFAR_SHAPE,
+        layers=(
+            Conv(16, kernel=3, padding=1, activation="relu"),
+            block(16),
+            block(32, stride=2),
+            block(64, stride=2),
+            GlobalAvgPool(),
+            Dense(10, activation=None),
+        ),
+    )
+
+
 MODEL_PRESETS = {
     "reference_cnn": reference_cnn,
     "lenet5": lenet5,
     "lenet5_relu": lenet5_relu,
     "cifar3conv": cifar3conv,
     "vgg_small": vgg_small,
+    "resnet8": resnet8,
 }
 
 

@@ -106,9 +106,13 @@ class PipelinePlan:
     a_max: int  # flat per-sample activation width crossing any stage boundary
     p_max: int  # padded per-stage flat param length
     backend: str = "xla"
+    compute_dtype: Any = None  # per-stage compute cast (e.g. bf16); master
+    #   params and the ppermute activation/param buffers stay f32
 
 
-def make_pipeline_plan(model, n_stages: int, *, backend: str = "xla") -> PipelinePlan:
+def make_pipeline_plan(
+    model, n_stages: int, *, backend: str = "xla", compute_dtype=None
+) -> PipelinePlan:
     """Split `model` (a Sequential) into n_stages balanced stages."""
     key = jax.random.key(0)
     shape = model.input_shape
@@ -146,6 +150,7 @@ def make_pipeline_plan(model, n_stages: int, *, backend: str = "xla") -> Pipelin
         a_max=max(boundary_widths),
         p_max=max(p_sizes) if p_sizes else 1,
         backend=backend,
+        compute_dtype=compute_dtype,
     )
 
 
@@ -197,9 +202,14 @@ def _stage_fns(plan: PipelinePlan, mb: int) -> list[Callable]:
         def fn(flat_p, flat_x, s=s, idxs=idxs, in_shape=in_shape, in_size=in_size):
             stage_params = _unpack_stage(plan, s, flat_p)
             x = flat_x[:, :in_size].reshape((mb,) + in_shape)
+            if plan.compute_dtype is not None:
+                x = x.astype(plan.compute_dtype)
+                stage_params = jax.tree.map(
+                    lambda p: p.astype(plan.compute_dtype), stage_params
+                )
             for i, p in zip(idxs, stage_params):
                 x = plan.model.layers[i].apply(p, x, backend=plan.backend)
-            y = x.reshape(mb, -1)
+            y = x.reshape(mb, -1).astype(jnp.float32)
             return jnp.pad(y, ((0, 0), (0, plan.a_max - y.shape[1])))
 
         fns.append(fn)
@@ -401,11 +411,10 @@ def make_pp_scan_epoch(
         return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
 
     specs = _state_specs(state, plan.n_stages)
-    perm_spec = P(None, DATA_AXIS) if has_data else P(None)
     sharded = jax.shard_map(
         epoch,
         mesh=mesh,
-        in_specs=(specs, P(), P(), perm_spec),
+        in_specs=(specs, P(), P(), _batch_spec(mesh)),
         out_specs=(specs, P()),
         check_vma=False,
     )

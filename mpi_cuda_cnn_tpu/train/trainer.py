@@ -148,10 +148,21 @@ class Trainer:
                 "mesh combines 'pipe' and 'model' axes; TP x PP is not "
                 "supported — use pipe+data or model+data"
             )
+        if self.n_pipe == 1 and config.num_microbatches:
+            raise ValueError(
+                "--num-microbatches requires a 'pipe' mesh axis "
+                f"(mesh_shape={config.mesh_shape!r} has none)"
+            )
         if self.n_pipe > 1:
             # Pipeline(+data) parallel: stage-sharded params, GPipe
             # microbatch schedule (parallel/pp.py). Beyond the reference,
             # which runs layers sequentially in one process (cnn.c:255-267).
+            if param_dtype != jnp.float32:
+                raise ValueError(
+                    "pipeline parallelism keeps master params in the packed "
+                    "f32 stage buffers; use --compute-dtype for low-precision "
+                    f"compute (got param_dtype={config.param_dtype})"
+                )
             self._pp_M = config.num_microbatches or self.n_pipe
             if config.batch_size % (self._pp_M * n_data):
                 raise ValueError(
@@ -159,7 +170,7 @@ class Trainer:
                     f"num_microbatches x data-axis ({self._pp_M} x {n_data})"
                 )
             self._pp_plan = make_pipeline_plan(
-                model, self.n_pipe, backend=backend
+                model, self.n_pipe, backend=backend, compute_dtype=compute_dtype
             )
             self.state = make_pp_state(
                 self._pp_plan, params, self.optimizer, self.mesh

@@ -108,6 +108,41 @@ def test_dp_loss_decreases(eight_devices):
     assert losses[-1] < losses[0]
 
 
+def test_dp_composes_with_pallas_backend(eight_devices):
+    """Device kernels + data parallelism together — the capability the
+    reference's CUDA+MPI variant aimed at and never reached (it does not
+    compile: SURVEY.md §0 table, 2.15). Pallas kernels inside the
+    shard_map-ed DP step must match the XLA-oracle DP step."""
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    optimizer = make_optimizer(0.1)
+
+    def fresh_state():
+        return replicate(
+            {"params": params, "opt_state": optimizer.init(params),
+             "step": jnp.zeros((), jnp.int32)},
+            mesh,
+        )
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.random((8, 28, 28, 1), np.float32))
+    y = np.zeros((8, 10), np.float32)
+    y[np.arange(8), rng.integers(0, 10, 8)] = 1
+    batch = dp_shard_batch((x, jnp.asarray(y)), mesh)
+
+    step_p = make_dp_train_step(
+        make_loss_fn(model, backend="pallas"), optimizer, mesh, donate=False
+    )
+    step_o = make_dp_train_step(make_loss_fn(model), optimizer, mesh, donate=False)
+    sp, mp = step_p(fresh_state(), *batch)
+    so, mo = step_o(fresh_state(), *batch)
+
+    np.testing.assert_allclose(float(mp["loss"]), float(mo["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(sp["params"]), jax.tree.leaves(so["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
 def test_uneven_batch_rejected(eight_devices):
     """batch not divisible by data axis must fail loudly, not silently
     mis-shard (the reference silently truncates its shard bounds,

@@ -80,3 +80,72 @@ def test_pooling_shapes():
     params = model.init(jax.random.key(0), get_initializer("he"))
     x = jnp.ones((3, 28, 28, 1))
     assert model.apply(params, x).shape == (3, 10)
+
+
+def test_residual_identity_vs_projection():
+    """Shape-preserving blocks get an identity shortcut (no proj params);
+    downsampling blocks get a 1x1 strided projection."""
+    from mpi_cuda_cnn_tpu.models.layers import Conv, Residual
+
+    same = Residual(body=(Conv(8, kernel=3, padding=1, activation="relu"),
+                          Conv(8, kernel=3, padding=1, activation=None)))
+    p, out = same.init(jax.random.key(0), (16, 16, 8), get_initializer("he"))
+    assert out == (16, 16, 8)
+    assert "proj" not in p
+
+    down = Residual(body=(Conv(16, kernel=3, stride=2, padding=1, activation="relu"),
+                          Conv(16, kernel=3, padding=1, activation=None)))
+    p, out = down.init(jax.random.key(0), (16, 16, 8), get_initializer("he"))
+    assert out == (8, 8, 16)
+    assert p["proj"]["w"].shape == (1, 1, 8, 16)
+
+    x = jnp.ones((2, 16, 16, 8))
+    assert down.apply(p, x).shape == (2, 8, 8, 16)
+
+
+def test_residual_odd_spatial_downsample():
+    """Stride-2 on odd dims (7 -> 4): the projection stride is solved from
+    (h-1)//s+1 == oh, not h//oh."""
+    from mpi_cuda_cnn_tpu.models.layers import Conv, Residual
+
+    blk = Residual(body=(Conv(16, kernel=3, stride=2, padding=1, activation="relu"),
+                         Conv(16, kernel=3, padding=1, activation=None)))
+    p, out = blk.init(jax.random.key(0), (7, 7, 8), get_initializer("he"))
+    assert out == (4, 4, 16)
+    x = jnp.ones((2, 7, 7, 8))
+    assert blk.apply(p, x).shape == (2, 4, 4, 16)
+
+
+def test_residual_gradients_flow_through_shortcut():
+    """Both branches must receive gradient — the add couples them."""
+    model = get_model("resnet8")
+    params = model.init(jax.random.key(0), get_initializer("he"))
+    x = jnp.ones((2, 32, 32, 3))
+
+    def loss(p):
+        return jnp.sum(model.apply(p, x) ** 2)
+
+    grads = jax.grad(loss)(params)
+    leaves = jax.tree.leaves(grads)
+    assert len(leaves) == len(jax.tree.leaves(params))
+    # every parameter (body convs AND projection shortcuts) gets signal
+    assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+    assert sum(float(jnp.abs(g).sum()) > 0 for g in leaves) == len(leaves)
+
+
+def test_residual_downsample_to_1x1():
+    """Stride may equal the full spatial extent (body shrinking to 1x1)."""
+    from mpi_cuda_cnn_tpu.models.layers import Conv, Residual
+
+    blk = Residual(body=(Conv(16, kernel=4, stride=4, padding=0, activation=None),))
+    p, out = blk.init(jax.random.key(0), (4, 4, 8), get_initializer("he"))
+    assert out == (1, 1, 16)
+    assert blk.apply(p, jnp.ones((2, 4, 4, 8))).shape == (2, 1, 1, 16)
+
+
+def test_residual_unprojectable_shape_rejected():
+    from mpi_cuda_cnn_tpu.models.layers import Conv, Residual
+
+    bad = Residual(body=(Conv(8, kernel=3, padding=0, activation=None),))  # 16->14
+    with pytest.raises(ValueError, match="projection"):
+        bad.init(jax.random.key(0), (16, 16, 8), get_initializer("he"))

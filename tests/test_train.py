@@ -129,6 +129,31 @@ def test_pp_trainer_end_to_end(ds, eight_devices):
     assert r.final_step == 3 * (512 // 32)
 
 
+def test_pp_bfloat16_training(ds, eight_devices):
+    """--compute-dtype bfloat16 reaches the PP stage fns (the plan carries
+    the cast; master params and ppermute buffers stay f32) and still
+    converges."""
+    cfg = Config(model="lenet5", init="he", epochs=3, eval_every=0,
+                 log_every=10**9, mesh_shape="pipe:2", num_devices=2,
+                 compute_dtype="bfloat16")
+    t = Trainer(get_model("lenet5"), ds, cfg, metrics=_quiet())
+    assert t._pp_plan.compute_dtype is not None
+    assert t.train().test_accuracy >= 0.9
+
+
+def test_pp_rejects_bfloat16_params(ds):
+    cfg = Config(model="lenet5", init="he", param_dtype="bfloat16",
+                 mesh_shape="pipe:2", num_devices=2, eval_every=0)
+    with pytest.raises(ValueError, match="master params"):
+        Trainer(get_model("lenet5"), ds, cfg, metrics=_quiet())
+
+
+def test_microbatches_require_pipe_axis(ds):
+    cfg = Config(num_microbatches=4, num_devices=1, eval_every=0)
+    with pytest.raises(ValueError, match="pipe"):
+        Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
+
+
 def test_pp_trainer_matches_dp(ds):
     """PP is a schedule, not different math: same seed/config under
     pipe:2 and plain DP produce near-identical final params."""
