@@ -121,37 +121,77 @@ dense_pallas.defvjp(_dense_fwd, _dense_bwd)
 # ---------------------------------------------------------------------------
 
 
+def _flatten_pixels(xs, m, cin):
+    """(BN, OH, OW, Cin) window slice -> (BN*OH*OW, Cin) matmul operand.
+
+    Packed dtypes (bf16) can't reshape across the sublane dim directly —
+    Mosaic rejects e.g. vector<8x7x7x16xbf16> -> vector<392x16xbf16> — so
+    the reshape goes through f32 (lossless for bf16) and casts back for
+    the MXU."""
+    if xs.dtype == jnp.float32:
+        return xs.reshape(m, cin)
+    return xs.astype(jnp.float32).reshape(m, cin).astype(xs.dtype)
+
+
 def _conv1_kernel(x_ref, w_ref, o_ref, acc_ref, *, kh, kw, oh, ow):
     """One batch-tile of stride-1 valid direct conv.
 
     x_ref: (BN, Hp, Wp, Cin) block in VMEM, Hp >= oh+kh-1, Wp >= ow+kw-1.
-    w_ref: (kh*kw*Cin, Cout) flattened kernel.
+    w_ref: (kh, kw, Cin, Cout) kernel.
     o_ref: (BN, OH, OW, Cout).
-    For each static kernel offset (ky, kx): unit-stride window slice,
-    flatten pixels, accumulate an MXU contraction — the same arithmetic as
-    the CUDA kernel's per-thread triple loop (CUDAcnn.cu:179-191), phrased
-    as (BN*OH*OW, Cin) @ (Cin, Cout) matmuls.
+    For each kernel offset (ky, kx): unit-stride window slice, flatten
+    pixels, accumulate an MXU contraction — the same arithmetic as the
+    CUDA kernel's per-thread triple loop (CUDAcnn.cu:179-191), phrased as
+    (BN*OH*OW, Cin) @ (Cin, Cout) matmuls.
+
+    Index discipline: ky advances via fori_loop — a dynamic offset, legal
+    because H is an untiled dim (so is w's kh) — while kx is a static
+    Python unroll: dim 2 is the sublane dim, where Mosaic cannot prove
+    alignment of dynamic offsets for packed dtypes (bf16's (16, 128)
+    tiling). The loop also keeps at most kw window slices live at a time;
+    with small cin the lane-padded slices are large, and unrolling all
+    kh*kw of them overflows VMEM.
     """
     bn = x_ref.shape[0]
     cin = x_ref.shape[3]
     acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # fori_loop (not a Python unroll) so only ONE window slice is live at a
-    # time — with small cin the lane-padded slices are large, and unrolling
-    # kh*kw of them overflows VMEM.
-    def body(idx, _):
-        ky, kx = idx // kw, idx % kw
-        xs = x_ref[:, pl.ds(ky, oh), pl.ds(kx, ow), :].reshape(bn * oh * ow, cin)
-        wk = w_ref[pl.ds(idx * cin, cin), :]
-        acc_ref[:] += jnp.dot(xs, wk, preferred_element_type=jnp.float32)
+    def body(ky, _):
+        for kx in range(kw):
+            xs = _flatten_pixels(
+                x_ref[:, pl.ds(ky, oh), kx : kx + ow, :], bn * oh * ow, cin
+            )
+            acc_ref[:] += jnp.dot(
+                xs, w_ref[ky, kx], preferred_element_type=jnp.float32
+            )
         return 0
 
-    jax.lax.fori_loop(0, kh * kw, body, 0)
+    jax.lax.fori_loop(0, kh, body, 0)
     o_ref[:] = acc_ref[:].reshape(o_ref.shape).astype(o_ref.dtype)
 
 
-def _pick_batch_tile(n, hp, wp, cin, oh, ow, cout, budget=6 * 2**20) -> int:
-    per_sample = 4 * (hp * wp * cin + 2 * oh * ow * cout)
+def _pick_batch_tile(
+    n, hp, wp, cin, oh, ow, cout, kw, itemsize, budget=8 * 2**20
+) -> int:
+    """Largest batch tile whose VMEM working set fits the scoped limit.
+
+    Counts what actually occupies VMEM, with the (8, 128)
+    sublane/lane padding Mosaic stores blocks with: the x and out blocks,
+    up to kw+1 live f32 window slices (_flatten_pixels round-trips packed
+    dtypes through f32, and the kx unroll keeps kw slices in flight), and
+    the f32 accumulator. The naive 4*elements estimate under-counted
+    lane padding ~8x for small channel counts and OOM'd the 16M scoped
+    vmem on the bf16 backward."""
+    lane = lambda c: -(-c // 128) * 128
+    # Packed dtypes tile (16, 128), f32 (8, 128); >=4-byte dtypes all (8, 128).
+    s_mult = 8 * max(4 // itemsize, 1)
+    sub = lambda s: -(-s // s_mult) * s_mult
+    per_sample = (
+        hp * sub(wp) * lane(cin) * itemsize        # x block
+        + (kw + 1) * oh * ow * lane(cin) * 4       # live window slices (f32)
+        + oh * ow * lane(cout) * 4                 # f32 accumulator
+        + oh * sub(ow) * lane(cout) * itemsize     # out / cotangent block
+    )
     bn = max(1, min(n, budget // max(per_sample, 1)))
     while n % bn:
         bn -= 1
@@ -162,8 +202,7 @@ def _conv1(x: jnp.ndarray, w: jnp.ndarray, oh: int, ow: int) -> jnp.ndarray:
     """Stride-1 valid conv via the Pallas kernel; x is already padded."""
     n, hp, wp, cin = x.shape
     kh, kw, _, cout = w.shape
-    bn = _pick_batch_tile(n, hp, wp, cin, oh, ow, cout)
-    wf = w.reshape(kh * kw * cin, cout)
+    bn = _pick_batch_tile(n, hp, wp, cin, oh, ow, cout, kw, x.dtype.itemsize)
     kernel = functools.partial(_conv1_kernel, kh=kh, kw=kw, oh=oh, ow=ow)
     return pl.pallas_call(
         kernel,
@@ -173,7 +212,9 @@ def _conv1(x: jnp.ndarray, w: jnp.ndarray, oh: int, ow: int) -> jnp.ndarray:
                 (bn, hp, wp, cin), lambda i: (i, 0, 0, 0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (kh * kw * cin, cout), lambda i: (0, 0), memory_space=pltpu.VMEM
+                (kh, kw, cin, cout),
+                lambda i: (0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
@@ -182,7 +223,7 @@ def _conv1(x: jnp.ndarray, w: jnp.ndarray, oh: int, ow: int) -> jnp.ndarray:
         out_shape=jax.ShapeDtypeStruct((n, oh, ow, cout), x.dtype),
         scratch_shapes=[pltpu.VMEM((bn * oh * ow, cout), jnp.float32)],
         interpret=_interpret(),
-    )(x, wf)
+    )(x, w)
 
 
 def _phases(xp, w, stride):
@@ -224,7 +265,8 @@ def _conv1_dw_kernel(x_ref, g_ref, dw_ref, *, kh, kw, oh, ow):
     """d(kernel) of a stride-1 valid conv for one batch tile, accumulated
     across the sequential grid: dw[ky,kx] = x_window^T @ g over all pixels —
     the Pallas twin of the reference's u_weights accumulation
-    (cnn.c:238-242)."""
+    (cnn.c:238-242). Same index discipline as _conv1_kernel: dynamic ky on
+    untiled dims, static kx on the sublane dim."""
     i = pl.program_id(0)
     bn = x_ref.shape[0]
     cin = x_ref.shape[3]
@@ -234,24 +276,26 @@ def _conv1_dw_kernel(x_ref, g_ref, dw_ref, *, kh, kw, oh, ow):
     def _():
         dw_ref[:] = jnp.zeros_like(dw_ref)
 
-    gf = g_ref[:].reshape(bn * oh * ow, cout)
+    gf = _flatten_pixels(g_ref[:], bn * oh * ow, cout)
 
-    def body(idx, _):
-        ky, kx = idx // kw, idx % kw
-        xs = x_ref[:, pl.ds(ky, oh), pl.ds(kx, ow), :].reshape(bn * oh * ow, cin)
-        dw_ref[idx, :, :] += jnp.dot(
-            xs.T, gf, preferred_element_type=jnp.float32
-        ).astype(dw_ref.dtype)
+    def body(ky, _):
+        for kx in range(kw):
+            xs = _flatten_pixels(
+                x_ref[:, pl.ds(ky, oh), kx : kx + ow, :], bn * oh * ow, cin
+            )
+            dw_ref[ky, kx] += jnp.dot(
+                xs.T, gf, preferred_element_type=jnp.float32
+            ).astype(dw_ref.dtype)
         return 0
 
-    jax.lax.fori_loop(0, kh * kw, body, 0)
+    jax.lax.fori_loop(0, kh, body, 0)
 
 
 def _conv1_dw(x, g, kh: int, kw: int):
     """dw for a stride-1 valid conv; x already padded/cropped to match g."""
     n, hp, wp, cin = x.shape
     _, oh, ow, cout = g.shape
-    bn = _pick_batch_tile(n, hp, wp, cin, oh, ow, cout)
+    bn = _pick_batch_tile(n, hp, wp, cin, oh, ow, cout, kw, x.dtype.itemsize)
     kernel = functools.partial(_conv1_dw_kernel, kh=kh, kw=kw, oh=oh, ow=ow)
     dw = pl.pallas_call(
         kernel,
@@ -265,12 +309,14 @@ def _conv1_dw(x, g, kh: int, kw: int):
             ),
         ],
         out_specs=pl.BlockSpec(
-            (kh * kw, cin, cout), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
+            (kh, kw, cin, cout),
+            lambda i: (0, 0, 0, 0),
+            memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((kh * kw, cin, cout), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((kh, kw, cin, cout), jnp.float32),
         interpret=_interpret(),
     )(x, g)
-    return dw.reshape(kh, kw, cin, cout)
+    return dw
 
 
 def _conv_dw(x, g, stride: int, padding: int, kh: int, kw: int):

@@ -87,6 +87,54 @@ def test_conv_grad_parity(n, h, w, cin, k, cout, stride, pad):
 
 
 # ---------------------------------------------------------------------------
+# bf16: the packed-dtype path (16,128) tiling — regression for the Mosaic
+# alignment failure the f32-only suite missed (dynamic sublane offsets and
+# packed-vector reshapes are illegal on real TPUs; the kernels must route
+# around both). Interpreter mode can't prove alignment, but it does pin the
+# numerics of the exact code path the TPU compiles.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,h,w,cin,k,cout,stride,pad", CONV_CASES)
+def test_conv_bf16_parity(n, h, w, cin, k, cout, stride, pad):
+    x = _rand(n, h, w, cin).astype(jnp.bfloat16)
+    wk = (_rand(k, k, cin, cout, seed=1) * 0.1).astype(jnp.bfloat16)
+
+    got = conv2d_pallas(x, wk, stride, pad)
+    want = conv2d(x, wk, stride=stride, padding=pad)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+    def loss_p(x, wk):
+        return jnp.sum(conv2d_pallas(x, wk, stride, pad).astype(jnp.float32) ** 2)
+
+    def loss_o(x, wk):
+        return jnp.sum(conv2d(x, wk, stride=stride, padding=pad).astype(jnp.float32) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1))(x, wk)
+    go = jax.grad(loss_o, argnums=(0, 1))(x, wk)
+    for a, b in zip(gp, go):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        scale = max(np.abs(b).max(), 1.0)
+        assert np.abs(a - b).max() / scale < 2e-2  # bf16 rounding band
+
+
+def test_dense_bf16_parity():
+    x = _rand(16, 64).astype(jnp.bfloat16)
+    w = (_rand(64, 10, seed=1) * 0.1).astype(jnp.bfloat16)
+    b = _rand(10, seed=2).astype(jnp.bfloat16)
+    got = dense_pallas(x, w, b)
+    want = dense(x, w, b)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+# ---------------------------------------------------------------------------
 # End to end through the model API
 # ---------------------------------------------------------------------------
 
