@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_serial test_dp8 test_tpu bench native test_native get_mnist clean
+.PHONY: test test_serial test_dp8 test_tpu bench northstar native test_native get_mnist clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -41,6 +41,19 @@ test_tpu:
 
 bench:
 	$(PY) bench.py
+
+# North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
+# accuracy — he init, momentum, cosine decay, random-shift augmentation.
+# Trains on real MNIST when $(DATA_DIR) holds the IDX files (make
+# get_mnist; needs network), synthetic stripes otherwise.
+northstar:
+	$(PY) -m mpi_cuda_cnn_tpu \
+	  $(if $(wildcard $(DATA_DIR)/train-images-idx3-ubyte),\
+	  $(DATA_DIR)/train-images-idx3-ubyte $(DATA_DIR)/train-labels-idx1-ubyte \
+	  $(DATA_DIR)/t10k-images-idx3-ubyte $(DATA_DIR)/t10k-labels-idx1-ubyte,\
+	  --dataset synthetic) \
+	  --model lenet5_relu --init he --epochs 20 --batch-size 128 --lr 0.1 \
+	  --momentum 0.9 --lr-schedule cosine --augment shift --eval-every 5
 
 # Fetch MNIST as the four IDX files (twin of get_mnist, reference
 # Makefile:24-35). Requires network access.

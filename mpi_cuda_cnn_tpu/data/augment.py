@@ -1,0 +1,73 @@
+"""On-device data augmentation.
+
+The reference has no augmentation of any kind (its full input pipeline is
+normalize + one-hot, cnn.c:457-464). The north-star accuracy target
+(>=99% MNIST test accuracy, BASELINE.json) is out of reach for plain
+SGD on un-augmented MNIST at LeNet scale, so augmentation is a
+capability the benchmark implies; it is off by default (reference
+semantics) and enabled with --augment.
+
+Everything here is pure JAX on already-normalized float batches, designed
+to run INSIDE the jitted train step (including the scanned epoch): static
+shapes, per-sample PRNG keys, no host round-trip. The caller supplies one
+key per (step, device) — see parallel/dp.py — and per-sample keys are
+folded in here.
+
+Specs:
+  "none"        identity (the default; reference parity)
+  "shift"       random +/-pad-pixel translation with zero fill (the classic
+                MNIST augmentation)
+  "shift-flip"  shift + random horizontal flip (CIFAR-style; flipping
+                digits would hurt MNIST)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+AugmentFn = Callable[[jax.Array, jnp.ndarray], jnp.ndarray]
+
+SPECS = ("none", "shift", "shift-flip")
+
+
+def _shift_one(key: jax.Array, img: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Random translation of one (H, W, C) image by up to +/-pad pixels:
+    zero-pad then dynamic-crop at a random corner. Static output shape, so
+    it scans/jits cleanly."""
+    h, w, c = img.shape
+    padded = jnp.pad(img, ((pad, pad), (pad, pad), (0, 0)))
+    oy, ox = jax.random.randint(key, (2,), 0, 2 * pad + 1)
+    return jax.lax.dynamic_slice(padded, (oy, ox, 0), (h, w, c))
+
+
+def _flip_one(key: jax.Array, img: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(jax.random.bernoulli(key), img[:, ::-1, :], img)
+
+
+def make_augment(spec: str, *, pad: int = 2) -> AugmentFn | None:
+    """Build augment(key, x) for a batch x: (B, H, W, C) float.
+
+    Returns None for "none" so callers can skip the whole machinery (and
+    the per-step key derivation) when augmentation is off.
+    """
+    if spec == "none":
+        return None
+    if spec not in SPECS:
+        raise ValueError(f"unknown augment spec {spec!r}; one of {SPECS}")
+    with_flip = spec == "shift-flip"
+
+    def augment(key: jax.Array, x: jnp.ndarray) -> jnp.ndarray:
+        keys = jax.random.split(key, x.shape[0] * 2).reshape(x.shape[0], 2)
+
+        def one(kpair, img):
+            img = _shift_one(kpair[0], img, pad)
+            if with_flip:
+                img = _flip_one(kpair[1], img)
+            return img
+
+        return jax.vmap(one)(keys, x)
+
+    return augment

@@ -55,12 +55,24 @@ def dp_shard_perm(perm, mesh, axis: str = DATA_AXIS):
     return jax.device_put(perm, NamedSharding(mesh, spec))
 
 
-def _make_step_body(loss_fn: Callable, optimizer, axis: str):
+def _make_step_body(
+    loss_fn: Callable, optimizer, axis: str, augment=None, aug_seed: int = 0
+):
     """The per-step SPMD body shared by the one-batch step and the scanned
     epoch: local grads, ONE fused gradient all-reduce, identical update on
-    every device."""
+    every device.
+
+    `augment` (data/augment.py) runs on-device on the normalized shard,
+    keyed by (step, data-axis index) so every device and every step draws
+    independent transforms, and a resumed run (step restored from a
+    checkpoint) replays the same stream.
+    """
 
     def step(state: TrainState, x, y):
+        if augment is not None:
+            key = jax.random.fold_in(jax.random.key(aug_seed), state["step"])
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+            x = augment(key, x)
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], x, y
         )
@@ -89,6 +101,8 @@ def make_dp_train_step(
     *,
     axis: str = DATA_AXIS,
     donate: bool = True,
+    augment=None,
+    aug_seed: int = 0,
 ):
     """Build the jitted DP train step.
 
@@ -96,7 +110,7 @@ def make_dp_train_step(
     per-device shard inside shard_map. Returns step(state, x, y) ->
     (state, metrics) with state replicated and batches sharded on `axis`.
     """
-    step = _make_step_body(loss_fn, optimizer, axis)
+    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed)
 
     # check_vma=False: collective typing stays classic/explicit (local grads
     # until the pmean above). Also required for Pallas interpreter-mode
@@ -119,6 +133,8 @@ def make_dp_scan_epoch(
     *,
     axis: str = DATA_AXIS,
     donate: bool = True,
+    augment=None,
+    aug_seed: int = 0,
 ):
     """Build a jitted many-steps-per-dispatch trainer: the whole (chunk of
     an) epoch is ONE `lax.scan` over a batch-index permutation, with the raw
@@ -135,7 +151,7 @@ def make_dp_scan_epoch(
       perm:   (nsteps, batch) int32, batch dim sharded on `axis`.
       metric_sums: metrics summed over the scanned steps.
     """
-    step = _make_step_body(loss_fn, optimizer, axis)
+    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed)
 
     def epoch(state: TrainState, images, labels, perm):
         def body(state, idx):

@@ -114,6 +114,12 @@ class Trainer:
         backend = "pallas" if config.use_pallas else "xla"
         self.loss_fn = make_loss_fn(model, backend=backend, compute_dtype=compute_dtype)
 
+        from ..data.augment import make_augment
+
+        self._augment = make_augment(config.augment, pad=config.aug_pad)
+        # fold_in needs a distinct stream from param init; offset the seed.
+        self._aug_seed = config.seed + 0x5EED
+
         # Normalized host copies are built lazily (train_x/train_y
         # properties): the default scanned path stages raw uint8 on device
         # and never needs the float32 host materialization.
@@ -157,6 +163,12 @@ class Trainer:
             # Pipeline(+data) parallel: stage-sharded params, GPipe
             # microbatch schedule (parallel/pp.py). Beyond the reference,
             # which runs layers sequentially in one process (cnn.c:255-267).
+            if self._augment is not None:
+                raise ValueError(
+                    "--augment is not supported on the pipeline-parallel "
+                    "path (inputs are pre-microbatched); use a data/model "
+                    "mesh"
+                )
             if param_dtype != jnp.float32:
                 raise ValueError(
                     "pipeline parallelism keeps master params in the packed "
@@ -187,7 +199,8 @@ class Trainer:
             # §2 checklist).
             self.state = make_tp_state(model, params, self.optimizer, self.mesh)
             self.train_step = make_tp_train_step(
-                self.loss_fn, self.optimizer, donate=config.donate
+                self.loss_fn, self.optimizer, donate=config.donate,
+                augment=self._augment, aug_seed=self._aug_seed,
             )
             self.eval_step = make_tp_eval_step(predict)
         else:
@@ -198,7 +211,8 @@ class Trainer:
                 self.mesh,
             )
             self.train_step = make_dp_train_step(
-                self.loss_fn, self.optimizer, self.mesh, donate=config.donate
+                self.loss_fn, self.optimizer, self.mesh, donate=config.donate,
+                augment=self._augment, aug_seed=self._aug_seed,
             )
             self.eval_step = make_dp_eval_step(predict, self.mesh)
         # Scanned-epoch path: built lazily on first use (run_epoch), since
@@ -330,11 +344,13 @@ class Trainer:
             self._scan_epoch_fn = make_tp_scan_epoch(
                 self.loss_fn, self.optimizer, self.ds.num_classes,
                 donate=self.cfg.donate,
+                augment=self._augment, aug_seed=self._aug_seed,
             )
         else:
             self._scan_epoch_fn = make_dp_scan_epoch(
                 self.loss_fn, self.optimizer, self.mesh, self.ds.num_classes,
                 donate=self.cfg.donate,
+                augment=self._augment, aug_seed=self._aug_seed,
             )
 
     def _run_epoch_scanned(self, epoch: int) -> dict:

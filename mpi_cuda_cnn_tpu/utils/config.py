@@ -36,6 +36,9 @@ class Config:
     lr_schedule: str = "constant"  # constant | cosine
     seed: int = 0                 # cnn.c:413 srand(0)
     init: str = "normal"          # normal | irwin_hall (reference nrnd, cnn.c:46-49)
+    augment: str = "none"         # none | shift | shift-flip (data/augment.py;
+                                  # the reference has no augmentation)
+    aug_pad: int = 2              # max +/- pixels for the random shift
 
     # Numerics (SURVEY.md §7 hard-part (b)).
     param_dtype: str = "float32"

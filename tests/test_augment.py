@@ -1,0 +1,120 @@
+"""Augmentation (data/augment.py): unit semantics + trainer integration.
+
+The reference has no augmentation (its pipeline is normalize + one-hot,
+cnn.c:457-464); these tests cover the capability added for the north-star
+accuracy target."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.data.augment import SPECS, make_augment
+
+
+def _batch(n=4, h=8, w=8, c=1, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).random((n, h, w, c)).astype(np.float32)
+    )
+
+
+def test_none_returns_none():
+    assert make_augment("none") is None
+
+
+def test_unknown_spec_raises():
+    with pytest.raises(ValueError):
+        make_augment("cutmix")
+
+
+def test_shift_preserves_shape_dtype_and_is_deterministic():
+    aug = make_augment("shift", pad=2)
+    x = _batch()
+    key = jax.random.key(7)
+    y1, y2 = aug(key, x), aug(key, x)
+    assert y1.shape == x.shape and y1.dtype == x.dtype
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    y3 = aug(jax.random.key(8), x)
+    assert not np.array_equal(np.asarray(y1), np.asarray(y3))
+
+
+def test_shift_pad0_is_identity():
+    aug = make_augment("shift", pad=0)
+    x = _batch()
+    np.testing.assert_array_equal(np.asarray(aug(jax.random.key(0), x)), np.asarray(x))
+
+
+def test_shift_is_a_translation():
+    """Every augmented image must equal its source translated by some
+    (dy, dx) in [-pad, pad]^2 with zero fill."""
+    pad = 2
+    aug = make_augment("shift", pad=pad)
+    x = _batch(n=8)
+    y = np.asarray(aug(jax.random.key(3), x))
+    xp = np.pad(np.asarray(x), ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    h, w = x.shape[1], x.shape[2]
+    for i in range(x.shape[0]):
+        candidates = [
+            xp[i, oy : oy + h, ox : ox + w]
+            for oy in range(2 * pad + 1)
+            for ox in range(2 * pad + 1)
+        ]
+        assert any(np.array_equal(y[i], c) for c in candidates), f"image {i}"
+
+
+def test_flip_spec_flips_some_images():
+    aug = make_augment("shift-flip", pad=0)  # isolate the flip
+    x = _batch(n=64)
+    y = np.asarray(aug(jax.random.key(0), x))
+    xn = np.asarray(x)
+    flipped = sum(
+        np.array_equal(y[i], xn[i, :, ::-1, :]) and not np.array_equal(y[i], xn[i])
+        for i in range(64)
+    )
+    kept = sum(np.array_equal(y[i], xn[i]) for i in range(64))
+    assert flipped > 0 and kept > 0 and flipped + kept == 64
+
+
+@pytest.mark.parametrize("scan", [True, False])
+def test_trainer_with_augment_converges(scan):
+    from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.train.trainer import Trainer
+    from mpi_cuda_cnn_tpu.utils.config import Config
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    ds = synthetic_stripes(num_train=512, num_test=128)
+    cfg = Config(epochs=2, augment="shift", aug_pad=1, eval_every=0,
+                 log_every=10**9, batch_size=32, scan=scan)
+    t = Trainer(get_model("reference_cnn"), ds, cfg,
+                metrics=MetricsLogger(echo=False))
+    r = t.train()
+    assert r.test_accuracy >= 0.9
+
+
+def test_trainer_augment_tp_mesh():
+    from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.train.trainer import Trainer
+    from mpi_cuda_cnn_tpu.utils.config import Config
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    ds = synthetic_stripes(num_train=256, num_test=64)
+    cfg = Config(epochs=1, augment="shift", eval_every=0, log_every=10**9,
+                 batch_size=32, mesh_shape="data:4,model:2")
+    t = Trainer(get_model("reference_cnn"), ds, cfg,
+                metrics=MetricsLogger(echo=False))
+    em = t.run_epoch(0)
+    assert np.isfinite(em["loss"])
+
+
+def test_trainer_augment_rejected_on_pp_mesh():
+    from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.train.trainer import Trainer
+    from mpi_cuda_cnn_tpu.utils.config import Config
+
+    ds = synthetic_stripes(num_train=64, num_test=32)
+    cfg = Config(epochs=1, augment="shift", batch_size=32, mesh_shape="pipe:2")
+    with pytest.raises(ValueError, match="augment"):
+        Trainer(get_model("reference_cnn"), ds, cfg)
