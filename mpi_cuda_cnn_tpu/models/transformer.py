@@ -1,0 +1,117 @@
+"""Minimal decoder-only transformer LM — the long-context model family.
+
+The reference has no attention and no sequence axis (SURVEY.md §5.7); this
+model exists to exercise the framework's long-context path end-to-end:
+ring / Ulysses sequence parallelism (parallel/sp.py) under a real training
+loop, not just as an op-level demo.
+
+Design for SPMD: `apply` is written to run either as a plain global
+program or INSIDE shard_map with the sequence dim sharded —
+
+- token embedding, layernorm, and the MLP are per-position (shard-local);
+- positions are explicit (`pos_offset`), so a sequence shard can compute
+  its true absolute positions from its axis index;
+- attention is pluggable (`attn_fn`): the full-attention oracle by
+  default, ring/Ulysses bodies under shard_map.
+
+Everything is f32; pre-LN blocks; learned position embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+
+
+def _layernorm(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerLM:
+    """Decoder-only LM: vocab -> dim, `depth` pre-LN blocks, tied LN head.
+
+    Sizes are kept explicit; heads must divide dim. The MLP expansion is
+    the standard 4x.
+    """
+
+    vocab: int = 64
+    dim: int = 64
+    heads: int = 4
+    depth: int = 2
+    max_seq: int = 256
+    name: str = "transformer_lm"
+
+    @property
+    def head_dim(self) -> int:
+        if self.dim % self.heads:
+            raise ValueError(f"dim {self.dim} not divisible by heads {self.heads}")
+        return self.dim // self.heads
+
+    def init(self, key) -> dict:
+        d, v, hd = self.dim, self.vocab, self.head_dim
+        keys = iter(jax.random.split(key, 4 + 6 * self.depth))
+        scale = 1.0 / math.sqrt(d)
+
+        def dense(k, din, dout):
+            return jax.random.normal(k, (din, dout), jnp.float32) / math.sqrt(din)
+
+        params = {
+            "tok_emb": jax.random.normal(next(keys), (v, d), jnp.float32) * scale,
+            "pos_emb": jax.random.normal(next(keys), (self.max_seq, d), jnp.float32) * scale,
+            "ln_f": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+            "head": dense(next(keys), d, v),
+            "blocks": [],
+        }
+        for _ in range(self.depth):
+            params["blocks"].append({
+                "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+                "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+                "wqkv": dense(next(keys), d, 3 * d),
+                "wo": dense(next(keys), d, d),
+                "w1": dense(next(keys), d, 4 * d),
+                "w2": dense(next(keys), 4 * d, d),
+            })
+        return params
+
+    def apply(
+        self,
+        params: dict,
+        tokens: jnp.ndarray,           # (B, S) int32
+        *,
+        attn_fn: Callable | None = None,
+        pos_offset: jnp.ndarray | int = 0,
+        causal: bool = True,
+    ) -> jnp.ndarray:                  # (B, S, vocab) logits
+        b, s = tokens.shape
+        h, hd = self.heads, self.head_dim
+        if s > self.max_seq:
+            # XLA's gather would silently clamp out-of-range positions to
+            # pos_emb[max_seq-1]; fail loudly instead. (Sharded callers
+            # check the GLOBAL length — see make_sp_lm_train_step.)
+            raise ValueError(f"sequence length {s} exceeds max_seq {self.max_seq}")
+        attn = attn_fn or (lambda q, k, v: attention(q, k, v, causal=causal))
+
+        pos = pos_offset + jnp.arange(s)
+        x = params["tok_emb"][tokens] + params["pos_emb"][pos][None, :, :]
+        for blk in params["blocks"]:
+            y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+            qkv = y @ blk["wqkv"]                       # (B, S, 3*dim)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, h, hd)
+            k = k.reshape(b, s, h, hd)
+            v = v.reshape(b, s, h, hd)
+            o = attn(q, k, v).reshape(b, s, h * hd)
+            x = x + o @ blk["wo"]
+            y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+        x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+        return x @ params["head"]

@@ -1,0 +1,127 @@
+"""Scaled dot-product attention ops.
+
+The reference has NO attention and no sequence axis anywhere (its op
+universe is conv + FC + softmax, SURVEY.md §2.3-2.5 / §5.7) — these ops
+exist because long-context support is a first-class capability of this
+framework, not a parity item. They are the single-device oracles that the
+sequence-parallel forms in parallel/sp.py (ring attention over 'seq' via
+ppermute; Ulysses all-to-all head parallelism) are tested against.
+
+Conventions: q/k/v are (B, S, H, D) — batch, sequence, heads, head_dim —
+the layout whose S axis shards over the 'seq' mesh axis. Softmax is
+max-subtracted (the same stabilization as ops/activations.stable_softmax,
+cnn.c:125-143's trick) and, for the blockwise form, an *online* softmax:
+running max m, running denominator l, running numerator o, renormalized
+as each key/value block arrives — the algebra that makes ring attention
+exact, not approximate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
+
+
+def attention(q, k, v, *, causal: bool = False):
+    """Full (quadratic) scaled dot-product attention — the oracle.
+
+    q, k, v: (B, S, H, D). Returns (B, S, H, D), f32 accumulation.
+    """
+    b, sq, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        qi = jnp.arange(sq)[:, None]
+        ki = jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where(ki <= qi, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+def _block_logits(q, k, scale):
+    return jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+
+
+def online_softmax_block(carry, q, k, v, mask=None):
+    """Fold one key/value block into the online-softmax state.
+
+    carry = (o, m, l):
+      o: (B, Sq, H, D) f32 — running unnormalized numerator,
+      m: (B, H, Sq)    f32 — running row max,
+      l: (B, H, Sq)    f32 — running denominator.
+    mask: optional (Sq, Sk) bool, True = attend.
+
+    Returns the updated carry. Finalize with o / l (see finalize_online).
+    This is the exact blockwise-softmax recurrence (numerically identical
+    to full softmax for any block order that respects the mask).
+    """
+    o, m, l = carry
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = _block_logits(q, k, scale)  # (B, H, Sq, Sk) f32
+    if mask is not None:
+        logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
+
+    m_blk = jnp.max(logits, axis=-1)          # (B, H, Sq)
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)                # rescale of old state
+    p = jnp.exp(logits - m_new[..., None])    # (B, H, Sq, Sk)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None]  # (B, Sq, H, 1) rescale
+    o_new = o_new + jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o_new, m_new, l_new
+
+
+def init_online(q):
+    """Fresh online-softmax carry for queries q: (B, Sq, H, D)."""
+    b, sq, h, d = q.shape
+    o = jnp.zeros((b, sq, h, d), jnp.float32)
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    return o, m, l
+
+
+def finalize_online(carry, dtype):
+    """o / l with fully-masked rows (l == 0) mapped to zeros."""
+    o, m, l = carry
+    l_t = l.transpose(0, 2, 1)[..., None]  # (B, Sq, H, 1)
+    return jnp.where(l_t > 0, o / jnp.maximum(l_t, 1e-30), 0.0).astype(dtype)
+
+
+def blockwise_attention(q, k, v, *, block_size: int, causal: bool = False):
+    """Full attention computed block-by-block with the online softmax —
+    the single-device form of the ring-attention math (memory O(S·block)
+    for the logits instead of O(S²)). Exact parity with attention()."""
+    b, s, h, d = q.shape
+    if s % block_size:
+        raise ValueError(f"seq len {s} not divisible by block {block_size}")
+    nblk = s // block_size
+    kb = k.reshape(b, nblk, block_size, h, d)
+    vb = v.reshape(b, nblk, block_size, h, d)
+    qi = jnp.arange(s)[:, None]
+
+    def fold(carry, blk):
+        kj, vj, j = blk
+        ki = j * block_size + jnp.arange(block_size)[None, :]
+        mask = (ki <= qi) if causal else jnp.ones((s, block_size), bool)
+        return online_softmax_block(carry, q, kj, vj, mask), None
+
+    carry, _ = jax.lax.scan(
+        fold,
+        init_online(q),
+        (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
+         jnp.arange(nblk)),
+    )
+    return finalize_online(carry, q.dtype)

@@ -1,0 +1,116 @@
+"""Transformer LM + sequence-parallel training (parallel/sp.py).
+
+End-to-end coverage of the long-context path: the decoder-only LM trains
+under ring / Ulysses sequence parallelism on the 8-virtual-device CPU
+mesh, with exact parity against the single-device program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from mpi_cuda_cnn_tpu.parallel.sp import SEQ_AXIS, make_sp_lm_train_step
+
+MODEL = TransformerLM(vocab=17, dim=32, heads=8, depth=2, max_seq=64)
+
+
+def _data(batch=4, s=64, seed=0):
+    """Cyclic-successor sequences: token[t+1] = token[t] + 1 (mod vocab) —
+    learnable by a 1-layer causal model, deterministic to evaluate."""
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, MODEL.vocab, size=(batch, 1))
+    toks = (start + np.arange(s)[None, :]) % MODEL.vocab
+    inputs = jnp.asarray(toks[:, :-1], jnp.int32)
+    targets = jnp.asarray(toks[:, 1:], jnp.int32)
+    return inputs, targets
+
+
+def _single_device_loss(params, inputs, targets):
+    logits = MODEL.apply(params, inputs)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def test_apply_shapes():
+    params = MODEL.init(jax.random.key(0))
+    inputs, _ = _data(batch=2, s=33)
+    logits = MODEL.apply(params, inputs)
+    assert logits.shape == (2, 32, MODEL.vocab)
+
+
+def test_apply_causality():
+    """Changing future tokens must not change past logits."""
+    params = MODEL.init(jax.random.key(0))
+    inputs, _ = _data(batch=2, s=33)
+    l1 = MODEL.apply(params, inputs)
+    mutated = inputs.at[:, 20:].set(0)
+    l2 = MODEL.apply(params, mutated)
+    np.testing.assert_allclose(
+        np.asarray(l1[:, :20]), np.asarray(l2[:, :20]), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sp_step_parity_with_single_device(impl):
+    """One SP train step over Mesh({'seq': 8}) == the same step computed
+    globally on one device (loss and updated params)."""
+    mesh = make_mesh({SEQ_AXIS: 8}, devices=jax.devices()[:8])
+    params = MODEL.init(jax.random.key(1))
+    opt = optax.sgd(0.1)
+    inputs, targets = _data(batch=2, s=65)  # 64 positions / 8 shards
+    state = {"params": params, "opt_state": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    step = make_sp_lm_train_step(MODEL, opt, mesh, impl=impl, donate=False)
+    new_state, metrics = step(state, inputs, targets)
+
+    want_loss = _single_device_loss(params, inputs, targets)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(want_loss), rtol=1e-5, atol=1e-5
+    )
+    grads = jax.grad(_single_device_loss)(params, inputs, targets)
+    updates, _ = opt.update(grads, opt.init(params), params)
+    want_params = optax.apply_updates(params, updates)
+    for a, b in zip(jax.tree.leaves(new_state["params"]),
+                    jax.tree.leaves(want_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_sp_dp_mesh_composes():
+    """SP x DP: Mesh({'data': 2, 'seq': 4}) — batch AND sequence sharded."""
+    mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4}, devices=jax.devices()[:8])
+    params = MODEL.init(jax.random.key(2))
+    opt = optax.sgd(0.1)
+    inputs, targets = _data(batch=4, s=65)
+    state = {"params": params, "opt_state": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    step = make_sp_lm_train_step(
+        MODEL, opt, mesh, data_axis=DATA_AXIS, donate=False
+    )
+    new_state, metrics = step(state, inputs, targets)
+    want_loss = _single_device_loss(params, inputs, targets)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(want_loss), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_sp_lm_learns_cyclic_task():
+    """Ring-SP training drives the loss to ~0 on the cyclic-successor task
+    (the model must actually learn through the sharded attention)."""
+    mesh = make_mesh({SEQ_AXIS: 8}, devices=jax.devices()[:8])
+    params = MODEL.init(jax.random.key(3))
+    opt = optax.adam(3e-3)
+    state = {"params": params, "opt_state": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    step = make_sp_lm_train_step(MODEL, opt, mesh)
+    losses = []
+    for i in range(150):
+        inputs, targets = _data(batch=8, s=65, seed=i)
+        state, metrics = step(state, inputs, targets)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < 0.15, f"did not learn: {losses[::30]}"
+    assert losses[-1] < losses[0] / 10
