@@ -58,7 +58,7 @@ class TransformerLM:
 
     def init(self, key) -> dict:
         d, v, hd = self.dim, self.vocab, self.head_dim
-        keys = iter(jax.random.split(key, 4 + 6 * self.depth))
+        keys = iter(jax.random.split(key, 3 + 4 * self.depth))
         scale = 1.0 / math.sqrt(d)
 
         def dense(k, din, dout):

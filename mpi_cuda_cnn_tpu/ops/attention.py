@@ -75,6 +75,11 @@ def online_softmax_block(carry, q, k, v, mask=None):
     m_new = jnp.maximum(m, m_blk)
     alpha = jnp.exp(m - m_new)                # rescale of old state
     p = jnp.exp(logits - m_new[..., None])    # (B, H, Sq, Sk)
+    if mask is not None:
+        # A fully-masked row keeps m == m_new == NEG_INF, where
+        # exp(logit - m_new) = exp(0) = 1 would silently count masked
+        # keys; zero them so l stays 0 and finalize_online yields zeros.
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
     l_new = l * alpha + jnp.sum(p, axis=-1)
     o_new = o * alpha.transpose(0, 2, 1)[..., None]  # (B, Sq, H, 1) rescale
     o_new = o_new + jnp.einsum(

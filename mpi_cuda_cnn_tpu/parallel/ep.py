@@ -1,0 +1,174 @@
+"""Expert parallelism (MoE) over an 'expert' mesh axis.
+
+The reference has no MoE/routing of any kind (SURVEY.md §2 parallelism
+checklist: "EP: absent") — like sequence parallelism, this is a
+first-class capability of the framework rather than a parity item, and it
+completes the parallelism family: DP (dp.py), TP (tp.py), PP (pp.py),
+SP (sp.py), EP (here).
+
+Design — Switch-style top-1 routing with static shapes (XLA needs them):
+
+- Gating: per-token softmax over experts, top-1 expert, gate = its prob.
+- Capacity: each expert accepts at most C tokens per device shard
+  (C = ceil(T/E * capacity_factor)); overflow tokens are DROPPED (their
+  MoE output is 0, the residual connection carries them — standard
+  Switch behavior) via position-in-expert cumsum masking.
+- Dispatch/combine are dense one-hot tensors (T, E, C) contracted with
+  einsum — the MXU-friendly formulation (no scatter/gather).
+- EP: experts shard over the 'expert' axis; a tiled all_to_all turns the
+  per-device (E, C, D) dispatch buffer into (E/P, P*C, D) — each device
+  holds ALL tokens routed to ITS experts — the experts run as one batched
+  einsum, and the inverse all_to_all returns outputs to the tokens'
+  owners. Two collectives per layer, exactly like the reference
+  frameworks this pattern comes from, riding ICI here.
+
+`moe_mlp` is the SPMD body (callable inside shard_map, or standalone with
+axis=None for the single-device oracle the tests compare against).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+EXPERT_AXIS = "expert"
+
+
+def init_moe_params(key, dim: int, hidden: int, n_experts: int) -> dict:
+    """Gate + expert-stacked MLP weights. Experts are stacked on a leading
+    dim so they shard/slice cleanly: w1 (E, D, H), w2 (E, H, D)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(jnp.asarray(dim, jnp.float32))
+    scale_hid = 1.0 / jnp.sqrt(jnp.asarray(hidden, jnp.float32))
+    return {
+        "gate": jax.random.normal(k1, (dim, n_experts), jnp.float32) * scale_in,
+        "w1": jax.random.normal(k2, (n_experts, dim, hidden), jnp.float32) * scale_in,
+        "w2": jax.random.normal(k3, (n_experts, hidden, dim), jnp.float32) * scale_hid,
+    }
+
+
+def top1_dispatch(x, gate_w, n_experts: int, capacity: int):
+    """Switch top-1 routing for tokens x: (T, D).
+
+    Returns (dispatch, combine, aux_loss):
+      dispatch: (T, E, C) f32 in {0, 1} — token t occupies slot c of
+                expert e (at most one nonzero per token);
+      combine:  (T, E, C) f32 — dispatch weighted by the token's gate;
+      aux_loss: scalar load-balancing loss (mean_prob · mean_assignment
+                · E, the Switch auxiliary), to be added by the caller.
+    """
+    t = x.shape[0]
+    logits = x @ gate_w                                   # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                   # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # (T, E)
+    # Position of each token within its expert's queue (first come first
+    # served in token order); tokens past capacity are dropped.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot    # (T, E), 0-based
+    keep = (pos < capacity).astype(jnp.float32) * onehot
+    slot = jax.nn.one_hot(
+        jnp.sum(pos, axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32
+    )                                                     # (T, C)
+    dispatch = keep[:, :, None] * slot[:, None, :]        # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: fraction of tokens per expert x mean router prob.
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = jnp.sum(frac_tokens * frac_probs) * n_experts
+    return dispatch, combine, aux_loss
+
+
+def _expert_ffn(h, w1, w2):
+    """Batched expert MLP: h (E_local, S, D) x w1 (E_local, D, H) ..."""
+    return jnp.einsum("esh,ehd->esd", jax.nn.relu(jnp.einsum("esd,edh->esh", h, w1)), w2)
+
+
+def moe_mlp(
+    x,
+    params: dict,
+    *,
+    n_experts: int,
+    capacity_factor: float = 1.25,
+    axis: str | None = EXPERT_AXIS,
+):
+    """MoE MLP for x: (T, D) local tokens. SPMD body when `axis` names a
+    mesh axis — then params["w1"]/["w2"] hold only THIS device's E/P
+    expert stack (sharded on their leading dim; the gate is replicated) —
+    or the exact single-device dense oracle when axis=None (full stacks).
+    Returns (y: (T, D), aux_loss: scalar)."""
+    t, d = x.shape
+    capacity = max(1, -int(-t * capacity_factor // n_experts))  # ceil
+    dispatch, combine, aux = top1_dispatch(
+        x, params["gate"], n_experts, capacity
+    )
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)    # (E, C, D)
+
+    if axis is None:
+        expert_out = _expert_ffn(expert_in, params["w1"], params["w2"])
+    else:
+        p = lax.axis_size(axis)
+        if n_experts % p:
+            raise ValueError(f"experts {n_experts} not divisible by axis size {p}")
+        if params["w1"].shape[0] != n_experts // p:
+            raise ValueError(
+                f"expected {n_experts // p} local experts in w1, got "
+                f"{params['w1'].shape[0]} — shard the stacks over {axis!r}"
+            )
+        # (E, C, D) -> (E/P, P*C, D): every device receives the slots
+        # destined for ITS experts from every device.
+        expert_in = lax.all_to_all(
+            expert_in, axis, split_axis=0, concat_axis=1, tiled=True
+        )
+        expert_out = _expert_ffn(expert_in, params["w1"], params["w2"])
+        # Inverse: (E/P, P*C, D) -> (E, C, D), back on the tokens' owner.
+        expert_out = lax.all_to_all(
+            expert_out, axis, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.astype(x.dtype), aux
+
+
+def moe_param_specs(axis: str = EXPERT_AXIS) -> dict:
+    """PartitionSpecs for init_moe_params' pytree: expert stacks sharded
+    on their leading (expert) dim — per-device memory O(E/P), the point
+    of EP — gate replicated (every device routes its own tokens)."""
+    return {"gate": P(), "w1": P(axis), "w2": P(axis)}
+
+
+def make_moe_layer(mesh, *, n_experts, capacity_factor=1.25, axis=EXPERT_AXIS):
+    """jitted (params, x) -> (y, aux) with x: (T, D) sharded on `axis` and
+    the expert stacks sharded per moe_param_specs — the wrapped EP layer
+    for standalone use. Pass full (host) params; shard_map's in_specs
+    place each device's expert slice."""
+
+    if n_experts % mesh.shape[axis]:
+        raise ValueError(
+            f"experts {n_experts} not divisible by {axis!r} size "
+            f"{mesh.shape[axis]}"
+        )
+    body = partial(
+        moe_mlp, n_experts=n_experts, capacity_factor=capacity_factor, axis=axis
+    )
+
+    def shard_body(p_, x_):
+        y, aux = body(x_, p_)
+        # aux is computed on local tokens; average it so the replicated
+        # out_spec is truthful.
+        return y, lax.pmean(aux, axis)
+
+    def fn(params, x):
+        return jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(moe_param_specs(axis), P(axis)),
+            out_specs=(P(axis), P()),
+            check_vma=False,
+        )(params, x)
+
+    return jax.jit(fn)

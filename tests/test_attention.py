@@ -53,6 +53,25 @@ def test_attention_causal_ignores_future():
     )
 
 
+def test_online_softmax_fully_masked_rows_yield_zeros():
+    """A row masked out of EVERY block must finalize to zeros, not to a
+    mean over masked keys (regression: exp(NEG_INF - NEG_INF) = 1)."""
+    from mpi_cuda_cnn_tpu.ops.attention import (
+        finalize_online,
+        init_online,
+        online_softmax_block,
+    )
+
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 4, 1, 8)), jnp.float32)
+               for _ in range(3))
+    mask = jnp.ones((4, 4), bool).at[0, :].set(False)  # row 0 sees nothing
+    carry = online_softmax_block(init_online(q), q, k, v, mask)
+    out = finalize_online(carry, q.dtype)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), 0.0, atol=1e-6)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("block", [8, 16, 64])
 def test_blockwise_matches_full(causal, block):
