@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_serial test_dp8 test_tpu bench northstar native test_native get_mnist clean
+.PHONY: test test_serial test_dp8 test_tpu bench bench_configs northstar native test_native get_mnist clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -41,6 +41,10 @@ test_tpu:
 
 bench:
 	$(PY) bench.py
+
+# All five BASELINE.json configs, one JSON line each.
+bench_configs:
+	$(PY) scripts/bench_configs.py
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

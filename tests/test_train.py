@@ -38,6 +38,19 @@ def test_convergence_lenet5(ds, eight_devices):
     assert t.train().test_accuracy >= 0.9
 
 
+def test_convergence_cifar3conv(eight_devices):
+    """The 32x32x3 input path (BASELINE.json configs 4-5) end to end:
+    cifar3conv on CIFAR-shaped synthetic stripes over the 8-device mesh."""
+    from mpi_cuda_cnn_tpu.data.datasets import get_dataset
+
+    ds = get_dataset("synthetic_cifar", num_train=512, num_test=128)
+    assert ds.input_shape == (32, 32, 3)
+    cfg = Config(model="cifar3conv", init="he", epochs=3, eval_every=0,
+                 log_every=10**9)
+    t = Trainer(get_model("cifar3conv"), ds, cfg, metrics=_quiet())
+    assert t.train().test_accuracy >= 0.9
+
+
 def test_determinism_same_seed(ds):
     """Fixed seed -> identical final params, the property the reference's
     srand(0) exists for (cnn.c:413)."""
