@@ -42,9 +42,19 @@ test_tpu:
 bench:
 	$(PY) bench.py
 
-# All five BASELINE.json configs, one JSON line each.
+# All five BASELINE.json configs, one JSON line each, on the visible
+# accelerator (multi-way DP configs clamp to the device count — the
+# "mesh" field records what ran). bench_configs_cpu8 provisions the
+# 8-virtual-device CPU mesh so the DP4/DP8 configs really fan out.
 bench_configs:
 	$(PY) scripts/bench_configs.py
+
+# CPU variant: the four CPU-tractable configs with real 4/8-way DP on the
+# virtual mesh (vgg_small needs an accelerator — run `make bench_configs`
+# on a TPU host for all five).
+bench_configs_cpu8:
+	$(CPU8) $(PY) scripts/bench_configs.py --device cpu --num-train 1024 \
+	  --configs lenet5,cifar3conv
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

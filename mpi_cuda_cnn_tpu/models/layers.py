@@ -259,18 +259,28 @@ class Sequential:
         return params
 
     def apply(self, params: list[Params], x: jnp.ndarray, *,
-              backend: str = "xla", compute_dtype=None) -> jnp.ndarray:
+              backend: str = "xla", compute_dtype=None,
+              remat: bool = False) -> jnp.ndarray:
         """x: (N, H, W, C) -> logits (N, num_classes).
 
         compute_dtype=bfloat16 casts activations (params are cast per-op by
         XLA's dot/conv mixed-precision) so matmuls hit the MXU's native
         bf16 path; logits are returned in f32 for the loss.
+
+        remat=True wraps each layer in jax.checkpoint: the backward pass
+        recomputes that layer's activations instead of keeping them live —
+        FLOPs traded for HBM (a lever the reference, which stores every
+        layer's outputs/errors permanently in the Layer struct, cnn.c:22-30,
+        does not have).
         """
         if compute_dtype is not None:
             x = x.astype(compute_dtype)
             params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
         for layer, p in zip(self.layers, params):
-            x = layer.apply(p, x, backend=backend)
+            f = (lambda p_, x_, _l=layer: _l.apply(p_, x_, backend=backend))
+            if remat:
+                f = jax.checkpoint(f)
+            x = f(p, x)
         return x.astype(jnp.float32)
 
     def num_params(self, params: list[Params]) -> int:

@@ -90,6 +90,7 @@ class TransformerLM:
         attn_fn: Callable | None = None,
         pos_offset: jnp.ndarray | int = 0,
         causal: bool = True,
+        remat: bool = False,           # jax.checkpoint per block
     ) -> jnp.ndarray:                  # (B, S, vocab) logits
         b, s = tokens.shape
         h, hd = self.heads, self.head_dim
@@ -102,7 +103,8 @@ class TransformerLM:
 
         pos = pos_offset + jnp.arange(s)
         x = params["tok_emb"][tokens] + params["pos_emb"][pos][None, :, :]
-        for blk in params["blocks"]:
+
+        def block(blk, x):
             y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
             qkv = y @ blk["wqkv"]                       # (B, S, 3*dim)
             q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -112,6 +114,14 @@ class TransformerLM:
             o = attn(q, k, v).reshape(b, s, h * hd)
             x = x + o @ blk["wo"]
             y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
-            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+            return x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+
+        if remat:
+            # Recompute block activations in the backward pass (the
+            # long-context memory lever; composes with ring attention's
+            # O(S/P) residency since attn_fn runs inside the checkpoint).
+            block = jax.checkpoint(block)
+        for blk in params["blocks"]:
+            x = block(blk, x)
         x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
         return x @ params["head"]

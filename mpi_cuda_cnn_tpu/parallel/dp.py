@@ -55,8 +55,40 @@ def dp_shard_perm(perm, mesh, axis: str = DATA_AXIS):
     return jax.device_put(perm, NamedSharding(mesh, spec))
 
 
+def _local_grads(loss_fn: Callable, params, x, y, grad_accum: int):
+    """(loss, aux, grads) on the local shard, optionally accumulated over
+    `grad_accum` sequential micro-batches (lax.scan keeps ONE micro-batch
+    of activations live — the memory half of the reference's 32-sample
+    accumulator semantics, cnn.c:467-469, generalized)."""
+
+    def compute(px, py):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, px, py
+        )
+        return loss, aux, grads
+
+    if grad_accum <= 1:
+        return compute(x, y)
+    a = grad_accum
+    xs = x.reshape(a, x.shape[0] // a, *x.shape[1:])
+    ys = y.reshape(a, y.shape[0] // a, *y.shape[1:])
+    shapes = jax.eval_shape(compute, xs[0], ys[0])
+    zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    totals, _ = jax.lax.scan(
+        lambda c, xy: (jax.tree.map(jnp.add, c, compute(*xy)), None),
+        zeros,
+        (xs, ys),
+    )
+    return jax.tree.map(lambda t: t / a, totals)
+
+
 def _make_step_body(
-    loss_fn: Callable, optimizer, axis: str, augment=None, aug_seed: int = 0
+    loss_fn: Callable,
+    optimizer,
+    axis: str,
+    augment=None,
+    aug_seed: int = 0,
+    grad_accum: int = 1,
 ):
     """The per-step SPMD body shared by the one-batch step and the scanned
     epoch: local grads, ONE fused gradient all-reduce, identical update on
@@ -73,8 +105,8 @@ def _make_step_body(
             key = jax.random.fold_in(jax.random.key(aug_seed), state["step"])
             key = jax.random.fold_in(key, jax.lax.axis_index(axis))
             x = augment(key, x)
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], x, y
+        loss, aux, grads = _local_grads(
+            loss_fn, state["params"], x, y, grad_accum
         )
         # ONE fused gradient all-reduce per step — the explicit SPMD twin
         # of the reference's intent, replacing its per-sample-per-layer
@@ -103,6 +135,7 @@ def make_dp_train_step(
     donate: bool = True,
     augment=None,
     aug_seed: int = 0,
+    grad_accum: int = 1,
 ):
     """Build the jitted DP train step.
 
@@ -110,7 +143,7 @@ def make_dp_train_step(
     per-device shard inside shard_map. Returns step(state, x, y) ->
     (state, metrics) with state replicated and batches sharded on `axis`.
     """
-    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed)
+    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed, grad_accum)
 
     # check_vma=False: collective typing stays classic/explicit (local grads
     # until the pmean above). Also required for Pallas interpreter-mode
@@ -135,6 +168,7 @@ def make_dp_scan_epoch(
     donate: bool = True,
     augment=None,
     aug_seed: int = 0,
+    grad_accum: int = 1,
 ):
     """Build a jitted many-steps-per-dispatch trainer: the whole (chunk of
     an) epoch is ONE `lax.scan` over a batch-index permutation, with the raw
@@ -151,7 +185,7 @@ def make_dp_scan_epoch(
       perm:   (nsteps, batch) int32, batch dim sharded on `axis`.
       metric_sums: metrics summed over the scanned steps.
     """
-    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed)
+    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed, grad_accum)
 
     def epoch(state: TrainState, images, labels, perm):
         def body(state, idx):

@@ -156,6 +156,7 @@ def make_sp_lm_train_step(
     axis: str = SEQ_AXIS,
     data_axis: str | None = None,
     donate: bool = True,
+    remat: bool = False,
 ):
     """Jitted causal-LM train step with the sequence dim sharded on `axis`
     (long-context training: each device holds S/P tokens of activations)
@@ -197,7 +198,8 @@ def make_sp_lm_train_step(
 
         def loss_fn(params):
             logits = model.apply(
-                params, tokens, attn_fn=attn, pos_offset=pos_offset
+                params, tokens, attn_fn=attn, pos_offset=pos_offset,
+                remat=remat,
             )
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -87,7 +87,13 @@ def make_tp_state(model, params, optimizer, mesh, axis: str = MODEL_AXIS) -> Tra
     }
 
 
-def _step_body(loss_fn: Callable, optimizer, augment=None, aug_seed: int = 0):
+def _step_body(
+    loss_fn: Callable,
+    optimizer,
+    augment=None,
+    aug_seed: int = 0,
+    grad_accum: int = 1,
+):
     """The one train-step body both TP entry points jit (the GSPMD twin of
     dp._make_step_body — but with NO explicit collective: the batch-mean
     loss over the 'data'-sharded batch lowers to partial sums + an ICI
@@ -97,14 +103,15 @@ def _step_body(loss_fn: Callable, optimizer, augment=None, aug_seed: int = 0):
     `augment` is keyed by step only (this is a GLOBAL program — per-sample
     keys fold in batch position inside make_augment, so shards still draw
     independent transforms)."""
+    from .dp import _local_grads
 
     def step(state: TrainState, x, y):
         if augment is not None:
             x = augment(
                 jax.random.fold_in(jax.random.key(aug_seed), state["step"]), x
             )
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], x, y
+        loss, aux, grads = _local_grads(
+            loss_fn, state["params"], x, y, grad_accum
         )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
@@ -127,6 +134,7 @@ def make_tp_train_step(
     donate: bool = True,
     augment=None,
     aug_seed: int = 0,
+    grad_accum: int = 1,
 ):
     """The GSPMD train step: a plain jitted step over sharded inputs.
 
@@ -134,7 +142,7 @@ def make_tp_train_step(
     the activation all-gathers. Shardings flow from the input arrays —
     callers place state via make_tp_state and batches via shard_batch_2d.
     """
-    step = _step_body(loss_fn, optimizer, augment, aug_seed)
+    step = _step_body(loss_fn, optimizer, augment, aug_seed, grad_accum)
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
@@ -146,13 +154,14 @@ def make_tp_scan_epoch(
     donate: bool = True,
     augment=None,
     aug_seed: int = 0,
+    grad_accum: int = 1,
 ):
     """Scanned-epoch twin of dp.make_dp_scan_epoch for the GSPMD path:
     lax.scan over a batch-index permutation with the uint8 dataset
     device-resident; normalization/one-hot on device (cnn.c:457,462-464)."""
     from ..data.pipeline import PIXEL_SCALE
 
-    step = _step_body(loss_fn, optimizer, augment, aug_seed)
+    step = _step_body(loss_fn, optimizer, augment, aug_seed, grad_accum)
 
     def epoch(state: TrainState, images, labels, perm):
         def body(state, idx):

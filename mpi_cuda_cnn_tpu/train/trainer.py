@@ -53,12 +53,14 @@ from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
 from .optimizer import make_optimizer
 
 
-def make_loss_fn(model, *, backend: str = "xla", compute_dtype=None):
+def make_loss_fn(model, *, backend: str = "xla", compute_dtype=None,
+                 remat: bool = False):
     """Softmax-CE loss + the reference's metrics (squared-error total,
     cnn.c:275-282; argmax accuracy, cnn.c:508-513)."""
 
     def loss_fn(params, x, y_onehot):
-        logits = model.apply(params, x, backend=backend, compute_dtype=compute_dtype)
+        logits = model.apply(params, x, backend=backend,
+                             compute_dtype=compute_dtype, remat=remat)
         loss = softmax_cross_entropy(logits, y_onehot)
         probs = stable_softmax(logits)
         acc = jnp.mean(
@@ -107,12 +109,19 @@ class Trainer:
             raise ValueError(
                 f"batch_size {config.batch_size} not divisible by data-axis size {n_data}"
             )
+        if config.grad_accum > 1 and (config.batch_size // n_data) % config.grad_accum:
+            raise ValueError(
+                f"per-device batch {config.batch_size // n_data} not divisible "
+                f"by grad_accum {config.grad_accum}"
+            )
 
         compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
         )
         backend = "pallas" if config.use_pallas else "xla"
-        self.loss_fn = make_loss_fn(model, backend=backend, compute_dtype=compute_dtype)
+        self.loss_fn = make_loss_fn(model, backend=backend,
+                                    compute_dtype=compute_dtype,
+                                    remat=config.remat)
 
         from ..data.augment import make_augment
 
@@ -169,6 +178,18 @@ class Trainer:
                     "path (inputs are pre-microbatched); use a data/model "
                     "mesh"
                 )
+            if config.grad_accum > 1:
+                raise ValueError(
+                    "--grad-accum is redundant on the pipeline path: "
+                    "--num-microbatches already accumulates over "
+                    "micro-batches"
+                )
+            if config.remat:
+                raise ValueError(
+                    "--remat is not wired into the pipeline path (stages "
+                    "already bound live activations to one microbatch); "
+                    "use a data/model mesh"
+                )
             if param_dtype != jnp.float32:
                 raise ValueError(
                     "pipeline parallelism keeps master params in the packed "
@@ -201,6 +222,7 @@ class Trainer:
             self.train_step = make_tp_train_step(
                 self.loss_fn, self.optimizer, donate=config.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
+                grad_accum=config.grad_accum,
             )
             self.eval_step = make_tp_eval_step(predict)
         else:
@@ -213,6 +235,7 @@ class Trainer:
             self.train_step = make_dp_train_step(
                 self.loss_fn, self.optimizer, self.mesh, donate=config.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
+                grad_accum=config.grad_accum,
             )
             self.eval_step = make_dp_eval_step(predict, self.mesh)
         # Scanned-epoch path: built lazily on first use (run_epoch), since
@@ -345,12 +368,14 @@ class Trainer:
                 self.loss_fn, self.optimizer, self.ds.num_classes,
                 donate=self.cfg.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
+                grad_accum=self.cfg.grad_accum,
             )
         else:
             self._scan_epoch_fn = make_dp_scan_epoch(
                 self.loss_fn, self.optimizer, self.mesh, self.ds.num_classes,
                 donate=self.cfg.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
+                grad_accum=self.cfg.grad_accum,
             )
 
     def _run_epoch_scanned(self, epoch: int) -> dict:

@@ -53,6 +53,12 @@ class Config:
                                   # (= pipe-axis size when PP is active)
     use_pallas: bool = False      # Pallas kernels instead of lax ops
     donate: bool = True
+    remat: bool = False           # jax.checkpoint per layer: recompute
+                                  # activations in backward (HBM for FLOPs)
+    grad_accum: int = 1           # micro-batches accumulated per optimizer
+                                  # step (batch_size splits evenly across
+                                  # them; generalizes cnn.c:467's 32-sample
+                                  # accumulator)
     scan: bool = True             # many-steps-per-dispatch epochs (lax.scan
                                   # over an HBM-resident dataset); off =
                                   # one dispatch per batch

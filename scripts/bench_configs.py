@@ -50,9 +50,24 @@ def main() -> None:
     ap.add_argument("--data-dir", default=None)
     ap.add_argument("--num-train", type=int, default=8192,
                     help="synthetic-set size when real data is absent")
+    ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--configs", default=None,
+                    help="comma-separated substring filter on config names "
+                         "(e.g. 'lenet5,cifar3conv')")
     args = ap.parse_args()
 
     import jax
+
+    if args.device == "cpu":
+        # In-process selection, like the CLI: the JAX_PLATFORMS env var can
+        # be intercepted by a pre-registered TPU plugin (see cli.py).
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu" and all(
+        d.platform == "cpu" for d in jax.devices()
+    ):
+        print("--device=tpu requested but no accelerator is visible",
+              file=sys.stderr)
+        raise SystemExit(1)
 
     from mpi_cuda_cnn_tpu.data.datasets import get_dataset
     from mpi_cuda_cnn_tpu.models.presets import get_model
@@ -61,7 +76,10 @@ def main() -> None:
     from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
 
     ndev = len(jax.devices())
+    wanted = args.configs.split(",") if args.configs else None
     for name, model, dataset, want_dp in CONFIGS:
+        if wanted is not None and not any(w in name for w in wanted):
+            continue
         data_dir = args.data_dir and Path(args.data_dir) / dataset
         if data_dir and (data_dir / "train-images-idx3-ubyte").exists():
             ds = get_dataset(dataset, data_dir=data_dir)
