@@ -70,8 +70,18 @@ def _local_grads(loss_fn: Callable, params, x, y, grad_accum: int):
     if grad_accum <= 1:
         return compute(x, y)
     a = grad_accum
-    xs = x.reshape(a, x.shape[0] // a, *x.shape[1:])
-    ys = y.reshape(a, y.shape[0] // a, *y.shape[1:])
+    # Interleaved split (micro i takes rows i, a+i, 2a+i, ...) rather than
+    # contiguous blocks: under GSPMD (TP/FSDP) the batch dim is sharded in
+    # contiguous device blocks, and a contiguous micro-split would give
+    # each micro-batch to ONE device, forcing a full resharding per scan
+    # step. The strided split keeps every micro-batch evenly spread across
+    # shards (reshape/transpose preserve the dim-0 sharding); the mean
+    # over micro-batches is partition-independent, so the math is
+    # unchanged either way.
+    def split(t):
+        return t.reshape(t.shape[0] // a, a, *t.shape[1:]).swapaxes(0, 1)
+
+    xs, ys = split(x), split(y)
     shapes = jax.eval_shape(compute, xs[0], ys[0])
     zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
     totals, _ = jax.lax.scan(

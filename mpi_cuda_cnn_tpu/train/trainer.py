@@ -163,6 +163,11 @@ class Trainer:
                 "mesh combines 'pipe' and 'model' axes; TP x PP is not "
                 "supported — use pipe+data or model+data"
             )
+        if config.fsdp and (self.n_pipe > 1 or self.n_model > 1):
+            raise ValueError(
+                "--fsdp shards params over the 'data' axis and does not "
+                "compose with 'pipe'/'model' meshes; use a pure data mesh"
+            )
         if self.n_pipe == 1 and config.num_microbatches:
             raise ValueError(
                 "--num-microbatches requires a 'pipe' mesh axis "
@@ -213,12 +218,21 @@ class Trainer:
                 donate=config.donate,
             )
             self.eval_step = make_pp_forward(self._pp_plan, self.mesh)
-        elif self.n_model > 1:
-            # Tensor(+data) parallel: GSPMD path — params sharded on the
-            # 'model' axis, plain jitted step, XLA inserts the collectives
-            # (parallel/tp.py). The reference has no TP at all (SURVEY.md
-            # §2 checklist).
-            self.state = make_tp_state(model, params, self.optimizer, self.mesh)
+        elif self.n_model > 1 or config.fsdp:
+            # GSPMD paths — sharding lives in the STATE PLACEMENT, the
+            # step is the plain jitted one and XLA inserts the
+            # collectives: TP shards params over 'model' (parallel/tp.py;
+            # the reference has no TP at all, SURVEY.md §2 checklist),
+            # FSDP shards params + optimizer state ZeRO-style over the
+            # same 'data' axis as the batch (parallel/fsdp.py).
+            if config.fsdp:
+                from ..parallel.fsdp import make_fsdp_state
+
+                self.state = make_fsdp_state(params, self.optimizer, self.mesh)
+            else:
+                self.state = make_tp_state(
+                    model, params, self.optimizer, self.mesh
+                )
             self.train_step = make_tp_train_step(
                 self.loss_fn, self.optimizer, donate=config.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
@@ -363,7 +377,9 @@ class Trainer:
                 self._pp_plan, self.optimizer, self.mesh, self.state,
                 self.ds.num_classes, self._pp_M, donate=self.cfg.donate,
             )
-        elif self.n_model > 1:
+        elif self.n_model > 1 or self.cfg.fsdp:
+            # Both GSPMD paths (TP-sharded or FSDP-sharded params) scan
+            # with the plain jitted epoch; shardings flow from the state.
             self._scan_epoch_fn = make_tp_scan_epoch(
                 self.loss_fn, self.optimizer, self.ds.num_classes,
                 donate=self.cfg.donate,

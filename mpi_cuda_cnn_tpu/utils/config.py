@@ -51,6 +51,9 @@ class Config:
                                   # "pipe:4", "pipe:2,data:2", ...
     num_microbatches: int = 0     # pipeline microbatches per step; 0 = auto
                                   # (= pipe-axis size when PP is active)
+    fsdp: bool = False            # ZeRO-style: shard params + optimizer
+                                  # state over the 'data' axis (parallel/
+                                  # fsdp.py); GSPMD inserts the gathers
     use_pallas: bool = False      # Pallas kernels instead of lax ops
     donate: bool = True
     remat: bool = False           # jax.checkpoint per layer: recompute

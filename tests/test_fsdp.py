@@ -1,0 +1,93 @@
+"""FSDP (parallel/fsdp.py): spec selection, real sharding, and exact
+parity with replicated DP on the 8-virtual-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+from mpi_cuda_cnn_tpu.models.presets import get_model
+from mpi_cuda_cnn_tpu.parallel.fsdp import fsdp_specs, make_fsdp_state
+from mpi_cuda_cnn_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from mpi_cuda_cnn_tpu.train.trainer import Trainer
+from mpi_cuda_cnn_tpu.utils.config import Config
+from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+
+def _quiet():
+    return MetricsLogger(echo=False)
+
+
+def _mesh(n=8):
+    return make_mesh({DATA_AXIS: n}, devices=jax.devices()[:n])
+
+
+def test_specs_shard_largest_divisible_dim():
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    specs = fsdp_specs(params, _mesh())
+    # fc1: (1568, 200) -> largest dim 1568 % 8 == 0 -> shard dim 0.
+    assert specs[2]["w"] == P(DATA_AXIS, None)
+    # conv1 kernel (3, 3, 1, 16): 16 % 8 == 0 -> shard the channel dim.
+    assert specs[0]["w"] == P(None, None, None, DATA_AXIS)
+    # conv1 bias (16,) divisible -> sharded; a (10,) head bias would not be.
+    assert specs[0]["b"] == P(DATA_AXIS)
+    assert specs[4]["b"] == P()  # output bias (10,) % 8 != 0
+
+
+def test_state_is_actually_sharded():
+    """Per-device bytes for the big FC kernel must be 1/8 of the full
+    array — the memory claim FSDP exists for."""
+    import optax
+
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    mesh = _mesh()
+    state = make_fsdp_state(params, optax.sgd(0.1, momentum=0.9), mesh)
+    w = state["params"][2]["w"]  # (1568, 200)
+    shard = w.addressable_shards[0].data
+    assert shard.shape == (1568 // 8, 200)
+    # Momentum buffer inherits the same sharding leaf-for-leaf.
+    mu = jax.tree.leaves(state["opt_state"])  # trace_state.mu leaves
+    mu_w = [m for m in mu if getattr(m, "shape", None) == w.shape]
+    assert mu_w and mu_w[0].addressable_shards[0].data.shape == (196, 200)
+
+
+@pytest.mark.parametrize("scan", [True, False])
+def test_fsdp_matches_replicated_dp(scan, eight_devices):
+    """Sharding placement must not change the math: one epoch under FSDP
+    == one epoch under replicated DP (same seed, same permutation)."""
+    ds = synthetic_stripes(num_train=256, num_test=64)
+    base = dict(model="reference_cnn", epochs=1, batch_size=32, seed=11,
+                eval_every=0, log_every=10**9, scan=scan, donate=False,
+                momentum=0.9)
+
+    def run(fsdp):
+        t = Trainer(get_model("reference_cnn"), ds, Config(fsdp=fsdp, **base),
+                    metrics=_quiet())
+        em = t.run_epoch(0)
+        return jax.device_get(t.state["params"]), em
+
+    p_dp, m_dp = run(False)
+    p_fsdp, m_fsdp = run(True)
+    np.testing.assert_allclose(m_dp["loss"], m_fsdp["loss"], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_dp), jax.tree.leaves(p_fsdp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fsdp_e2e_train_and_eval(eight_devices):
+    ds = synthetic_stripes(num_train=512, num_test=128)
+    cfg = Config(model="lenet5", init="he", epochs=2, fsdp=True,
+                 eval_every=0, log_every=10**9)
+    t = Trainer(get_model("lenet5"), ds, cfg, metrics=_quiet())
+    assert t.train().test_accuracy >= 0.9
+
+
+def test_fsdp_rejected_with_model_axis(eight_devices):
+    ds = synthetic_stripes(num_train=64, num_test=32)
+    cfg = Config(batch_size=32, fsdp=True, mesh_shape="data:4,model:2")
+    with pytest.raises(ValueError, match="fsdp"):
+        Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
