@@ -48,6 +48,8 @@ class TransformerLM:
     heads: int = 4
     depth: int = 2
     max_seq: int = 256
+    moe_experts: int = 0   # 0 = dense MLP; >0 = Switch-MoE MLP per block
+                           # (parallel/ep.py), EP-shardable over a mesh axis
     name: str = "transformer_lm"
 
     @property
@@ -72,14 +74,23 @@ class TransformerLM:
             "blocks": [],
         }
         for _ in range(self.depth):
-            params["blocks"].append({
+            blk = {
                 "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
                 "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
                 "wqkv": dense(next(keys), d, 3 * d),
                 "wo": dense(next(keys), d, d),
-                "w1": dense(next(keys), d, 4 * d),
-                "w2": dense(next(keys), 4 * d, d),
-            })
+            }
+            if self.moe_experts:
+                from ..parallel.ep import init_moe_params
+
+                blk["moe"] = init_moe_params(
+                    next(keys), d, 4 * d, self.moe_experts
+                )
+                next(keys)  # keep the per-block key budget uniform
+            else:
+                blk["w1"] = dense(next(keys), d, 4 * d)
+                blk["w2"] = dense(next(keys), 4 * d, d)
+            params["blocks"].append(blk)
         return params
 
     def apply(
@@ -91,7 +102,10 @@ class TransformerLM:
         pos_offset: jnp.ndarray | int = 0,
         causal: bool = True,
         remat: bool = False,           # jax.checkpoint per block
-    ) -> jnp.ndarray:                  # (B, S, vocab) logits
+        moe_axis: str | None = None,   # mesh axis for EP expert sharding
+                                       # (None = dense single-device MoE)
+        return_aux: bool = False,      # also return the MoE balance loss
+    ):                                 # (B, S, vocab) logits [, aux]
         b, s = tokens.shape
         h, hd = self.heads, self.head_dim
         if s > self.max_seq:
@@ -114,14 +128,25 @@ class TransformerLM:
             o = attn(q, k, v).reshape(b, s, h * hd)
             x = x + o @ blk["wo"]
             y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
-            return x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+            if self.moe_experts:
+                from ..parallel.ep import moe_mlp
+
+                m, aux = moe_mlp(
+                    y.reshape(b * s, self.dim), blk["moe"],
+                    n_experts=self.moe_experts, axis=moe_axis,
+                )
+                return x + m.reshape(b, s, self.dim), aux
+            return x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"], jnp.zeros(())
 
         if remat:
             # Recompute block activations in the backward pass (the
             # long-context memory lever; composes with ring attention's
             # O(S/P) residency since attn_fn runs inside the checkpoint).
             block = jax.checkpoint(block)
+        aux_total = jnp.zeros(())
         for blk in params["blocks"]:
-            x = block(blk, x)
+            x, aux = block(blk, x)
+            aux_total = aux_total + aux
         x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-        return x @ params["head"]
+        logits = x @ params["head"]
+        return (logits, aux_total) if return_aux else logits

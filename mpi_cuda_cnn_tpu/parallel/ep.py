@@ -114,17 +114,35 @@ def moe_mlp(
         p = lax.axis_size(axis)
         if n_experts % p:
             raise ValueError(f"experts {n_experts} not divisible by axis size {p}")
-        if params["w1"].shape[0] != n_experts // p:
+        e_local = n_experts // p
+        if params["w1"].shape[0] == e_local:
+            # Pre-sharded stacks (moe_param_specs): O(E/P) param memory —
+            # the standalone EP layer's layout.
+            w1, w2 = params["w1"], params["w2"]
+        elif params["w1"].shape[0] == n_experts:
+            # Replicated full stacks, sliced to this device's experts by
+            # axis index — the layout a replicated-params train step
+            # (e.g. the SP LM step) provides. Compute/token routing is
+            # still expert-parallel; only param memory is not scaled.
+            # Gradient note: the dynamic_slice transpose scatters each
+            # expert's cotangent into its rows on exactly one device, so
+            # a pmean over the axis yields the same (1/P)-scaled gradient
+            # as every replicated leaf.
+            me = lax.axis_index(axis)
+            w1 = lax.dynamic_slice_in_dim(params["w1"], me * e_local, e_local, 0)
+            w2 = lax.dynamic_slice_in_dim(params["w2"], me * e_local, e_local, 0)
+        else:
             raise ValueError(
-                f"expected {n_experts // p} local experts in w1, got "
-                f"{params['w1'].shape[0]} — shard the stacks over {axis!r}"
+                f"w1 holds {params['w1'].shape[0]} experts; expected "
+                f"{e_local} (sharded over {axis!r}) or {n_experts} "
+                "(replicated)"
             )
         # (E, C, D) -> (E/P, P*C, D): every device receives the slots
         # destined for ITS experts from every device.
         expert_in = lax.all_to_all(
             expert_in, axis, split_axis=0, concat_axis=1, tiled=True
         )
-        expert_out = _expert_ffn(expert_in, params["w1"], params["w2"])
+        expert_out = _expert_ffn(expert_in, w1, w2)
         # Inverse: (E/P, P*C, D) -> (E, C, D), back on the tokens' owner.
         expert_out = lax.all_to_all(
             expert_out, axis, split_axis=1, concat_axis=0, tiled=True

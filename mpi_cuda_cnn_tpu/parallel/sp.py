@@ -157,6 +157,7 @@ def make_sp_lm_train_step(
     data_axis: str | None = None,
     donate: bool = True,
     remat: bool = False,
+    moe_aux_weight: float = 0.01,
 ):
     """Jitted causal-LM train step with the sequence dim sharded on `axis`
     (long-context training: each device holds S/P tokens of activations)
@@ -196,14 +197,22 @@ def make_sp_lm_train_step(
         pos_offset = lax.axis_index(axis) * s_local
         attn = partial(attn_body, axis=axis, causal=True)
 
+        moe = getattr(model, "moe_experts", 0)
+
         def loss_fn(params):
-            logits = model.apply(
+            # MoE blocks run expert-parallel over the SAME 'seq' axis the
+            # sequence is sharded on (EP x SP: each device holds E/P
+            # experts AND S/P tokens; parallel/ep.py's all_to_alls route
+            # between them).
+            out = model.apply(
                 params, tokens, attn_fn=attn, pos_offset=pos_offset,
                 remat=remat,
+                **({"moe_axis": axis, "return_aux": True} if moe else {}),
             )
+            logits, aux = out if moe else (out, 0.0)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-            return jnp.mean(nll)
+            return jnp.mean(nll) + moe_aux_weight * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(state["params"])
         grads = lax.pmean(grads, reduce_axes)
