@@ -1,0 +1,208 @@
+"""Autoregressive decoding for TransformerLM with a KV cache.
+
+The training path (transformer.py) recomputes full-sequence attention;
+decoding reuses it would be O(S^2) per generated token. This module adds
+the standard cache: each block keeps (k, v) of static shape
+(B, max_seq, H, D), a decode step writes position t with
+dynamic_update_slice and attends over positions <= t via masking — all
+static shapes, so the whole generate loop jits as one lax.scan program.
+
+Works with dense and MoE blocks (single-device routing; EP-sharded decode
+is not wired). Sampling: greedy (temperature=0) or temperature-scaled
+categorical with a jax.random key.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import NEG_INF
+from .transformer import TransformerLM, _layernorm
+
+
+def init_cache(model: TransformerLM, batch: int) -> list[dict]:
+    """Empty per-block KV buffers, static (B, max_seq, H, head_dim)."""
+    shape = (batch, model.max_seq, model.heads, model.head_dim)
+    return [
+        {"k": jnp.zeros(shape, jnp.float32), "v": jnp.zeros(shape, jnp.float32)}
+        for _ in range(model.depth)
+    ]
+
+
+def _attend_cached(q, ck, cv, pos):
+    """q: (B, 1, H, D) at position `pos`; ck/cv: (B, max_seq, H, D) with
+    positions > pos unwritten. Masked softmax over the valid prefix."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
+    ) * scale                                       # (B, H, 1, max_seq)
+    valid = jnp.arange(ck.shape[1]) <= pos          # (max_seq,)
+    logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(cv.dtype), cv,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
+    """Batched prompt pass: one full-sequence forward (large causal-
+    attention matmuls, not S0 sequential decode steps) that also captures
+    each block's K/V into max_seq-sized cache buffers.
+
+    Returns (logits_last: (B, vocab), cache). MoE blocks route with
+    no-drop capacity, matching decode_step (see the note there).
+    """
+    from ..ops.attention import attention
+
+    b, s0 = prompt.shape
+    h, hd = model.heads, model.head_dim
+    pos = jnp.arange(s0)
+    x = params["tok_emb"][prompt] + params["pos_emb"][pos][None, :, :]
+    cache = []
+    full = (b, model.max_seq, h, hd)
+    for blk in params["blocks"]:
+        y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+        qkv = y @ blk["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s0, h, hd)
+        k = k.reshape(b, s0, h, hd)
+        v = v.reshape(b, s0, h, hd)
+        cache.append({
+            "k": lax.dynamic_update_slice(
+                jnp.zeros(full, jnp.float32), k.astype(jnp.float32), (0, 0, 0, 0)
+            ),
+            "v": lax.dynamic_update_slice(
+                jnp.zeros(full, jnp.float32), v.astype(jnp.float32), (0, 0, 0, 0)
+            ),
+        })
+        o = attention(q, k, v, causal=True).reshape(b, s0, h * hd)
+        x = x + o @ blk["wo"]
+        y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+        if model.moe_experts:
+            from ..parallel.ep import moe_mlp
+
+            m, _ = moe_mlp(
+                y.reshape(b * s0, model.dim), blk["moe"],
+                n_experts=model.moe_experts, axis=None,
+                capacity_factor=float(model.moe_experts),
+            )
+            x = x + m.reshape(b, s0, model.dim)
+        else:
+            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return (x @ params["head"])[:, -1, :], cache
+
+
+def decode_step(model: TransformerLM, params, tok, pos, cache):
+    """One token through the model using/updating the cache.
+
+    tok: (B,) int32 current tokens; pos: scalar int32 their position.
+    Returns (logits: (B, vocab), new_cache).
+    """
+    b = tok.shape[0]
+    h, hd = model.heads, model.head_dim
+    x = params["tok_emb"][tok] + params["pos_emb"][pos]   # (B, dim)
+    x = x[:, None, :]                                     # (B, 1, dim)
+    new_cache = []
+    for blk, c in zip(params["blocks"], cache):
+        y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+        qkv = y @ blk["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, 1, h, hd)
+        k = k.reshape(b, 1, h, hd)
+        v = v.reshape(b, 1, h, hd)
+        ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype), (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype), (0, pos, 0, 0))
+        new_cache.append({"k": ck, "v": cv})
+        o = _attend_cached(q, ck, cv, pos).reshape(b, 1, h * hd)
+        x = x + o @ blk["wo"]
+        y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+        if model.moe_experts:
+            from ..parallel.ep import moe_mlp
+
+            # capacity_factor = E makes capacity = batch: no decode token
+            # is ever dropped, so one request's output cannot depend on
+            # which experts OTHER batch rows happened to pick (training's
+            # capacity dropping is a regularizer; at inference it would be
+            # cross-request contamination).
+            m, _ = moe_mlp(
+                y.reshape(b, model.dim), blk["moe"],
+                n_experts=model.moe_experts, axis=None,
+                capacity_factor=float(model.moe_experts),
+            )
+            x = x + m.reshape(b, 1, model.dim)
+        else:
+            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return (x @ params["head"])[:, 0, :], new_cache
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
+                  temperature: float):
+    """One jitted prefill+scan program per (model, shape, sampling)
+    combination — repeat generate() calls hit this cache instead of
+    retracing."""
+
+    def sample(logits, k):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    def gen_body(params):
+        def body(carry, i):
+            cache, logits, klocal = carry
+            klocal, kstep = jax.random.split(klocal)
+            tok = sample(logits, kstep)
+            logits, cache = decode_step(model, params, tok, s0 + i, cache)
+            return (cache, logits, klocal), tok
+
+        return body
+
+    @jax.jit
+    def run(params, prompt, key):
+        logits, cache = prefill(model, params, prompt)
+        (_, _, _), toks = lax.scan(
+            gen_body(params), (cache, logits, key), jnp.arange(num_tokens)
+        )
+        return toks.T                                   # (B, num_tokens)
+
+    return run
+
+
+def generate(
+    model: TransformerLM,
+    params,
+    prompt: jnp.ndarray,          # (B, S0) int32
+    num_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+):
+    """Prefill the prompt (one batched forward), then sample `num_tokens`
+    continuations with the KV-cached decode scan.
+
+    Returns (B, num_tokens) int32. Greedy argmax at temperature 0,
+    categorical sampling otherwise (key required). Prompt length +
+    num_tokens must fit max_seq.
+    """
+    b, s0 = prompt.shape
+    if s0 + num_tokens > model.max_seq:
+        raise ValueError(
+            f"prompt {s0} + {num_tokens} new tokens exceeds max_seq "
+            f"{model.max_seq}"
+        )
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused at temperature 0
+    run = _compiled_run(model, s0, num_tokens, float(temperature))
+    return run(params, prompt, key)

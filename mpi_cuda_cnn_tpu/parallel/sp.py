@@ -197,19 +197,16 @@ def make_sp_lm_train_step(
         pos_offset = lax.axis_index(axis) * s_local
         attn = partial(attn_body, axis=axis, causal=True)
 
-        moe = getattr(model, "moe_experts", 0)
-
         def loss_fn(params):
-            # MoE blocks run expert-parallel over the SAME 'seq' axis the
-            # sequence is sharded on (EP x SP: each device holds E/P
-            # experts AND S/P tokens; parallel/ep.py's all_to_alls route
-            # between them).
-            out = model.apply(
+            # MoE blocks (if the model has any) run expert-parallel over
+            # the SAME 'seq' axis the sequence is sharded on (EP x SP:
+            # each device holds E/P experts AND S/P tokens;
+            # parallel/ep.py's all_to_alls route between them). Dense
+            # models return aux = 0.
+            logits, aux = model.apply(
                 params, tokens, attn_fn=attn, pos_offset=pos_offset,
-                remat=remat,
-                **({"moe_axis": axis, "return_aux": True} if moe else {}),
+                remat=remat, moe_axis=axis, return_aux=True,
             )
-            logits, aux = out if moe else (out, 0.0)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
             return jnp.mean(nll) + moe_aux_weight * aux
