@@ -1,15 +1,26 @@
 """Autoregressive decoding for TransformerLM with a KV cache.
 
 The training path (transformer.py) recomputes full-sequence attention;
-decoding reuses it would be O(S^2) per generated token. This module adds
-the standard cache: each block keeps (k, v) of static shape
-(B, max_seq, H, D), a decode step writes position t with
+reusing it per generated token would be O(S^2). This module adds the
+standard cache: each block keeps (k, v) of static shape
+(B, max_seq, H, D); a decode step writes position t with
 dynamic_update_slice and attends over positions <= t via masking — all
 static shapes, so the whole generate loop jits as one lax.scan program.
 
-Works with dense and MoE blocks (single-device routing; EP-sharded decode
-is not wired). Sampling: greedy (temperature=0) or temperature-scaled
-categorical with a jax.random key.
+Prefill is NOT a separate forward implementation: it calls
+`model.apply` with a k/v-capturing attn_fn, so the training forward stays
+the single source of truth for the prompt pass (decode_step is the only
+cached re-implementation, and the teacher-forcing parity test binds it to
+apply()).
+
+MoE blocks use `moe_mlp_inference` (compute-all-experts, top-1 select) in
+BOTH prefill and decode: exactly no-drop, O(T*E*H) memory, and token t's
+output depends on token t alone — training's capacity-dropped dispatch
+is a regularizer, not an inference semantic (it would leak other batch
+rows' routing into a request's logits).
+
+Sampling: greedy (temperature=0) or temperature-scaled categorical with a
+jax.random key.
 """
 
 from __future__ import annotations
@@ -20,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import NEG_INF
+from ..ops.attention import NEG_INF, attention
 from .transformer import TransformerLM, _layernorm
 
 
@@ -31,6 +42,37 @@ def init_cache(model: TransformerLM, batch: int) -> list[dict]:
         {"k": jnp.zeros(shape, jnp.float32), "v": jnp.zeros(shape, jnp.float32)}
         for _ in range(model.depth)
     ]
+
+
+def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
+    """Batched prompt pass: ONE model.apply call whose attn_fn captures
+    each block's K/V into max_seq-sized cache buffers.
+
+    Returns (logits_last: (B, vocab), cache).
+    """
+    b, s0 = prompt.shape
+    if s0 > model.max_seq:
+        raise ValueError(f"prompt length {s0} exceeds max_seq {model.max_seq}")
+    full = (b, model.max_seq, model.heads, model.head_dim)
+    cache: list[dict] = []
+
+    def capture_attn(q, k, v):
+        cache.append({
+            "k": lax.dynamic_update_slice(
+                jnp.zeros(full, jnp.float32), k.astype(jnp.float32),
+                (0, 0, 0, 0),
+            ),
+            "v": lax.dynamic_update_slice(
+                jnp.zeros(full, jnp.float32), v.astype(jnp.float32),
+                (0, 0, 0, 0),
+            ),
+        })
+        return attention(q, k, v, causal=True)
+
+    logits = model.apply(
+        params, prompt, attn_fn=capture_attn, moe_inference=True
+    )
+    return logits[:, -1, :], cache
 
 
 def _attend_cached(q, ck, cv, pos):
@@ -50,61 +92,16 @@ def _attend_cached(q, ck, cv, pos):
     ).astype(q.dtype)
 
 
-def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
-    """Batched prompt pass: one full-sequence forward (large causal-
-    attention matmuls, not S0 sequential decode steps) that also captures
-    each block's K/V into max_seq-sized cache buffers.
-
-    Returns (logits_last: (B, vocab), cache). MoE blocks route with
-    no-drop capacity, matching decode_step (see the note there).
-    """
-    from ..ops.attention import attention
-
-    b, s0 = prompt.shape
-    h, hd = model.heads, model.head_dim
-    pos = jnp.arange(s0)
-    x = params["tok_emb"][prompt] + params["pos_emb"][pos][None, :, :]
-    cache = []
-    full = (b, model.max_seq, h, hd)
-    for blk in params["blocks"]:
-        y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-        qkv = y @ blk["wqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s0, h, hd)
-        k = k.reshape(b, s0, h, hd)
-        v = v.reshape(b, s0, h, hd)
-        cache.append({
-            "k": lax.dynamic_update_slice(
-                jnp.zeros(full, jnp.float32), k.astype(jnp.float32), (0, 0, 0, 0)
-            ),
-            "v": lax.dynamic_update_slice(
-                jnp.zeros(full, jnp.float32), v.astype(jnp.float32), (0, 0, 0, 0)
-            ),
-        })
-        o = attention(q, k, v, causal=True).reshape(b, s0, h * hd)
-        x = x + o @ blk["wo"]
-        y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
-        if model.moe_experts:
-            from ..parallel.ep import moe_mlp
-
-            m, _ = moe_mlp(
-                y.reshape(b * s0, model.dim), blk["moe"],
-                n_experts=model.moe_experts, axis=None,
-                capacity_factor=float(model.moe_experts),
-            )
-            x = x + m.reshape(b, s0, model.dim)
-        else:
-            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
-    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return (x @ params["head"])[:, -1, :], cache
-
-
 def decode_step(model: TransformerLM, params, tok, pos, cache):
     """One token through the model using/updating the cache.
 
-    tok: (B,) int32 current tokens; pos: scalar int32 their position.
+    tok: (B,) int32 current tokens; pos: their position — a traced scalar
+    inside generate()'s scan (bounds are enforced there; a concrete
+    out-of-range pos raises here, a traced one cannot be checked).
     Returns (logits: (B, vocab), new_cache).
     """
+    if isinstance(pos, int) and pos >= model.max_seq:
+        raise ValueError(f"position {pos} out of range (max_seq {model.max_seq})")
     b = tok.shape[0]
     h, hd = model.heads, model.head_dim
     x = params["tok_emb"][tok] + params["pos_emb"][pos]   # (B, dim)
@@ -124,17 +121,11 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
         x = x + o @ blk["wo"]
         y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
         if model.moe_experts:
-            from ..parallel.ep import moe_mlp
+            from ..parallel.ep import moe_mlp_inference
 
-            # capacity_factor = E makes capacity = batch: no decode token
-            # is ever dropped, so one request's output cannot depend on
-            # which experts OTHER batch rows happened to pick (training's
-            # capacity dropping is a regularizer; at inference it would be
-            # cross-request contamination).
-            m, _ = moe_mlp(
+            m = moe_mlp_inference(
                 y.reshape(b, model.dim), blk["moe"],
-                n_experts=model.moe_experts, axis=None,
-                capacity_factor=float(model.moe_experts),
+                n_experts=model.moe_experts,
             )
             x = x + m.reshape(b, 1, model.dim)
         else:
@@ -170,10 +161,16 @@ def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
     @jax.jit
     def run(params, prompt, key):
         logits, cache = prefill(model, params, prompt)
-        (_, _, _), toks = lax.scan(
-            gen_body(params), (cache, logits, key), jnp.arange(num_tokens)
+        # Scan N-1 steps (each samples from the carried logits, then runs
+        # the forward that produces the NEXT logits); the final token only
+        # needs a sample, not another forward.
+        (_, logits, key), toks = lax.scan(
+            gen_body(params), (cache, logits, key),
+            jnp.arange(num_tokens - 1),
         )
-        return toks.T                                   # (B, num_tokens)
+        key, klast = jax.random.split(key)
+        last = sample(logits, klast)
+        return jnp.concatenate([toks, last[None, :]], axis=0).T
 
     return run
 
@@ -195,6 +192,8 @@ def generate(
     num_tokens must fit max_seq.
     """
     b, s0 = prompt.shape
+    if num_tokens < 1:
+        raise ValueError("num_tokens must be >= 1")
     if s0 + num_tokens > model.max_seq:
         raise ValueError(
             f"prompt {s0} + {num_tokens} new tokens exceeds max_seq "

@@ -104,6 +104,9 @@ class TransformerLM:
         remat: bool = False,           # jax.checkpoint per block
         moe_axis: str | None = None,   # mesh axis for EP expert sharding
                                        # (None = dense single-device MoE)
+        moe_inference: bool = False,   # no-drop compute-all-experts MoE
+                                       # (ep.moe_mlp_inference) — the
+                                       # decode/prefill semantic
         return_aux: bool = False,      # also return the MoE balance loss
     ):                                 # (B, S, vocab) logits [, aux]
         b, s = tokens.shape
@@ -129,12 +132,21 @@ class TransformerLM:
             x = x + o @ blk["wo"]
             y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
             if self.moe_experts:
-                from ..parallel.ep import moe_mlp
+                if moe_inference:
+                    from ..parallel.ep import moe_mlp_inference
 
-                m, aux = moe_mlp(
-                    y.reshape(b * s, self.dim), blk["moe"],
-                    n_experts=self.moe_experts, axis=moe_axis,
-                )
+                    m = moe_mlp_inference(
+                        y.reshape(b * s, self.dim), blk["moe"],
+                        n_experts=self.moe_experts,
+                    )
+                    aux = jnp.zeros(())
+                else:
+                    from ..parallel.ep import moe_mlp
+
+                    m, aux = moe_mlp(
+                        y.reshape(b * s, self.dim), blk["moe"],
+                        n_experts=self.moe_experts, axis=moe_axis,
+                    )
                 return x + m.reshape(b, s, self.dim), aux
             return x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"], jnp.zeros(())
 

@@ -159,6 +159,26 @@ def moe_param_specs(axis: str = EXPERT_AXIS) -> dict:
     return {"gate": P(), "w1": P(axis), "w2": P(axis)}
 
 
+def moe_mlp_inference(x, params: dict, *, n_experts: int):
+    """No-drop top-1 MoE for INFERENCE: every token runs through every
+    expert and the router's choice selects the output.
+
+    E-fold MLP FLOPs, but O(T*E*H) memory instead of the dispatch
+    formulation's O(T^2) no-drop tensors — and, unlike capacity routing,
+    token t's output depends on token t alone (no batch contamination, no
+    causality leak through queue positions). The right trade for decode
+    and prefill; training keeps the capacity-dropped dispatch (moe_mlp).
+    """
+    probs = jax.nn.softmax((x @ params["gate"]).astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                       # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)
+    h = jax.nn.relu(jnp.einsum("td,edh->teh", x, params["w1"]))
+    y_all = jnp.einsum("teh,ehd->ted", h, params["w2"])       # (T, E, D)
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=y_all.dtype)
+    y = jnp.einsum("ted,te->td", y_all, onehot) * gate.astype(y_all.dtype)
+    return y.astype(x.dtype)
+
+
 def make_moe_layer(mesh, *, n_experts, capacity_factor=1.25, axis=EXPERT_AXIS):
     """jitted (params, x) -> (y, aux) with x: (T, D) sharded on `axis` and
     the expert stacks sharded per moe_param_specs — the wrapped EP layer

@@ -1,0 +1,71 @@
+"""Flash-attention Pallas kernel (ops/pallas_attention.py): parity with
+the oracle in interpreter mode, gradients, block picking, shape guards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.ops.attention import attention
+from mpi_cuda_cnn_tpu.ops.pallas_attention import _pick_block, flash_attention
+
+
+def _qkv(b, s, h, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("b,s,h,d", [(2, 256, 2, 64), (1, 384, 4, 32),
+                                     (1, 1024, 2, 128)])
+def test_flash_matches_oracle(causal, b, s, h, d):
+    q, k, v = _qkv(b, s, h, d)
+    got = flash_attention(q, k, v, causal)
+    want = attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_gradients_match_oracle():
+    q, k, v = _qkv(1, 256, 2, 64, seed=1)
+
+    def loss_f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True) ** 2)
+
+    def loss_o(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss_o, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, go):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_pick_block():
+    assert _pick_block(8192, 512) == 512
+    assert _pick_block(256, 512) == 256
+    assert _pick_block(384, 512) == 384
+    assert _pick_block(640, 512) == 128   # 640 = 5 * 128
+    assert _pick_block(1024, 1024) == 1024
+
+
+def test_flash_rejects_unaligned_seq():
+    q, k, v = _qkv(1, 130, 2, 64)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention(q, k, v)
+
+
+def test_flash_bf16_inputs_roundtrip():
+    q, k, v = _qkv(1, 256, 2, 64, seed=2)
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    out = flash_attention(qb, kb, vb, True)
+    assert out.dtype == jnp.bfloat16
+    want = attention(qb.astype(jnp.float32), kb.astype(jnp.float32),
+                     vb.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(want), rtol=5e-2, atol=5e-2
+    )

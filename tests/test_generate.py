@@ -87,6 +87,43 @@ def test_trained_model_generates_the_cycle():
     np.testing.assert_array_equal(out[0], want)
 
 
+def test_decode_matches_inference_forward_moe():
+    """MoE decode parity: cached per-token decoding must equal the
+    teacher-forced forward under the same no-drop inference routing."""
+    model = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=32,
+                          moe_experts=4)
+    params = model.init(jax.random.key(1))
+    toks = jnp.asarray(
+        np.random.default_rng(2).integers(0, 13, (2, 12)), jnp.int32
+    )
+    want = model.apply(params, toks, moe_inference=True)
+
+    cache = init_cache(model, 2)
+    got = []
+    for i in range(12):
+        logits, cache = decode_step(model, params, toks[:, i], i, cache)
+        got.append(logits)
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_inference_routing_is_per_token():
+    """moe_mlp_inference: a token's output must not depend on other
+    tokens in the batch (the property capacity dropping violates)."""
+    from mpi_cuda_cnn_tpu.parallel.ep import init_moe_params, moe_mlp_inference
+
+    p = init_moe_params(jax.random.key(0), 16, 32, 4)
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((8, 16)),
+                    jnp.float32)
+    full = moe_mlp_inference(x, p, n_experts=4)
+    solo = jnp.concatenate([
+        moe_mlp_inference(x[i : i + 1], p, n_experts=4) for i in range(8)
+    ])
+    np.testing.assert_allclose(np.asarray(full), np.asarray(solo),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_generate_moe_model_runs():
     model = TransformerLM(vocab=13, dim=32, heads=4, depth=1, max_seq=32,
                           moe_experts=4)
