@@ -18,11 +18,14 @@ Compute is (BLK_Q, D) @ (D, BLK_K) MXU contractions at HIGHEST precision
 treatment; bf16 casts at the boundary). Causal masking uses 2-D
 broadcasted_iota and skips blocks fully above the diagonal.
 
-Backward: custom_vjp recomputes attention with the XLA oracle and
-differentiates that — correct gradients (tested), O(S^2) bwd memory; a
-fused Pallas backward is future work. The reference never wrote ANY
-attention (SURVEY.md §5.7) — this kernel exists for the framework's
-long-context path, as the fused twin of ops/attention.py.
+Backward: fused too — a dq kernel (q-rows outer, k-blocks streamed) and a
+dk/dv kernel (k-rows outer, q-blocks streamed), with the softmax
+probabilities reconstructed exactly from the forward's saved per-row
+logsumexp (p = exp(s - L); causal masking falls out as exp(NEG_INF - L)
+= 0). O(block) memory end to end; gradient accuracy ~4e-5 of a float64
+reference on TPU (PERF.md). The reference never wrote ANY attention
+(SURVEY.md §5.7) — this kernel exists for the framework's long-context
+path, as the fused twin of ops/attention.py.
 """
 
 from __future__ import annotations
@@ -35,7 +38,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention import NEG_INF, attention
+from .attention import NEG_INF
 
 # Tuned on v5e (s=8192, d=64): large blocks amortize per-grid-step
 # overhead; (512, 1024) ran ~1.5x faster than the XLA oracle at equal
@@ -49,7 +52,8 @@ def _interpret() -> bool:
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, causal, nk, scale
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, causal, nk, scale
 ):
     """One (batch*head, q-block, k-block) grid step.
 
@@ -115,6 +119,12 @@ def _flash_kernel(
     def _():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # Per-row logsumexp, saved for the fused backward: p can be
+        # reconstructed exactly as exp(s - L) without re-running the
+        # online recurrence. Stored (1, 8, blk_q) — the sublane dim is
+        # padded to 8 because Pallas blocks need (8, 128)-divisible tails.
+        lse = m_ref[:, 0] + jnp.log(l[:, 0])
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
 def _pick_block(s: int, cap: int) -> int:
@@ -126,7 +136,15 @@ def _pick_block(s: int, cap: int) -> int:
     return b
 
 
-def _flash_forward(q, k, v, causal: bool):
+def _to_rows(t, b, h, s, d):
+    return t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_rows(t, b, h, s, d):
+    return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False):
     b, s, h, d = q.shape
     if s % 128:
         raise ValueError(f"seq len {s} must be a multiple of 128")
@@ -136,14 +154,13 @@ def _flash_forward(q, k, v, causal: bool):
     # f32 in the kernel: packed-dtype (bf16) sublane slicing needs extra
     # alignment work; numerics match the oracle's f32 accumulation anyway.
     qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
-    to_rows = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    qr, kr, vr = to_rows(qf), to_rows(kf), to_rows(vf)
+    qr, kr, vr = (_to_rows(t, b, h, s, d) for t in (qf, kf, vf))
 
     nk = s // blk_k
     kernel = functools.partial(
         _flash_kernel, causal=causal, nk=nk, scale=1.0 / (d ** 0.5)
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // blk_q, nk),
         in_specs=[
@@ -154,9 +171,16 @@ def _flash_forward(q, k, v, causal: bool):
             pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, blk_q), lambda bh, i, j: (bh, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 8, s), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, d), jnp.float32),    # acc
             pltpu.VMEM((blk_q, 128), jnp.float32),  # running max (col 0)
@@ -164,28 +188,208 @@ def _flash_forward(q, k, v, causal: bool):
         ],
         interpret=_interpret(),
     )(qr, kr, vr)
-    return (
-        out.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(orig_dtype)
+    out = _from_rows(out, b, h, s, d).astype(orig_dtype)
+    return (out, lse[:, 0, :]) if with_lse else out
+
+
+# ---------------------------------------------------------------------------
+# Fused backward: dq kernel (rows x streamed k-blocks) + dk/dv kernel
+# (k-rows x streamed q-blocks). p is reconstructed exactly from the saved
+# logsumexp (p = exp(s - L)); causal masking falls out of s = NEG_INF ->
+# p = 0 with finite L. All accumulators live in VMEM scratch: O(block)
+# memory, like the forward.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, acc_ref,
+    *, causal, nk, scale
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    q = q_ref[0]
+    blk_q, d = q.shape
+    blk_k = k_ref.shape[1]
+
+    @pl.when(kj == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def fold():
+        s = jax.lax.dot_general(
+            q, k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale
+        if causal:
+            qpos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = kj * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        # lse/dvec arrive column-oriented: (1, blk_q, 128) with the row
+        # value replicated along lanes; [:, :1] is the (blk_q, 1) column.
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dov = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ds = p * (dov - dvec_ref[0][:, :1]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    if causal:
+        pl.when(kj * blk_k <= qi * blk_q + blk_q - 1)(fold)
+    else:
+        fold()
+
+    @pl.when(kj == nk - 1)
+    def _():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, causal, nq, scale
+):
+    ki = pl.program_id(1)
+    qj = pl.program_id(2)
+    k = k_ref[0]
+    blk_k, d = k.shape
+    blk_q = q_ref.shape[1]
+
+    @pl.when(qj == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def fold():
+        # Transposed tile: rows = this program's keys, lanes = queries.
+        s_t = jax.lax.dot_general(
+            k, q_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale                                    # (blk_k, blk_q)
+        if causal:
+            kpos = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 0)
+            qpos = qj * blk_q + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 1)
+            s_t = jnp.where(kpos <= qpos, s_t, NEG_INF)
+        # lse/dvec arrive lane-oriented: (1, 8, blk_q); row 0 of the
+        # sublane padding is the (blk_q,) lane vector.
+        p_t = jnp.exp(s_t - lse_ref[0, 0, :][None, :])
+        dv_acc[:] += jax.lax.dot_general(
+            p_t, do_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        vdo = jax.lax.dot_general(
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                            # (blk_k, blk_q)
+        ds_t = p_t * (vdo - dvec_ref[0, 0, :][None, :]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds_t, q_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    if causal:
+        # Queries strictly before this key block are fully masked.
+        pl.when(qj * blk_q + blk_q - 1 >= ki * blk_k)(fold)
+    else:
+        fold()
+
+    @pl.when(qj == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal: bool):
+    b, s, h, d = q.shape
+    blk_q = _pick_block(s, BLK_Q)
+    blk_k = _pick_block(s, BLK_K)
+    scale = 1.0 / (d ** 0.5)
+    qr, kr, vr, orr, gr = (
+        _to_rows(t.astype(jnp.float32), b, h, s, d) for t in (q, k, v, o, g)
+    )
+    # D_i = rowsum(dO_i * O_i) — elementwise, O(S*D).
+    dvec = jnp.sum(gr * orr, axis=-1)                # (b*h, s)
+    # Two orientations of the per-row vectors, so neither kernel pays a
+    # sublane<->lane relayout: columns (lanes replicated) for the dq
+    # kernel, lanes (8 sublanes replicated) for the dk/dv kernel.
+    lse_col = jnp.broadcast_to(lse[:, :, None], (b * h, s, 128))
+    dvec_col = jnp.broadcast_to(dvec[:, :, None], (b * h, s, 128))
+    lse_row = jnp.broadcast_to(lse[:, None, :], (b * h, 8, s))
+    dvec_row = jnp.broadcast_to(dvec[:, None, :], (b * h, 8, s))
+
+    q_spec = pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
+                          memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((1, blk_q, 128), lambda bh, i, j: (bh, i, 0),
+                            memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, j, 0),
+                          memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, nk=s // blk_k,
+                          scale=scale),
+        grid=(b * h, s // blk_q, s // blk_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, col_spec, col_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lse_col, dvec_col)
+
+    # dk/dv: k-rows outer, q-blocks streamed innermost.
+    kq_spec = pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, i, 0),
+                           memory_space=pltpu.VMEM)
+    qs_spec = pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, j, 0),
+                           memory_space=pltpu.VMEM)
+    rows_spec = pl.BlockSpec((1, 8, blk_q), lambda bh, i, j: (bh, 0, j),
+                             memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, nq=s // blk_q,
+                          scale=scale),
+        grid=(b * h, s // blk_k, s // blk_q),
+        in_specs=[qs_spec, kq_spec, kq_spec, qs_spec, rows_spec, rows_spec],
+        out_specs=[kq_spec, kq_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lse_row, dvec_row)
+
+    return tuple(
+        _from_rows(t, b, h, s, d).astype(ref.dtype)
+        for t, ref in ((dq, q), (dk, k), (dv, v))
     )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal: bool = False):
     """Fused scaled-dot-product attention. q/k/v: (B, S, H, D), S a
-    multiple of 128. Exact (online softmax), causal optional."""
+    multiple of 128. Exact (online softmax), causal optional. Both the
+    forward and backward are fused Pallas kernels with O(block) memory."""
     return _flash_forward(q, k, v, causal)
 
 
 def _fwd(q, k, v, causal):
-    return _flash_forward(q, k, v, causal), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, res, g):
-    # Recompute-and-differentiate via the XLA oracle: correct, O(S^2)
-    # bwd memory (documented limitation; fused bwd kernel is future work).
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal)
 
 
 flash_attention.defvjp(_fwd, _bwd)
