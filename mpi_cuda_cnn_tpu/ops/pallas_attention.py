@@ -225,8 +225,9 @@ def _bwd_dq_kernel(
             qpos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = kj * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
-        # lse/dvec arrive column-oriented: (1, blk_q, 128) with the row
-        # value replicated along lanes; [:, :1] is the (blk_q, 1) column.
+        # lse/dvec arrive column-oriented: (1, blk_q, 8) with the row
+        # value replicated along the narrow lane dim; [:, :1] is the
+        # (blk_q, 1) column.
         p = jnp.exp(s - lse_ref[0][:, :1])
         dov = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -319,16 +320,20 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool):
     # D_i = rowsum(dO_i * O_i) — elementwise, O(S*D).
     dvec = jnp.sum(gr * orr, axis=-1)                # (b*h, s)
     # Two orientations of the per-row vectors, so neither kernel pays a
-    # sublane<->lane relayout: columns (lanes replicated) for the dq
-    # kernel, lanes (8 sublanes replicated) for the dk/dv kernel.
-    lse_col = jnp.broadcast_to(lse[:, :, None], (b * h, s, 128))
-    dvec_col = jnp.broadcast_to(dvec[:, :, None], (b * h, s, 128))
+    # sublane<->lane relayout: columns for the dq kernel, lanes for the
+    # dk/dv kernel. Both are NARROW (8-wide minor dim, not 128): the
+    # kernels only read lane/sublane 0, so HBM holds 8 replicas (the f32
+    # sublane tile) instead of a full 128-lane broadcast — 16x less HBM
+    # footprint/bandwidth for these side inputs; Mosaic lane-pads the
+    # (blk_q, 8) tile on load.
+    lse_col = jnp.broadcast_to(lse[:, :, None], (b * h, s, 8))
+    dvec_col = jnp.broadcast_to(dvec[:, :, None], (b * h, s, 8))
     lse_row = jnp.broadcast_to(lse[:, None, :], (b * h, 8, s))
     dvec_row = jnp.broadcast_to(dvec[:, None, :], (b * h, 8, s))
 
     q_spec = pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
                           memory_space=pltpu.VMEM)
-    col_spec = pl.BlockSpec((1, blk_q, 128), lambda bh, i, j: (bh, i, 0),
+    col_spec = pl.BlockSpec((1, blk_q, 8), lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, j, 0),
                           memory_space=pltpu.VMEM)

@@ -8,9 +8,11 @@ classic schedules:
   Each device keeps its query shard; key/value shards rotate around the
   ring with `lax.ppermute` (ICI neighbor exchange), and each arriving
   block folds into the exact online-softmax state (ops/attention.py).
-  After P hops every query has attended to every key: exact attention,
-  O(S/P) memory per device, compute/comm overlapped by XLA across the
-  fori_loop's ppermute + matmul.
+  P-1 rotate hops plus a final fold of the last-arrived block (the P-th
+  rotate would only return each device its own block, so it is skipped):
+  after the final fold every query has attended to every key — exact
+  attention, O(S/P) memory per device, compute/comm overlapped by XLA
+  across the fori_loop's ppermute + matmul.
 
 - **Ulysses all-to-all** (`ulysses_attention`): q/k/v sharded on S; an
   all_to_all re-shards to heads-sharded/sequence-complete, each device
@@ -70,20 +72,24 @@ def ring_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
     # so after h hops this device holds the block that started on me - h.
     perm = [(i, (i + 1) % p) for i in range(p)]
 
-    def hop(h, carry):
-        o_m_l, kh, vh = carry
+    def fold(o_m_l, kh, vh, h):
         src = (me - h) % p
         mask = _pair_mask(me, src, s_local, causal)
-        o_m_l = online_softmax_block(o_m_l, q, kh, vh, mask)
-        # Rotate AFTER folding; the last hop's rotate hands every device
-        # back its own block (cheap, and keeps the loop uniform).
+        return online_softmax_block(o_m_l, q, kh, vh, mask)
+
+    def hop(h, carry):
+        o_m_l, kh, vh = carry
+        o_m_l = fold(o_m_l, kh, vh, h)
         kh = lax.ppermute(kh, axis, perm)
         vh = lax.ppermute(vh, axis, perm)
         return o_m_l, kh, vh
 
-    carry = (init_online(q), k, v)
-    carry = lax.fori_loop(0, p, hop, carry)
-    return finalize_online(carry[0], q.dtype)
+    # p-1 fold+rotate hops, then fold the final resident block WITHOUT
+    # rotating — the p-th ppermute would only hand every device back its
+    # own k/v block, a wasted ICI hop per attention call.
+    o_m_l, kh, vh = lax.fori_loop(0, p - 1, hop, (init_online(q), k, v))
+    o_m_l = fold(o_m_l, kh, vh, p - 1)
+    return finalize_online(o_m_l, q.dtype)
 
 
 def ulysses_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
