@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_serial test_dp8 test_tpu bench bench_configs bench_configs_cpu8 northstar northstar_digits native test_native get_mnist clean
+.PHONY: test test_all test_serial test_dp8 test_tpu bench bench_configs bench_configs_cpu8 northstar northstar_digits native test_native get_mnist clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -17,8 +17,13 @@ test_native: native
 	$(MAKE) -C native test_abi
 
 # Unit/integration suite (CPU, 8 virtual devices — set in tests/conftest.py).
+# Fast default: the heavy tests in conftest.SLOW_TESTS are skipped (<5 min);
+# `make test_all` is the full superset (~15 min).
 test:
 	$(PY) -m pytest tests/ -x -q
+
+test_all:
+	$(PY) -m pytest tests/ -x -q --runslow
 
 # Serial e2e smoke run (twin of `make test_serial`, reference Makefile:38).
 # Uses synthetic data when $(DATA_DIR) has no MNIST IDX files.

@@ -24,6 +24,84 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Tests measured >=~7s on the CI box (pytest --durations, 2026-07-30).
+# Skipped by default so the round-trip suite stays <5 min; `--runslow`
+# (or `make test_all`) runs everything. Every subsystem keeps faster
+# tests in the default set — this list only trims the heavy variants.
+SLOW_TESTS = {
+    "test_accum_remat.py::test_grad_accum_matches_plain[data]",
+    "test_accum_remat.py::test_remat_transformer_grads_match",
+    "test_digits.py::test_accuracy_on_real_digits",
+    "test_dp.py::test_dp_composes_with_pallas_backend",
+    "test_flash_attention.py::test_flash_gradients_match_oracle[256-False]",
+    "test_flash_attention.py::test_flash_gradients_match_oracle[512-False]",
+    "test_fsdp.py::test_fsdp_e2e_train_and_eval",
+    "test_fsdp.py::test_fsdp_matches_replicated_dp[False]",
+    "test_fsdp.py::test_fsdp_matches_replicated_dp[True]",
+    "test_generate.py::test_decode_matches_inference_forward_moe",
+    "test_generate.py::test_decode_matches_training_forward",
+    "test_generate.py::test_moe_inference_routing_is_per_token",
+    "test_generate.py::test_trained_model_generates_the_cycle",
+    "test_models.py::test_presets_init_and_apply[cifar3conv]",
+    "test_models.py::test_presets_init_and_apply[lenet5_relu]",
+    "test_models.py::test_presets_init_and_apply[resnet8]",
+    "test_models.py::test_presets_init_and_apply[vgg_small]",
+    "test_models.py::test_residual_downsample_to_1x1",
+    "test_models.py::test_residual_gradients_flow_through_shortcut",
+    "test_models.py::test_residual_identity_vs_projection",
+    "test_multihost.py::test_two_process_dp_step",
+    "test_pallas.py::test_conv_bf16_parity[4-14-14-16-3-32-2-1]",
+    "test_pallas.py::test_conv_bf16_parity[4-28-28-1-3-16-2-1]",
+    "test_pallas.py::test_model_pallas_backend_trains",
+    "test_pp.py::test_pp_loss_and_grads_match_serial[2-4]",
+    "test_train.py::test_checkpoint_resume",
+    "test_train.py::test_convergence_cifar3conv",
+    "test_train.py::test_determinism_same_seed",
+    "test_train.py::test_irwin_hall_reference_config",
+    "test_train.py::test_pp_bfloat16_training",
+    "test_train.py::test_pp_checkpoint_resume",
+    "test_train.py::test_pp_rejects_bfloat16_params",
+    "test_train.py::test_pp_trainer_end_to_end",
+    "test_train.py::test_pp_trainer_matches_dp",
+    "test_train.py::test_scan_matches_per_batch_loop",
+    "test_transformer.py::test_moe_lm_trains_under_ring_sp",
+    "test_transformer.py::test_sp_dp_mesh_composes",
+    "test_transformer.py::test_sp_lm_learns_cyclic_task",
+    "test_transformer.py::test_sp_remat_composition",
+    "test_transformer.py::test_sp_step_parity_with_single_device[ring]",
+}
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="also run the tests listed in conftest.SLOW_TESTS",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    if any("::" in a for a in config.args):
+        # A test named explicitly on the command line should always run.
+        return
+    skip = pytest.mark.skip(reason="slow; use --runslow (make test_all)")
+    matched = set()
+    for item in items:
+        key = item.nodeid.split("/")[-1]
+        if key in SLOW_TESTS:
+            matched.add(key)
+            item.add_marker(skip)
+    # A renamed/reparametrized test would silently rejoin the fast suite;
+    # flag stale entries loudly. (Partial collection runs see a subset, so
+    # only check when the whole suite was collected.)
+    if len(items) > len(SLOW_TESTS) * 3:
+        stale = SLOW_TESTS - matched
+        if stale:
+            import warnings
+
+            warnings.warn(f"SLOW_TESTS entries match no test: {sorted(stale)}")
+
 
 @pytest.fixture(scope="session")
 def eight_devices():
