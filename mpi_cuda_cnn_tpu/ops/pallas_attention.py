@@ -144,7 +144,11 @@ def _from_rows(t, b, h, s, d):
     return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False):
+def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
+                   out_f32: bool = False):
+    """out_f32 keeps the f32 kernel output uncast — for callers (the
+    ring-flash fold) that merge partials in f32; casting each per-hop
+    partial to a bf16 input dtype would accumulate truncation error."""
     b, s, h, d = q.shape
     if s % 128:
         raise ValueError(f"seq len {s} must be a multiple of 128")
@@ -188,7 +192,9 @@ def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False):
         ],
         interpret=_interpret(),
     )(qr, kr, vr)
-    out = _from_rows(out, b, h, s, d).astype(orig_dtype)
+    out = _from_rows(out, b, h, s, d)
+    if not out_f32:
+        out = out.astype(orig_dtype)
     return (out, lse[:, 0, :]) if with_lse else out
 
 

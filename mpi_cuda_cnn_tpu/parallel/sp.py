@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import (
+    NEG_INF,
     finalize_online,
     init_online,
     online_softmax_block,
@@ -90,6 +91,163 @@ def ring_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
     o_m_l, kh, vh = lax.fori_loop(0, p - 1, hop, (init_online(q), k, v))
     o_m_l = fold(o_m_l, kh, vh, p - 1)
     return finalize_online(o_m_l, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ring-FLASH attention: the fused Pallas flash kernel as the per-shard
+# fold inside the ring. Same collective schedule as ring_attention, but
+# each arriving k/v block is folded by ops/pallas_attention's fused
+# kernels instead of the jnp online_softmax_block — logits never leave
+# VMEM. Differentiable via a custom VJP whose backward is a second ring
+# pass: k/v blocks rotate together with their dk/dv accumulators, and
+# each hop reuses the fused flash backward for one (q-shard, k-block)
+# pair with the probabilities reconstructed from the forward's global
+# logsumexp. This is the form a real long-context trainer runs.
+# ---------------------------------------------------------------------------
+
+
+def _flash_block(q, k, v, causal_flag: bool):
+    """(o, lse) of the fused flash forward for one k/v block; o stays in
+    the kernel's f32 (out_f32 — no per-hop truncation to a bf16 input
+    dtype before the f32 merge)."""
+    from ..ops.pallas_attention import _flash_forward
+
+    return _flash_forward(q, k, v, causal_flag, with_lse=True, out_f32=True)
+
+
+def _merge_partials(o, lse, o_blk, lse_blk, b, h):
+    """Fold a per-block normalized partial (o_blk, lse_blk) into the
+    running (o, lse). Both o's are (B, S, H, D) f32, lse's (B*H, S).
+    Standard two-softmax merge: weights exp(lse_i - logaddexp(...))."""
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    w_old = jnp.exp(lse - lse_new)
+    w_new = jnp.exp(lse_blk - lse_new)
+
+    def to_bsh1(x):  # (B*H, S) -> (B, S, H, 1)
+        return x.reshape(b, h, x.shape[-1]).transpose(0, 2, 1)[..., None]
+
+    return o * to_bsh1(w_old) + o_blk * to_bsh1(w_new), lse_new
+
+
+def _ring_case(me, src):
+    """0 = block fully before my rows (attend all), 1 = my own block
+    (local causal), 2 = block fully after (skip)."""
+    return jnp.where(src == me, 1, jnp.where(src < me, 0, 2))
+
+
+def _hop_dispatch(me, p, hcnt, causal, full, diag, none):
+    """The per-hop mask dispatch shared by the forward fold and the
+    backward contrib: the block folded at hop `hcnt` originated on
+    src = (me - hcnt) % p, and with equal shards a (me, src) pair is
+    either fully attended, the local-causal diagonal, or fully masked."""
+    if not causal:
+        return full(None)
+    src = (me - hcnt) % p
+    return lax.switch(_ring_case(me, src), (full, diag, none), None)
+
+
+def _ring_flash_fwd_impl(q, k, v, axis, causal):
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, s_local, h, d = q.shape
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def fold(o, lse, kh, vh, hcnt):
+        o_blk, lse_blk = _hop_dispatch(
+            me, p, hcnt, causal,
+            full=lambda _: _flash_block(q, kh, vh, False),
+            diag=lambda _: _flash_block(q, kh, vh, True),
+            none=lambda _: (
+                jnp.zeros((b, s_local, h, d), jnp.float32),
+                jnp.full((b * h, s_local), NEG_INF, jnp.float32),
+            ),
+        )
+        return _merge_partials(o, lse, o_blk, lse_blk, b, h)
+
+    def hop(hcnt, carry):
+        o, lse, kh, vh = carry
+        o, lse = fold(o, lse, kh, vh, hcnt)
+        kh = lax.ppermute(kh, axis, perm)
+        vh = lax.ppermute(vh, axis, perm)
+        return o, lse, kh, vh
+
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    lse0 = jnp.full((b * h, s_local), NEG_INF, jnp.float32)
+    o, lse, kh, vh = lax.fori_loop(0, p - 1, hop, (o0, lse0, k, v))
+    o, lse = fold(o, lse, kh, vh, p - 1)
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_bwd_impl(q, k, v, o, lse, g, axis, causal):
+    from ..ops.pallas_attention import _flash_backward
+
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def contrib(kh, vh, hcnt):
+        return _hop_dispatch(
+            me, p, hcnt, causal,
+            full=lambda _: _flash_backward(q, kh, vh, o, lse, g, False),
+            diag=lambda _: _flash_backward(q, kh, vh, o, lse, g, True),
+            none=lambda _: (
+                jnp.zeros_like(q), jnp.zeros_like(kh), jnp.zeros_like(vh)
+            ),
+        )
+
+    def hop(hcnt, carry):
+        dq, kh, vh, dkh, dvh = carry
+        dq_c, dk_c, dv_c = contrib(kh, vh, hcnt)
+        dq = dq + dq_c.astype(jnp.float32)
+        dkh = dkh + dk_c.astype(jnp.float32)
+        dvh = dvh + dv_c.astype(jnp.float32)
+        # k/v rotate WITH their gradient accumulators so each dk/dv rides
+        # along with its block; after p total rotations they are home.
+        kh, vh, dkh, dvh = (
+            lax.ppermute(t, axis, perm) for t in (kh, vh, dkh, dvh)
+        )
+        return dq, kh, vh, dkh, dvh
+
+    zero = jnp.zeros(q.shape, jnp.float32)
+    carry = (zero, k, v, jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    dq, kh, vh, dkh, dvh = lax.fori_loop(0, p - 1, hop, carry)
+    # Final hop: contribute, then rotate ONLY the accumulators home (the
+    # k/v rotate would be the wasted return hop — see ring_attention).
+    dq_c, dk_c, dv_c = contrib(kh, vh, p - 1)
+    dq = dq + dq_c.astype(jnp.float32)
+    dkh = lax.ppermute(dkh + dk_c.astype(jnp.float32), axis, perm)
+    dvh = lax.ppermute(dvh + dv_c.astype(jnp.float32), axis, perm)
+    return dq.astype(q.dtype), dkh.astype(k.dtype), dvh.astype(v.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, axis, causal):
+    o, _ = _ring_flash_fwd_impl(q, k, v, axis, causal)
+    return o
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis, causal):
+    o, lse = _ring_flash_fwd_impl(q, k, v, axis, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_vjp_bwd(axis, causal, res, g):
+    q, k, v, o, lse = res
+    return _ring_flash_bwd_impl(q, k, v, o, lse, g, axis, causal)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
+    """SPMD body: ring attention with the fused flash kernel as the fold.
+
+    q, k, v: (B, s_local, H, D), s_local a multiple of 128 (the flash
+    kernel's block constraint). Must run inside shard_map over a mesh
+    with `axis`. Exact (same online-softmax algebra as ring_attention),
+    differentiable (fused flash backward per hop), O(s_local) VMEM.
+    """
+    return _ring_flash(q, k, v, axis, causal)
 
 
 def ulysses_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
@@ -143,6 +301,12 @@ def make_ring_attention(mesh, axis: str = SEQ_AXIS):
     return _wrap(ring_attention, mesh, axis)
 
 
+def make_ring_flash_attention(mesh, axis: str = SEQ_AXIS):
+    """jitted (q, k, v, causal=False) -> out with S sharded over `axis`,
+    folding each hop with the fused Pallas flash kernel."""
+    return _wrap(ring_flash_attention, mesh, axis)
+
+
 def make_ulysses_attention(mesh, axis: str = SEQ_AXIS):
     """jitted (q, k, v, causal=False) -> out with S sharded over `axis`."""
     return _wrap(ulysses_attention, mesh, axis)
@@ -182,10 +346,14 @@ def make_sp_lm_train_step(
 
     if impl == "ring":
         attn_body = ring_attention
+    elif impl == "ring_flash":
+        attn_body = ring_flash_attention
     elif impl == "ulysses":
         attn_body = ulysses_attention
     else:
-        raise ValueError(f"unknown SP impl {impl!r}; 'ring' or 'ulysses'")
+        raise ValueError(
+            f"unknown SP impl {impl!r}; 'ring', 'ring_flash' or 'ulysses'"
+        )
     reduce_axes = tuple(a for a in (data_axis, axis) if a)
 
     n_seq = mesh.shape[axis]
@@ -199,6 +367,15 @@ def make_sp_lm_train_step(
             raise ValueError(
                 f"global sequence {s_local * n_seq} exceeds "
                 f"max_seq {model.max_seq}"
+            )
+        if impl == "ring_flash" and s_local % 128:
+            # Fail here with global context — the kernel's own check
+            # would name only the confusing shard-local length.
+            raise ValueError(
+                f"impl='ring_flash' needs the per-shard sequence to be a"
+                f" multiple of 128 (flash block granularity): global"
+                f" S={s_local * n_seq} over {axis}={n_seq} devices gives"
+                f" s_local={s_local}"
             )
         pos_offset = lax.axis_index(axis) * s_local
         attn = partial(attn_body, axis=axis, causal=True)

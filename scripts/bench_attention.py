@@ -1,0 +1,90 @@
+"""Device-time benchmark for the attention paths (PERF.md methodology).
+
+Times each implementation as a `lax.scan` of N calls inside ONE jit —
+inputs perturbed per step (defeats CSE), outputs summed (defeats DCE),
+`float()` on the result (forces completion through this environment's
+TPU tunnel; block_until_ready alone can return early). Prints one line
+per implementation.
+
+Usage: python scripts/bench_attention.py [--seq 32768] [--iters 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def device_time(fn, n, *args):
+    """Mean seconds per call of fn(*args) over n on-device iterations."""
+
+    @jax.jit
+    def run(args):
+        def body(acc, i):
+            # Perturb the first operand so each iteration is fresh work.
+            a0 = args[0] * (1.0 + i * 1e-9)
+            out = fn(a0, *args[1:])
+            return acc + jnp.sum(out.astype(jnp.float32)), None
+
+        acc, _ = lax.scan(body, jnp.zeros((), jnp.float32),
+                          jnp.arange(n, dtype=jnp.float32))
+        return acc
+
+    float(run(args))  # compile + warmup
+    t0 = time.perf_counter()
+    float(run(args))
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=32768)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--block", type=int, default=1024,
+                    help="block size for the jnp blockwise path")
+    args = ap.parse_args()
+
+    from mpi_cuda_cnn_tpu.ops.attention import blockwise_attention
+    from mpi_cuda_cnn_tpu.ops.pallas_attention import flash_attention
+    from mpi_cuda_cnn_tpu.parallel.sp import make_ring_flash_attention
+
+    b, s, h, d = 1, args.seq, args.heads, args.head_dim
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+               for _ in range(3))
+    n = args.iters
+
+    t = device_time(partial(flash_attention, causal=True), n, q, k, v)
+    print(f"flash_attention   causal s={s}: {t * 1000:8.1f} ms/call")
+
+    # Ring-flash over however many devices are visible (p=1 on one chip:
+    # the ring reduces to one diag fold — kernel cost + one merge).
+    # Measured through the library's own wrapper so the benchmark and
+    # the shipped program can't drift apart.
+    devs = jax.devices()
+    mesh = jax.sharding.Mesh(np.array(devs), ("seq",))
+    ring = make_ring_flash_attention(mesh)
+    t = device_time(partial(ring, causal=True), n, q, k, v)
+    print(f"ring_flash (p={len(devs)}) causal s={s}: {t * 1000:8.1f} ms/call")
+
+    t = device_time(
+        partial(blockwise_attention, block_size=args.block, causal=True),
+        n, q, k, v,
+    )
+    print(f"jnp blockwise b{args.block} causal s={s}: {t * 1000:8.1f} ms/call")
+
+
+if __name__ == "__main__":
+    main()
