@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_all test_serial test_dp8 test_tpu bench bench_configs bench_configs_cpu8 northstar northstar_digits native test_native get_mnist clean
+.PHONY: test test_all test_serial test_dp8 test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native get_mnist clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -63,6 +63,11 @@ bench_configs:
 bench_configs_cpu8:
 	$(CPU8) $(PY) scripts/bench_configs.py --device cpu --num-train 1024 \
 	  --configs lenet5,cifar3conv
+
+# MFU-honest LM pretraining benchmark: ~34M-param transformer, s=2048,
+# {f32,bf16} x {oracle,flash} matrix; prints tokens/s + MFU per config.
+bench_lm:
+	$(PY) scripts/bench_lm.py
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

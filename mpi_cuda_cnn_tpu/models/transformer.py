@@ -14,7 +14,10 @@ program or INSIDE shard_map with the sequence dim sharded —
 - attention is pluggable (`attn_fn`): the full-attention oracle by
   default, ring/Ulysses bodies under shard_map.
 
-Everything is f32; pre-LN blocks; learned position embeddings.
+Numerics: master params are f32; `compute_dtype=jnp.bfloat16` runs every
+matmul (and the residual stream) in bf16 — the MXU's native path — with
+layernorms and the softmax/loss still computed in f32. Pre-LN blocks;
+learned position embeddings.
 """
 
 from __future__ import annotations
@@ -30,9 +33,13 @@ from ..ops.attention import attention
 
 
 def _layernorm(x, g, b, eps=1e-5):
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+    """Layernorm with the statistics in f32 regardless of x.dtype (bf16
+    means/variances lose ~3 decimal digits); output back in x.dtype."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps) * g + b
+    return y.astype(x.dtype)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,9 +115,15 @@ class TransformerLM:
                                        # (ep.moe_mlp_inference) — the
                                        # decode/prefill semantic
         return_aux: bool = False,      # also return the MoE balance loss
+        compute_dtype=None,            # e.g. jnp.bfloat16: run matmuls +
+                                       # residual stream in this dtype
+                                       # (master params stay f32; LN and
+                                       # the caller's loss stay f32)
     ):                                 # (B, S, vocab) logits [, aux]
         b, s = tokens.shape
         h, hd = self.heads, self.head_dim
+        cd = compute_dtype
+        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
         if s > self.max_seq:
             # XLA's gather would silently clamp out-of-range positions to
             # pos_emb[max_seq-1]; fail loudly instead. (Sharded callers
@@ -119,24 +132,29 @@ class TransformerLM:
         attn = attn_fn or (lambda q, k, v: attention(q, k, v, causal=causal))
 
         pos = pos_offset + jnp.arange(s)
-        x = params["tok_emb"][tokens] + params["pos_emb"][pos][None, :, :]
+        x = w(params["tok_emb"][tokens] + params["pos_emb"][pos][None, :, :])
 
         def block(blk, x):
             y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-            qkv = y @ blk["wqkv"]                       # (B, S, 3*dim)
+            qkv = y @ w(blk["wqkv"])                    # (B, S, 3*dim)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(b, s, h, hd)
             k = k.reshape(b, s, h, hd)
             v = v.reshape(b, s, h, hd)
             o = attn(q, k, v).reshape(b, s, h * hd)
-            x = x + o @ blk["wo"]
+            x = x + (o.astype(x.dtype) @ w(blk["wo"]))
             y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
             if self.moe_experts:
+                # Expert weights go through the same compute-dtype cast
+                # as the dense matmuls (the router's softmax stays f32
+                # inside moe_mlp); without this the 16d² expert FLOPs
+                # would silently promote back to f32.
+                moe_p = jax.tree.map(w, blk["moe"]) if cd else blk["moe"]
                 if moe_inference:
                     from ..parallel.ep import moe_mlp_inference
 
                     m = moe_mlp_inference(
-                        y.reshape(b * s, self.dim), blk["moe"],
+                        y.reshape(b * s, self.dim), moe_p,
                         n_experts=self.moe_experts,
                     )
                     aux = jnp.zeros(())
@@ -144,11 +162,14 @@ class TransformerLM:
                     from ..parallel.ep import moe_mlp
 
                     m, aux = moe_mlp(
-                        y.reshape(b * s, self.dim), blk["moe"],
+                        y.reshape(b * s, self.dim), moe_p,
                         n_experts=self.moe_experts, axis=moe_axis,
                     )
-                return x + m.reshape(b, s, self.dim), aux
-            return x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"], jnp.zeros(())
+                return x + m.reshape(b, s, self.dim).astype(x.dtype), aux
+            return (
+                x + jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"]),
+                jnp.zeros(()),
+            )
 
         if remat:
             # Recompute block activations in the backward pass (the
@@ -160,5 +181,7 @@ class TransformerLM:
             x, aux = block(blk, x)
             aux_total = aux_total + aux
         x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-        logits = x @ params["head"]
+        # Head matmul in compute dtype (it is the single largest matmul);
+        # logits come back in f32 — the loss softmax must not run in bf16.
+        logits = (x @ w(params["head"])).astype(jnp.float32)
         return (logits, aux_total) if return_aux else logits

@@ -315,7 +315,11 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, g, causal: bool):
+def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False):
+    """grads_f32 keeps the f32 kernel gradients uncast — for callers (the
+    ring-flash backward) that ACCUMULATE partials across hops in f32;
+    rounding each per-hop partial to a bf16 input dtype first would
+    collect p truncation errors instead of one."""
     b, s, h, d = q.shape
     blk_q = _pick_block(s, BLK_Q)
     blk_k = _pick_block(s, BLK_K)
@@ -380,7 +384,7 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool):
     )(qr, kr, vr, gr, lse_row, dvec_row)
 
     return tuple(
-        _from_rows(t, b, h, s, d).astype(ref.dtype)
+        _from_rows(t, b, h, s, d).astype(jnp.float32 if grads_f32 else ref.dtype)
         for t, ref in ((dq, q), (dk, k), (dv, v))
     )
 

@@ -106,6 +106,11 @@ def moe_mlp(
     dispatch, combine, aux = top1_dispatch(
         x, params["gate"], n_experts, capacity
     )
+    # Dispatch/combine follow x's dtype so a bf16 compute path stays bf16
+    # end to end (dispatch is exact {0,1} in any float dtype; combine's
+    # gate weights round like every other bf16 operand).
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x)    # (E, C, D)
 
     if axis is None:

@@ -186,21 +186,27 @@ def _ring_flash_bwd_impl(q, k, v, o, lse, g, axis, causal):
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     def contrib(kh, vh, hcnt):
+        # grads_f32: each hop's partial stays f32 into the accumulators —
+        # one final cast at the end, not p per-hop bf16 roundings.
         return _hop_dispatch(
             me, p, hcnt, causal,
-            full=lambda _: _flash_backward(q, kh, vh, o, lse, g, False),
-            diag=lambda _: _flash_backward(q, kh, vh, o, lse, g, True),
+            full=lambda _: _flash_backward(q, kh, vh, o, lse, g, False,
+                                           grads_f32=True),
+            diag=lambda _: _flash_backward(q, kh, vh, o, lse, g, True,
+                                           grads_f32=True),
             none=lambda _: (
-                jnp.zeros_like(q), jnp.zeros_like(kh), jnp.zeros_like(vh)
+                jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros(kh.shape, jnp.float32),
+                jnp.zeros(vh.shape, jnp.float32),
             ),
         )
 
     def hop(hcnt, carry):
         dq, kh, vh, dkh, dvh = carry
         dq_c, dk_c, dv_c = contrib(kh, vh, hcnt)
-        dq = dq + dq_c.astype(jnp.float32)
-        dkh = dkh + dk_c.astype(jnp.float32)
-        dvh = dvh + dv_c.astype(jnp.float32)
+        dq = dq + dq_c
+        dkh = dkh + dk_c
+        dvh = dvh + dv_c
         # k/v rotate WITH their gradient accumulators so each dk/dv rides
         # along with its block; after p total rotations they are home.
         kh, vh, dkh, dvh = (
@@ -214,9 +220,9 @@ def _ring_flash_bwd_impl(q, k, v, o, lse, g, axis, causal):
     # Final hop: contribute, then rotate ONLY the accumulators home (the
     # k/v rotate would be the wasted return hop — see ring_attention).
     dq_c, dk_c, dv_c = contrib(kh, vh, p - 1)
-    dq = dq + dq_c.astype(jnp.float32)
-    dkh = lax.ppermute(dkh + dk_c.astype(jnp.float32), axis, perm)
-    dvh = lax.ppermute(dvh + dv_c.astype(jnp.float32), axis, perm)
+    dq = dq + dq_c
+    dkh = lax.ppermute(dkh + dk_c, axis, perm)
+    dvh = lax.ppermute(dvh + dv_c, axis, perm)
     return dq.astype(q.dtype), dkh.astype(k.dtype), dvh.astype(v.dtype)
 
 

@@ -1,0 +1,150 @@
+"""Language-model training: the transformer's train step + loss.
+
+The reference's training loop is CNN-only (cnn.c:445-474); this module is
+its twin for the framework's long-context model family (models/
+transformer.py). One jitted step — forward, causal-LM cross-entropy,
+backward, optimizer update — with the TPU levers exposed:
+
+- `attn_impl`: "flash" (the fused Pallas kernel pair,
+  ops/pallas_attention.py) is the default on TPU; "oracle" is the
+  quadratic jnp reference; "auto" picks per backend/shape.
+- `compute_dtype`: bfloat16 runs every matmul on the MXU's native path
+  (master params stay f32 — mixed precision, not low-precision training).
+- `remat`: jax.checkpoint per block (activation memory for FLOPs).
+
+Sequence-parallel training lives in parallel/sp.py (shard_map over a
+'seq' axis); this step is the single-device / pure-DP form. For DP, jit
+partitions it over the mesh from the state/batch shardings (GSPMD), the
+same design as parallel/tp.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import TransformerLM
+
+
+def pick_attn_impl(impl: str, seq_len: int) -> str:
+    """Resolve "auto" to a concrete attention implementation: the fused
+    flash kernel wherever its block constraint (S % 128 == 0) holds on a
+    real TPU; the jnp oracle otherwise (interpret-mode Pallas on CPU is
+    orders of magnitude slower than XLA — correct, but only for tests)."""
+    if impl != "auto":
+        return impl
+    on_tpu = jax.default_backend() == "tpu"
+    return "flash" if on_tpu and seq_len % 128 == 0 else "oracle"
+
+
+def get_attn_fn(impl: str):
+    """Concrete attention callable (q, k, v) -> o, causal, for `impl`."""
+    if impl == "flash":
+        from ..ops.pallas_attention import flash_attention
+
+        return lambda q, k, v: flash_attention(q, k, v, True)
+    if impl == "oracle":
+        from ..ops.attention import attention
+
+        return lambda q, k, v: attention(q, k, v, causal=True)
+    raise ValueError(f"unknown attention impl {impl!r}; 'flash'|'oracle'|'auto'")
+
+
+def lm_loss(
+    model: TransformerLM,
+    params,
+    tokens,
+    targets,
+    *,
+    attn_fn=None,
+    compute_dtype=None,
+    remat: bool = False,
+    moe_aux_weight: float = 0.01,
+):
+    """Mean next-token NLL (+ the Switch aux loss when the model is MoE).
+    tokens/targets: (B, S) int32. The loss softmax always runs in f32."""
+    logits, aux = model.apply(
+        params, tokens, attn_fn=attn_fn, remat=remat,
+        compute_dtype=compute_dtype, return_aux=True,
+    )
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll) + moe_aux_weight * aux
+
+
+def make_lm_train_step(
+    model: TransformerLM,
+    optimizer,
+    *,
+    attn_impl: str = "auto",
+    seq_len: int | None = None,
+    compute_dtype=None,
+    remat: bool = False,
+    donate: bool = True,
+    moe_aux_weight: float = 0.01,
+):
+    """step(state, tokens, targets) -> (state, {"loss": ...}), jitted.
+
+    state = {"params", "opt_state", "step"} — the same pytree-of-arrays
+    state scheme as every other train step (checkpointable by
+    train/checkpoint.py unchanged). Under a multi-device mesh, place the
+    state replicated (or FSDP-sharded) and the batch data-sharded; jit
+    inserts the psums (GSPMD).
+    """
+    import optax
+
+    impl = pick_attn_impl(attn_impl, seq_len or model.max_seq)
+    attn_fn = get_attn_fn(impl)
+    loss = partial(
+        lm_loss, model, attn_fn=attn_fn, compute_dtype=compute_dtype,
+        remat=remat, moe_aux_weight=moe_aux_weight,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(state, tokens, targets):
+        l, grads = jax.value_and_grad(
+            lambda p: loss(p, tokens, targets)
+        )(state["params"])
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": l},
+        )
+
+    return step
+
+
+def make_lm_state(model: TransformerLM, optimizer, seed: int = 0) -> dict:
+    """Fresh {"params", "opt_state", "step"} for the LM train step."""
+    params = model.init(jax.random.key(seed))
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def lm_flops_per_token(model: TransformerLM, seq_len: int) -> float:
+    """Analytic forward+backward FLOPs per trained token (the MFU
+    denominator; backward = 2x forward, the standard accounting).
+
+    Per layer forward, per token: qkv 6d², attn-out 2d², MLP 16d²
+    (dense; MoE counts the same — top-1 routes each token through one
+    expert of the same hidden size), plus attention scores+values
+    2·s·d (causal: each query sees s/2 keys on average; QK^T and P·V
+    each cost 2·(s/2)·d). Embedding head: 2·d·V.
+    """
+    d, s, v = model.dim, seq_len, model.vocab
+    per_layer = 24 * d * d + 2 * s * d
+    fwd = model.depth * per_layer + 2 * d * v
+    return 3.0 * fwd
+
+
+def count_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

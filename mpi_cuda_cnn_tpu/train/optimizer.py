@@ -19,6 +19,7 @@ import optax
 def make_optimizer(
     lr: float = 0.1,
     *,
+    opt: str = "sgd",
     momentum: float = 0.0,
     schedule: str = "constant",
     total_steps: int | None = None,
@@ -39,7 +40,19 @@ def make_optimizer(
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
 
-    tx = optax.sgd(lr_sched, momentum=momentum or None)
-    if weight_decay:
-        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    if opt == "sgd":
+        tx = optax.sgd(lr_sched, momentum=momentum or None)
+        if weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    elif opt == "adamw":
+        # The LM family's optimizer (train/lm.py); the CNN paths keep the
+        # reference's SGD semantics by default.
+        if momentum:
+            raise ValueError(
+                "momentum is an SGD knob; adamw's betas are not remapped "
+                "from it — drop --momentum or use opt='sgd'"
+            )
+        tx = optax.adamw(lr_sched, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {opt!r}; 'sgd' or 'adamw'")
     return tx

@@ -49,6 +49,7 @@ from ..parallel.tp import (
 )
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer, profile_trace
+from ..utils.sync import hard_block
 from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
 from .optimizer import make_optimizer
 
@@ -345,7 +346,10 @@ class Trainer:
                     etotal=float(running["etotal"]) / nsteps,
                     acc=float(running["acc"]) / nsteps,
                 )
-        jax.block_until_ready(self.state)
+        # hard_block, not block_until_ready: the epoch wall-clock must
+        # cover the COMPUTE, and under this env's remote-TPU tunnel
+        # block_until_ready returns at enqueue (utils/sync.py).
+        hard_block(self.state)
         seconds = time.perf_counter() - t0
         if nsteps == 0:
             raise ValueError(
@@ -432,7 +436,7 @@ class Trainer:
                     etotal=float(totals["etotal"]) / done,
                     acc=float(totals["acc"]) / done,
                 )
-        jax.block_until_ready(self.state)
+        hard_block(self.state)  # see run_epoch: must wait for compute
         seconds = time.perf_counter() - t0
         return {
             "epoch": epoch,

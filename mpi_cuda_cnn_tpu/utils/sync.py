@@ -1,0 +1,32 @@
+"""Device synchronization that actually waits.
+
+`jax.block_until_ready` is the documented way to drain async dispatch
+before reading a wall-clock — but under this environment's remote-TPU
+tunnel (the experimental 'axon' platform) it returns once the work is
+*queued*, not done: measured, a 1.5 s matmul chain "blocks" in 0.16 s
+and a later host fetch then stalls the remaining 1.4 s. Every timing in
+the framework therefore syncs through `hard_block`, which combines the
+normal block with a device->host fetch of the smallest array leaf — a
+transfer cannot complete before its value exists, and fetching any
+output of the final program in a dispatch chain drains the whole chain.
+
+On backends where block_until_ready is correct this adds one scalar-ish
+D2H copy per call — noise. Timing-critical loops should arrange for a
+small leaf (a step counter, a scalar loss) to exist in the synced tree.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def hard_block(tree):
+    """Force completion of every computation `tree` depends on; returns
+    `tree` unchanged (like jax.block_until_ready)."""
+    jax.block_until_ready(tree)
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
+    if leaves:
+        smallest = min(leaves, key=lambda l: getattr(l, "size", 0))
+        np.asarray(jax.device_get(smallest))
+    return tree

@@ -85,13 +85,16 @@ def pytest_collection_modifyitems(config, items):
         return
     # A test named explicitly on the command line (::-qualified) always
     # runs; other args in the same invocation still get the skip.
-    explicit = tuple(a for a in config.args if "::" in a)
+    # Compare on the "file.py::name" tail: nodeids are rootdir-relative
+    # while CLI args may be absolute or cwd-relative paths.
+    def _tail(s):
+        return s.split("/")[-1]
+
+    explicit = tuple(_tail(a) for a in config.args if "::" in a)
 
     def named_explicitly(item):
-        return any(
-            item.nodeid == a or item.nodeid.startswith(a + "[")
-            for a in explicit
-        )
+        tail = _tail(item.nodeid)
+        return any(tail == a or tail.startswith(a + "[") for a in explicit)
 
     skip = pytest.mark.skip(reason="slow; use --runslow (make test_all)")
     matched = set()
