@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_all test_serial test_dp8 test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native get_mnist clean
+.PHONY: test test_all test_serial test_dp8 test_sp8 test_ep8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native get_mnist clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -38,6 +38,27 @@ test_serial:
 test_dp8:
 	$(CPU8) $(PY) -m mpi_cuda_cnn_tpu --dataset synthetic \
 	  --model reference_cnn --epochs 2 --device cpu
+
+# 8-way sequence-parallel LM e2e smoke (ring attention over seq:8,
+# char-level on the framework's own sources) — the SP twin of test_dp8.
+test_sp8:
+	$(CPU8) $(PY) -m mpi_cuda_cnn_tpu lm --device cpu --corpus self \
+	  --dim 64 --depth 2 --heads 8 --seq-len 128 --steps 30 \
+	  --batch-size 4 --mesh-shape seq:8 --log-every 10
+
+# Expert-parallel MoE LM e2e smoke: SP x DP mesh, 8 experts riding the
+# 'seq' axis all_to_alls (parallel/ep.py) — the EP twin of test_dp8.
+test_ep8:
+	$(CPU8) $(PY) -m mpi_cuda_cnn_tpu lm --device cpu --corpus self \
+	  --dim 64 --depth 2 --heads 8 --seq-len 128 --steps 30 \
+	  --batch-size 4 --mesh-shape data:2,seq:4 --moe-experts 8 \
+	  --log-every 10
+
+# LM training on the visible accelerator (bf16 + flash kernel on TPU).
+test_lm_tpu:
+	$(PY) -m mpi_cuda_cnn_tpu lm --corpus self --dim 256 --depth 4 \
+	  --seq-len 512 --steps 100 --batch-size 8 --compute-dtype bfloat16 \
+	  --log-every 25
 
 # Same on whatever accelerator is visible (TPU on a TPU VM).
 # lr 0.02: with momentum 0.9 the effective step is ~10x lr, and plain

@@ -82,7 +82,39 @@ def run(cfg: Config) -> int:
     return 0
 
 
+def run_lm(argv: list[str]) -> int:
+    """The `lm` subcommand: train the transformer LM (long-context
+    path — flash attention, data/seq meshes, MoE)."""
+    from .train.lm_trainer import LMTrainer
+    from .utils.config import parse_lm_args
+
+    cfg = parse_lm_args(argv)
+    log = get_logger()
+    if not _select_device(cfg, log):
+        return 2
+    initialize_distributed()
+    try:
+        trainer = LMTrainer(cfg, metrics=MetricsLogger())
+    except (OSError, ValueError) as e:
+        log.error("lm setup failed: %s", e)
+        return 2
+    log.info(
+        "lm model=d%dx%d h%d seq=%d vocab=%d moe=%d mesh=%s attn=%s",
+        cfg.dim, cfg.depth, cfg.heads, cfg.seq_len, trainer.model.vocab,
+        cfg.moe_experts, dict(trainer.mesh.shape), trainer.attn_impl,
+    )
+    result = trainer.train()
+    log.info(
+        "done: steps=%d eval_ppl=%.3f tokens/s=%.0f",
+        result.steps_run, result.eval_ppl, result.tokens_per_s,
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "lm":
+        return run_lm(argv[1:])
     cfg = parse_args(argv)
     return run(cfg)
 

@@ -12,10 +12,12 @@ Layout: q/k/v arrive (B, S, H, D) (the framework's SP-friendly layout),
 kernel works on (B*H, S, D) over a (batch*head, q-block, k-block) grid —
 the k-block axis is innermost/sequential and the carry persists in VMEM
 scratch, so VMEM stays O(BLK) regardless of S (32k+ context on one chip).
-Compute is (BLK_Q, D) @ (D, BLK_K) MXU contractions at HIGHEST precision
-(~1e-6 vs a float64 reference — the default-precision XLA oracle sits at
-~1e-2). f32 in-kernel (packed-dtype sublane slicing needs the conv-kernel
-treatment; bf16 casts at the boundary). Causal masking uses 2-D
+Compute is (BLK_Q, D) @ (D, BLK_K) MXU contractions with f32 accumulators.
+Dtype policy: f32 inputs run at HIGHEST precision (~1e-6 vs a float64
+reference — the default-precision XLA oracle sits at ~1e-2); bf16 inputs
+stay bf16 operands on the MXU's native bf16 x bf16 -> f32 path (~4x the
+f32 matmul throughput — the training configuration), with the softmax,
+online-carry, and output accumulation still f32. Causal masking uses 2-D
 broadcasted_iota and skips blocks fully above the diagonal.
 
 Backward: fused too — a dq kernel (q-rows outer, k-blocks streamed) and a
@@ -51,6 +53,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _dot(a, b, dims, hi: bool):
+    """MXU contraction with f32 accumulation. hi=True adds HIGHEST
+    precision — right for f32 inputs (the kernel's original accuracy
+    contract); for bf16 inputs the default precision IS the native
+    bf16 x bf16 -> f32 MXU path (~4x the f32 throughput), and HIGHEST
+    would force f32 upconversion passes."""
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST if hi else None,
+    )
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, causal, nk, scale
@@ -75,12 +90,10 @@ def _flash_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
+    hi = q_ref.dtype == jnp.float32
+
     def fold():
-        s = jax.lax.dot_general(
-            q, k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        ) * scale                                   # (BLK_Q, BLK_K)
+        s = _dot(q, k_ref[0], ((1,), (1,)), hi) * scale  # (BLK_Q, BLK_K)
         if causal:
             qpos = qi * blk_q + jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 0
@@ -102,10 +115,11 @@ def _flash_kernel(
         alpha = jnp.exp(m - m_new)
         l_ref[:, :1] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[:, :1] = m_new
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+        # p rounds to the input dtype for the PV contraction (exact for
+        # f32; the standard flash-attention practice for bf16 — the MXU
+        # takes bf16 operands, the accumulator stays f32).
+        acc_ref[:] = acc_ref[:] * alpha + _dot(
+            p.astype(v_ref.dtype), v_ref[0], ((1,), (0,)), hi
         )
 
     if causal:
@@ -155,10 +169,12 @@ def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
     blk_q = _pick_block(s, BLK_Q)
     blk_k = _pick_block(s, BLK_K)
     orig_dtype = q.dtype
-    # f32 in the kernel: packed-dtype (bf16) sublane slicing needs extra
-    # alignment work; numerics match the oracle's f32 accumulation anyway.
-    qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
-    qr, kr, vr = (_to_rows(t, b, h, s, d) for t in (qf, kf, vf))
+    # bf16 inputs stay bf16 into the kernel (native MXU operands, f32
+    # accumulators/softmax inside — ~4x the f32 matmul throughput);
+    # anything else computes in f32 at HIGHEST precision (the original
+    # accuracy contract: ~1e-6 of a float64 reference).
+    kdt = jnp.bfloat16 if orig_dtype == jnp.bfloat16 else jnp.float32
+    qr, kr, vr = (_to_rows(t.astype(kdt), b, h, s, d) for t in (q, k, v))
 
     nk = s // blk_k
     kernel = functools.partial(
@@ -221,12 +237,10 @@ def _bwd_dq_kernel(
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    hi = q_ref.dtype == jnp.float32
+
     def fold():
-        s = jax.lax.dot_general(
-            q, k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        ) * scale
+        s = _dot(q, k_ref[0], ((1,), (1,)), hi) * scale
         if causal:
             qpos = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = kj * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -235,17 +249,9 @@ def _bwd_dq_kernel(
         # value replicated along the narrow lane dim; [:, :1] is the
         # (blk_q, 1) column.
         p = jnp.exp(s - lse_ref[0][:, :1])
-        dov = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        dov = _dot(do_ref[0], v_ref[0], ((1,), (1,)), hi)
         ds = p * (dov - dvec_ref[0][:, :1]) * scale
-        acc_ref[:] += jax.lax.dot_general(
-            ds, k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        acc_ref[:] += _dot(ds.astype(k_ref.dtype), k_ref[0], ((1,), (0,)), hi)
 
     if causal:
         pl.when(kj * blk_k <= qi * blk_q + blk_q - 1)(fold)
@@ -272,13 +278,11 @@ def _bwd_dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
+    hi = q_ref.dtype == jnp.float32
+
     def fold():
         # Transposed tile: rows = this program's keys, lanes = queries.
-        s_t = jax.lax.dot_general(
-            k, q_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        ) * scale                                    # (blk_k, blk_q)
+        s_t = _dot(k, q_ref[0], ((1,), (1,)), hi) * scale  # (blk_k, blk_q)
         if causal:
             kpos = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 0)
             qpos = qj * blk_q + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 1)
@@ -286,22 +290,10 @@ def _bwd_dkv_kernel(
         # lse/dvec arrive lane-oriented: (1, 8, blk_q); row 0 of the
         # sublane padding is the (blk_q,) lane vector.
         p_t = jnp.exp(s_t - lse_ref[0, 0, :][None, :])
-        dv_acc[:] += jax.lax.dot_general(
-            p_t, do_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        vdo = jax.lax.dot_general(
-            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                            # (blk_k, blk_q)
+        dv_acc[:] += _dot(p_t.astype(do_ref.dtype), do_ref[0], ((1,), (0,)), hi)
+        vdo = _dot(v_ref[0], do_ref[0], ((1,), (1,)), hi)  # (blk_k, blk_q)
         ds_t = p_t * (vdo - dvec_ref[0, 0, :][None, :]) * scale
-        dk_acc[:] += jax.lax.dot_general(
-            ds_t, q_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        dk_acc[:] += _dot(ds_t.astype(q_ref.dtype), q_ref[0], ((1,), (0,)), hi)
 
     if causal:
         # Queries strictly before this key block are fully masked.
@@ -324,11 +316,16 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
     blk_q = _pick_block(s, BLK_Q)
     blk_k = _pick_block(s, BLK_K)
     scale = 1.0 / (d ** 0.5)
+    # Same dtype policy as the forward: bf16 operands stay bf16 into the
+    # kernels (native MXU path), everything else f32 at HIGHEST.
+    kdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
     qr, kr, vr, orr, gr = (
-        _to_rows(t.astype(jnp.float32), b, h, s, d) for t in (q, k, v, o, g)
+        _to_rows(t.astype(kdt), b, h, s, d) for t in (q, k, v, o, g)
     )
-    # D_i = rowsum(dO_i * O_i) — elementwise, O(S*D).
-    dvec = jnp.sum(gr * orr, axis=-1)                # (b*h, s)
+    # D_i = rowsum(dO_i * O_i) — elementwise, O(S*D), always f32.
+    dvec = jnp.sum(
+        gr.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1
+    )                                                # (b*h, s)
     # Two orientations of the per-row vectors, so neither kernel pays a
     # sublane<->lane relayout: columns for the dq kernel, lanes for the
     # dk/dv kernel. Both are NARROW (8-wide minor dim, not 128): the

@@ -26,30 +26,52 @@ from .mesh import DATA_AXIS
 __all__ = ["fsdp_specs", "shard_params_fsdp", "make_fsdp_state"]
 
 
-def fsdp_specs(params, mesh, axis: str = DATA_AXIS):
+def fsdp_specs(params, mesh, axis: str = DATA_AXIS, base_specs=None):
     """A PartitionSpec per leaf: shard the largest dim divisible by the
     axis size (ties broken toward the earliest dim); leaves with no such
-    dim (scalars, tiny heads) stay replicated."""
+    dim (scalars, tiny heads) stay replicated.
+
+    base_specs (optional, same tree structure) composes FSDP with TP:
+    dims already claimed by the base spec (e.g. features over 'model')
+    are kept, and the 'data' shard goes on the largest REMAINING dim —
+    the ZeRO-over-Megatron layout."""
     n = mesh.shape.get(axis, 1)
 
-    def spec(leaf) -> P:
+    def spec(leaf, base: P | None = None) -> P:
+        taken = tuple(base) if base is not None else ()
+        taken = taken + (None,) * (leaf.ndim - len(taken))
+
+        def out(entries):
+            # P(None, ...) and P() place identically, but compare unequal;
+            # normalize all-None to the canonical empty spec.
+            return P(*entries) if any(e is not None for e in entries) else P()
+
         if n <= 1 or leaf.ndim == 0:
-            return P()
+            return out(taken)
         best = None
         for d in range(leaf.ndim):
+            if taken[d] is not None:
+                continue
             if leaf.shape[d] % n == 0 and leaf.shape[d] >= n:
                 if best is None or leaf.shape[d] > leaf.shape[best]:
                     best = d
         if best is None:
-            return P()
-        return P(*[axis if i == best else None for i in range(leaf.ndim)])
+            return out(taken)
+        return out([
+            axis if i == best else taken[i] for i in range(leaf.ndim)
+        ])
 
-    return jax.tree.map(spec, params)
+    if base_specs is None:
+        return jax.tree.map(spec, params)
+    return jax.tree.map(
+        spec, params, base_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
-def shard_params_fsdp(params, mesh, axis: str = DATA_AXIS):
+def shard_params_fsdp(params, mesh, axis: str = DATA_AXIS, base_specs=None):
     """Place a host/replicated param pytree with FSDP shardings."""
-    specs = fsdp_specs(params, mesh, axis)
+    specs = fsdp_specs(params, mesh, axis, base_specs)
     return jax.device_put(
         params,
         jax.tree.map(
@@ -59,13 +81,15 @@ def shard_params_fsdp(params, mesh, axis: str = DATA_AXIS):
     )
 
 
-def make_fsdp_state(params, optimizer, mesh, axis: str = DATA_AXIS):
+def make_fsdp_state(params, optimizer, mesh, axis: str = DATA_AXIS,
+                    base_specs=None):
     """Train state with FSDP-sharded params; optimizer.init on the sharded
     params makes every optimizer buffer inherit the same shardings
-    leaf-for-leaf (ZeRO's optimizer-state sharding for free)."""
+    leaf-for-leaf (ZeRO's optimizer-state sharding for free). base_specs
+    composes with TP (see fsdp_specs)."""
     import jax.numpy as jnp
 
-    params = shard_params_fsdp(params, mesh, axis)
+    params = shard_params_fsdp(params, mesh, axis, base_specs)
     return {
         "params": params,
         "opt_state": optimizer.init(params),

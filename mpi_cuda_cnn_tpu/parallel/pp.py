@@ -25,6 +25,17 @@ Composes with DP on a ('pipe', 'data') mesh: the microbatch dim shards over
 'data', gradients pmean over 'data' exactly as in dp.py. Stage buffers are
 padded to the widest stage (A_max activations, P_max params); padding costs
 memory, not FLOPs — the switch branches only compute their real shapes.
+
+TP x PP composes on a ('pipe', 'model'[, 'data']) mesh (n_model > 1 in the
+plan): inside each stage, Conv/Dense output features are sliced over
+'model' Megatron-style — the packed params become (S, M, Pm_max), each
+device holds its stage's model-shard, each sliced layer computes its
+feature slice and `lax.all_gather`s the activation back to full before
+the next layer (the gather's transpose routes the cotangent slices back —
+reduce-scatter — so backward needs no extra code). Layers that do not
+expose a divisible feature count (pools, heads, Residual blocks) stay
+replicated across 'model': every rank computes them identically, which is
+correct (same input, same weights) and costs only the unsliced FLOPs.
 """
 
 from __future__ import annotations
@@ -40,7 +51,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.activations import stable_softmax
 from ..ops.losses import softmax_cross_entropy, squared_error_total
-from .mesh import DATA_AXIS, PIPE_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 
 TrainState = dict[str, Any]
 
@@ -104,24 +115,50 @@ class PipelinePlan:
     param_treedefs: tuple
     num_classes: int
     a_max: int  # flat per-sample activation width crossing any stage boundary
-    p_max: int  # padded per-stage flat param length
+    p_max: int  # padded per-stage flat param length (PER MODEL SHARD when
+    #   n_model > 1)
     backend: str = "xla"
     compute_dtype: Any = None  # per-stage compute cast (e.g. bf16); master
     #   params and the ppermute activation/param buffers stay f32
+    n_model: int = 1  # TP degree inside each stage ('model' mesh axis)
+    layer_sliced: tuple[bool, ...] = ()  # per LAYER: features sliced over
+    #   'model'? (leaves whose last dim == features are sliced; the
+    #   activation is gathered back to full after the layer)
+
+
+def _slice_last(leaf, m: int, n: int):
+    """m-th of n equal slices of the last dim."""
+    w = leaf.shape[-1] // n
+    return leaf[..., m * w : (m + 1) * w]
+
+
+def _local_leaf_shape(shape, layer_features, sliced: bool, n_model: int):
+    """Shape of one model-shard's copy of a stage leaf: last dim / n_model
+    for leaves carrying the layer's feature dim, unchanged otherwise."""
+    if sliced and shape and shape[-1] == layer_features:
+        return shape[:-1] + (shape[-1] // n_model,)
+    return tuple(shape)
 
 
 def make_pipeline_plan(
-    model, n_stages: int, *, backend: str = "xla", compute_dtype=None
+    model, n_stages: int, *, backend: str = "xla", compute_dtype=None,
+    n_model: int = 1,
 ) -> PipelinePlan:
-    """Split `model` (a Sequential) into n_stages balanced stages."""
+    """Split `model` (a Sequential) into n_stages balanced stages;
+    n_model > 1 additionally slices each stage's Conv/Dense features
+    over the 'model' mesh axis (TP x PP)."""
     key = jax.random.key(0)
     shape = model.input_shape
-    layer_in_shapes, costs, zero_params = [], [], []
+    layer_in_shapes, costs, zero_params, layer_sliced = [], [], [], []
     for layer in model.layers:
         p, out = layer.init(key, shape, _zeros_init)
         layer_in_shapes.append(tuple(shape))
         costs.append(_layer_cost(layer, shape, out, p))
         zero_params.append(p)
+        f = getattr(layer, "features", None)
+        layer_sliced.append(
+            bool(n_model > 1 and f is not None and f % n_model == 0)
+        )
         shape = out
     num_classes = int(shape[-1])
     stage_layers = _partition_balanced(costs, n_stages)
@@ -132,9 +169,18 @@ def make_pipeline_plan(
         stage_in_shapes.append(layer_in_shapes[idxs[0]])
         stage_p = [zero_params[i] for i in idxs]
         leaves, treedef = jax.tree.flatten(stage_p)
-        param_shapes.append(tuple(tuple(l.shape) for l in leaves))
+        # Local (per-model-shard) leaf shapes: flatten order must match
+        # tree order, so walk per layer and re-flatten.
+        local_shapes = []
+        for i in idxs:
+            f = getattr(model.layers[i], "features", None)
+            for leaf in jax.tree.leaves(zero_params[i]):
+                local_shapes.append(_local_leaf_shape(
+                    leaf.shape, f, layer_sliced[i], n_model
+                ))
+        param_shapes.append(tuple(local_shapes))
         param_treedefs.append(treedef)
-        p_sizes.append(sum(int(np.prod(l.shape)) for l in leaves))
+        p_sizes.append(sum(int(np.prod(s)) for s in local_shapes))
         end = idxs[-1] + 1
         out_shape = layer_in_shapes[end] if end < len(model.layers) else shape
         boundary_widths.append(int(np.prod(out_shape)))
@@ -151,31 +197,78 @@ def make_pipeline_plan(
         p_max=max(p_sizes) if p_sizes else 1,
         backend=backend,
         compute_dtype=compute_dtype,
+        n_model=n_model,
+        layer_sliced=tuple(layer_sliced),
     )
 
 
+def _stage_local_leaves(plan: PipelinePlan, params, idxs, m: int):
+    """Stage leaves for model-shard m, in tree order, feature dims sliced."""
+    leaves = []
+    for i in idxs:
+        f = getattr(plan.model.layers[i], "features", None)
+        for leaf in jax.tree.leaves(params[i]):
+            if plan.layer_sliced[i] and leaf.shape and leaf.shape[-1] == f:
+                leaf = _slice_last(leaf, m, plan.n_model)
+            leaves.append(leaf)
+    return leaves
+
+
 def pack_params(plan: PipelinePlan, params) -> jnp.ndarray:
-    """Model params (the Sequential's per-layer list) -> (S, P_max) f32 array;
-    row s is stage s's leaves raveled and zero-padded."""
-    rows = []
-    for s, idxs in enumerate(plan.stage_layers):
-        leaves = jax.tree.leaves([params[i] for i in idxs])
+    """Model params (the Sequential's per-layer list) -> (S, P_max) f32
+    array — or (S, M, P_max) under TP x PP — row [s(, m)] is stage s's
+    (model-shard m's) leaves raveled and zero-padded."""
+
+    def row(leaves):
         flat = (
             jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
             if leaves
             else jnp.zeros((0,), jnp.float32)
         )
-        rows.append(jnp.pad(flat, (0, plan.p_max - flat.shape[0])))
-    return jnp.stack(rows)
+        return jnp.pad(flat, (0, plan.p_max - flat.shape[0]))
+
+    if plan.n_model == 1:
+        return jnp.stack([
+            row(jax.tree.leaves([params[i] for i in idxs]))
+            for idxs in plan.stage_layers
+        ])
+    return jnp.stack([
+        jnp.stack([
+            row(_stage_local_leaves(plan, params, idxs, m))
+            for m in range(plan.n_model)
+        ])
+        for idxs in plan.stage_layers
+    ])
 
 
 def unpack_params(plan: PipelinePlan, packed) -> list:
-    """(S, P_max) -> the Sequential's per-layer params list (for eval,
-    checkpointing, and parity tests against the unpipelined model)."""
+    """(S, P_max) / (S, M, P_max) -> the Sequential's per-layer params list
+    (for eval, checkpointing, and parity tests against the unpipelined
+    model). Under TP x PP, sliced leaves are re-concatenated from the
+    model shards; replicated leaves read shard 0."""
     packed = jnp.asarray(packed)
     out: list = [None] * len(plan.model.layers)
     for s, idxs in enumerate(plan.stage_layers):
-        stage = _unpack_stage(plan, s, packed[s])
+        if plan.n_model == 1:
+            stage = _unpack_stage(plan, s, packed[s])
+        else:
+            shards = [
+                _unpack_stage(plan, s, packed[s, m])
+                for m in range(plan.n_model)
+            ]
+            stage = []
+            for li, i in enumerate(idxs):
+                f = getattr(plan.model.layers[i], "features", None)
+                merged = jax.tree.map(
+                    lambda *ls: (
+                        jnp.concatenate(ls, axis=-1)
+                        if plan.layer_sliced[i]
+                        and ls[0].shape and ls[0].shape[-1] * plan.n_model == f
+                        else ls[0]
+                    ),
+                    *[sh[li] for sh in shards],
+                )
+                stage.append(merged)
         for i, p in zip(idxs, stage):
             out[i] = p
     return out
@@ -193,7 +286,14 @@ def _unpack_stage(plan: PipelinePlan, s: int, flat: jnp.ndarray) -> list:
 def _stage_fns(plan: PipelinePlan, mb: int) -> list[Callable]:
     """One (flat_params, flat_x) -> flat_y function per stage, all with the
     identical (mb, A_max) signature `lax.switch` requires; each branch only
-    computes its stage's true shapes."""
+    computes its stage's true shapes.
+
+    Under TP x PP (plan.n_model > 1) flat_p is this device's model-shard:
+    sliced layers compute their feature slice, then `all_gather` the
+    activation back to full over 'model' (every device of a model group is
+    at the same pipe stage, so the branch — and its collective — matches
+    across the group). The gather's transpose is the reduce-scatter that
+    routes each shard its cotangent slice in backward."""
     fns = []
     for s, idxs in enumerate(plan.stage_layers):
         in_shape = plan.stage_in_shapes[s]
@@ -209,6 +309,13 @@ def _stage_fns(plan: PipelinePlan, mb: int) -> list[Callable]:
                 )
             for i, p in zip(idxs, stage_params):
                 x = plan.model.layers[i].apply(p, x, backend=plan.backend)
+                if plan.layer_sliced[i]:
+                    # (..., features/M) -> (..., features). Elementwise
+                    # activations act per-feature, so gathering AFTER the
+                    # activation is exact.
+                    x = jax.lax.all_gather(
+                        x, MODEL_AXIS, axis=x.ndim - 1, tiled=True
+                    )
             y = x.reshape(mb, -1).astype(jnp.float32)
             return jnp.pad(y, ((0, 0), (0, plan.a_max - y.shape[1])))
 
@@ -216,18 +323,62 @@ def _stage_fns(plan: PipelinePlan, mb: int) -> list[Callable]:
     return fns
 
 
+def _tp_replicated_mask(plan: PipelinePlan) -> np.ndarray:
+    """(S, P_max) mask for TP x PP gradient repair: 1.0 over flat
+    positions holding REPLICATED leaves, 0.0 over SLICED leaves (padding
+    is 1.0 — its grads are zero, so the psum below is harmless).
+
+    Why: the local loss is scaled by 1/n_model (every model rank of the
+    last stage computes the full loss, so the SPMD objective sums it
+    n_model times). Under that seeding a SLICED leaf's gradient arrives
+    exact — every downstream all_gather's transpose is a psum-scatter,
+    which performs the cross-rank reduction — but a REPLICATED leaf's
+    per-rank copy receives only the cotangent that flowed through ITS
+    rank's chain: the full loss for leaves downstream of every sliced
+    layer (each rank re-computes them identically, each scaled 1/n_model),
+    but a PARTIAL, rank-varying term for leaves upstream of a sliced
+    layer (the psum-scatter hands each rank only its slice's
+    contribution). In both cases the true gradient of the single logical
+    parameter is the SUM over the rank copies — one masked
+    `psum(MODEL_AXIS)` repairs both, with no rescale."""
+    mask = np.ones((plan.n_stages, plan.p_max), np.float32)
+    for s, idxs in enumerate(plan.stage_layers):
+        # plan.param_shapes[s] lists the LOCAL leaf shapes in tree order;
+        # replay the per-layer flatten to know which layer owns each leaf.
+        off = 0
+        shape_iter = iter(plan.param_shapes[s])
+        for i in idxs:
+            zero_p, _ = plan.model.layers[i].init(
+                jax.random.key(0), plan.layer_in_shapes[i], _zeros_init
+            )
+            for _ in jax.tree.leaves(zero_p):
+                shp = next(shape_iter)
+                size = int(np.prod(shp)) if shp else 1
+                if plan.layer_sliced[i]:
+                    mask[s, off:off + size] = 0.0
+                off += size
+    return mask
+
+
 def _make_local_loss(plan: PipelinePlan):
     """The per-device GPipe schedule. Returns local (masked) loss — nonzero
     only on the last stage — so value_and_grad never differentiates through
-    a collective; cross-stage gradient flow rides the ppermute transposes."""
+    a collective; cross-stage gradient flow rides the ppermute transposes.
+
+    Under TP x PP the returned loss/metrics are additionally scaled by
+    1/n_model (every model rank of the last stage holds the full logits and
+    computes the full loss): summing over BOTH the pipe and model axes then
+    reconstitutes the true value once, and the gradient scaling is repaired
+    by _tp_grad_factor in the step body."""
     S = plan.n_stages
     C = plan.num_classes
+    nm = plan.n_model
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
     def local_loss(flat_params, x_mb, y_mb):
-        # flat_params: (1, P_max) local row; x_mb: (M, mb, H, W, C) f32;
-        # y_mb: (M, mb, C) one-hot.
-        fp = flat_params[0]
+        # flat_params: (1, P_max) local row — (1, 1, P_max) under TP x PP;
+        # x_mb: (M, mb, H, W, C) f32; y_mb: (M, mb, C) one-hot.
+        fp = flat_params[0, 0] if plan.n_model > 1 else flat_params[0]
         M, mb = x_mb.shape[0], x_mb.shape[1]
         fns = _stage_fns(plan, mb)
         s_idx = jax.lax.axis_index(PIPE_AXIS)
@@ -263,17 +414,23 @@ def _make_local_loss(plan: PipelinePlan):
         )
         # Per-microbatch means averaged over microbatches == the full-batch
         # means the unpipelined loss_fn reports (equal microbatch sizes).
-        return loss_sum / M, (etot_sum / M, acc_sum / M)
+        # The extra / nm makes the model-axis copies sum to the true value
+        # (and under-seeds gradients by 1/nm — repaired per-segment by
+        # _tp_grad_factor in the step body).
+        return loss_sum / (M * nm), (etot_sum / (M * nm), acc_sum / (M * nm))
 
     return local_loss
 
 
-def _state_specs(state: TrainState, n_stages: int):
+def _state_specs(state: TrainState, n_stages: int, n_model: int = 1):
     """PartitionSpecs for a PP train state: (S, ...)-leading leaves shard
-    over 'pipe' (params + matching optimizer buffers), scalars replicate."""
+    over 'pipe' (and their second dim over 'model' under TP x PP; params +
+    matching optimizer buffers), scalars replicate."""
 
     def spec(a):
         if getattr(a, "ndim", 0) >= 1 and a.shape[0] == n_stages:
+            if n_model > 1 and a.ndim >= 2 and a.shape[1] == n_model:
+                return P(PIPE_AXIS, MODEL_AXIS, *([None] * (a.ndim - 2)))
             return P(PIPE_AXIS, *([None] * (a.ndim - 1)))
         return P()
 
@@ -281,11 +438,16 @@ def _state_specs(state: TrainState, n_stages: int):
 
 
 def make_pp_state(plan: PipelinePlan, params, optimizer, mesh) -> TrainState:
-    """Pack + place the train state: stage rows on their pipe coordinate,
-    optimizer state created FROM the packed array so its buffers inherit the
-    sharding leaf-for-leaf."""
+    """Pack + place the train state: stage rows on their pipe coordinate
+    (model shards on their model coordinate under TP x PP), optimizer state
+    created FROM the packed array so its buffers inherit the sharding
+    leaf-for-leaf."""
+    row_spec = (
+        P(PIPE_AXIS, MODEL_AXIS, None) if plan.n_model > 1
+        else P(PIPE_AXIS, None)
+    )
     packed = jax.device_put(
-        pack_params(plan, params), NamedSharding(mesh, P(PIPE_AXIS, None))
+        pack_params(plan, params), NamedSharding(mesh, row_spec)
     )
     return {
         "flat_params": packed,
@@ -319,15 +481,29 @@ def _make_step_body(plan: PipelinePlan, optimizer, has_data: bool):
     """The per-device PP(+DP) train-step body shared by the one-batch step
     and the scanned epoch (the PP twin of dp._make_step_body)."""
     local_loss = _make_local_loss(plan)
+    tp = plan.n_model > 1
+    rep_mask = jnp.asarray(_tp_replicated_mask(plan)) if tp else None
+    metric_axes = (PIPE_AXIS, MODEL_AXIS) if tp else PIPE_AXIS
 
     def step(state: TrainState, x_mb, y_mb):
         (loss, (etot, acc)), grads = jax.value_and_grad(
             local_loss, has_aux=True
         )(state["flat_params"], x_mb, y_mb)
+        if tp:
+            # Restore exact gradients for the replicated segments: sum the
+            # rank copies over 'model' (see _tp_replicated_mask); sliced
+            # segments pass through. (1, 1, P_max) local grads broadcast.
+            row = rep_mask[jax.lax.axis_index(PIPE_AXIS)]
+            grads = jax.tree.map(
+                lambda g: g * (1.0 - row)
+                + jax.lax.psum(g * row, MODEL_AXIS),
+                grads,
+            )
         # The masked loss lives on the last stage only: one psum replicates
-        # it (and the metric sums) across the pipe.
+        # it (and the metric sums) across the pipe (and, under TP x PP, the
+        # 1/n_model-scaled model-axis copies).
         loss, etot, acc = (
-            jax.lax.psum(m, PIPE_AXIS) for m in (loss, etot, acc)
+            jax.lax.psum(m, metric_axes) for m in (loss, etot, acc)
         )
         if has_data:
             grads = jax.lax.pmean(grads, DATA_AXIS)
@@ -361,7 +537,7 @@ def make_pp_train_step(
     parallel modes uniformly.
     """
     step = _make_step_body(plan, optimizer, DATA_AXIS in mesh.axis_names)
-    specs = _state_specs(state, plan.n_stages)
+    specs = _state_specs(state, plan.n_stages, plan.n_model)
     bspec = _batch_spec(mesh)
     sharded = jax.shard_map(
         step,
@@ -410,7 +586,7 @@ def make_pp_scan_epoch(
         state, metrics = jax.lax.scan(body, state, perm)
         return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
 
-    specs = _state_specs(state, plan.n_stages)
+    specs = _state_specs(state, plan.n_stages, plan.n_model)
     sharded = jax.shard_map(
         epoch,
         mesh=mesh,
@@ -431,7 +607,7 @@ def make_pp_forward(plan: PipelinePlan, mesh):
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
     def forward(flat_params, x_mb):
-        fp = flat_params[0]
+        fp = flat_params[0, 0] if plan.n_model > 1 else flat_params[0]
         M, mb = x_mb.shape[0], x_mb.shape[1]
         fns = _stage_fns(plan, mb)
         s_idx = jax.lax.axis_index(PIPE_AXIS)
@@ -449,10 +625,14 @@ def make_pp_forward(plan: PipelinePlan, mesh):
         return jax.lax.psum(logits, PIPE_AXIS)
 
     bspec = _batch_spec(mesh)
+    row_spec = (
+        P(PIPE_AXIS, MODEL_AXIS, None) if plan.n_model > 1
+        else P(PIPE_AXIS, None)
+    )
     sharded = jax.shard_map(
         forward,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS, None), bspec),
+        in_specs=(row_spec, bspec),
         out_specs=bspec,
         check_vma=False,
     )

@@ -334,6 +334,7 @@ def make_sp_lm_train_step(
     donate: bool = True,
     remat: bool = False,
     moe_aux_weight: float = 0.01,
+    compute_dtype=None,
 ):
     """Jitted causal-LM train step with the sequence dim sharded on `axis`
     (long-context training: each device holds S/P tokens of activations)
@@ -395,6 +396,7 @@ def make_sp_lm_train_step(
             logits, aux = model.apply(
                 params, tokens, attn_fn=attn, pos_offset=pos_offset,
                 remat=remat, moe_axis=axis, return_aux=True,
+                compute_dtype=compute_dtype,
             )
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -1,0 +1,263 @@
+"""End-to-end LM trainer: corpus -> trained TransformerLM.
+
+The product form of the long-context path (train/lm.py has the step;
+this has the loop): char-level corpus, random-window batches, train/eval
+split, checkpointing, and the parallelism surface — a mesh with a 'data'
+and/or 'seq' axis. With a 'seq' axis the step is the sequence-parallel
+shard_map program (parallel/sp.py: ring / ring-flash / Ulysses
+attention, MoE blocks expert-parallel over the same axis); without one
+it is the plain jitted step (data-parallel via GSPMD from the batch
+sharding). The CNN Trainer (train/trainer.py) is the reference-parity
+loop; this is its twin for the model family the reference never had.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerLM
+from ..parallel.dp import replicate
+from ..parallel.mesh import DATA_AXIS, make_mesh
+from ..parallel.sp import SEQ_AXIS, make_sp_lm_train_step
+from ..utils.logging import MetricsLogger, get_logger
+from ..utils.sync import hard_block
+from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .lm import get_attn_fn, lm_loss, make_lm_state, make_lm_train_step, pick_attn_impl
+from .optimizer import make_optimizer
+
+
+def load_corpus(spec: str, package_root: Path | None = None) -> np.ndarray:
+    """Resolve a corpus spec to a uint8/int32 token array (char-level).
+
+    "self"      — the framework's own Python sources (real text, zero
+                  network: the analog of the digits dataset for the LM).
+    "synthetic" — cyclic-successor tokens (deterministic, converges fast).
+    a path      — any local text/bytes file.
+    """
+    if spec == "synthetic":
+        return (np.arange(1 << 20) % 251).astype(np.int32)
+    if spec == "self":
+        root = package_root or Path(__file__).resolve().parents[1]
+        parts = [p.read_bytes() for p in sorted(root.rglob("*.py"))]
+        data = b"\n".join(parts)
+    else:
+        data = Path(spec).read_bytes()
+    if len(data) < 1 << 12:
+        raise ValueError(f"corpus {spec!r} too small: {len(data)} bytes")
+    return np.frombuffer(data, np.uint8).astype(np.int32)
+
+
+@dataclasses.dataclass
+class LMResult:
+    steps_run: int
+    final_loss: float
+    eval_loss: float
+    eval_ppl: float
+    tokens_per_s: float
+
+
+class LMTrainer:
+    """tokens (int32 stream) + config -> trained params.
+
+    Batches are random (seq_len+1)-windows of the training stream; eval
+    is mean NLL over deterministic windows of the held-out tail (10%).
+    """
+
+    def __init__(self, cfg, *, mesh=None, metrics: MetricsLogger | None = None):
+        self.cfg = cfg
+        self.log = get_logger()
+        self.metrics = metrics or MetricsLogger()
+
+        tokens = load_corpus(cfg.corpus)
+        vocab = int(tokens.max()) + 1
+        split = max(len(tokens) - len(tokens) // 10, cfg.seq_len + 1)
+        self.train_tokens = tokens[:split]
+        self.eval_tokens = tokens[split:]
+        if len(self.train_tokens) < cfg.seq_len + 1:
+            raise ValueError(
+                f"corpus ({len(tokens)} tokens) shorter than --seq-len "
+                f"{cfg.seq_len}"
+            )
+
+        self.model = TransformerLM(
+            vocab=vocab, dim=cfg.dim, heads=cfg.heads, depth=cfg.depth,
+            max_seq=cfg.seq_len, moe_experts=cfg.moe_experts,
+        )
+
+        ndev = cfg.num_devices or len(jax.devices())
+        if mesh is None:
+            from ..utils.config import parse_mesh_shape
+
+            axes = parse_mesh_shape(cfg.mesh_shape, ndev)
+            mesh = make_mesh(axes, devices=jax.devices()[:ndev])
+        self.mesh = mesh
+        self.n_seq = self.mesh.shape.get(SEQ_AXIS, 1)
+        self.n_data = self.mesh.shape.get(DATA_AXIS, 1)
+        if cfg.batch_size % self.n_data:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} not divisible by data-axis "
+                f"size {self.n_data}"
+            )
+        if cfg.seq_len % self.n_seq:
+            raise ValueError(
+                f"seq_len {cfg.seq_len} not divisible by seq-axis size "
+                f"{self.n_seq}"
+            )
+
+        self.optimizer = make_optimizer(
+            cfg.lr, opt="adamw", schedule=cfg.lr_schedule,
+            total_steps=cfg.steps or None, warmup_steps=cfg.warmup_steps,
+            weight_decay=cfg.weight_decay,
+        )
+        compute_dtype = (
+            jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+        )
+        self._compute_dtype = compute_dtype
+
+        if self.n_seq > 1:
+            impl = cfg.attn_impl
+            if impl in ("auto", "flash"):
+                # ring_flash needs 128-aligned shards; plain ring otherwise.
+                on_tpu = jax.default_backend() == "tpu"
+                local = cfg.seq_len // self.n_seq
+                impl = "ring_flash" if on_tpu and local % 128 == 0 else "ring"
+            elif impl == "oracle":
+                impl = "ring"
+            self.attn_impl = impl
+            self.train_step = make_sp_lm_train_step(
+                self.model, self.optimizer, self.mesh, impl=impl,
+                data_axis=DATA_AXIS if self.n_data > 1 else None,
+                remat=cfg.remat, compute_dtype=compute_dtype,
+            )
+        else:
+            self.attn_impl = pick_attn_impl(cfg.attn_impl, cfg.seq_len)
+            self.train_step = make_lm_train_step(
+                self.model, self.optimizer, attn_impl=self.attn_impl,
+                seq_len=cfg.seq_len, compute_dtype=compute_dtype,
+                remat=cfg.remat,
+            )
+        self.state = replicate(
+            make_lm_state(self.model, self.optimizer, cfg.seed), self.mesh
+        )
+        self._rng = np.random.default_rng(cfg.seed)
+        self._eval_fn = None
+
+    # ------------------------------------------------------------------
+
+    def _sample_batch(self):
+        """(B, S) inputs + targets: random windows of the train stream."""
+        cfg = self.cfg
+        # A window consumes seq_len+1 tokens; valid starts are
+        # [0, len - seq_len - 1] inclusive, so the exclusive high bound is
+        # len - seq_len (== 1 for the minimal corpus the ctor accepts).
+        n = len(self.train_tokens) - cfg.seq_len
+        starts = self._rng.integers(0, n, size=cfg.batch_size)
+        idx = starts[:, None] + np.arange(cfg.seq_len + 1)[None, :]
+        w = self.train_tokens[idx]
+        return jnp.asarray(w[:, :-1]), jnp.asarray(w[:, 1:])
+
+    def _place(self, t):
+        """Shard (B, S) over (data, seq) mesh axes."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(
+            DATA_AXIS if self.n_data > 1 else None,
+            SEQ_AXIS if self.n_seq > 1 else None,
+        )
+        return jax.device_put(t, NamedSharding(self.mesh, spec))
+
+    def train(self) -> LMResult:
+        cfg = self.cfg
+        start_step = 0
+        if cfg.resume and cfg.checkpoint_dir:
+            ckpt = latest_checkpoint(cfg.checkpoint_dir)
+            if ckpt is not None:
+                host = jax.device_get(self.state)
+                restored = restore_checkpoint(ckpt, host)
+                shardings = jax.tree.map(lambda a: a.sharding, self.state)
+                self.state = jax.device_put(restored, shardings)
+                start_step = int(jax.device_get(self.state["step"]))
+                self.log.info("resumed from %s at step %d", ckpt, start_step)
+                # A checkpoint past --steps means nothing left to run; the
+                # loop below is empty and steps_run clamps to 0.
+                start_step = min(start_step, cfg.steps)
+
+        t0 = time.perf_counter()
+        loss = float("nan")
+        m = None
+        for step in range(start_step, cfg.steps):
+            tokens, targets = self._sample_batch()
+            self.state, m = self.train_step(
+                self.state, self._place(tokens), self._place(targets)
+            )
+            if cfg.log_every and (step + 1) % cfg.log_every == 0:
+                loss = float(m["loss"])
+                self.metrics.log("train", step=step + 1, loss=loss)
+            if cfg.checkpoint_dir and cfg.checkpoint_every and (
+                (step + 1) % cfg.checkpoint_every == 0
+            ):
+                save_checkpoint(
+                    cfg.checkpoint_dir, jax.device_get(self.state), step + 1
+                )
+        hard_block(self.state)
+        dt = time.perf_counter() - t0
+        steps_run = cfg.steps - start_step
+        loss = float(m["loss"]) if m is not None else loss
+        if cfg.checkpoint_dir:
+            save_checkpoint(
+                cfg.checkpoint_dir, jax.device_get(self.state), cfg.steps
+            )
+
+        eval_loss = self.evaluate()
+        tok_s = steps_run * cfg.batch_size * cfg.seq_len / max(dt, 1e-9)
+        self.log.info(
+            "lm done: steps=%d loss=%.4f eval_loss=%.4f ppl=%.2f tok/s=%.0f",
+            steps_run, loss, eval_loss, float(np.exp(eval_loss)), tok_s,
+        )
+        return LMResult(
+            steps_run=steps_run,
+            final_loss=loss,
+            eval_loss=eval_loss,
+            eval_ppl=float(np.exp(eval_loss)),
+            tokens_per_s=tok_s,
+        )
+
+    # ------------------------------------------------------------------
+
+    def evaluate(self) -> float:
+        """Mean next-token NLL over deterministic windows of the held-out
+        tail (single-device forward — eval is tiny next to training)."""
+        cfg = self.cfg
+        s = cfg.seq_len
+        stream = self.eval_tokens
+        if len(stream) < s + 1:
+            stream = self.train_tokens  # tiny-corpus fallback
+        nwin = min(8, (len(stream) - 1) // s)
+        if self._eval_fn is None:
+            attn_fn = get_attn_fn(
+                "flash" if self.attn_impl in ("flash", "ring_flash")
+                else "oracle"
+            )
+
+            @jax.jit
+            def eval_fn(params, tokens, targets):
+                return lm_loss(
+                    self.model, params, tokens, targets, attn_fn=attn_fn,
+                    compute_dtype=self._compute_dtype, moe_aux_weight=0.0,
+                )
+
+            self._eval_fn = eval_fn
+        params = jax.device_get(self.state["params"])
+        losses = []
+        for i in range(nwin):
+            w = stream[i * s : i * s + s + 1]
+            losses.append(float(self._eval_fn(
+                params, jnp.asarray(w[None, :-1]), jnp.asarray(w[None, 1:])
+            )))
+        return float(np.mean(losses)) if losses else float("nan")

@@ -159,15 +159,11 @@ class Trainer:
         self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
         self.n_pipe = self.mesh.shape.get(PIPE_AXIS, 1)
         self._pp_M = 1  # microbatches per step; >1 only on the PP path
-        if self.n_pipe > 1 and self.n_model > 1:
+        if config.fsdp and self.n_pipe > 1:
             raise ValueError(
-                "mesh combines 'pipe' and 'model' axes; TP x PP is not "
-                "supported — use pipe+data or model+data"
-            )
-        if config.fsdp and (self.n_pipe > 1 or self.n_model > 1):
-            raise ValueError(
-                "--fsdp shards params over the 'data' axis and does not "
-                "compose with 'pipe'/'model' meshes; use a pure data mesh"
+                "--fsdp shards unpacked param pytrees over 'data' and does "
+                "not compose with the pipeline path's packed stage rows; "
+                "use a data/model mesh (FSDP x TP composes)"
             )
         if self.n_pipe == 1 and config.num_microbatches:
             raise ValueError(
@@ -209,7 +205,8 @@ class Trainer:
                     f"num_microbatches x data-axis ({self._pp_M} x {n_data})"
                 )
             self._pp_plan = make_pipeline_plan(
-                model, self.n_pipe, backend=backend, compute_dtype=compute_dtype
+                model, self.n_pipe, backend=backend,
+                compute_dtype=compute_dtype, n_model=self.n_model,
             )
             self.state = make_pp_state(
                 self._pp_plan, params, self.optimizer, self.mesh
@@ -229,7 +226,16 @@ class Trainer:
             if config.fsdp:
                 from ..parallel.fsdp import make_fsdp_state
 
-                self.state = make_fsdp_state(params, self.optimizer, self.mesh)
+                base = None
+                if self.n_model > 1:
+                    # FSDP x TP: features over 'model' (Megatron), the
+                    # largest remaining dim over 'data' (ZeRO).
+                    from ..parallel.tp import tp_param_specs
+
+                    base = tp_param_specs(model, self.mesh)
+                self.state = make_fsdp_state(
+                    params, self.optimizer, self.mesh, base_specs=base
+                )
             else:
                 self.state = make_tp_state(
                     model, params, self.optimizer, self.mesh

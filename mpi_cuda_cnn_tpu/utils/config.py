@@ -82,6 +82,65 @@ class Config:
         return cls(**json.loads(text))
 
 
+@dataclasses.dataclass
+class LMConfig:
+    """Config for the `lm` subcommand (train/lm_trainer.py) — the
+    long-context model family's product surface: transformer size,
+    corpus, parallelism mesh (data/seq axes), MoE, attention impl."""
+
+    corpus: str = "self"          # self | synthetic | path to a text file
+    dim: int = 256
+    depth: int = 4
+    heads: int = 8
+    seq_len: int = 256
+    moe_experts: int = 0          # >0: Switch-MoE MLP per block (EP over
+                                  # the 'seq' axis when one exists)
+    steps: int = 200
+    batch_size: int = 8
+    lr: float = 3e-4
+    lr_schedule: str = "cosine"
+    warmup_steps: int = 20
+    weight_decay: float = 0.01
+    seed: int = 0
+
+    compute_dtype: str = "float32"   # bfloat16 = MXU-native matmuls
+    attn_impl: str = "auto"          # auto | flash | oracle (seq-sharded
+                                     # meshes map these to ring_flash/ring;
+                                     # 'ulysses' forces all-to-all SP)
+    remat: bool = False
+    device: str = "auto"
+    num_devices: int = 0
+    mesh_shape: str = "data"         # e.g. "data:2,seq:4"
+
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0
+    resume: bool = False
+    log_every: int = 20
+
+
+def build_lm_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mpi_cuda_cnn_tpu lm",
+        description="Train the transformer LM (long-context path: "
+                    "flash attention, ring/Ulysses SP, MoE).",
+    )
+    defaults = LMConfig()
+    for f in dataclasses.fields(LMConfig):
+        flag = "--" + f.name.replace("_", "-")
+        default = getattr(defaults, f.name)
+        if isinstance(default, bool):
+            p.add_argument(flag, action=argparse.BooleanOptionalAction,
+                           default=default)
+        else:
+            ftype = str if default is None else type(default)
+            p.add_argument(flag, type=ftype, default=default)
+    return p
+
+
+def parse_lm_args(argv: list[str] | None = None) -> "LMConfig":
+    return LMConfig(**vars(build_lm_parser().parse_args(argv)))
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="mpi_cuda_cnn_tpu",

@@ -97,3 +97,26 @@ def test_flash_bf16_inputs_roundtrip():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(want), rtol=5e-2, atol=5e-2
     )
+
+
+def test_flash_bf16_gradients_match_oracle():
+    """bf16-native kernels (bf16 MXU operands, f32 accumulators): the
+    fused backward must track the f32 oracle to bf16-rounding accuracy."""
+    q, k, v = _qkv(2, 128, 2, 64, seed=3)
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True).astype(jnp.float32) ** 2)
+
+    def oracle(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+    got = jax.grad(f, argnums=(0, 1, 2))(qb, kb, vb)
+    want = jax.grad(oracle, argnums=(0, 1, 2))(
+        qb.astype(jnp.float32), kb.astype(jnp.float32), vb.astype(jnp.float32)
+    )
+    for g, w in zip(got, want):
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w), rtol=8e-2, atol=8e-2
+        )

@@ -86,8 +86,20 @@ def test_fsdp_e2e_train_and_eval(eight_devices):
     assert t.train().test_accuracy >= 0.9
 
 
-def test_fsdp_rejected_with_model_axis(eight_devices):
+def test_fsdp_composes_with_model_axis(eight_devices):
+    """FSDP x TP (round-2): a data:4,model:2 mesh with --fsdp builds and
+    trains (combined specs: features over 'model', rest over 'data');
+    exact parity vs pure DP is covered in test_tp_pp.py."""
     ds = synthetic_stripes(num_train=64, num_test=32)
-    cfg = Config(batch_size=32, fsdp=True, mesh_shape="data:4,model:2")
+    cfg = Config(batch_size=32, fsdp=True, mesh_shape="data:4,model:2",
+                 epochs=1, eval_every=0, log_every=0, scan=False)
+    t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
+    em = t.run_epoch(0)
+    assert np.isfinite(em["loss"])
+
+
+def test_fsdp_rejected_with_pipe_axis(eight_devices):
+    ds = synthetic_stripes(num_train=64, num_test=32)
+    cfg = Config(batch_size=32, fsdp=True, mesh_shape="pipe:2,data:4")
     with pytest.raises(ValueError, match="fsdp"):
         Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())

@@ -1,0 +1,85 @@
+"""LMTrainer + the `lm` CLI subcommand (train/lm_trainer.py, cli.run_lm).
+
+The product surface of the long-context path: corpus loading, the
+data/seq mesh dispatch (plain step vs shard_map SP step), checkpointing,
+and eval perplexity.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.cli import main
+from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer, load_corpus
+from mpi_cuda_cnn_tpu.utils.config import LMConfig
+from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+
+def _cfg(**kw):
+    base = dict(
+        corpus="synthetic", dim=32, depth=2, heads=4, seq_len=64,
+        steps=20, batch_size=4, log_every=0, lr_schedule="constant",
+        warmup_steps=0, num_devices=1,
+    )
+    if "mesh_shape" in kw:
+        base.pop("num_devices")  # mesh tests use all 8 virtual devices
+    base.update(kw)
+    return LMConfig(**base)
+
+
+def test_load_corpus_self_is_real_text():
+    toks = load_corpus("self")
+    assert len(toks) > 10_000
+    # It is the package's own source: ASCII-dominated, contains newlines.
+    assert toks.max() < 256 and (toks == ord("\n")).sum() > 100
+
+
+def test_load_corpus_rejects_tiny_file(tmp_path):
+    p = tmp_path / "tiny.txt"
+    p.write_text("too small")
+    with pytest.raises(ValueError, match="too small"):
+        load_corpus(str(p))
+
+
+def test_single_device_trains_and_evals():
+    r = LMTrainer(_cfg(), metrics=MetricsLogger(echo=False)).train()
+    assert r.steps_run == 20
+    assert np.isfinite(r.final_loss) and np.isfinite(r.eval_ppl)
+
+
+def test_sp_mesh_learns_synthetic_cycle():
+    """seq:8 mesh on the deterministic successor corpus: loss must drop
+    well below ln(vocab) — the SP step is optimizing, not just running."""
+    cfg = _cfg(mesh_shape="seq:8", seq_len=128, steps=150, lr=3e-3)
+    r = LMTrainer(cfg, metrics=MetricsLogger(echo=False)).train()
+    assert r.final_loss < 2.0  # ln(251) ~ 5.5 at init
+
+
+def test_data_seq_mesh_with_moe():
+    cfg = _cfg(mesh_shape="data:2,seq:4", moe_experts=8, seq_len=128)
+    r = LMTrainer(cfg, metrics=MetricsLogger(echo=False)).train()
+    assert np.isfinite(r.final_loss)
+
+
+def test_checkpoint_resume_continues_at_step(tmp_path):
+    ck = str(tmp_path / "ck")
+    cfg = _cfg(steps=10, checkpoint_dir=ck, checkpoint_every=5)
+    LMTrainer(cfg, metrics=MetricsLogger(echo=False)).train()
+    cfg2 = _cfg(steps=15, checkpoint_dir=ck, resume=True)
+    r = LMTrainer(cfg2, metrics=MetricsLogger(echo=False)).train()
+    assert r.steps_run == 5  # resumed at 10, ran to 15
+
+
+def test_seq_len_must_divide():
+    with pytest.raises(ValueError, match="not divisible"):
+        LMTrainer(_cfg(mesh_shape="seq:8", seq_len=100),
+                  metrics=MetricsLogger(echo=False))
+
+
+def test_cli_lm_subcommand():
+    rc = main([
+        "lm", "--device", "cpu", "--corpus", "synthetic", "--dim", "32",
+        "--depth", "1", "--heads", "4", "--seq-len", "64", "--steps", "5",
+        "--batch-size", "2", "--log-every", "0", "--num-devices", "1",
+        "--lr-schedule", "constant", "--warmup-steps", "0",
+    ])
+    assert rc == 0
