@@ -57,6 +57,7 @@ class TransformerLM:
     max_seq: int = 256
     moe_experts: int = 0   # 0 = dense MLP; >0 = Switch-MoE MLP per block
                            # (parallel/ep.py), EP-shardable over a mesh axis
+    moe_top_k: int = 1     # experts per token: 1 = Switch, 2 = GShard-style
     name: str = "transformer_lm"
 
     @property
@@ -155,7 +156,7 @@ class TransformerLM:
 
                     m = moe_mlp_inference(
                         y.reshape(b * s, self.dim), moe_p,
-                        n_experts=self.moe_experts,
+                        n_experts=self.moe_experts, top_k=self.moe_top_k,
                     )
                     aux = jnp.zeros(())
                 else:
@@ -164,6 +165,7 @@ class TransformerLM:
                     m, aux = moe_mlp(
                         y.reshape(b * s, self.dim), moe_p,
                         n_experts=self.moe_experts, axis=moe_axis,
+                        top_k=self.moe_top_k,
                     )
                 return x + m.reshape(b, s, self.dim).astype(x.dtype), aux
             return (

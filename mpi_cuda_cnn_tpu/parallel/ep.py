@@ -83,6 +83,52 @@ def top1_dispatch(x, gate_w, n_experts: int, capacity: int):
     return dispatch, combine, aux_loss
 
 
+def topk_dispatch(x, gate_w, n_experts: int, capacity: int, k: int = 2):
+    """Top-k routing (GShard-style) for tokens x: (T, D).
+
+    Each token is routed to its k highest-probability experts with the
+    combined gate renormalized over the chosen k (the standard top-k
+    normalization). Capacity is allocated by CHOICE PRIORITY: all tokens'
+    1st choices claim slots before any 2nd choice does, so adding k > 1
+    never evicts a would-be top-1 assignment. Per choice, slots go in
+    token order (same policy as top1_dispatch).
+
+    Returns (dispatch, combine, aux_loss) with the same shapes/semantics
+    as top1_dispatch — (T, E, C) tensors, einsum-ready; k=1 reproduces
+    top1_dispatch exactly (tested)."""
+    t = x.shape[0]
+    logits = x @ gate_w                                   # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(probs, k)                   # (T, k), distinct
+    # k=1 keeps the RAW top prob (Switch semantics — degenerates to
+    # top1_dispatch exactly); k>1 renormalizes over the chosen k (GShard).
+    gates = vals if k == 1 else vals / jnp.sum(vals, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    used = jnp.zeros((n_experts,), jnp.float32)  # kept slots per expert
+    for j in range(k):
+        onehot = jax.nn.one_hot(idx[:, j], n_experts, dtype=jnp.float32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + used[None, :]) * onehot
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
+            dtype=jnp.float32,
+        )
+        d_j = keep[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gates[:, j, None, None]
+        used = used + jnp.sum(keep, axis=0)
+    # Load-balance aux (Switch form over FIRST choices: the signal that
+    # spreads primary assignments; renormalized 2nd choices would dilute it).
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(idx[:, 0], n_experts, dtype=jnp.float32), axis=0
+    )
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = jnp.sum(frac_tokens * frac_probs) * n_experts
+    return dispatch, combine, aux_loss
+
+
 def _expert_ffn(h, w1, w2):
     """Batched expert MLP: h (E_local, S, D) x w1 (E_local, D, H) ..."""
     return jnp.einsum("esh,ehd->esd", jax.nn.relu(jnp.einsum("esd,edh->esh", h, w1)), w2)
@@ -95,17 +141,25 @@ def moe_mlp(
     n_experts: int,
     capacity_factor: float = 1.25,
     axis: str | None = EXPERT_AXIS,
+    top_k: int = 1,
 ):
     """MoE MLP for x: (T, D) local tokens. SPMD body when `axis` names a
     mesh axis — then params["w1"]/["w2"] hold only THIS device's E/P
     expert stack (sharded on their leading dim; the gate is replicated) —
     or the exact single-device dense oracle when axis=None (full stacks).
+    top_k=1 is Switch routing; top_k=2 the GShard form (capacity scales
+    with k so per-expert slots track the k*T total assignments).
     Returns (y: (T, D), aux_loss: scalar)."""
     t, d = x.shape
-    capacity = max(1, -int(-t * capacity_factor // n_experts))  # ceil
-    dispatch, combine, aux = top1_dispatch(
-        x, params["gate"], n_experts, capacity
-    )
+    capacity = max(1, -int(-t * top_k * capacity_factor // n_experts))  # ceil
+    if top_k == 1:
+        dispatch, combine, aux = top1_dispatch(
+            x, params["gate"], n_experts, capacity
+        )
+    else:
+        dispatch, combine, aux = topk_dispatch(
+            x, params["gate"], n_experts, capacity, top_k
+        )
     # Dispatch/combine follow x's dtype so a bf16 compute path stays bf16
     # end to end (dispatch is exact {0,1} in any float dtype; combine's
     # gate weights round like every other bf16 operand).
@@ -164,27 +218,35 @@ def moe_param_specs(axis: str = EXPERT_AXIS) -> dict:
     return {"gate": P(), "w1": P(axis), "w2": P(axis)}
 
 
-def moe_mlp_inference(x, params: dict, *, n_experts: int):
-    """No-drop top-1 MoE for INFERENCE: every token runs through every
-    expert and the router's choice selects the output.
+def moe_mlp_inference(x, params: dict, *, n_experts: int, top_k: int = 1):
+    """No-drop top-k MoE for INFERENCE: every token runs through every
+    expert and the router's choice(s) select (and weight) the output.
 
     E-fold MLP FLOPs, but O(T*E*H) memory instead of the dispatch
     formulation's O(T^2) no-drop tensors — and, unlike capacity routing,
     token t's output depends on token t alone (no batch contamination, no
     causality leak through queue positions). The right trade for decode
     and prefill; training keeps the capacity-dropped dispatch (moe_mlp).
+    top_k > 1 mirrors topk_dispatch's renormalized combined gates.
     """
     probs = jax.nn.softmax((x @ params["gate"]).astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                       # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)
+    vals, idx = jax.lax.top_k(probs, top_k)                   # (T, k)
+    gates = (
+        vals if top_k == 1
+        else vals / jnp.sum(vals, axis=-1, keepdims=True)
+    )  # same gate rule as topk_dispatch
     h = jax.nn.relu(jnp.einsum("td,edh->teh", x, params["w1"]))
     y_all = jnp.einsum("teh,ehd->ted", h, params["w2"])       # (T, E, D)
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=y_all.dtype)
-    y = jnp.einsum("ted,te->td", y_all, onehot) * gate.astype(y_all.dtype)
+    weight = jnp.zeros_like(probs)
+    weight = jnp.put_along_axis(
+        weight, idx, gates, axis=-1, inplace=False
+    )                                                          # (T, E)
+    y = jnp.einsum("ted,te->td", y_all, weight.astype(y_all.dtype))
     return y.astype(x.dtype)
 
 
-def make_moe_layer(mesh, *, n_experts, capacity_factor=1.25, axis=EXPERT_AXIS):
+def make_moe_layer(mesh, *, n_experts, capacity_factor=1.25, axis=EXPERT_AXIS,
+                   top_k=1):
     """jitted (params, x) -> (y, aux) with x: (T, D) sharded on `axis` and
     the expert stacks sharded per moe_param_specs — the wrapped EP layer
     for standalone use. Pass full (host) params; shard_map's in_specs
@@ -196,7 +258,8 @@ def make_moe_layer(mesh, *, n_experts, capacity_factor=1.25, axis=EXPERT_AXIS):
             f"{mesh.shape[axis]}"
         )
     body = partial(
-        moe_mlp, n_experts=n_experts, capacity_factor=capacity_factor, axis=axis
+        moe_mlp, n_experts=n_experts, capacity_factor=capacity_factor,
+        axis=axis, top_k=top_k,
     )
 
     def shard_body(p_, x_):

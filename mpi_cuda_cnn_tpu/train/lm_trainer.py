@@ -88,6 +88,7 @@ class LMTrainer:
         self.model = TransformerLM(
             vocab=vocab, dim=cfg.dim, heads=cfg.heads, depth=cfg.depth,
             max_seq=cfg.seq_len, moe_experts=cfg.moe_experts,
+            moe_top_k=cfg.moe_top_k,
         )
 
         ndev = cfg.num_devices or len(jax.devices())
@@ -110,9 +111,18 @@ class LMTrainer:
                 f"{self.n_seq}"
             )
 
+        # Cosine needs positive decay_steps: clamp warmup only when it
+        # would swallow the whole (short) run, and say so.
+        warmup = cfg.warmup_steps
+        if warmup >= cfg.steps:
+            warmup = max(cfg.steps - 1, 0)
+            self.log.warning(
+                "warmup_steps %d >= steps %d; clamped to %d",
+                cfg.warmup_steps, cfg.steps, warmup,
+            )
         self.optimizer = make_optimizer(
             cfg.lr, opt="adamw", schedule=cfg.lr_schedule,
-            total_steps=cfg.steps or None, warmup_steps=cfg.warmup_steps,
+            total_steps=cfg.steps or None, warmup_steps=warmup,
             weight_decay=cfg.weight_decay,
         )
         compute_dtype = (

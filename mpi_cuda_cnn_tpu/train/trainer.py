@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..data.pipeline import epoch_batches, normalize_images, one_hot
+from ..data.pipeline import normalize_images, one_hot
 from ..models.initializers import get_initializer
 from ..ops import softmax_cross_entropy, squared_error_total, stable_softmax
 from ..parallel.dp import (
@@ -267,15 +267,39 @@ class Trainer:
         self._eval_batch = self._pick_eval_batch(
             len(self.test_x), n_data * self._pp_M
         )
-        # One shuffle stream for the whole run, shared by every entry point
-        # (train(), run_epoch() via the C ABI) so batch order is identical
-        # regardless of which driver runs the loop.
-        self._rng = np.random.default_rng(config.seed)
+        # Shuffle order is a pure function of (seed, epoch) — see
+        # _epoch_order — so every entry point (train(), run_epoch() via
+        # the C ABI, a resumed process after preemption) reconstructs the
+        # exact batch order without any serialized RNG state. This is what
+        # makes STEP-granular resume bitwise-exact (SURVEY.md §5.3/5.4
+        # "elastic recovery"): epoch = step // steps_per_epoch, position
+        # = step % steps_per_epoch, order = _epoch_order(epoch).
 
         if self.steps_per_epoch == 0:
             raise ValueError(
                 f"batch_size {config.batch_size} exceeds train set size "
                 f"{self.num_train}: no full batches"
+            )
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """The epoch's sample permutation — derived, never stored."""
+        return np.random.default_rng((self.cfg.seed, epoch)).permutation(
+            self.num_train
+        )
+
+    def _global_step(self) -> int:
+        return int(jax.device_get(self.state["step"]))
+
+    def _maybe_step_checkpoint(self, global_step: int) -> None:
+        """Mid-epoch save when --checkpoint-every-steps divides the global
+        step (called at batch/chunk boundaries; the host-side step count
+        avoids a per-step device sync — saving itself syncs)."""
+        cfg = self.cfg
+        if not (cfg.checkpoint_dir and cfg.checkpoint_every_steps):
+            return
+        if global_step and global_step % cfg.checkpoint_every_steps == 0:
+            save_checkpoint(
+                cfg.checkpoint_dir, jax.device_get(self.state), global_step
             )
 
     @staticmethod
@@ -319,39 +343,47 @@ class Trainer:
         shardings = jax.tree.map(lambda a: a.sharding, self.state)
         self.state = jax.device_put(host_state, shardings)
 
-    def run_epoch(self, epoch: int) -> dict:
+    def run_epoch(self, epoch: int, *, skip_steps: int = 0) -> dict:
         """Run one epoch of the jitted step over the whole training set.
 
         The single implementation behind both the Python CLI loop (train())
-        and the C driver's ABI (runtime_abi.train_epoch) — one shuffle
-        stream (self._rng, seeded once from cfg.seed), one metric scheme.
-        Metric sums accumulate as device scalars: no host sync per step, so
-        dispatch stays async (the reference blocks on every sample by
-        construction; we must not).
+        and the C driver's ABI (runtime_abi.train_epoch) — one derived
+        shuffle order (_epoch_order), one metric scheme. skip_steps > 0
+        resumes MID-epoch: the first skip_steps batches of this epoch's
+        order are skipped (they ran before the preemption). Metric sums
+        accumulate as device scalars: no host sync per step, so dispatch
+        stays async (the reference blocks on every sample by construction;
+        we must not).
         """
         if self.cfg.scan:
-            return self._run_epoch_scanned(epoch)
+            return self._run_epoch_scanned(epoch, skip_steps=skip_steps)
         cfg = self.cfg
         t0 = time.perf_counter()
         running = None
         nsteps = 0
-        for bx, by in epoch_batches(
-            self.train_x, self.train_y, cfg.batch_size, rng=self._rng
-        ):
-            batch = self._place_batch(bx, by)
+        order = self._epoch_order(epoch)
+        b = cfg.batch_size
+        for start in range(skip_steps * b, self.num_train - self.num_train % b, b):
+            idx = order[start : start + b]
+            batch = self._place_batch(self.train_x[idx], self.train_y[idx])
             self.state, m = self.train_step(self.state, *batch)
             running = m if running is None else jax.tree.map(jnp.add, running, m)
             nsteps += 1
-            if cfg.log_every > 0 and nsteps % cfg.log_every == 0:
+            # step is the ABSOLUTE in-epoch position (skip included) so a
+            # resumed run's metric stream lines up with the scanned path's.
+            if cfg.log_every > 0 and (skip_steps + nsteps) % cfg.log_every == 0:
                 jax.block_until_ready(running)
                 self.metrics.log(
                     "train",
                     epoch=epoch,
-                    step=nsteps,
+                    step=skip_steps + nsteps,
                     loss=float(running["loss"]) / nsteps,
                     etotal=float(running["etotal"]) / nsteps,
                     acc=float(running["acc"]) / nsteps,
                 )
+            self._maybe_step_checkpoint(
+                epoch * self.steps_per_epoch + skip_steps + nsteps
+            )
         # hard_block, not block_until_ready: the epoch wall-clock must
         # cover the COMPUTE, and under this env's remote-TPU tunnel
         # block_until_ready returns at enqueue (utils/sync.py).
@@ -404,17 +436,20 @@ class Trainer:
                 grad_accum=self.cfg.grad_accum,
             )
 
-    def _run_epoch_scanned(self, epoch: int) -> dict:
+    def _run_epoch_scanned(self, epoch: int, *, skip_steps: int = 0) -> dict:
         """Scanned epoch: one device dispatch per `log_every` steps (one per
         epoch when logging is off). The host sends only the int32 batch
-        permutation; the dataset stays HBM-resident across epochs."""
+        permutation; the dataset stays HBM-resident across epochs.
+        skip_steps resumes mid-epoch; --checkpoint-every-steps additionally
+        splits chunks at checkpoint boundaries so mid-epoch saves land on
+        exact step counts."""
         cfg = self.cfg
         t0 = time.perf_counter()
         if self._scan_epoch_fn is None:
             self._stage_dataset()
         b = cfg.batch_size
         nsteps = self.steps_per_epoch
-        order = self._rng.permutation(self.num_train)[: nsteps * b]
+        order = self._epoch_order(epoch)[: nsteps * b]
         perm = order.reshape(nsteps, b).astype(np.int32)
 
         # log_every <= 0 means logging off -> the whole epoch is one scan.
@@ -422,50 +457,70 @@ class Trainer:
         chunk = nsteps if cfg.log_every <= 0 else min(cfg.log_every, nsteps)
         log_chunks = 0 < cfg.log_every <= nsteps  # parity with the loop path
         totals = None
-        done = 0
-        for start in range(0, nsteps, chunk):
-            rows = dp_shard_perm(perm[start : start + chunk], self.mesh)
+        done = skip_steps
+        while done < nsteps:
+            end = min(done + chunk - done % chunk, nsteps)
+            if cfg.checkpoint_dir and cfg.checkpoint_every_steps:
+                # Break the chunk at the next global checkpoint boundary
+                # (gated like _maybe_step_checkpoint — no dir, no split).
+                # Chunk shapes recur once boundary offsets cycle; choosing
+                # --checkpoint-every-steps to divide steps_per_epoch keeps
+                # the XLA shape/compile set at its minimum.
+                gstep = epoch * nsteps + done
+                nxt = gstep + (
+                    cfg.checkpoint_every_steps - gstep % cfg.checkpoint_every_steps
+                )
+                end = min(end, nxt - epoch * nsteps)
+            rows = dp_shard_perm(perm[done:end], self.mesh)
             self.state, sums = self._scan_epoch_fn(
                 self.state, self._dev_images, self._dev_labels, rows
             )
             totals = sums if totals is None else jax.tree.map(jnp.add, totals, sums)
-            done += len(perm[start : start + chunk])
+            done = end
             # Parity with the loop path: log only at exact multiples of
             # log_every (a short tail chunk trains but does not log).
             if log_chunks and done % cfg.log_every == 0:
                 jax.block_until_ready(totals)
+                run = done - skip_steps
                 self.metrics.log(
                     "train",
                     epoch=epoch,
                     step=done,
-                    loss=float(totals["loss"]) / done,
-                    etotal=float(totals["etotal"]) / done,
-                    acc=float(totals["acc"]) / done,
+                    loss=float(totals["loss"]) / run,
+                    etotal=float(totals["etotal"]) / run,
+                    acc=float(totals["acc"]) / run,
                 )
+            self._maybe_step_checkpoint(epoch * nsteps + done)
         hard_block(self.state)  # see run_epoch: must wait for compute
         seconds = time.perf_counter() - t0
+        run = nsteps - skip_steps
         return {
             "epoch": epoch,
-            "steps": nsteps,
-            "loss": float(totals["loss"]) / nsteps,
-            "etotal": float(totals["etotal"]) / nsteps,
-            "acc": float(totals["acc"]) / nsteps,
+            "steps": run,
+            "loss": float(totals["loss"]) / run,
+            "etotal": float(totals["etotal"]) / run,
+            "acc": float(totals["acc"]) / run,
             "seconds": seconds,
         }
 
     def train(self) -> TrainResult:
         cfg = self.cfg
         start_epoch = 0
+        skip_steps = 0  # mid-epoch resume position within start_epoch
 
         if cfg.resume and cfg.checkpoint_dir:
             ckpt = latest_checkpoint(cfg.checkpoint_dir)
             if ckpt is not None:
                 host_state = jax.device_get(self.state)
                 self.place_state(restore_checkpoint(ckpt, host_state))
-                start_epoch = int(jax.device_get(self.state["step"])) // max(
-                    self.steps_per_epoch, 1
+                spe = max(self.steps_per_epoch, 1)
+                step0 = self._global_step()
+                start_epoch = step0 // spe
+                skip_steps = step0 % spe
+                self.log.info(
+                    "resumed from %s at epoch %d step %d (in-epoch %d)",
+                    ckpt, start_epoch, step0, skip_steps,
                 )
-                self.log.info("resumed from %s at epoch %d", ckpt, start_epoch)
 
         timer = StepTimer()
         epoch_seconds: list[float] = []
@@ -474,7 +529,8 @@ class Trainer:
         with profile_trace(cfg.profile_dir):
             for epoch in range(start_epoch, cfg.epochs):
                 timer.start()
-                em = self.run_epoch(epoch)
+                em = self.run_epoch(epoch, skip_steps=skip_steps)
+                skip_steps = 0  # only the resumed epoch is partial
                 timer.stop(em["steps"])
                 epoch_seconds.append(em["seconds"])
                 self.metrics.log("epoch", epoch=epoch, seconds=em["seconds"])
@@ -490,14 +546,14 @@ class Trainer:
                     save_checkpoint(
                         cfg.checkpoint_dir,
                         jax.device_get(self.state),
-                        int(jax.device_get(self.state["step"])),
+                        self._global_step(),
                     )
 
         if cfg.checkpoint_dir:
             save_checkpoint(
                 cfg.checkpoint_dir,
                 jax.device_get(self.state),
-                int(jax.device_get(self.state["step"])),
+                self._global_step(),
             )
         if not (cfg.eval_every and cfg.epochs > start_epoch
                 and cfg.epochs % cfg.eval_every == 0):
@@ -509,7 +565,7 @@ class Trainer:
         self.log.info("ntests=%d, ncorrect=%d", ntests, ncorrect)
         return TrainResult(
             epochs_run=cfg.epochs - start_epoch,
-            final_step=int(jax.device_get(self.state["step"])),
+            final_step=self._global_step(),
             test_accuracy=result_acc,
             ntests=ntests,
             ncorrect=ncorrect,

@@ -69,6 +69,10 @@ class Config:
     # Aux subsystems.
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0     # epochs; 0 = only at end when dir is set
+    checkpoint_every_steps: int = 0  # optimizer steps; >0 = mid-epoch
+                                  # saves, and --resume continues at the
+                                  # exact step (bitwise — the shuffle
+                                  # order is derived from (seed, epoch))
     resume: bool = False
     log_every: int = 100          # steps; reference prints every 1000 samples
     profile_dir: str | None = None
@@ -95,6 +99,7 @@ class LMConfig:
     seq_len: int = 256
     moe_experts: int = 0          # >0: Switch-MoE MLP per block (EP over
                                   # the 'seq' axis when one exists)
+    moe_top_k: int = 1            # experts per token (1=Switch, 2=GShard)
     steps: int = 200
     batch_size: int = 8
     lr: float = 3e-4

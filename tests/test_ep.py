@@ -146,3 +146,115 @@ def test_ep_layer_trains():
         params = optax.apply_updates(params, updates)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, f"no learning: {losses[::15]}"
+
+
+# ---------------------------------------------------------------------------
+# Top-k (k=2) routing — round-2 item 9
+# ---------------------------------------------------------------------------
+
+
+def test_topk_k1_matches_top1_exactly():
+    from mpi_cuda_cnn_tpu.parallel.ep import topk_dispatch
+
+    x, p = _tokens(t=64), _params()
+    d1, c1, a1 = top1_dispatch(x, p["gate"], E, capacity=16)
+    dk, ck, ak = topk_dispatch(x, p["gate"], E, capacity=16, k=1)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(dk))
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(ck), atol=1e-7)
+    assert float(a1) == pytest.approx(float(ak))
+
+
+def test_top2_dispatch_invariants():
+    from mpi_cuda_cnn_tpu.parallel.ep import topk_dispatch
+
+    x, p = _tokens(t=128), _params()
+    cap = 40
+    dispatch, combine, _ = topk_dispatch(x, p["gate"], E, capacity=cap, k=2)
+    d = np.asarray(dispatch)
+    # Each token occupies at most 2 slots, in 2 DIFFERENT experts.
+    per_token = d.sum(axis=(1, 2))
+    assert per_token.max() <= 2.0 + 1e-6
+    per_token_expert = d.sum(axis=2)
+    assert per_token_expert.max() <= 1.0 + 1e-6
+    # Each (expert, slot) pair holds at most one token; capacity respected.
+    assert d.sum(axis=0).max() <= 1.0 + 1e-6
+    assert d.sum(axis=(0, 2)).max() <= cap
+    # Combined gates are renormalized: a fully-kept token's combine sums
+    # to ~1 (both choices kept), a half-dropped one to < 1.
+    kept_both = per_token >= 2.0 - 1e-6
+    csum = np.asarray(combine).sum(axis=(1, 2))
+    np.testing.assert_allclose(csum[kept_both], 1.0, atol=1e-5)
+    assert np.all(csum <= 1.0 + 1e-5)
+
+
+def test_top2_first_choices_never_evicted():
+    """Choice-priority capacity: adding 2nd choices must not change which
+    FIRST choices are kept."""
+    from mpi_cuda_cnn_tpu.parallel.ep import topk_dispatch
+
+    x, p = _tokens(t=128), _params()
+    cap = 8
+    d1, _, _ = topk_dispatch(x, p["gate"], E, capacity=cap, k=1)
+    d2, _, _ = topk_dispatch(x, p["gate"], E, capacity=cap, k=2)
+    probs = jax.nn.softmax(x @ p["gate"], axis=-1)
+    first = np.asarray(jnp.argmax(probs, axis=-1))
+    # Project d2 onto first-choice experts only.
+    d2_first = np.asarray(d2).sum(axis=2)[np.arange(128), first]
+    d1_first = np.asarray(d1).sum(axis=2)[np.arange(128), first]
+    np.testing.assert_array_equal(d1_first, d2_first)
+
+
+def test_top2_ep_matches_oracle():
+    """Sharded top-2 EP layer == the axis=None oracle on the same tokens."""
+    mesh = _mesh()
+    p = _params()
+    x = _tokens(t=8 * 16, seed=4)
+    layer = make_moe_layer(mesh, n_experts=E, top_k=2)
+    y_ep, aux_ep = layer(p, x)
+    y_or, aux_or = moe_mlp(x, p, n_experts=E, axis=None, top_k=2)
+    # The sharded layer routes per device shard (16 tokens each) while the
+    # oracle routes globally — compare per-shard oracles.
+    ys = []
+    for s in range(8):
+        y_s, _ = moe_mlp(x[s * 16:(s + 1) * 16], p, n_experts=E, axis=None,
+                         top_k=2)
+        ys.append(np.asarray(y_s))
+    np.testing.assert_allclose(
+        np.asarray(y_ep), np.concatenate(ys), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_top2_moe_lm_trains():
+    """A top-2 MoE TransformerLM trains end to end under SP x EP."""
+    import optax as _optax
+
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.parallel.sp import SEQ_AXIS, make_sp_lm_train_step
+
+    mesh = make_mesh({SEQ_AXIS: 4}, devices=jax.devices()[:4])
+    lm = TransformerLM(vocab=17, dim=32, heads=4, depth=2, max_seq=64,
+                       moe_experts=4, moe_top_k=2)
+    params = lm.init(jax.random.key(0))
+    opt = _optax.adam(3e-3)
+    state = {"params": params, "opt_state": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    step = make_sp_lm_train_step(lm, opt, mesh)
+    rng = np.random.default_rng(0)
+    start = rng.integers(0, 17, size=(4, 1))
+    toks = jnp.asarray((start + np.arange(65)) % 17, jnp.int32)
+    losses = []
+    for _ in range(40):
+        state, m = step(state, toks[:, :-1], toks[:, 1:])
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_top2_inference_weights_two_experts():
+    from mpi_cuda_cnn_tpu.parallel.ep import moe_mlp_inference
+
+    x, p = _tokens(t=16), _params()
+    y1 = moe_mlp_inference(x, p, n_experts=E, top_k=1)
+    y2 = moe_mlp_inference(x, p, n_experts=E, top_k=2)
+    assert y1.shape == y2.shape == x.shape
+    # k=2 mixes a second expert: outputs must differ from pure top-1.
+    assert float(jnp.max(jnp.abs(y1 - y2))) > 1e-4
