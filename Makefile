@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_all test_serial test_dp8 test_sp8 test_ep8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native get_mnist clean
+.PHONY: test test_all test_serial test_dp8 test_sp8 test_ep8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -15,6 +15,10 @@ native:
 test_native: native
 	$(MAKE) -C native test
 	$(MAKE) -C native test_abi
+
+# C driver -> embedded JAX -> the real chip (run on a TPU host).
+test_native_tpu: native
+	$(MAKE) -C native test_tpu
 
 # Unit/integration suite (CPU, 8 virtual devices — set in tests/conftest.py).
 # Fast default: the heavy tests in conftest.SLOW_TESTS are skipped (<5 min);
@@ -116,6 +120,15 @@ northstar_digits:
 get_mnist:
 	mkdir -p $(DATA_DIR)
 	$(PY) scripts/get_mnist.py $(DATA_DIR)
+
+# Fetch + convert CIFAR-10 (binary batches -> IDX, md5/sha256-checked)
+# and Fashion-MNIST (IDX upstream). Network-gated; the CIFAR converter
+# itself is selftested offline (tests/test_data.py).
+get_cifar10:
+	$(PY) scripts/get_cifar10.py data/cifar10
+
+get_fashion:
+	$(PY) scripts/get_fashion.py data/fashion_mnist
 
 clean:
 	rm -rf __pycache__ */__pycache__ .pytest_cache build dist

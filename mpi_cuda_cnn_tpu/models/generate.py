@@ -13,7 +13,7 @@ the single source of truth for the prompt pass (decode_step is the only
 cached re-implementation, and the teacher-forcing parity test binds it to
 apply()).
 
-MoE blocks use `moe_mlp_inference` (compute-all-experts, top-1 select) in
+MoE blocks use `moe_mlp_inference` (compute-all-experts, top-k select) in
 BOTH prefill and decode: exactly no-drop, O(T*E*H) memory, and token t's
 output depends on token t alone — training's capacity-dropped dispatch
 is a regularizer, not an inference semantic (it would leak other batch
@@ -125,7 +125,7 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
 
             m = moe_mlp_inference(
                 y.reshape(b, model.dim), blk["moe"],
-                n_experts=model.moe_experts,
+                n_experts=model.moe_experts, top_k=model.moe_top_k,
             )
             x = x + m.reshape(b, 1, model.dim)
         else:

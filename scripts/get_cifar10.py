@@ -1,0 +1,155 @@
+"""Fetch CIFAR-10 and convert its binary batches to IDX files.
+
+Twin of the reference's data-fetch harness (its Makefile:24-35 pulls
+MNIST; CIFAR-10 has no IDX distribution at all) — real CIFAR-10 ships as
+a tarball of 6 binary batches, each record 1 label byte + 3072 pixel
+bytes in CHW plane order (cs.toronto.edu/~kriz/cifar.html). This script
+downloads the tarball (md5-verified), converts to the four IDX files the
+CLI contract expects (images as 4-D (N,32,32,3) uint8 IDX — the reader
+supports any ndims), and writes a checksum manifest.
+
+Zero-network environments: `--selftest` synthesizes a tarball in the
+exact CIFAR byte format, runs the same conversion, and verifies the
+round-trip — so the converter itself is CI-testable offline (the fetch
+is the only network-gated step; see PERF.md).
+
+    python scripts/get_cifar10.py data/cifar10
+    python scripts/get_cifar10.py --selftest /tmp/cifar_selftest
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import sys
+import tarfile
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from mpi_cuda_cnn_tpu.data.idx import read_idx, write_idx
+
+URL = "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+MD5 = "c32a1d4ab5d03f1284b67883e8d87530"
+TRAIN_BATCHES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+TEST_BATCH = "test_batch.bin"
+RECORD = 1 + 3072  # label byte + 3 x 32 x 32 pixel planes
+
+
+def parse_batch(raw: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """One CIFAR binary batch -> (images (N,32,32,3) u8, labels (N,) u8)."""
+    if len(raw) % RECORD:
+        raise ValueError(f"batch size {len(raw)} not a multiple of {RECORD}")
+    rec = np.frombuffer(raw, np.uint8).reshape(-1, RECORD)
+    labels = rec[:, 0].copy()
+    # CHW planes (R then G then B, row-major) -> HWC.
+    images = rec[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+    return images, labels
+
+
+def convert(batches: dict[str, bytes], out: Path) -> dict[str, str]:
+    """Named batch payloads -> the four IDX files; returns sha256 manifest."""
+    train = [parse_batch(batches[n]) for n in TRAIN_BATCHES if n in batches]
+    if not train or TEST_BATCH not in batches:
+        missing = [n for n in TRAIN_BATCHES + [TEST_BATCH] if n not in batches]
+        raise ValueError(f"archive is missing batches: {missing}")
+    tx = np.concatenate([t[0] for t in train])
+    ty = np.concatenate([t[1] for t in train])
+    ex, ey = parse_batch(batches[TEST_BATCH])
+    out.mkdir(parents=True, exist_ok=True)
+    files = {
+        "train-images-idx3-ubyte": tx,
+        "train-labels-idx1-ubyte": ty,
+        "t10k-images-idx3-ubyte": ex,
+        "t10k-labels-idx1-ubyte": ey,
+    }
+    manifest = {}
+    for name, arr in files.items():
+        write_idx(out / name, arr)
+        manifest[name] = hashlib.sha256((out / name).read_bytes()).hexdigest()
+    (out / "manifest.json").write_text(json.dumps(manifest, indent=2))
+    return manifest
+
+
+def extract_batches(tar_bytes: bytes) -> dict[str, bytes]:
+    batches = {}
+    with tarfile.open(fileobj=io.BytesIO(tar_bytes), mode="r:*") as tf:
+        for member in tf.getmembers():
+            base = Path(member.name).name
+            if base in TRAIN_BATCHES + [TEST_BATCH]:
+                batches[base] = tf.extractfile(member).read()
+    return batches
+
+
+def fetch(out: Path) -> int:
+    print(f"fetching {URL}", file=sys.stderr)
+    try:
+        data = urllib.request.urlopen(URL, timeout=120).read()
+    except Exception as e:
+        print(
+            f"fetch failed ({e}); this environment has no network egress.\n"
+            "The converter is selftested offline (--selftest); rerun this "
+            "script where the CIFAR mirror is reachable.",
+            file=sys.stderr,
+        )
+        return 1
+    digest = hashlib.md5(data).hexdigest()
+    if digest != MD5:
+        print(f"md5 mismatch: got {digest}, want {MD5}", file=sys.stderr)
+        return 1
+    manifest = convert(extract_batches(data), out)
+    print(json.dumps(manifest, indent=2))
+    return 0
+
+
+def selftest(out: Path) -> int:
+    """Synthesize a CIFAR-format tarball, convert, verify round-trip."""
+    rng = np.random.default_rng(0)
+    payloads = {}
+    want = {}
+    for name in TRAIN_BATCHES + [TEST_BATCH]:
+        n = 20
+        labels = rng.integers(0, 10, n, dtype=np.uint8)
+        images = rng.integers(0, 256, (n, 32, 32, 3), dtype=np.uint8)
+        rec = np.zeros((n, RECORD), np.uint8)
+        rec[:, 0] = labels
+        rec[:, 1:] = images.transpose(0, 3, 1, 2).reshape(n, 3072)
+        payloads[name] = rec.tobytes()
+        want[name] = (images, labels)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, blob in payloads.items():
+            info = tarfile.TarInfo(f"cifar-10-batches-bin/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    convert(extract_batches(buf.getvalue()), out)
+
+    tx = read_idx(out / "train-images-idx3-ubyte")
+    ty = read_idx(out / "train-labels-idx1-ubyte")
+    ex = read_idx(out / "t10k-images-idx3-ubyte")
+    ey = read_idx(out / "t10k-labels-idx1-ubyte")
+    assert tx.shape == (100, 32, 32, 3) and ty.shape == (100,)
+    np.testing.assert_array_equal(
+        tx[:20], want[TRAIN_BATCHES[0]][0]
+    )
+    np.testing.assert_array_equal(ex, want[TEST_BATCH][0])
+    np.testing.assert_array_equal(ey, want[TEST_BATCH][1])
+    print("selftest ok: CIFAR binary -> IDX round-trip exact")
+    return 0
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:]]
+    run_selftest = "--selftest" in args
+    if run_selftest:
+        args.remove("--selftest")
+    out = Path(args[0]) if args else Path("data/cifar10")
+    return selftest(out) if run_selftest else fetch(out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

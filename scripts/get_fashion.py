@@ -1,0 +1,68 @@
+"""Fetch Fashion-MNIST (already IDX; gzip-compressed upstream).
+
+Same four-file contract as get_mnist.py; checksummed. Zero-network
+environments get a clear error (the files are plain IDX — no converter
+to selftest beyond the reader, which tests/test_idx.py covers).
+
+    python scripts/get_fashion.py data/fashion_mnist
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+BASE = "https://fashion-mnist.s3-website.eu-central-1.amazonaws.com/"
+FILES = {
+    "train-images-idx3-ubyte": "8d4fb7e6c68d591d4c3dfef9ec88bf0d",
+    "train-labels-idx1-ubyte": "25c81989df183df01b3e8a0aad5dffbe",
+    "t10k-images-idx3-ubyte": "bef4ecab320f06d8554ea6380940ec79",
+    "t10k-labels-idx1-ubyte": "bb300cfdad3c16e7a12a480ee83cd310",
+}
+
+
+def main(out_dir: str) -> int:
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    manifest = {}
+    old = {}
+    mpath = out / "manifest.json"
+    if mpath.exists():
+        old = json.loads(mpath.read_text())
+    for name, md5 in FILES.items():
+        dest = out / name
+        # A pre-existing file counts only if it matches the recorded
+        # sha256 (a truncated leftover from an interrupted run must not
+        # be accepted just for existing); otherwise re-fetch.
+        if dest.exists() and (
+            hashlib.sha256(dest.read_bytes()).hexdigest() != old.get(name)
+        ):
+            dest.unlink()
+        if not dest.exists():
+            url = BASE + name + ".gz"
+            print(f"fetching {url}", file=sys.stderr)
+            try:
+                gz = urllib.request.urlopen(url, timeout=60).read()
+            except Exception as e:
+                print(
+                    f"fetch failed ({e}); no network egress here — rerun "
+                    "where the Fashion-MNIST mirror is reachable.",
+                    file=sys.stderr,
+                )
+                return 1
+            if hashlib.md5(gz).hexdigest() != md5:
+                print(f"md5 mismatch for {name}.gz", file=sys.stderr)
+                return 1
+            dest.write_bytes(gzip.decompress(gz))
+        manifest[name] = hashlib.sha256(dest.read_bytes()).hexdigest()
+    (out / "manifest.json").write_text(json.dumps(manifest, indent=2))
+    print(json.dumps(manifest, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "data/fashion_mnist"))

@@ -80,3 +80,25 @@ def test_epoch_batches_sequential_without_rng():
     x = np.arange(8).reshape(8, 1)
     batches = list(epoch_batches(x, x, 4, rng=None))
     np.testing.assert_array_equal(batches[0][0][:, 0], [0, 1, 2, 3])
+
+
+def test_cifar10_converter_selftest(tmp_path):
+    """scripts/get_cifar10.py --selftest: CIFAR binary-batch -> IDX
+    conversion is exact, and the output feeds the dataset registry
+    (the fetch itself is network-gated; the converter is not)."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    script = _Path(__file__).resolve().parents[1] / "scripts" / "get_cifar10.py"
+    out = tmp_path / "cifar"
+    res = subprocess.run(
+        [_sys.executable, str(script), "--selftest", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr
+    from mpi_cuda_cnn_tpu.data.datasets import get_dataset
+
+    ds = get_dataset("cifar10", data_dir=str(out))
+    assert ds.input_shape == (32, 32, 3)
+    assert len(ds.train_images) == 100 and len(ds.test_images) == 20

@@ -132,3 +132,24 @@ def test_generate_moe_model_runs():
     out = generate(model, params, prompt, 4)
     assert out.shape == (2, 4)
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 13))
+
+
+def test_decode_matches_inference_forward_moe_top2():
+    """Top-2 MoE decode parity: the KV-cache path must route with the
+    model's moe_top_k, not silently fall back to top-1."""
+    model = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=32,
+                          moe_experts=4, moe_top_k=2)
+    params = model.init(jax.random.key(1))
+    toks = jnp.asarray(
+        np.random.default_rng(2).integers(0, 13, (2, 10)), jnp.int32
+    )
+    want = model.apply(params, toks, moe_inference=True)
+
+    cache = init_cache(model, 2)
+    got = []
+    for i in range(10):
+        logits, cache = decode_step(model, params, toks[:, i], i, cache)
+        got.append(logits)
+    got = jnp.stack(got, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
