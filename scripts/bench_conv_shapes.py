@@ -1,0 +1,78 @@
+"""Per-op conv benchmark: XLA emitter vs the Pallas direct kernels.
+
+Produces the per-shape table in PERF.md ("Pallas conv/dense kernels:
+per-shape analysis"). Device time = lax.scan of `--iters` calls inside
+one jit with a perturbed carry (defeats CSE) and a summed output fetched
+to host (forces completion through the tunnel; block_until_ready alone
+returns at enqueue here — utils/sync.py). The fixed tunnel round-trip
+(~110 ms) amortizes across iterations; 200 is enough to make it noise.
+
+    python scripts/bench_conv_shapes.py [--iters 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_cnn_tpu.ops.conv import conv2d
+from mpi_cuda_cnn_tpu.ops.pallas_ops import conv2d_pallas
+
+# The round-1 verdict's question shapes: cifar3conv/vgg_small layers +
+# the reference's own conv1.
+SHAPES = [
+    (128, 32, 32, 3, 3, 64, 1, 1),
+    (128, 32, 32, 64, 3, 64, 1, 1),
+    (128, 16, 16, 64, 3, 128, 1, 1),
+    (128, 8, 8, 128, 3, 256, 1, 1),
+    (32, 28, 28, 1, 3, 16, 2, 1),
+]
+
+
+def dev_time(fn, x, w, iters):
+    @jax.jit
+    def run(x0, wt):
+        def body(c, _):
+            y = fn(c, wt)
+            return c + 1e-6, jnp.sum(y.astype(jnp.float32))
+
+        _, ys = jax.lax.scan(body, x0, None, length=iters)
+        return jnp.sum(ys)
+
+    float(run(x, w))  # compile + warm
+    t0 = time.perf_counter()
+    float(run(x, w))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=200)
+    args = ap.parse_args()
+    rng = np.random.default_rng(0)
+    for dt_name, cast in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        for (n, h, w, ci, k, co, s, p) in SHAPES:
+            x = jnp.asarray(rng.standard_normal((n, h, w, ci)), cast)
+            wt = jnp.asarray(rng.standard_normal((k, k, ci, co)), cast)
+            t_xla = dev_time(partial(conv2d, stride=s, padding=p), x, wt,
+                             args.iters)
+            t_pl = dev_time(partial(conv2d_pallas, stride=s, padding=p), x,
+                            wt, args.iters)
+            print(
+                f"{dt_name} {n}x{h}x{w}x{ci} k{k} -> {co} s{s}: "
+                f"xla {t_xla:7.3f} ms  pallas {t_pl:7.3f} ms  "
+                f"ratio {t_pl / t_xla:5.2f}"
+            )
+
+
+if __name__ == "__main__":
+    main()
