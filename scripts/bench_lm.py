@@ -61,15 +61,24 @@ def bench_config(model, *, batch, seq, compute_dtype, attn_impl,
     # "1.2 ms" step that really takes 300 ms), while a device->host
     # transfer cannot complete before the value exists. The fetched loss
     # depends on the whole step chain, so one fetch drains it all.
+    def run(state, n):
+        t0 = time.perf_counter()
+        m = None
+        for _ in range(n):
+            state, m = step_fn(state, tokens, targets)
+        loss = float(m["loss"])
+        return state, time.perf_counter() - t0, loss
+
     for _ in range(warmup):
         state, m = step_fn(state, tokens, targets)
     float(m["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step_fn(state, tokens, targets)
-    loss = float(m["loss"])
-    dt = (time.perf_counter() - t0) / steps
+    # Two-point timing: the tunnel adds a fixed ~100 ms round-trip per
+    # timed window; (T2N - TN)/N cancels it instead of smearing it
+    # across the steps (~5 ms/step at N=20 — enough to bias ratios).
+    state, t1, _ = run(state, steps)
+    state, t2, loss = run(state, 2 * steps)
+    dt = (t2 - t1) / steps
     return dt, loss
 
 

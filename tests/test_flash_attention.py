@@ -73,6 +73,35 @@ def test_flash_gradients_multiblock(monkeypatch, causal):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_gradients_multiblock(monkeypatch, causal):
+    """bf16 reads its OWN block caps (_blocks dtype dispatch): force
+    several blocks through the bf16 kernels so the multi-block carry/
+    skip/index paths of the production LM configuration are exercised,
+    not just the single-block small-s cases."""
+    import mpi_cuda_cnn_tpu.ops.pallas_attention as fa
+
+    monkeypatch.setattr(fa, "BLK_Q_BF16", 128)
+    monkeypatch.setattr(fa, "BLK_K_BF16", 128)
+    q, k, v = _qkv(1, 512, 2, 64, seed=5)
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            fa.flash_attention(q, k, v, causal).astype(jnp.float32) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(qb, kb, vb)
+    go = jax.grad(
+        lambda q, k, v: jnp.sum(attention(q, k, v, causal=causal) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_ in zip(gf, go):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_), rtol=8e-2, atol=8e-2
+        )
+
+
 def test_pick_block():
     assert _pick_block(8192, 512) == 512
     assert _pick_block(256, 512) == 256
