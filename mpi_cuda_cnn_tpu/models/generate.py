@@ -31,13 +31,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import NEG_INF, attention
+from ..ops.attention import NEG_INF, attention, rope
 from .transformer import TransformerLM, _layernorm
 
 
 def init_cache(model: TransformerLM, batch: int) -> list[dict]:
-    """Empty per-block KV buffers, static (B, max_seq, H, head_dim)."""
-    shape = (batch, model.max_seq, model.heads, model.head_dim)
+    """Empty per-block KV buffers, static (B, max_seq, Hkv, head_dim) —
+    under GQA the cache shrinks by heads/kv_heads (the reason serving
+    stacks use GQA: cache bytes bound decode batch size)."""
+    shape = (batch, model.max_seq, model.n_kv, model.head_dim)
     return [
         {"k": jnp.zeros(shape, jnp.float32), "v": jnp.zeros(shape, jnp.float32)}
         for _ in range(model.depth)
@@ -53,7 +55,7 @@ def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
     b, s0 = prompt.shape
     if s0 > model.max_seq:
         raise ValueError(f"prompt length {s0} exceeds max_seq {model.max_seq}")
-    full = (b, model.max_seq, model.heads, model.head_dim)
+    full = (b, model.max_seq, model.n_kv, model.head_dim)
     cache: list[dict] = []
 
     def capture_attn(q, k, v):
@@ -76,20 +78,25 @@ def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
 
 
 def _attend_cached(q, ck, cv, pos):
-    """q: (B, 1, H, D) at position `pos`; ck/cv: (B, max_seq, H, D) with
-    positions > pos unwritten. Masked softmax over the valid prefix."""
-    d = q.shape[-1]
+    """q: (B, 1, H, D) at position `pos`; ck/cv: (B, max_seq, Hkv, D)
+    with positions > pos unwritten (Hkv <= H: GQA). Masked softmax over
+    the valid prefix."""
+    b, one, h, d = q.shape
+    hkv = ck.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, one, hkv, g, d)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
-    ) * scale                                       # (B, H, 1, max_seq)
+        "bqhgd,bkhd->bhgqk", qg, ck, preferred_element_type=jnp.float32
+    ) * scale                                       # (B, Hkv, g, 1, max_seq)
     valid = jnp.arange(ck.shape[1]) <= pos          # (max_seq,)
-    logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+    logits = jnp.where(valid[None, None, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum(
-        "bhqk,bkhd->bqhd", probs.astype(cv.dtype), cv,
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,
         preferred_element_type=jnp.float32,
-    ).astype(q.dtype)
+    )
+    return out.reshape(b, one, h, d).astype(q.dtype)
 
 
 def decode_step(model: TransformerLM, params, tok, pos, cache):
@@ -103,17 +110,29 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
     if isinstance(pos, int) and pos >= model.max_seq:
         raise ValueError(f"position {pos} out of range (max_seq {model.max_seq})")
     b = tok.shape[0]
-    h, hd = model.heads, model.head_dim
-    x = params["tok_emb"][tok] + params["pos_emb"][pos]   # (B, dim)
+    h, hd, hkv = model.heads, model.head_dim, model.n_kv
+    x = params["tok_emb"][tok]                            # (B, dim)
+    if model.pos == "learned":
+        x = x + params["pos_emb"][pos]
     x = x[:, None, :]                                     # (B, 1, dim)
     new_cache = []
     for blk, c in zip(params["blocks"], cache):
         y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-        qkv = y @ blk["wqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if hkv == h:
+            qkv = y @ blk["wqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = y @ blk["wq"]
+            k, v = jnp.split(y @ blk["wkv"], 2, axis=-1)
         q = q.reshape(b, 1, h, hd)
-        k = k.reshape(b, 1, h, hd)
-        v = v.reshape(b, 1, h, hd)
+        k = k.reshape(b, 1, hkv, hd)
+        v = v.reshape(b, 1, hkv, hd)
+        if model.pos == "rope":
+            # One-position rotation: positions arg is the (1,)-vector
+            # [pos] (traced scalars broadcast fine).
+            p1 = jnp.reshape(pos, (1,))
+            q = rope(q, p1)
+            k = rope(k, p1)
         ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype), (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype), (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})

@@ -29,7 +29,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import attention
+from ..ops.attention import attention, rope
 
 
 def _layernorm(x, g, b, eps=1e-5):
@@ -55,6 +55,12 @@ class TransformerLM:
     heads: int = 4
     depth: int = 2
     max_seq: int = 256
+    kv_heads: int = 0      # 0 = heads (MHA); < heads = grouped-query
+                           # attention (1 = MQA): k/v projections and the
+                           # KV cache shrink by heads/kv_heads
+    pos: str = "learned"   # learned | rope (rotary, ops/attention.rope —
+                           # no position table, exact under SP shards via
+                           # explicit absolute positions)
     moe_experts: int = 0   # 0 = dense MLP; >0 = Switch-MoE MLP per block
                            # (parallel/ep.py), EP-shardable over a mesh axis
     moe_top_k: int = 1     # experts per token: 1 = Switch, 2 = GShard-style
@@ -66,8 +72,24 @@ class TransformerLM:
             raise ValueError(f"dim {self.dim} not divisible by heads {self.heads}")
         return self.dim // self.heads
 
+    @property
+    def n_kv(self) -> int:
+        hkv = self.kv_heads or self.heads
+        if hkv <= 0 or self.heads % hkv:
+            # <= 0 must be caught explicitly: heads % -1 == 0 in Python,
+            # and a negative count would flow into param shapes.
+            raise ValueError(
+                f"kv_heads must be a positive divisor of heads "
+                f"{self.heads}; got {hkv}"
+            )
+        return hkv
+
     def init(self, key) -> dict:
         d, v, hd = self.dim, self.vocab, self.head_dim
+        # Key budget is fixed regardless of config so the default
+        # (learned-pos MHA) consumes keys exactly as in round 1 — golden
+        # params stay reproducible; GQA draws one extra subkey from the
+        # block key instead of shifting the stream.
         keys = iter(jax.random.split(key, 3 + 4 * self.depth))
         scale = 1.0 / math.sqrt(d)
 
@@ -76,18 +98,30 @@ class TransformerLM:
 
         params = {
             "tok_emb": jax.random.normal(next(keys), (v, d), jnp.float32) * scale,
-            "pos_emb": jax.random.normal(next(keys), (self.max_seq, d), jnp.float32) * scale,
             "ln_f": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
-            "head": dense(next(keys), d, v),
             "blocks": [],
         }
+        pos_key = next(keys)  # drawn even for rope: keeps the stream fixed
+        if self.pos == "learned":
+            params["pos_emb"] = jax.random.normal(
+                pos_key, (self.max_seq, d), jnp.float32
+            ) * scale
+        elif self.pos != "rope":
+            raise ValueError(f"unknown pos {self.pos!r}; 'learned' or 'rope'")
+        params["head"] = dense(next(keys), d, v)
         for _ in range(self.depth):
             blk = {
                 "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
                 "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
-                "wqkv": dense(next(keys), d, 3 * d),
-                "wo": dense(next(keys), d, d),
             }
+            qkv_key = next(keys)
+            if self.n_kv == self.heads:
+                blk["wqkv"] = dense(qkv_key, d, 3 * d)
+            else:
+                kq, kkv = jax.random.split(qkv_key)
+                blk["wq"] = dense(kq, d, d)
+                blk["wkv"] = dense(kkv, d, 2 * self.n_kv * hd)
+            blk["wo"] = dense(next(keys), d, d)
             if self.moe_experts:
                 from ..parallel.ep import init_moe_params
 
@@ -131,17 +165,29 @@ class TransformerLM:
             # check the GLOBAL length — see make_sp_lm_train_step.)
             raise ValueError(f"sequence length {s} exceeds max_seq {self.max_seq}")
         attn = attn_fn or (lambda q, k, v: attention(q, k, v, causal=causal))
+        hkv = self.n_kv
 
         pos = pos_offset + jnp.arange(s)
-        x = w(params["tok_emb"][tokens] + params["pos_emb"][pos][None, :, :])
+        x = params["tok_emb"][tokens]
+        if self.pos == "learned":
+            x = x + params["pos_emb"][pos][None, :, :]
+        x = w(x)
 
         def block(blk, x):
             y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-            qkv = y @ w(blk["wqkv"])                    # (B, S, 3*dim)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            if hkv == self.heads:
+                qkv = y @ w(blk["wqkv"])                # (B, S, 3*dim)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+            else:
+                q = y @ w(blk["wq"])                    # (B, S, dim)
+                kv = y @ w(blk["wkv"])                  # (B, S, 2*hkv*hd)
+                k, v = jnp.split(kv, 2, axis=-1)
             q = q.reshape(b, s, h, hd)
-            k = k.reshape(b, s, h, hd)
-            v = v.reshape(b, s, h, hd)
+            k = k.reshape(b, s, hkv, hd)
+            v = v.reshape(b, s, hkv, hd)
+            if self.pos == "rope":
+                q = rope(q, pos)
+                k = rope(k, pos)
             o = attn(q, k, v).reshape(b, s, h * hd)
             x = x + (o.astype(x.dtype) @ w(blk["wo"]))
             y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])

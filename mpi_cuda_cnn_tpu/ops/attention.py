@@ -27,22 +27,55 @@ NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
 def attention(q, k, v, *, causal: bool = False):
     """Full (quadratic) scaled dot-product attention — the oracle.
 
-    q, k, v: (B, S, H, D). Returns (B, S, H, D), f32 accumulation.
+    q: (B, S, H, D); k/v: (B, S, Hkv, D) with H % Hkv == 0 — Hkv < H is
+    grouped-query attention (each kv head serves H/Hkv query heads;
+    Hkv == 1 is MQA). Returns (B, S, H, D), f32 accumulation.
     """
     b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    g = h // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
         qi = jnp.arange(sq)[:, None]
         ki = jnp.arange(k.shape[1])[None, :]
         logits = jnp.where(ki <= qi, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum(
-        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
-    ).astype(q.dtype)
+    )
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def rope(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding (rotate-half form) for x: (B, S, H, D).
+
+    positions: (S,) absolute token positions — explicit, so sequence
+    shards under SP pass their true global positions (pos_offset +
+    arange, exactly like the learned table). Angles are computed in f32
+    regardless of x.dtype (bf16 loses position precision past ~256);
+    output returns in x.dtype. D must be even.
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope needs an even head dim, got {d}")
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)  # (half,)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]       # (1, S, 1, half)
+    sin = jnp.sin(angles)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
 
 
 def _block_logits(q, k, scale):

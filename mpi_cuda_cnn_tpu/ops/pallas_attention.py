@@ -169,12 +169,33 @@ def _from_rows(t, b, h, s, d):
     return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+def _kv_row_map(h: int, hkv: int, block_axis: int = 2):
+    """Grid row (b*H + qhead) -> k/v row (b*Hkv + qhead // group): the
+    zero-copy GQA mapping — Hkv < H kv heads serve H query heads straight
+    from their (b*Hkv, S, D) buffers, no repeat materialization.
+    block_axis picks which grid coordinate walks the sequence blocks
+    (2 = innermost j, the forward/dq layout; 1 = i, the dkv layout)."""
+    g = h // hkv
+
+    def index_map(bh, i, j):
+        blk = j if block_axis == 2 else i
+        return (bh // h) * hkv + (bh % h) // g, blk, 0
+
+    return index_map
+
+
 def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
                    out_f32: bool = False):
     """out_f32 keeps the f32 kernel output uncast — for callers (the
     ring-flash fold) that merge partials in f32; casting each per-hop
-    partial to a bf16 input dtype would accumulate truncation error."""
+    partial to a bf16 input dtype would accumulate truncation error.
+
+    GQA: k/v may carry Hkv < H heads (H % Hkv == 0); the kernel reads
+    each kv head for its query-head group via the block index map."""
     b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     if s % 128:
         raise ValueError(f"seq len {s} must be a multiple of 128")
     orig_dtype = q.dtype
@@ -186,7 +207,10 @@ def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
     # anything else computes in f32 at HIGHEST precision (the original
     # accuracy contract: ~1e-6 of a float64 reference).
     kdt = jnp.bfloat16 if orig_dtype == jnp.bfloat16 else jnp.float32
-    qr, kr, vr = (_to_rows(t.astype(kdt), b, h, s, d) for t in (q, k, v))
+    qr = _to_rows(q.astype(kdt), b, h, s, d)
+    kr = _to_rows(k.astype(kdt), b, hkv, s, d)
+    vr = _to_rows(v.astype(kdt), b, hkv, s, d)
+    kv_map = _kv_row_map(h, hkv)
 
     nk = s // blk_k
     kernel = functools.partial(
@@ -198,10 +222,8 @@ def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
         in_specs=[
             pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, j, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, d), kv_map, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
@@ -325,6 +347,7 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
     rounding each per-hop partial to a bf16 input dtype first would
     collect p truncation errors instead of one."""
     b, s, h, d = q.shape
+    hkv = k.shape[2]
     bq, bk = _blocks(q.dtype)
     blk_q = _pick_block(s, bq)
     blk_k = _pick_block(s, bk)
@@ -332,9 +355,11 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
     # Same dtype policy as the forward: bf16 operands stay bf16 into the
     # kernels (native MXU path), everything else f32 at HIGHEST.
     kdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
-    qr, kr, vr, orr, gr = (
-        _to_rows(t.astype(kdt), b, h, s, d) for t in (q, k, v, o, g)
+    qr, orr, gr = (
+        _to_rows(t.astype(kdt), b, h, s, d) for t in (q, o, g)
     )
+    kr = _to_rows(k.astype(kdt), b, hkv, s, d)
+    vr = _to_rows(v.astype(kdt), b, hkv, s, d)
     # D_i = rowsum(dO_i * O_i) — elementwise, O(S*D), always f32.
     dvec = jnp.sum(
         gr.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1
@@ -355,7 +380,7 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
                           memory_space=pltpu.VMEM)
     col_spec = pl.BlockSpec((1, blk_q, 8), lambda bh, i, j: (bh, i, 0),
                             memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, j, 0),
+    k_spec = pl.BlockSpec((1, blk_k, d), _kv_row_map(h, hkv, 2),
                           memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -369,9 +394,14 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
         interpret=_interpret(),
     )(qr, kr, vr, gr, lse_col, dvec_col)
 
-    # dk/dv: k-rows outer, q-blocks streamed innermost.
-    kq_spec = pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, i, 0),
-                           memory_space=pltpu.VMEM)
+    # dk/dv: k-rows outer, q-blocks streamed innermost. The grid stays
+    # per QUERY head; under GQA each kv head's gradient is produced as
+    # H/Hkv per-qhead partial rows (racing writes to one shared kv row
+    # are not expressible) and group-summed after the kernel.
+    kq_in_spec = pl.BlockSpec((1, blk_k, d), _kv_row_map(h, hkv, 1),
+                              memory_space=pltpu.VMEM)
+    kq_out_spec = pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, i, 0),
+                               memory_space=pltpu.VMEM)
     qs_spec = pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, j, 0),
                            memory_space=pltpu.VMEM)
     rows_spec = pl.BlockSpec((1, 8, blk_q), lambda bh, i, j: (bh, 0, j),
@@ -380,8 +410,9 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
         functools.partial(_bwd_dkv_kernel, causal=causal, nq=s // blk_q,
                           scale=scale),
         grid=(b * h, s // blk_k, s // blk_q),
-        in_specs=[qs_spec, kq_spec, kq_spec, qs_spec, rows_spec, rows_spec],
-        out_specs=[kq_spec, kq_spec],
+        in_specs=[qs_spec, kq_in_spec, kq_in_spec, qs_spec, rows_spec,
+                  rows_spec],
+        out_specs=[kq_out_spec, kq_out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
             jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
@@ -393,17 +424,35 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
         interpret=_interpret(),
     )(qr, kr, vr, gr, lse_row, dvec_row)
 
+    dq = _from_rows(dq, b, h, s, d)
+    if hkv == h:
+        dk = _from_rows(dk, b, h, s, d)
+        dv = _from_rows(dv, b, h, s, d)
+    else:
+        # Sum the per-qhead partials within each kv group: rows are
+        # ordered b*H with H = Hkv * group, group-major within a batch.
+        g_ = h // hkv
+        dk = _from_rows(
+            dk.reshape(b, hkv, g_, s, d).sum(axis=2).reshape(b * hkv, s, d),
+            b, hkv, s, d,
+        )
+        dv = _from_rows(
+            dv.reshape(b, hkv, g_, s, d).sum(axis=2).reshape(b * hkv, s, d),
+            b, hkv, s, d,
+        )
     return tuple(
-        _from_rows(t, b, h, s, d).astype(jnp.float32 if grads_f32 else ref.dtype)
+        t.astype(jnp.float32 if grads_f32 else ref.dtype)
         for t, ref in ((dq, q), (dk, k), (dv, v))
     )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal: bool = False):
-    """Fused scaled-dot-product attention. q/k/v: (B, S, H, D), S a
-    multiple of 128. Exact (online softmax), causal optional. Both the
-    forward and backward are fused Pallas kernels with O(block) memory."""
+    """Fused scaled-dot-product attention. q: (B, S, H, D); k/v:
+    (B, S, Hkv, D) with H % Hkv == 0 (Hkv < H = grouped-query attention,
+    served zero-copy via the kernel's block index maps). S a multiple of
+    128. Exact (online softmax), causal optional. Both the forward and
+    backward are fused Pallas kernels with O(block) memory."""
     return _flash_forward(q, k, v, causal)
 
 

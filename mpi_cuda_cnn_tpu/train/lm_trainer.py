@@ -88,7 +88,7 @@ class LMTrainer:
         self.model = TransformerLM(
             vocab=vocab, dim=cfg.dim, heads=cfg.heads, depth=cfg.depth,
             max_seq=cfg.seq_len, moe_experts=cfg.moe_experts,
-            moe_top_k=cfg.moe_top_k,
+            moe_top_k=cfg.moe_top_k, kv_heads=cfg.kv_heads, pos=cfg.pos,
         )
 
         ndev = cfg.num_devices or len(jax.devices())

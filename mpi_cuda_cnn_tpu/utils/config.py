@@ -96,6 +96,9 @@ class LMConfig:
     dim: int = 256
     depth: int = 4
     heads: int = 8
+    kv_heads: int = 0             # 0 = heads (MHA); < heads = GQA (1=MQA):
+                                  # kv projections + decode cache shrink
+    pos: str = "learned"          # learned | rope
     seq_len: int = 256
     moe_experts: int = 0          # >0: Switch-MoE MLP per block (EP over
                                   # the 'seq' axis when one exists)

@@ -64,6 +64,18 @@ SLOW_TESTS = {
     "test_train.py::test_pp_trainer_end_to_end",
     "test_train.py::test_pp_trainer_matches_dp",
     "test_train.py::test_scan_matches_per_batch_loop",
+    "test_gqa_rope.py::test_gqa_rope_under_ring_flash_sp",
+    "test_gqa_rope.py::test_gqa_rope_under_ring_sp",
+    "test_gqa_rope.py::test_lm_variants_train_and_decode[2-learned]",
+    "test_lm.py::test_flash_impl_matches_oracle_in_step",
+    "test_lm.py::test_train_step_learns_cyclic_task",
+    "test_lm_trainer.py::test_checkpoint_resume_continues_at_step",
+    "test_lm_trainer.py::test_data_seq_mesh_with_moe",
+    "test_lm_trainer.py::test_sp_mesh_learns_synthetic_cycle",
+    "test_step_resume.py::test_mid_epoch_resume_is_bitwise_exact[True]",
+    "test_tp_pp.py::test_tp_pp_replicated_upstream_layers_match_serial",
+    "test_tp_pp.py::test_tp_pp_step_matches_serial[mesh_axes0-2]",
+    "test_tp_pp.py::test_trainer_accepts_tp_pp_mesh",
     "test_transformer.py::test_moe_lm_trains_under_ring_sp",
     "test_transformer.py::test_sp_dp_mesh_composes",
     "test_transformer.py::test_sp_step_parity_ring_flash",
