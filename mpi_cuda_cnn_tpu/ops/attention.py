@@ -53,6 +53,19 @@ def attention(q, k, v, *, causal: bool = False):
     return out.reshape(b, sq, h, d).astype(q.dtype)
 
 
+def repeat_kv(kv, n_heads: int):
+    """Expand (B, S, Hkv, D) k/v to the full H query heads by repeating
+    each kv head over its group — THE one definition of the grouping
+    convention (query head qh reads kv head qh // (H/Hkv); group-major,
+    matching the oracle's reshape and the flash kernels' index maps)."""
+    hkv = kv.shape[2]
+    if n_heads == hkv:
+        return kv
+    if n_heads % hkv:
+        raise ValueError(f"heads {n_heads} not a multiple of kv heads {hkv}")
+    return jnp.repeat(kv, n_heads // hkv, axis=2)
+
+
 def rope(x, positions, *, base: float = 10000.0):
     """Rotary position embedding (rotate-half form) for x: (B, S, H, D).
 

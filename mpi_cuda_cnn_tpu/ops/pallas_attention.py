@@ -12,6 +12,10 @@ Layout: q/k/v arrive (B, S, H, D) (the framework's SP-friendly layout),
 kernel works on (B*H, S, D) over a (batch*head, q-block, k-block) grid —
 the k-block axis is innermost/sequential and the carry persists in VMEM
 scratch, so VMEM stays O(BLK) regardless of S (32k+ context on one chip).
+GQA (k/v with Hkv < H heads) switches to a 5-D (b, hkv, group, q-block,
+k-block) grid whose index maps are pure mul/add — each kv head serves
+its query group zero-copy, and no map ever needs div/mod on a grid
+coordinate.
 Compute is (BLK_Q, D) @ (D, BLK_K) MXU contractions with f32 accumulators.
 Dtype policy: f32 inputs run at HIGHEST precision (~1e-6 vs a float64
 reference — the default-precision XLA oracle sits at ~1e-2); bf16 inputs
@@ -79,7 +83,7 @@ def _dot(a, b, dims, hi: bool):
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, causal, nk, scale
+    *, causal, nk, scale, pid=(1, 2)
 ):
     """One (batch*head, q-block, k-block) grid step.
 
@@ -89,8 +93,8 @@ def _flash_kernel(
     tile, write the normalized output at kj == nk - 1. K/V blocks are
     (BLK_K, D) — VMEM stays O(BLK) regardless of S.
     """
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
+    qi = pl.program_id(pid[0])
+    kj = pl.program_id(pid[1])
     q = q_ref[0]                                   # (BLK_Q, D)
     blk_q, d = q.shape
     blk_k = k_ref.shape[1]
@@ -169,19 +173,31 @@ def _from_rows(t, b, h, s, d):
     return t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _kv_row_map(h: int, hkv: int, block_axis: int = 2):
-    """Grid row (b*H + qhead) -> k/v row (b*Hkv + qhead // group): the
-    zero-copy GQA mapping — Hkv < H kv heads serve H query heads straight
-    from their (b*Hkv, S, D) buffers, no repeat materialization.
-    block_axis picks which grid coordinate walks the sequence blocks
-    (2 = innermost j, the forward/dq layout; 1 = i, the dkv layout)."""
+def _gqa_maps(h: int, hkv: int):
+    """Index maps for the GQA 5-D grid (b, hkv, g, blkA, blkB): query
+    rows live at b*H + kvh*g + gi, kv rows at b*Hkv + kvh — all mul/add
+    (a fused (b*H,) grid would need div/mod in the maps, which Mosaic
+    compiles pathologically slowly at large grids: measured minutes-long
+    hangs at s >= 8192). blkA/blkB pick their grid coordinate per kernel
+    via the returned lambdas' last two axes."""
     g = h // hkv
 
-    def index_map(bh, i, j):
-        blk = j if block_axis == 2 else i
-        return (bh // h) * hkv + (bh % h) // g, blk, 0
+    def q_rows(axis):  # row from (b, kvh, gi); seq block from grid[axis]
+        def index_map(b, kvh, gi, i, j):
+            return b * h + kvh * g + gi, (i if axis == 3 else j), 0
+        return index_map
 
-    return index_map
+    def kv_rows(axis):
+        def index_map(b, kvh, gi, i, j):
+            return b * hkv + kvh, (i if axis == 3 else j), 0
+        return index_map
+
+    def lse_rows(axis):  # (rows, 8, s) layout: block index in slot 2
+        def index_map(b, kvh, gi, i, j):
+            return b * h + kvh * g + gi, 0, (i if axis == 3 else j)
+        return index_map
+
+    return q_rows, kv_rows, lse_rows
 
 
 def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
@@ -210,26 +226,37 @@ def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
     qr = _to_rows(q.astype(kdt), b, h, s, d)
     kr = _to_rows(k.astype(kdt), b, hkv, s, d)
     vr = _to_rows(v.astype(kdt), b, hkv, s, d)
-    kv_map = _kv_row_map(h, hkv)
 
     nk = s // blk_k
+    if hkv == h:
+        grid = (b * h, s // blk_q, nk)
+        pid = (1, 2)
+        q_map = lambda bh, i, j: (bh, i, 0)
+        kvm = lambda bh, i, j: (bh, j, 0)
+        lse_map = lambda bh, i, j: (bh, 0, i)
+    else:
+        g_ = h // hkv
+        grid = (b, hkv, g_, s // blk_q, nk)
+        pid = (3, 4)
+        q_rows, kv_rows, lse_rows = _gqa_maps(h, hkv)
+        q_map = q_rows(3)
+        kvm = kv_rows(4)
+        lse_map = lse_rows(3)
     kernel = functools.partial(
-        _flash_kernel, causal=causal, nk=nk, scale=1.0 / (d ** 0.5)
+        _flash_kernel, causal=causal, nk=nk, scale=1.0 / (d ** 0.5),
+        pid=pid,
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, s // blk_q, nk),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d), kv_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk_k, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_q, d), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, d), kvm, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, d), kvm, memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 8, blk_q), lambda bh, i, j: (bh, 0, i),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_q, d), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, blk_q), lse_map, memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
@@ -259,10 +286,10 @@ def _flash_forward(q, k, v, causal: bool, *, with_lse: bool = False,
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref, acc_ref,
-    *, causal, nk, scale
+    *, causal, nk, scale, pid=(1, 2)
 ):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
+    qi = pl.program_id(pid[0])
+    kj = pl.program_id(pid[1])
     q = q_ref[0]
     blk_q, d = q.shape
     blk_k = k_ref.shape[1]
@@ -299,10 +326,10 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, causal, nq, scale
+    dk_acc, dv_acc, *, causal, nq, scale, pid=(1, 2)
 ):
-    ki = pl.program_id(1)
-    qj = pl.program_id(2)
+    ki = pl.program_id(pid[0])
+    qj = pl.program_id(pid[1])
     k = k_ref[0]
     blk_k, d = k.shape
     blk_q = q_ref.shape[1]
@@ -376,17 +403,38 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
     lse_row = jnp.broadcast_to(lse[:, None, :], (b * h, 8, s))
     dvec_row = jnp.broadcast_to(dvec[:, None, :], (b * h, 8, s))
 
-    q_spec = pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, i, 0),
-                          memory_space=pltpu.VMEM)
-    col_spec = pl.BlockSpec((1, blk_q, 8), lambda bh, i, j: (bh, i, 0),
-                            memory_space=pltpu.VMEM)
-    k_spec = pl.BlockSpec((1, blk_k, d), _kv_row_map(h, hkv, 2),
-                          memory_space=pltpu.VMEM)
+    # Grid layout mirrors the forward: 3-D per-(b*h) for MHA; a 5-D
+    # (b, hkv, g, blkA, blkB) grid for GQA so every index map stays
+    # mul/add (div/mod in maps stalls Mosaic's compile at large grids).
+    if hkv == h:
+        dq_grid = (b * h, s // blk_q, s // blk_k)
+        kv_grid = (b * h, s // blk_k, s // blk_q)
+        pid = (1, 2)
+        q_map = lambda bh, i, j: (bh, i, 0)
+        q_stream_map = lambda bh, i, j: (bh, j, 0)
+        kv_map = q_stream_map
+        kv_row_map = q_map
+        rows_map = lambda bh, i, j: (bh, 0, j)
+    else:
+        g_ = h // hkv
+        dq_grid = (b, hkv, g_, s // blk_q, s // blk_k)
+        kv_grid = (b, hkv, g_, s // blk_k, s // blk_q)
+        pid = (3, 4)
+        q_rows, kv_rows, lse_rows = _gqa_maps(h, hkv)
+        q_map = q_rows(3)         # q/dq rows, block from grid[3]
+        q_stream_map = q_rows(4)  # q/do streamed on grid[4] (dkv kernel)
+        kv_map = kv_rows(4)       # k/v streamed on grid[4] (dq kernel)
+        kv_row_map = kv_rows(3)   # k/v rows on grid[3] (dkv kernel)
+        rows_map = lse_rows(4)
+
+    q_spec = pl.BlockSpec((1, blk_q, d), q_map, memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((1, blk_q, 8), q_map, memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, blk_k, d), kv_map, memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, nk=s // blk_k,
-                          scale=scale),
-        grid=(b * h, s // blk_q, s // blk_k),
+                          scale=scale, pid=pid),
+        grid=dq_grid,
         in_specs=[q_spec, k_spec, k_spec, q_spec, col_spec, col_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
@@ -397,19 +445,22 @@ def _flash_backward(q, k, v, o, lse, g, causal: bool, *, grads_f32: bool = False
     # dk/dv: k-rows outer, q-blocks streamed innermost. The grid stays
     # per QUERY head; under GQA each kv head's gradient is produced as
     # H/Hkv per-qhead partial rows (racing writes to one shared kv row
-    # are not expressible) and group-summed after the kernel.
-    kq_in_spec = pl.BlockSpec((1, blk_k, d), _kv_row_map(h, hkv, 1),
+    # are not expressible) and group-summed after the kernel — the
+    # OUTPUT rows therefore index by query head in both layouts.
+    kq_in_spec = pl.BlockSpec((1, blk_k, d), kv_row_map,
                               memory_space=pltpu.VMEM)
-    kq_out_spec = pl.BlockSpec((1, blk_k, d), lambda bh, i, j: (bh, i, 0),
+    # Output rows index by QUERY head with the block on grid[3] — which
+    # is exactly q_map in both layouts (MHA: q rows == kv rows).
+    kq_out_spec = pl.BlockSpec((1, blk_k, d), q_map,
                                memory_space=pltpu.VMEM)
-    qs_spec = pl.BlockSpec((1, blk_q, d), lambda bh, i, j: (bh, j, 0),
+    qs_spec = pl.BlockSpec((1, blk_q, d), q_stream_map,
                            memory_space=pltpu.VMEM)
-    rows_spec = pl.BlockSpec((1, 8, blk_q), lambda bh, i, j: (bh, 0, j),
+    rows_spec = pl.BlockSpec((1, 8, blk_q), rows_map,
                              memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, nq=s // blk_q,
-                          scale=scale),
-        grid=(b * h, s // blk_k, s // blk_q),
+                          scale=scale, pid=pid),
+        grid=kv_grid,
         in_specs=[qs_spec, kq_in_spec, kq_in_spec, qs_spec, rows_spec,
                   rows_spec],
         out_specs=[kq_out_spec, kq_out_spec],

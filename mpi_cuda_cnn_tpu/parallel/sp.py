@@ -44,6 +44,7 @@ from ..ops.attention import (
     finalize_online,
     init_online,
     online_softmax_block,
+    repeat_kv,
 )
 
 SEQ_AXIS = "seq"
@@ -76,12 +77,10 @@ def ring_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
     def fold(o_m_l, kh, vh, h):
         src = (me - h) % p
         mask = _pair_mask(me, src, s_local, causal)
-        if kh.shape[2] != q.shape[2]:
-            # GQA: the ring rotates the SMALL (Hkv) buffers (less ICI
-            # traffic); heads expand only at fold time, on-device.
-            g = q.shape[2] // kh.shape[2]
-            kh = jnp.repeat(kh, g, axis=2)
-            vh = jnp.repeat(vh, g, axis=2)
+        # GQA: the ring rotates the SMALL (Hkv) buffers (less ICI
+        # traffic); heads expand only at fold time, on-device.
+        kh = repeat_kv(kh, q.shape[2])
+        vh = repeat_kv(vh, q.shape[2])
         return online_softmax_block(o_m_l, q, kh, vh, mask)
 
     def hop(h, carry):
@@ -276,13 +275,11 @@ def ulysses_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
     h = q.shape[2]
     if h % p:
         raise ValueError(f"heads {h} not divisible by seq-axis size {p}")
-    if k.shape[2] != h:
-        # GQA: Ulysses shards the HEAD dim, so expand kv to full H first
-        # (costs the repeat in the all_to_all; ring keeps kv small —
-        # prefer ring/ring_flash for GQA models).
-        g = h // k.shape[2]
-        k = jnp.repeat(k, g, axis=2)
-        v = jnp.repeat(v, g, axis=2)
+    # GQA: Ulysses shards the HEAD dim, so expand kv to full H first
+    # (costs the repeat in the all_to_all; ring keeps kv small —
+    # prefer ring/ring_flash for GQA models).
+    k = repeat_kv(k, h)
+    v = repeat_kv(v, h)
 
     # Tiled all_to_all: split the head dim into P chunks, receive every
     # shard's chunk concatenated along the sequence dim -> each device

@@ -134,14 +134,16 @@ def lm_flops_per_token(model: TransformerLM, seq_len: int) -> float:
     """Analytic forward+backward FLOPs per trained token (the MFU
     denominator; backward = 2x forward, the standard accounting).
 
-    Per layer forward, per token: qkv 6d², attn-out 2d², MLP 16d²
-    (dense; MoE counts the same — top-1 routes each token through one
-    expert of the same hidden size), plus attention scores+values
-    2·s·d (causal: each query sees s/2 keys on average; QK^T and P·V
-    each cost 2·(s/2)·d). Embedding head: 2·d·V.
+    Per layer forward, per token: q proj 2d², kv proj 4·d·(Hkv·hd)
+    (= 4d² for MHA, less under GQA), attn-out 2d², MLP 16d² (dense; MoE
+    counts the same — top-1 routes each token through one expert of the
+    same hidden size), plus attention scores+values 2·s·d (causal: each
+    query sees s/2 keys on average; QK^T and P·V each cost 2·(s/2)·d).
+    Embedding head: 2·d·V.
     """
     d, s, v = model.dim, seq_len, model.vocab
-    per_layer = 24 * d * d + 2 * s * d
+    kv_dim = model.n_kv * model.head_dim
+    per_layer = 2 * d * d + 4 * d * kv_dim + 2 * d * d + 16 * d * d + 2 * s * d
     fwd = model.depth * per_layer + 2 * d * v
     return 3.0 * fwd
 

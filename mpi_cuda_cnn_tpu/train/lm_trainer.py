@@ -123,7 +123,7 @@ class LMTrainer:
         self.optimizer = make_optimizer(
             cfg.lr, opt="adamw", schedule=cfg.lr_schedule,
             total_steps=cfg.steps or None, warmup_steps=warmup,
-            weight_decay=cfg.weight_decay,
+            weight_decay=cfg.weight_decay, grad_clip=cfg.grad_clip,
         )
         compute_dtype = (
             jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None

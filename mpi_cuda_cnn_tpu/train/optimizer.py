@@ -25,6 +25,7 @@ def make_optimizer(
     total_steps: int | None = None,
     warmup_steps: int = 0,
     weight_decay: float = 0.0,
+    grad_clip: float = 0.0,  # >0: clip_by_global_norm before the update
 ) -> optax.GradientTransformation:
     if schedule == "constant":
         lr_sched: optax.Schedule | float = lr
@@ -55,4 +56,6 @@ def make_optimizer(
         tx = optax.adamw(lr_sched, weight_decay=weight_decay)
     else:
         raise ValueError(f"unknown optimizer {opt!r}; 'sgd' or 'adamw'")
+    if grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
     return tx

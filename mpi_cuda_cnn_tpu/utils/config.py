@@ -109,6 +109,9 @@ class LMConfig:
     lr_schedule: str = "cosine"
     warmup_steps: int = 20
     weight_decay: float = 0.01
+    grad_clip: float = 0.0        # global-norm clip; 0 (default) disables
+                                  # — off by default so existing configs
+                                  # reproduce; 1.0 is the usual LM choice
     seed: int = 0
 
     compute_dtype: str = "float32"   # bfloat16 = MXU-native matmuls

@@ -87,6 +87,10 @@ def main():
     ap.add_argument("--dim", type=int, default=512)
     ap.add_argument("--depth", type=int, default=8)
     ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="0 = MHA; < heads = GQA (flash kernel zero-copy)")
+    ap.add_argument("--pos", type=str, default="learned",
+                    help="learned | rope")
     ap.add_argument("--vocab", type=int, default=8192)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=8)
@@ -101,7 +105,8 @@ def main():
 
     model = TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.heads,
-        depth=args.depth, max_seq=args.seq,
+        depth=args.depth, max_seq=args.seq, kv_heads=args.kv_heads,
+        pos=args.pos,
     )
 
     def peak_for(dtype_name):

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
-from mpi_cuda_cnn_tpu.ops.attention import attention, rope
+from mpi_cuda_cnn_tpu.ops.attention import attention, repeat_kv, rope
 from mpi_cuda_cnn_tpu.ops.pallas_attention import flash_attention
 
 
@@ -24,17 +24,12 @@ def _qkv(b, s, h, hkv, d, seed=0, dtype=jnp.float32):
     return q, k, v
 
 
-def _repeat_kv(k, g):
-    return jnp.repeat(k, g, axis=2)
-
-
 @pytest.mark.parametrize("hkv", [1, 2])
 def test_gqa_oracle_matches_repeated_mha(hkv):
     """GQA == MHA with kv heads explicitly repeated per group."""
     q, k, v = _qkv(2, 64, 4, hkv, 32)
     got = attention(q, k, v, causal=True)
-    want = attention(q, _repeat_kv(k, 4 // hkv), _repeat_kv(v, 4 // hkv),
-                     causal=True)
+    want = attention(q, repeat_kv(k, 4), repeat_kv(v, 4), causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
 
@@ -237,3 +232,37 @@ def test_gqa_rope_under_ring_flash_sp():
             np.asarray(a), np.asarray(p0) - 0.1 * np.asarray(b),
             rtol=1e-3, atol=1e-5,
         )
+
+
+def test_gqa_under_ulysses_sp():
+    """GQA under Ulysses all-to-all SP (the kv expand-then-shard branch):
+    output must match the single-device GQA oracle."""
+    from mpi_cuda_cnn_tpu.parallel.mesh import make_mesh
+    from mpi_cuda_cnn_tpu.parallel.sp import SEQ_AXIS, make_ulysses_attention
+
+    mesh = make_mesh({SEQ_AXIS: 4}, devices=jax.devices()[:4])
+    q, k, v = _qkv(2, 64, 8, 2, 16, seed=6)
+    fn = make_ulysses_attention(mesh)
+    got = fn(q, k, v, causal=True)
+    want = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grad_clip_bounds_update():
+    """make_optimizer(grad_clip=c): the applied update's global norm is
+    bounded by lr * c (adamw scales elementwise, so use sgd for an exact
+    bound), and grad_clip=0 leaves gradients untouched."""
+    import optax
+
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+
+    g = {"w": jnp.full((4, 4), 100.0)}
+    params = {"w": jnp.zeros((4, 4))}
+    tx = make_optimizer(0.1, opt="sgd", grad_clip=1.0)
+    upd, _ = tx.update(g, tx.init(params), params)
+    norm = float(optax.global_norm(upd))
+    assert norm <= 0.1 + 1e-6
+    tx0 = make_optimizer(0.1, opt="sgd", grad_clip=0.0)
+    upd0, _ = tx0.update(g, tx0.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd0["w"]), -10.0, rtol=1e-6)
