@@ -7,7 +7,12 @@ the ordinary DP train step runs unchanged — collectives cross process
 boundaries via the runtime (ICI/DCN on a real pod; TCP here on CPU).
 
 Usage (one line per "host"):
-    python scripts/multihost_worker.py <pid> <nproc> <coordinator> [devs_per_proc]
+    python scripts/multihost_worker.py <pid> <nproc> <coordinator> \
+        [devs_per_proc] [mode]
+
+mode "cnn" (default): the DP CNN step. mode "lm": RING sequence
+parallelism for the transformer LM over the GLOBAL mesh — the k/v blocks
+ppermute across the OS-process boundary (multi-host long context).
 
 Every process feeds the SAME global batch (the reference's every-rank-
 loads-the-full-dataset pattern, cnnmpi.c:426-454, made correct); the
@@ -26,6 +31,7 @@ def main() -> int:
     pid, nproc = int(sys.argv[1]), int(sys.argv[2])
     coordinator = sys.argv[3]
     devs = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    mode = sys.argv[5] if len(sys.argv) > 5 else "cnn"
 
     import jax
 
@@ -54,6 +60,9 @@ def main() -> int:
 
     import jax.numpy as jnp
     import numpy as np
+
+    if mode == "lm":
+        return _lm_main(info)
 
     from mpi_cuda_cnn_tpu.models.initializers import get_initializer
     from mpi_cuda_cnn_tpu.models.presets import get_model
@@ -89,6 +98,42 @@ def main() -> int:
     print(
         f"MHOK pid={info.process_index} procs={info.process_count} "
         f"gdev={info.global_devices} loss={float(metrics['loss']):.6f}",
+        flush=True,
+    )
+    return 0
+
+
+def _lm_main(info) -> int:
+    """Ring-SP LM step over the global mesh: every device holds S/gdev
+    tokens; k/v blocks rotate through EVERY device — including across
+    the process boundary (the multi-host long-context path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.parallel.mesh import make_mesh
+    from mpi_cuda_cnn_tpu.parallel.sp import SEQ_AXIS, make_sp_lm_train_step
+
+    gdev = info.global_devices
+    mesh = make_mesh({SEQ_AXIS: gdev})
+    # GQA + rope: the round-2 features ride the multi-host ring too.
+    model = TransformerLM(vocab=13, dim=16, heads=4, depth=1,
+                          max_seq=8 * gdev, kv_heads=2, pos="rope")
+    params = model.init(jax.random.key(0))
+    opt = optax.sgd(0.1)
+    state = {"params": params, "opt_state": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+    step = make_sp_lm_train_step(model, opt, mesh, impl="ring",
+                                 donate=False)
+    rng = np.random.default_rng(7)  # same seed everywhere -> same tokens
+    toks = jnp.asarray(rng.integers(0, 13, (2, 8 * gdev + 1)), jnp.int32)
+    _, metrics = step(state, toks[:, :-1], toks[:, 1:])
+    jax.block_until_ready(metrics)
+    print(
+        f"MHOK pid={info.process_index} procs={info.process_count} "
+        f"gdev={gdev} loss={float(metrics['loss']):.6f}",
         flush=True,
     )
     return 0

@@ -25,11 +25,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_dp_step():
+def _run_two_process(mode):
     coord = f"127.0.0.1:{_free_port()}"
     procs = [
         subprocess.Popen(
-            [sys.executable, str(WORKER), str(pid), "2", coord, "4"],
+            [sys.executable, str(WORKER), str(pid), "2", coord, "4", mode],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
@@ -57,3 +57,14 @@ def test_two_process_dp_step():
         assert m, f"no MHOK line from pid {pid}: {out}"
         losses.append(float(m.group(1)))
     assert losses[0] == losses[1]  # one global step, one loss
+
+
+def test_two_process_dp_step():
+    _run_two_process("cnn")
+
+
+def test_two_process_ring_sp_lm_step():
+    """Ring sequence parallelism ACROSS a real OS-process boundary: the
+    LM's k/v blocks ppermute through all 8 global devices split over 2
+    processes (multi-host long context, GQA + rope included)."""
+    _run_two_process("lm")
