@@ -28,15 +28,34 @@ import jax.numpy as jnp
 from ..models.transformer import TransformerLM
 
 
-def pick_attn_impl(impl: str, seq_len: int) -> str:
-    """Resolve "auto" to a concrete attention implementation: the fused
-    flash kernel wherever its block constraint (S % 128 == 0) holds on a
-    real TPU; the jnp oracle otherwise (interpret-mode Pallas on CPU is
-    orders of magnitude slower than XLA — correct, but only for tests)."""
+# Measured f32 oracle/flash crossover (PERF.md "LM pretraining" table,
+# one v5e): at s=2048 f32+flash LOSES to the f32 oracle (215.9 vs
+# 194.4 ms/step — the HIGHEST-precision dots the f32 kernel uses for its
+# accuracy contract run the MXU at 1/4 rate), while by s=8192 flash wins
+# (12-16 vs ~21 ms fwd; the oracle starts paying O(S^2) HBM). The
+# crossover sits between; route f32 to the oracle below this bound.
+_F32_FLASH_MIN_SEQ = 4096
+
+
+def pick_attn_impl(impl: str, seq_len: int, compute_dtype=None) -> str:
+    """Resolve "auto" to a concrete attention implementation.
+
+    Measurement-driven (PERF.md, one v5e): the fused flash kernel wins
+    wherever its block constraint (S % 128 == 0) holds on a real TPU
+    *except* f32 at short sequences, where the oracle's default-precision
+    XLA matmuls beat the f32 kernel's HIGHEST-precision dots — there the
+    oracle is both faster and the f32 path's accuracy story. On CPU the
+    oracle always wins (interpret-mode Pallas is orders of magnitude
+    slower than XLA — correct, but only for tests).
+    """
     if impl != "auto":
         return impl
-    on_tpu = jax.default_backend() == "tpu"
-    return "flash" if on_tpu and seq_len % 128 == 0 else "oracle"
+    if jax.default_backend() != "tpu" or seq_len % 128 != 0:
+        return "oracle"
+    f32 = compute_dtype is None or jnp.dtype(compute_dtype) == jnp.float32
+    if f32 and seq_len < _F32_FLASH_MIN_SEQ:
+        return "oracle"
+    return "flash"
 
 
 def get_attn_fn(impl: str):
@@ -95,7 +114,7 @@ def make_lm_train_step(
     """
     import optax
 
-    impl = pick_attn_impl(attn_impl, seq_len or model.max_seq)
+    impl = pick_attn_impl(attn_impl, seq_len or model.max_seq, compute_dtype)
     attn_fn = get_attn_fn(impl)
     loss = partial(
         lm_loss, model, attn_fn=attn_fn, compute_dtype=compute_dtype,
@@ -135,15 +154,21 @@ def lm_flops_per_token(model: TransformerLM, seq_len: int) -> float:
     denominator; backward = 2x forward, the standard accounting).
 
     Per layer forward, per token: q proj 2d², kv proj 4·d·(Hkv·hd)
-    (= 4d² for MHA, less under GQA), attn-out 2d², MLP 16d² (dense; MoE
-    counts the same — top-1 routes each token through one expert of the
-    same hidden size), plus attention scores+values 2·s·d (causal: each
-    query sees s/2 keys on average; QK^T and P·V each cost 2·(s/2)·d).
-    Embedding head: 2·d·V.
+    (= 4d² for MHA, less under GQA), attn-out 2d², MLP 16d²·k where
+    k = moe_top_k for MoE blocks (each routed token runs k experts of
+    the same 4d hidden size; Switch k=1 matches dense, GShard k=2
+    doubles the MLP work) plus the router 2·d·E, plus attention
+    scores+values 2·s·d (causal: each query sees s/2 keys on average;
+    QK^T and P·V each cost 2·(s/2)·d). Embedding head: 2·d·V.
     """
     d, s, v = model.dim, seq_len, model.vocab
     kv_dim = model.n_kv * model.head_dim
-    per_layer = 2 * d * d + 4 * d * kv_dim + 2 * d * d + 16 * d * d + 2 * s * d
+    k = model.moe_top_k if model.moe_experts else 1
+    mlp = 16 * d * d * k
+    gate = 2 * d * model.moe_experts if model.moe_experts else 0
+    per_layer = (
+        2 * d * d + 4 * d * kv_dim + 2 * d * d + mlp + gate + 2 * s * d
+    )
     fwd = model.depth * per_layer + 2 * d * v
     return 3.0 * fwd
 

@@ -146,7 +146,9 @@ class LMTrainer:
                 remat=cfg.remat, compute_dtype=compute_dtype,
             )
         else:
-            self.attn_impl = pick_attn_impl(cfg.attn_impl, cfg.seq_len)
+            self.attn_impl = pick_attn_impl(
+                cfg.attn_impl, cfg.seq_len, compute_dtype
+            )
             self.train_step = make_lm_train_step(
                 self.model, self.optimizer, attn_impl=self.attn_impl,
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
@@ -155,19 +157,26 @@ class LMTrainer:
         self.state = replicate(
             make_lm_state(self.model, self.optimizer, cfg.seed), self.mesh
         )
-        self._rng = np.random.default_rng(cfg.seed)
         self._eval_fn = None
 
     # ------------------------------------------------------------------
 
-    def _sample_batch(self):
-        """(B, S) inputs + targets: random windows of the train stream."""
+    def _sample_batch(self, step: int):
+        """(B, S) inputs + targets: random windows of the train stream.
+
+        The RNG is derived from (seed, step), not a stream advanced from
+        cfg.seed, so a run resumed at step k sees exactly the windows the
+        uninterrupted run would have seen at steps k, k+1, ... — the same
+        step-exact-resume contract the CNN trainer keeps with its
+        (seed, epoch)-derived shuffle order.
+        """
         cfg = self.cfg
         # A window consumes seq_len+1 tokens; valid starts are
         # [0, len - seq_len - 1] inclusive, so the exclusive high bound is
         # len - seq_len (== 1 for the minimal corpus the ctor accepts).
         n = len(self.train_tokens) - cfg.seq_len
-        starts = self._rng.integers(0, n, size=cfg.batch_size)
+        rng = np.random.default_rng((cfg.seed, step))
+        starts = rng.integers(0, n, size=cfg.batch_size)
         idx = starts[:, None] + np.arange(cfg.seq_len + 1)[None, :]
         w = self.train_tokens[idx]
         return jnp.asarray(w[:, :-1]), jnp.asarray(w[:, 1:])
@@ -202,7 +211,7 @@ class LMTrainer:
         loss = float("nan")
         m = None
         for step in range(start_step, cfg.steps):
-            tokens, targets = self._sample_batch()
+            tokens, targets = self._sample_batch(step)
             self.state, m = self.train_step(
                 self.state, self._place(tokens), self._place(targets)
             )

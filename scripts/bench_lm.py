@@ -91,6 +91,11 @@ def main():
                     help="0 = MHA; < heads = GQA (flash kernel zero-copy)")
     ap.add_argument("--pos", type=str, default="learned",
                     help="learned | rope")
+    ap.add_argument("--moe-experts", type=int, default=0,
+                    help="0 = dense MLP; >0 = Switch/GShard MoE blocks")
+    ap.add_argument("--moe-top-k", type=int, default=1,
+                    help="experts per token (1 = Switch, 2 = GShard); "
+                         "lm_flops_per_token scales the MLP term by k")
     ap.add_argument("--vocab", type=int, default=8192)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=8)
@@ -101,12 +106,23 @@ def main():
                          "Default: v5e (197, f32 49)")
     ap.add_argument("--quick", action="store_true",
                     help="bf16+flash only (the headline config)")
+    ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     args = ap.parse_args()
+
+    if args.device == "cpu":
+        # In-process selection, like the CLI: the JAX_PLATFORMS env var can
+        # be intercepted by a pre-registered TPU plugin (see cli.py).
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu" and jax.default_backend() != "tpu":
+        print("--device=tpu requested but the backend is "
+              f"{jax.default_backend()}", file=sys.stderr)
+        raise SystemExit(1)
 
     model = TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.heads,
         depth=args.depth, max_seq=args.seq, kv_heads=args.kv_heads,
-        pos=args.pos,
+        pos=args.pos, moe_experts=args.moe_experts,
+        moe_top_k=args.moe_top_k,
     )
 
     def peak_for(dtype_name):
@@ -121,6 +137,13 @@ def main():
 
     tokens_per_step = args.batch * args.seq
     flops_per_step = lm_flops_per_token(model, args.seq) * tokens_per_step
+
+    # MFU is only meaningful against a real chip peak: emit it when the
+    # backend is a TPU or the caller supplied --peak-tflops; otherwise
+    # report tokens/s with mfu=null rather than an MFU against a peak the
+    # backend doesn't have.
+    backend = jax.default_backend()
+    mfu_valid = backend == "tpu" or args.peak_tflops is not None
 
     configs = [("bfloat16", "flash")]
     if not args.quick:
@@ -138,11 +161,14 @@ def main():
             compute_dtype=cd, attn_impl=impl, steps=args.steps,
         )
         tok_s = tokens_per_step / dt
-        mfu = flops_per_step / dt / (peak_for(dtype_name) * 1e12)
+        mfu = (
+            round(flops_per_step / dt / (peak_for(dtype_name) * 1e12), 4)
+            if mfu_valid else None
+        )
         results[f"{dtype_name}+{impl}"] = {
             "step_ms": round(dt * 1e3, 2),
             "tokens_per_s": round(tok_s),
-            "mfu": round(mfu, 4),
+            "mfu": mfu,
             "loss": round(loss, 4),
         }
         print(json.dumps({
@@ -159,9 +185,11 @@ def main():
         "mfu": best[1]["mfu"],
         "params": nparams,
         "model": f"d{args.dim}x{args.depth} h{args.heads} "
-                 f"s{args.seq} v{args.vocab} b{args.batch}",
-        "peak_tflops": peak_for(best[0].split("+")[0]),
-        "backend": jax.default_backend(),
+                 f"s{args.seq} v{args.vocab} b{args.batch}"
+                 + (f" moe{args.moe_experts}k{args.moe_top_k}"
+                    if args.moe_experts else ""),
+        "peak_tflops": peak_for(best[0].split("+")[0]) if mfu_valid else None,
+        "backend": backend,
     }))
 
 

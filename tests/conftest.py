@@ -98,16 +98,20 @@ def pytest_collection_modifyitems(config, items):
         return
     # A test named explicitly on the command line (::-qualified) always
     # runs; other args in the same invocation still get the skip.
-    # Compare on the "file.py::name" tail: nodeids are rootdir-relative
-    # while CLI args may be absolute or cwd-relative paths.
-    def _tail(s):
-        return s.split("/")[-1]
+    # Nodeids are rootdir-relative with forward slashes, while CLI args
+    # may be absolute or cwd-relative paths — normalize the arg's path
+    # part against rootdir so `pytest /abs/tests/test_x.py::name` matches
+    # exactly that file's test and nothing sharing its basename.
+    def _normalize(arg):
+        path, sep, rest = arg.partition("::")
+        rel = os.path.relpath(os.path.abspath(path), str(config.rootdir))
+        return rel.replace(os.sep, "/") + sep + rest
 
-    explicit = tuple(_tail(a) for a in config.args if "::" in a)
+    explicit = tuple(_normalize(a) for a in config.args if "::" in a)
 
     def named_explicitly(item):
-        tail = _tail(item.nodeid)
-        return any(tail == a or tail.startswith(a + "[") for a in explicit)
+        nid = item.nodeid
+        return any(nid == a or nid.startswith(a + "[") for a in explicit)
 
     skip = pytest.mark.skip(reason="slow; use --runslow (make test_all)")
     matched = set()

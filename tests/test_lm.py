@@ -93,13 +93,34 @@ def test_flash_impl_matches_oracle_in_step():
     )
 
 
-def test_pick_attn_impl():
+def test_pick_attn_impl(monkeypatch):
     # On the CPU test backend "auto" must not pick the interpret-mode
     # flash kernel (orders of magnitude slower than XLA).
     assert pick_attn_impl("auto", 2048) == "oracle"
     assert pick_attn_impl("flash", 2048) == "flash"
     with pytest.raises(ValueError):
         get_attn_fn("nope")
+
+
+def test_pick_attn_impl_routing_table(monkeypatch):
+    """Pin "auto" to PERF.md's measured crossovers (one v5e): bf16 ->
+    flash at any 128-aligned s (wins 2.0x at s=2048); f32 -> oracle below
+    s=4096 (flash loses 215.9 vs 194.4 ms at 2048), flash from 4096 up
+    (wins by s=8192); unaligned s -> oracle always."""
+    from mpi_cuda_cnn_tpu.train import lm as lm_mod
+
+    monkeypatch.setattr(lm_mod.jax, "default_backend", lambda: "tpu")
+    bf16 = jnp.bfloat16
+    assert pick_attn_impl("auto", 2048, bf16) == "flash"
+    assert pick_attn_impl("auto", 128, bf16) == "flash"
+    assert pick_attn_impl("auto", 2048, None) == "oracle"       # f32 short
+    assert pick_attn_impl("auto", 2048, jnp.float32) == "oracle"
+    assert pick_attn_impl("auto", 4096, None) == "flash"        # f32 long
+    assert pick_attn_impl("auto", 8192, jnp.float32) == "flash"
+    assert pick_attn_impl("auto", 2000, bf16) == "oracle"       # unaligned
+    # Explicit impls are never overridden.
+    assert pick_attn_impl("oracle", 8192, bf16) == "oracle"
+    assert pick_attn_impl("flash", 2048, None) == "flash"
 
 
 def test_flops_accounting_scales():
