@@ -124,6 +124,15 @@ class PipelinePlan:
     layer_sliced: tuple[bool, ...] = ()  # per LAYER: features sliced over
     #   'model'? (leaves whose last dim == features are sliced; the
     #   activation is gathered back to full after the layer)
+    remat: bool = False  # jax.checkpoint around each stage fn: backward
+    #   re-runs the stage instead of saving its internal activations —
+    #   the scan carry (one A_max boundary buffer per tick) becomes the
+    #   only live activation state, exactly the memory regime long
+    #   pipelined models need
+    fsdp: bool = False  # ZeRO over 'data' INSIDE each stage row: the
+    #   packed (S[, M], P_max) params shard their last dim over 'data';
+    #   the step all-gathers the row, computes, then reduce-scatters the
+    #   mean gradient back to shards (see _make_step_body)
 
 
 def _slice_last(leaf, m: int, n: int):
@@ -142,11 +151,13 @@ def _local_leaf_shape(shape, layer_features, sliced: bool, n_model: int):
 
 def make_pipeline_plan(
     model, n_stages: int, *, backend: str = "xla", compute_dtype=None,
-    n_model: int = 1,
+    n_model: int = 1, remat: bool = False, fsdp_degree: int = 1,
 ) -> PipelinePlan:
     """Split `model` (a Sequential) into n_stages balanced stages;
     n_model > 1 additionally slices each stage's Conv/Dense features
-    over the 'model' mesh axis (TP x PP)."""
+    over the 'model' mesh axis (TP x PP). fsdp_degree > 1 pads P_max to a
+    multiple of the 'data'-axis size and marks the plan for ZeRO row
+    sharding (FSDP x PP)."""
     key = jax.random.key(0)
     shape = model.input_shape
     layer_in_shapes, costs, zero_params, layer_sliced = [], [], [], []
@@ -184,6 +195,10 @@ def make_pipeline_plan(
         end = idxs[-1] + 1
         out_shape = layer_in_shapes[end] if end < len(model.layers) else shape
         boundary_widths.append(int(np.prod(out_shape)))
+    p_max = max(p_sizes) if p_sizes else 1
+    if fsdp_degree > 1:
+        # The ZeRO row shard splits P_max evenly over 'data'.
+        p_max += -p_max % fsdp_degree
     return PipelinePlan(
         model=model,
         n_stages=n_stages,
@@ -194,11 +209,13 @@ def make_pipeline_plan(
         param_treedefs=tuple(param_treedefs),
         num_classes=num_classes,
         a_max=max(boundary_widths),
-        p_max=max(p_sizes) if p_sizes else 1,
+        p_max=p_max,
         backend=backend,
         compute_dtype=compute_dtype,
         n_model=n_model,
         layer_sliced=tuple(layer_sliced),
+        remat=remat,
+        fsdp=fsdp_degree > 1,
     )
 
 
@@ -319,7 +336,11 @@ def _stage_fns(plan: PipelinePlan, mb: int) -> list[Callable]:
             y = x.reshape(mb, -1).astype(jnp.float32)
             return jnp.pad(y, ((0, 0), (0, plan.a_max - y.shape[1])))
 
-        fns.append(fn)
+        # remat: the backward pass re-runs the stage from (flat_p, flat_x)
+        # instead of saving its per-layer activations; with the scan carry
+        # already bounded to one (mb, A_max) boundary buffer, live
+        # activation memory becomes O(stage boundary), not O(stage depth).
+        fns.append(jax.checkpoint(fn) if plan.remat else fn)
     return fns
 
 
@@ -422,16 +443,23 @@ def _make_local_loss(plan: PipelinePlan):
     return local_loss
 
 
-def _state_specs(state: TrainState, n_stages: int, n_model: int = 1):
+def _state_specs(state: TrainState, n_stages: int, n_model: int = 1,
+                 fsdp: bool = False):
     """PartitionSpecs for a PP train state: (S, ...)-leading leaves shard
     over 'pipe' (and their second dim over 'model' under TP x PP; params +
-    matching optimizer buffers), scalars replicate."""
+    matching optimizer buffers), scalars replicate. fsdp additionally
+    shards the flat param dim (last) over 'data' — ZeRO's param +
+    optimizer-state partitioning, inherited by every optimizer buffer
+    because they share the packed row shape."""
 
     def spec(a):
         if getattr(a, "ndim", 0) >= 1 and a.shape[0] == n_stages:
+            mid = [None] * (a.ndim - 1)
             if n_model > 1 and a.ndim >= 2 and a.shape[1] == n_model:
-                return P(PIPE_AXIS, MODEL_AXIS, *([None] * (a.ndim - 2)))
-            return P(PIPE_AXIS, *([None] * (a.ndim - 1)))
+                mid[0] = MODEL_AXIS
+            if fsdp and a.ndim >= 2:
+                mid[-1] = DATA_AXIS
+            return P(PIPE_AXIS, *mid)
         return P()
 
     return jax.tree.map(spec, state)
@@ -439,12 +467,13 @@ def _state_specs(state: TrainState, n_stages: int, n_model: int = 1):
 
 def make_pp_state(plan: PipelinePlan, params, optimizer, mesh) -> TrainState:
     """Pack + place the train state: stage rows on their pipe coordinate
-    (model shards on their model coordinate under TP x PP), optimizer state
-    created FROM the packed array so its buffers inherit the sharding
-    leaf-for-leaf."""
+    (model shards on their model coordinate under TP x PP; the flat dim
+    over 'data' under FSDP x PP), optimizer state created FROM the packed
+    array so its buffers inherit the sharding leaf-for-leaf."""
+    last = DATA_AXIS if plan.fsdp else None
     row_spec = (
-        P(PIPE_AXIS, MODEL_AXIS, None) if plan.n_model > 1
-        else P(PIPE_AXIS, None)
+        P(PIPE_AXIS, MODEL_AXIS, last) if plan.n_model > 1
+        else P(PIPE_AXIS, last)
     )
     packed = jax.device_put(
         pack_params(plan, params), NamedSharding(mesh, row_spec)
@@ -477,18 +506,49 @@ def microbatch(x, y, num_microbatches: int):
     return split(x), split(y)
 
 
-def _make_step_body(plan: PipelinePlan, optimizer, has_data: bool):
+def _make_step_body(plan: PipelinePlan, optimizer, mesh,
+                    augment=None, aug_seed: int = 0):
     """The per-device PP(+DP) train-step body shared by the one-batch step
-    and the scanned epoch (the PP twin of dp._make_step_body)."""
+    and the scanned epoch (the PP twin of dp._make_step_body).
+
+    `augment` runs on-device on the (flattened) microbatched inputs,
+    keyed by (step, data-axis index) exactly like dp._make_step_body —
+    pipe (and model) ranks draw the SAME key, so the stage-0 feed every
+    rank computes against is identical across the pipe.
+
+    plan.fsdp (ZeRO x GPipe): the local flat_params hold 1/n_data of the
+    stage row. The step all-gathers the full row over 'data', runs the
+    schedule, differentiates w.r.t. the FULL row, then one
+    psum_scatter / n_data both averages the gradient across the data
+    shards (the DP pmean) and hands each device exactly its shard's
+    slice (the ZeRO reduce-scatter) — master params + optimizer state
+    stay sharded; only the transient gathered row is ever full-width.
+    """
     local_loss = _make_local_loss(plan)
     tp = plan.n_model > 1
     rep_mask = jnp.asarray(_tp_replicated_mask(plan)) if tp else None
     metric_axes = (PIPE_AXIS, MODEL_AXIS) if tp else PIPE_AXIS
+    has_data = DATA_AXIS in mesh.axis_names
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    if plan.fsdp and n_data <= 1:
+        raise ValueError("FSDP x PP needs a 'data' mesh axis of size > 1")
 
     def step(state: TrainState, x_mb, y_mb):
+        if augment is not None:
+            key = jax.random.fold_in(jax.random.key(aug_seed), state["step"])
+            if has_data:
+                key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            flat_x = x_mb.reshape((-1,) + x_mb.shape[2:])
+            x_mb = augment(key, flat_x).reshape(x_mb.shape)
+        local = state["flat_params"]
+        full = (
+            jax.lax.all_gather(local, DATA_AXIS, axis=local.ndim - 1,
+                               tiled=True)
+            if plan.fsdp else local
+        )
         (loss, (etot, acc)), grads = jax.value_and_grad(
             local_loss, has_aux=True
-        )(state["flat_params"], x_mb, y_mb)
+        )(full, x_mb, y_mb)
         if tp:
             # Restore exact gradients for the replicated segments: sum the
             # rank copies over 'model' (see _tp_replicated_mask); sliced
@@ -505,8 +565,14 @@ def _make_step_body(plan: PipelinePlan, optimizer, has_data: bool):
         loss, etot, acc = (
             jax.lax.psum(m, metric_axes) for m in (loss, etot, acc)
         )
-        if has_data:
+        if plan.fsdp:
+            grads = jax.lax.psum_scatter(
+                grads, DATA_AXIS, scatter_dimension=grads.ndim - 1,
+                tiled=True,
+            ) / n_data
+        elif has_data:
             grads = jax.lax.pmean(grads, DATA_AXIS)
+        if has_data:
             loss, etot, acc = (
                 jax.lax.pmean(m, DATA_AXIS) for m in (loss, etot, acc)
             )
@@ -528,6 +594,8 @@ def make_pp_train_step(
     state: TrainState,
     *,
     donate: bool = True,
+    augment=None,
+    aug_seed: int = 0,
 ):
     """Build the jitted PP(+DP) train step.
 
@@ -536,8 +604,8 @@ def make_pp_train_step(
     steps' {loss, etotal, acc} means, so the Trainer can treat all three
     parallel modes uniformly.
     """
-    step = _make_step_body(plan, optimizer, DATA_AXIS in mesh.axis_names)
-    specs = _state_specs(state, plan.n_stages, plan.n_model)
+    step = _make_step_body(plan, optimizer, mesh, augment, aug_seed)
+    specs = _state_specs(state, plan.n_stages, plan.n_model, plan.fsdp)
     bspec = _batch_spec(mesh)
     sharded = jax.shard_map(
         step,
@@ -558,6 +626,8 @@ def make_pp_scan_epoch(
     num_microbatches: int,
     *,
     donate: bool = True,
+    augment=None,
+    aug_seed: int = 0,
 ):
     """Scanned-epoch twin of dp.make_dp_scan_epoch for the pipelined path:
     lax.scan over a batch-index permutation with the uint8 dataset
@@ -571,8 +641,7 @@ def make_pp_scan_epoch(
     """
     from ..data.pipeline import PIXEL_SCALE
 
-    has_data = DATA_AXIS in mesh.axis_names
-    step = _make_step_body(plan, optimizer, has_data)
+    step = _make_step_body(plan, optimizer, mesh, augment, aug_seed)
     M = num_microbatches
 
     def epoch(state: TrainState, images, labels, perm):
@@ -586,7 +655,7 @@ def make_pp_scan_epoch(
         state, metrics = jax.lax.scan(body, state, perm)
         return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
 
-    specs = _state_specs(state, plan.n_stages, plan.n_model)
+    specs = _state_specs(state, plan.n_stages, plan.n_model, plan.fsdp)
     sharded = jax.shard_map(
         epoch,
         mesh=mesh,
@@ -607,6 +676,10 @@ def make_pp_forward(plan: PipelinePlan, mesh):
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
     def forward(flat_params, x_mb):
+        if plan.fsdp:
+            flat_params = jax.lax.all_gather(
+                flat_params, DATA_AXIS, axis=flat_params.ndim - 1, tiled=True
+            )
         fp = flat_params[0, 0] if plan.n_model > 1 else flat_params[0]
         M, mb = x_mb.shape[0], x_mb.shape[1]
         fns = _stage_fns(plan, mb)
@@ -625,9 +698,10 @@ def make_pp_forward(plan: PipelinePlan, mesh):
         return jax.lax.psum(logits, PIPE_AXIS)
 
     bspec = _batch_spec(mesh)
+    last = DATA_AXIS if plan.fsdp else None
     row_spec = (
-        P(PIPE_AXIS, MODEL_AXIS, None) if plan.n_model > 1
-        else P(PIPE_AXIS, None)
+        P(PIPE_AXIS, MODEL_AXIS, last) if plan.n_model > 1
+        else P(PIPE_AXIS, last)
     )
     sharded = jax.shard_map(
         forward,

@@ -159,12 +159,6 @@ class Trainer:
         self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
         self.n_pipe = self.mesh.shape.get(PIPE_AXIS, 1)
         self._pp_M = 1  # microbatches per step; >1 only on the PP path
-        if config.fsdp and self.n_pipe > 1:
-            raise ValueError(
-                "--fsdp shards unpacked param pytrees over 'data' and does "
-                "not compose with the pipeline path's packed stage rows; "
-                "use a data/model mesh (FSDP x TP composes)"
-            )
         if self.n_pipe == 1 and config.num_microbatches:
             raise ValueError(
                 "--num-microbatches requires a 'pipe' mesh axis "
@@ -174,29 +168,26 @@ class Trainer:
             # Pipeline(+data) parallel: stage-sharded params, GPipe
             # microbatch schedule (parallel/pp.py). Beyond the reference,
             # which runs layers sequentially in one process (cnn.c:255-267).
-            if self._augment is not None:
-                raise ValueError(
-                    "--augment is not supported on the pipeline-parallel "
-                    "path (inputs are pre-microbatched); use a data/model "
-                    "mesh"
-                )
+            # Composes with --augment (applied in the step body, keyed like
+            # the DP path), --remat (jax.checkpoint per stage), --fsdp
+            # (ZeRO sharding of the packed stage rows over 'data'), and TP.
             if config.grad_accum > 1:
                 raise ValueError(
                     "--grad-accum is redundant on the pipeline path: "
                     "--num-microbatches already accumulates over "
                     "micro-batches"
                 )
-            if config.remat:
-                raise ValueError(
-                    "--remat is not wired into the pipeline path (stages "
-                    "already bound live activations to one microbatch); "
-                    "use a data/model mesh"
-                )
             if param_dtype != jnp.float32:
                 raise ValueError(
                     "pipeline parallelism keeps master params in the packed "
                     "f32 stage buffers; use --compute-dtype for low-precision "
                     f"compute (got param_dtype={config.param_dtype})"
+                )
+            if config.fsdp and n_data <= 1:
+                raise ValueError(
+                    "FSDP x PP shards the packed stage rows over 'data'; "
+                    f"add a data axis of size > 1 (mesh_shape="
+                    f"{config.mesh_shape!r})"
                 )
             self._pp_M = config.num_microbatches or self.n_pipe
             if config.batch_size % (self._pp_M * n_data):
@@ -207,6 +198,8 @@ class Trainer:
             self._pp_plan = make_pipeline_plan(
                 model, self.n_pipe, backend=backend,
                 compute_dtype=compute_dtype, n_model=self.n_model,
+                remat=config.remat,
+                fsdp_degree=n_data if config.fsdp else 1,
             )
             self.state = make_pp_state(
                 self._pp_plan, params, self.optimizer, self.mesh
@@ -214,6 +207,7 @@ class Trainer:
             self.train_step = make_pp_train_step(
                 self._pp_plan, self.optimizer, self.mesh, self.state,
                 donate=config.donate,
+                augment=self._augment, aug_seed=self._aug_seed,
             )
             self.eval_step = make_pp_forward(self._pp_plan, self.mesh)
         elif self.n_model > 1 or config.fsdp:
@@ -418,6 +412,7 @@ class Trainer:
             self._scan_epoch_fn = make_pp_scan_epoch(
                 self._pp_plan, self.optimizer, self.mesh, self.state,
                 self.ds.num_classes, self._pp_M, donate=self.cfg.donate,
+                augment=self._augment, aug_seed=self._aug_seed,
             )
         elif self.n_model > 1 or self.cfg.fsdp:
             # Both GSPMD paths (TP-sharded or FSDP-sharded params) scan

@@ -69,12 +69,17 @@ def test_grad_accum_rejected_on_pp_mesh(eight_devices):
         Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
 
 
-def test_remat_rejected_on_pp_mesh(eight_devices):
-    """--remat must fail loudly on the pipeline path, not silently no-op."""
+def test_pp_remat_matches_plain_pp(eight_devices):
+    """--remat on the pipeline path (jax.checkpoint around each stage fn)
+    must change the backward schedule, not the math: params after an epoch
+    on a pipe:2 mesh match the non-remat pipelined run."""
     ds = _ds()
-    cfg = Config(batch_size=32, remat=True, mesh_shape="pipe:2")
-    with pytest.raises(ValueError, match="remat"):
-        Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
+    base = dict(model="reference_cnn", epochs=1, batch_size=32, seed=11,
+                eval_every=0, log_every=10**9, mesh_shape="pipe:2",
+                donate=False)
+    p_plain, _ = _final_params(Config(**base), ds)
+    p_remat, _ = _final_params(Config(remat=True, **base), ds)
+    _assert_trees_close(p_plain, p_remat, rtol=1e-6, atol=1e-7)
 
 
 def test_remat_matches_plain(eight_devices):
