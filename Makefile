@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test test_all test_serial test_dp8 test_sp8 test_ep8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode test test_all test_serial test_dp8 test_sp8 test_ep8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -21,10 +21,16 @@ test_native_tpu: native
 	$(MAKE) -C native test_tpu
 
 # Unit/integration suite (CPU, 8 virtual devices — set in tests/conftest.py).
-# Fast default: the heavy tests in conftest.SLOW_TESTS are skipped (<5 min);
-# `make test_all` is the full superset (~15 min).
+# Fast default: the heavy tests in conftest.SLOW_TESTS are skipped and the
+# run fans out over cores (pytest-xdist -n auto; each worker gets its own
+# 8-virtual-device jax). Measured 2026-07-30: 400 s serial on a 1-core box
+# under load — multicore boxes land well under the 5-min bar, single-core
+# near it. `make test_all` is the full superset (~15 min serial).
+# pytest-xdist is optional: fan out when importable, serial otherwise.
+XDIST := $(shell $(PY) -c "import xdist" 2>/dev/null && echo "-n auto")
+
 test:
-	$(PY) -m pytest tests/ -x -q
+	$(PY) -m pytest tests/ -x -q $(XDIST)
 
 test_all:
 	$(PY) -m pytest tests/ -x -q --runslow
@@ -93,6 +99,11 @@ bench_configs_cpu8:
 # {f32,bf16} x {oracle,flash} matrix; prints tokens/s + MFU per config.
 bench_lm:
 	$(PY) scripts/bench_lm.py
+
+# KV-cache decode benchmark: prefill + steady-state generation tokens/s,
+# MHA vs GQA vs MQA cache sizes (two-point timing; scripts/bench_decode.py).
+bench_decode:
+	$(PY) scripts/bench_decode.py
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

@@ -154,6 +154,11 @@ class TransformerLM:
                                        # residual stream in this dtype
                                        # (master params stay f32; LN and
                                        # the caller's loss stay f32)
+        return_features: bool = False,  # skip the head matmul and return
+                                       # the final-LN features (B, S, dim)
+                                       # — for losses that fuse the head
+                                       # (train/lm.py chunked CE, which
+                                       # never materializes (B,S,V) f32)
     ):                                 # (B, S, vocab) logits [, aux]
         b, s = tokens.shape
         h, hd = self.heads, self.head_dim
@@ -229,6 +234,8 @@ class TransformerLM:
             x, aux = block(blk, x)
             aux_total = aux_total + aux
         x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+        if return_features:
+            return (x, aux_total) if return_aux else x
         # Head matmul in compute dtype (it is the single largest matmul);
         # logits come back in f32 — the loss softmax must not run in bf16.
         logits = (x @ w(params["head"])).astype(jnp.float32)

@@ -1,11 +1,17 @@
 """Training: optimizers, train/eval loops, checkpointing."""
 
 from .optimizer import make_optimizer
-from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    AsyncCheckpointer,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from .trainer import Trainer, TrainResult, make_loss_fn
 
 __all__ = [
     "make_optimizer",
+    "AsyncCheckpointer",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_checkpoint",

@@ -48,6 +48,55 @@ def save_checkpoint(ckpt_dir: str | Path, state, step: int, *, keep: int = 3) ->
     return path
 
 
+class AsyncCheckpointer:
+    """Overlap checkpoint IO with the next training steps.
+
+    save_checkpoint() stalls the step loop for the whole device_get +
+    npz write; at CNN scale that is milliseconds, but at the LM bench's
+    sizes the write dominates (VERDICT round 2). Here save() snapshots
+    the state to host synchronously — it must happen before the next
+    step donates the buffers — and hands the arrays to ONE background
+    worker that does the savez + atomic rename + prune. At most one
+    write is in flight: a second save() first drains the previous one
+    (bounded memory; files appear in step order). A failed write
+    re-raises at the next save()/wait() — it cannot pass silently.
+
+    async_=False degrades to the synchronous save_checkpoint, so callers
+    hold one code path and a flag.
+    """
+
+    def __init__(self, ckpt_dir: str | Path, *, keep: int = 3,
+                 async_: bool = True):
+        self.ckpt_dir = Path(ckpt_dir)
+        self.keep = keep
+        self._executor = None
+        self._pending = None
+        if async_:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt"
+            )
+
+    def save(self, state, step: int) -> None:
+        """Snapshot `state` (device or host pytree) and schedule the write."""
+        if self._executor is None:
+            save_checkpoint(self.ckpt_dir, jax.device_get(state),
+                            step, keep=self.keep)
+            return
+        self.wait()  # drain (and re-raise from) any in-flight write
+        host = jax.device_get(state)
+        self._pending = self._executor.submit(
+            save_checkpoint, self.ckpt_dir, host, step, keep=self.keep
+        )
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) lands; re-raise errors."""
+        if self._pending is not None:
+            fut, self._pending = self._pending, None
+            fut.result()
+
+
 def _list_checkpoints(ckpt_dir: Path) -> list[Path]:
     found = [(int(m.group(1)), p) for p in ckpt_dir.glob("ckpt_*.npz")
              if (m := _STEP_RE.search(p.name))]

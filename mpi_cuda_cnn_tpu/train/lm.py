@@ -81,9 +81,54 @@ def lm_loss(
     compute_dtype=None,
     remat: bool = False,
     moe_aux_weight: float = 0.01,
+    ce_chunk: int = 0,
 ):
     """Mean next-token NLL (+ the Switch aux loss when the model is MoE).
-    tokens/targets: (B, S) int32. The loss softmax always runs in f32."""
+    tokens/targets: (B, S) int32. The loss softmax always runs in f32.
+
+    ce_chunk > 0 fuses the head matmul into a chunked cross-entropy: the
+    final-LN features go through the head in S-chunks of that size inside
+    a lax.scan, each chunk's NLL computed and reduced under
+    jax.checkpoint — the (B, S, V) f32 logits are NEVER materialized
+    (peak extra memory O(B * chunk * V), recomputed in backward). At
+    vocab 8k x s 2k x b 8 the dense logits are 512 MB of HBM traffic; at
+    32k+ vocab they stop fitting at all — this is the standard fix.
+    ce_chunk must divide S; 0 keeps the dense path.
+    """
+    if ce_chunk:
+        feats, aux = model.apply(
+            params, tokens, attn_fn=attn_fn, remat=remat,
+            compute_dtype=compute_dtype, return_aux=True,
+            return_features=True,
+        )
+        b, s, d = feats.shape
+        if s % ce_chunk:
+            raise ValueError(f"ce_chunk {ce_chunk} must divide seq len {s}")
+        n = s // ce_chunk
+        head = params["head"].astype(compute_dtype) if compute_dtype \
+            else params["head"]
+
+        def chunk_nll(f_c, t_c):
+            # (B, c, d) @ (d, V) in compute dtype, f32 accumulation via
+            # preferred_element_type (same numerics contract as the dense
+            # head matmul, which also feeds an f32 softmax).
+            logits = jnp.matmul(
+                f_c, head, preferred_element_type=jnp.float32
+            )
+            lse = jax.nn.logsumexp(logits, axis=-1)           # (B, c)
+            tgt = jnp.take_along_axis(
+                logits, t_c[..., None], axis=-1
+            )[..., 0]
+            return jnp.sum(lse - tgt)
+
+        chunk_nll = jax.checkpoint(chunk_nll)
+        fs = jnp.moveaxis(feats.reshape(b, n, ce_chunk, d), 1, 0)
+        ts = jnp.moveaxis(targets.reshape(b, n, ce_chunk), 1, 0)
+        total, _ = jax.lax.scan(
+            lambda acc, ft: (acc + chunk_nll(*ft), None),
+            jnp.zeros((), jnp.float32), (fs, ts),
+        )
+        return total / (b * s) + moe_aux_weight * aux
     logits, aux = model.apply(
         params, tokens, attn_fn=attn_fn, remat=remat,
         compute_dtype=compute_dtype, return_aux=True,
@@ -103,6 +148,7 @@ def make_lm_train_step(
     remat: bool = False,
     donate: bool = True,
     moe_aux_weight: float = 0.01,
+    ce_chunk: int = 0,
 ):
     """step(state, tokens, targets) -> (state, {"loss": ...}), jitted.
 
@@ -118,7 +164,7 @@ def make_lm_train_step(
     attn_fn = get_attn_fn(impl)
     loss = partial(
         lm_loss, model, attn_fn=attn_fn, compute_dtype=compute_dtype,
-        remat=remat, moe_aux_weight=moe_aux_weight,
+        remat=remat, moe_aux_weight=moe_aux_weight, ce_chunk=ce_chunk,
     )
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())

@@ -27,7 +27,11 @@ from ..parallel.mesh import DATA_AXIS, make_mesh
 from ..parallel.sp import SEQ_AXIS, make_sp_lm_train_step
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.sync import hard_block
-from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    AsyncCheckpointer,
+    latest_checkpoint,
+    restore_checkpoint,
+)
 from .lm import get_attn_fn, lm_loss, make_lm_state, make_lm_train_step, pick_attn_impl
 from .optimizer import make_optimizer
 
@@ -131,6 +135,12 @@ class LMTrainer:
         self._compute_dtype = compute_dtype
 
         if self.n_seq > 1:
+            if cfg.ce_chunk:
+                raise ValueError(
+                    "--ce-chunk applies to the plain/DP step only; the "
+                    "SP step computes its loss shard-local over the seq "
+                    "axis (drop the flag or the 'seq' mesh axis)"
+                )
             impl = cfg.attn_impl
             if impl in ("auto", "flash"):
                 # ring_flash needs 128-aligned shards; plain ring otherwise.
@@ -152,12 +162,17 @@ class LMTrainer:
             self.train_step = make_lm_train_step(
                 self.model, self.optimizer, attn_impl=self.attn_impl,
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
-                remat=cfg.remat,
+                remat=cfg.remat, ce_chunk=cfg.ce_chunk,
             )
         self.state = replicate(
             make_lm_state(self.model, self.optimizer, cfg.seed), self.mesh
         )
         self._eval_fn = None
+        self._ckpt = (
+            AsyncCheckpointer(cfg.checkpoint_dir,
+                              async_=cfg.async_checkpoint)
+            if cfg.checkpoint_dir else None
+        )
 
     # ------------------------------------------------------------------
 
@@ -221,17 +236,14 @@ class LMTrainer:
             if cfg.checkpoint_dir and cfg.checkpoint_every and (
                 (step + 1) % cfg.checkpoint_every == 0
             ):
-                save_checkpoint(
-                    cfg.checkpoint_dir, jax.device_get(self.state), step + 1
-                )
+                self._ckpt.save(self.state, step + 1)
         hard_block(self.state)
         dt = time.perf_counter() - t0
         steps_run = cfg.steps - start_step
         loss = float(m["loss"]) if m is not None else loss
         if cfg.checkpoint_dir:
-            save_checkpoint(
-                cfg.checkpoint_dir, jax.device_get(self.state), cfg.steps
-            )
+            self._ckpt.save(self.state, cfg.steps)
+            self._ckpt.wait()  # the final write must land before eval/return
 
         eval_loss = self.evaluate()
         tok_s = steps_run * cfg.batch_size * cfg.seq_len / max(dt, 1e-9)

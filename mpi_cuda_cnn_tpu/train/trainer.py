@@ -50,7 +50,11 @@ from ..parallel.tp import (
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer, profile_trace
 from ..utils.sync import hard_block
-from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    AsyncCheckpointer,
+    latest_checkpoint,
+    restore_checkpoint,
+)
 from .optimizer import make_optimizer
 
 
@@ -275,6 +279,15 @@ class Trainer:
                 f"{self.num_train}: no full batches"
             )
 
+        # One checkpointer for every save site; async by default (the
+        # step loop pays only the host snapshot, the npz write overlaps
+        # the next steps; train() drains it before returning).
+        self._ckpt = (
+            AsyncCheckpointer(config.checkpoint_dir,
+                              async_=config.async_checkpoint)
+            if config.checkpoint_dir else None
+        )
+
     def _epoch_order(self, epoch: int) -> np.ndarray:
         """The epoch's sample permutation — derived, never stored."""
         return np.random.default_rng((self.cfg.seed, epoch)).permutation(
@@ -292,9 +305,7 @@ class Trainer:
         if not (cfg.checkpoint_dir and cfg.checkpoint_every_steps):
             return
         if global_step and global_step % cfg.checkpoint_every_steps == 0:
-            save_checkpoint(
-                cfg.checkpoint_dir, jax.device_get(self.state), global_step
-            )
+            self._ckpt.save(self.state, global_step)
 
     @staticmethod
     def _pick_eval_batch(ntest: int, granularity: int, target: int = 2048) -> int:
@@ -538,18 +549,11 @@ class Trainer:
                 if cfg.checkpoint_dir and cfg.checkpoint_every and (
                     (epoch + 1) % cfg.checkpoint_every == 0
                 ):
-                    save_checkpoint(
-                        cfg.checkpoint_dir,
-                        jax.device_get(self.state),
-                        self._global_step(),
-                    )
+                    self._ckpt.save(self.state, self._global_step())
 
         if cfg.checkpoint_dir:
-            save_checkpoint(
-                cfg.checkpoint_dir,
-                jax.device_get(self.state),
-                self._global_step(),
-            )
+            self._ckpt.save(self.state, self._global_step())
+            self._ckpt.wait()  # the final write must land before return
         if not (cfg.eval_every and cfg.epochs > start_epoch
                 and cfg.epochs % cfg.eval_every == 0):
             ntests, ncorrect = self.evaluate()

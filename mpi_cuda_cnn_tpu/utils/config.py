@@ -73,6 +73,12 @@ class Config:
                                   # saves, and --resume continues at the
                                   # exact step (bitwise — the shuffle
                                   # order is derived from (seed, epoch))
+    async_checkpoint: bool = True  # write checkpoints on a background
+                                  # worker (train/checkpoint.py
+                                  # AsyncCheckpointer): the step loop
+                                  # only pays the host snapshot, not the
+                                  # npz write; --no-async-checkpoint
+                                  # restores fully synchronous saves
     resume: bool = False
     log_every: int = 100          # steps; reference prints every 1000 samples
     profile_dir: str | None = None
@@ -119,12 +125,19 @@ class LMConfig:
                                      # meshes map these to ring_flash/ring;
                                      # 'ulysses' forces all-to-all SP)
     remat: bool = False
+    ce_chunk: int = 0                # >0: fused chunked cross-entropy
+                                     # (never materializes (B,S,V) f32
+                                     # logits; must divide seq_len).
+                                     # Plain/DP path only — the SP step
+                                     # computes its loss shard-local.
     device: str = "auto"
     num_devices: int = 0
     mesh_shape: str = "data"         # e.g. "data:2,seq:4"
 
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0
+    async_checkpoint: bool = True    # background checkpoint writes (see
+                                     # Config.async_checkpoint)
     resume: bool = False
     log_every: int = 20
 

@@ -1,0 +1,162 @@
+"""KV-cache decode benchmark: prefill + steady-state generation tokens/s.
+
+The training benches (bench_lm.py) measure the MXU-bound step; decode is
+the other regime — one token per forward, bound by reading the KV cache
+and weights from HBM. This bench times models/generate.py's real product
+path (prefill -> jitted decode scan) and shows the GQA effect: the cache
+is (B, max_seq, Hkv, D), so kv_heads < heads cuts cache reads by
+heads/kv_heads — the reason serving stacks use GQA (generate.init_cache).
+
+Timing: a generate(num_tokens=N) run costs fixed dispatch + prefill +
+N * per_token; timing N and 2N and reporting (T2N - TN)/N cancels the
+fixed and prefill parts exactly, leaving the steady-state per-token
+decode cost (the same two-point method as scripts/bench_lm.py, which
+measured ~100 ms fixed tunnel round-trips that would otherwise smear
+into the number). Prefill is timed separately on its own jitted
+function, also two-point (loops of n and 2n calls).
+
+Completion is forced with a HOST FETCH of real values, not
+block_until_ready (under this environment's remote-TPU tunnel the latter
+returns at enqueue — utils/sync.py).
+
+One JSON line per (kv_heads) config + a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_cnn_tpu.models.generate import generate, prefill
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.train.lm import count_params
+from mpi_cuda_cnn_tpu.utils.sync import hard_block as _force
+
+
+def bench_decode_config(model, *, batch, prompt_len, gen_tokens, seed=0):
+    params = model.init(jax.random.key(seed))
+    rng = np.random.default_rng(seed)
+    prompt = jnp.asarray(
+        rng.integers(0, model.vocab, (batch, prompt_len)), jnp.int32
+    )
+
+    def timed_gen(n):
+        t0 = time.perf_counter()
+        toks = generate(model, params, prompt, n)
+        _force(toks)
+        return time.perf_counter() - t0
+
+    # Warm both compile-cache entries, then two-point with min-of-2 per
+    # point (the minimum is the steady state; dispatch jitter only adds).
+    timed_gen(gen_tokens)
+    timed_gen(2 * gen_tokens)
+    t_n = min(timed_gen(gen_tokens), timed_gen(gen_tokens))
+    t_2n = min(timed_gen(2 * gen_tokens), timed_gen(2 * gen_tokens))
+    per_tok = (t_2n - t_n) / gen_tokens
+
+    # Prefill alone (jitted once here; generate()'s fused program includes
+    # it, which is exactly why the two-point difference above excludes it).
+    pf = jax.jit(lambda p, t: prefill(model, p, t)[0])
+    _force(pf(params, prompt))
+
+    def timed_pf(loops):
+        t0 = time.perf_counter()
+        for _ in range(loops):
+            out = pf(params, prompt)
+        _force(out)
+        return time.perf_counter() - t0
+
+    loops = 4
+    pf_n = timed_pf(loops)
+    pf_2n = timed_pf(2 * loops)
+    prefill_s = (pf_2n - pf_n) / loops
+    return per_tok, prefill_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=str, default="0,2,1",
+                    help="comma list; 0 = MHA, else GQA/MQA cache sizes")
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--max-seq", type=int, default=2048)
+    ap.add_argument("--prompt", type=int, default=1024)
+    ap.add_argument("--tokens", type=int, default=128,
+                    help="N for the two-point (N, 2N) decode timing; "
+                         "prompt + 2N must fit --max-seq")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    args = ap.parse_args()
+
+    if args.device == "cpu":
+        # In-process selection, like the CLI: the JAX_PLATFORMS env var can
+        # be intercepted by a pre-registered TPU plugin (see cli.py).
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu" and jax.default_backend() != "tpu":
+        print("--device=tpu requested but the backend is "
+              f"{jax.default_backend()}", file=sys.stderr)
+        raise SystemExit(1)
+    if args.prompt + 2 * args.tokens > args.max_seq:
+        print(f"prompt {args.prompt} + 2x{args.tokens} tokens exceeds "
+              f"--max-seq {args.max_seq}", file=sys.stderr)
+        raise SystemExit(1)
+
+    results = {}
+    for kv in (int(s) for s in args.kv_heads.split(",")):
+        model = TransformerLM(
+            vocab=args.vocab, dim=args.dim, heads=args.heads,
+            depth=args.depth, max_seq=args.max_seq, kv_heads=kv,
+        )
+        per_tok, prefill_s = bench_decode_config(
+            model, batch=args.batch, prompt_len=args.prompt,
+            gen_tokens=args.tokens,
+        )
+        hkv = model.n_kv
+        # f32 cache k+v bytes actually resident per decoded token's attention
+        cache_mb = (
+            args.batch * args.max_seq * hkv * model.head_dim * 4 * 2
+            * args.depth / 1e6
+        )
+        label = f"kv{hkv}" + ("(MHA)" if hkv == args.heads else "")
+        # A non-positive two-point delta means the per-token cost is below
+        # the timer's noise floor at these shapes — report null, never a
+        # negative throughput.
+        ok = per_tok > 0
+        results[label] = {
+            "decode_ms_per_tok": round(per_tok * 1e3, 3) if ok else None,
+            "decode_tokens_per_s": round(args.batch / per_tok) if ok else None,
+            "prefill_ms": round(prefill_s * 1e3, 2),
+            "cache_mb": round(cache_mb, 1),
+        }
+        print(json.dumps({
+            "bench": "lm_decode", "kv_heads": hkv,
+            "params": count_params(model.init(jax.random.key(0))),
+            **results[label],
+        }))
+
+    best = max(results.items(),
+               key=lambda kv_: kv_[1]["decode_tokens_per_s"] or 0)
+    print(json.dumps({
+        "metric": "decode_tokens_per_s",
+        "value": best[1]["decode_tokens_per_s"],
+        "unit": "tokens/s",
+        "config": best[0],
+        "model": f"d{args.dim}x{args.depth} h{args.heads} v{args.vocab} "
+                 f"b{args.batch} prompt{args.prompt} cache{args.max_seq}",
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

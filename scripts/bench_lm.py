@@ -42,11 +42,11 @@ PEAK_TFLOPS = {"tpu_v5e_bf16": 197.0, "tpu_v5e_f32": 49.0}
 
 
 def bench_config(model, *, batch, seq, compute_dtype, attn_impl,
-                 steps=20, warmup=3, seed=0):
+                 steps=20, warmup=3, seed=0, ce_chunk=0):
     opt = make_optimizer(3e-4, opt="adamw", schedule="constant")
     step_fn = make_lm_train_step(
         model, opt, attn_impl=attn_impl, seq_len=seq,
-        compute_dtype=compute_dtype, remat=False,
+        compute_dtype=compute_dtype, remat=False, ce_chunk=ce_chunk,
     )
     state = make_lm_state(model, opt, seed)
     rng = np.random.default_rng(seed)
@@ -106,6 +106,9 @@ def main():
                          "Default: v5e (197, f32 49)")
     ap.add_argument("--quick", action="store_true",
                     help="bf16+flash only (the headline config)")
+    ap.add_argument("--ce-chunk", type=int, default=0,
+                    help="chunked fused cross-entropy (train/lm.lm_loss): "
+                         "S-chunk size, 0 = dense (B,S,V) logits")
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     args = ap.parse_args()
 
@@ -159,6 +162,7 @@ def main():
         dt, loss = bench_config(
             model, batch=args.batch, seq=args.seq,
             compute_dtype=cd, attn_impl=impl, steps=args.steps,
+            ce_chunk=args.ce_chunk,
         )
         tok_s = tokens_per_step / dt
         mfu = (

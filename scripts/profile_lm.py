@@ -1,0 +1,195 @@
+"""Attribute the LM train-step wall-clock to its components.
+
+VERDICT round 2: "32.4% MFU is good; the remaining 68% is unexplained."
+This script explains it by ABLATION — each row times a program with one
+component removed or swapped, all with the same two-point method as
+scripts/bench_lm.py ((T2N - TN)/N cancels the fixed tunnel round-trip),
+completion forced by a host fetch:
+
+  full_step        fwd + bwd + AdamW update (the real train step)
+  fwd_only         loss forward alone -> bwd+update = full - fwd
+  fwd_identity_attn  forward with attention replaced by (q,k,v)->v
+                     -> attention fwd share = fwd_only - this
+  fwd_no_head      forward returning mean(features) (no head matmul, no
+                     CE) -> head+CE share = fwd_only - this
+  full_ce_chunked  the fused chunked-CE step (train/lm.lm_loss ce_chunk)
+                     -> what the (B,S,V) f32 logits materialization costs
+
+Differences of measurements, not a tracer: coarse (shares overlap where
+XLA fuses across seams) but honest, and enough to rank where the next
+milliseconds are. A jax.profiler trace dir can be captured alongside
+(--profile-dir) for manual inspection in TensorBoard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.train.lm import (
+    get_attn_fn,
+    lm_flops_per_token,
+    lm_loss,
+    make_lm_state,
+    make_lm_train_step,
+)
+from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+from mpi_cuda_cnn_tpu.utils.sync import hard_block as _force
+
+
+def _two_point(fn, steps):
+    """(T2N - TN)/N with a warmup; fn(n) must run n dependent iterations
+    and force completion."""
+    fn(2)  # compile + warm
+    t_n = fn(steps)
+    t_2n = fn(2 * steps)
+    return (t_2n - t_n) / steps
+
+
+def _timed_loop(step_fn, state0, *args):
+    def run(n):
+        state = state0
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            state, out = step_fn(state, *args)
+        _force(out)
+        return time.perf_counter() - t0
+
+    return run
+
+
+def _timed_fwd(loss_fn, params, *args):
+    def run(n):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(n):
+            # Chain through the loss scalar so iterations are dependent
+            # (XLA cannot elide or overlap them into one).
+            out = loss_fn(params, *args) + (acc if acc is not None else 0.0)
+            acc = out * 0.0
+        _force(out)
+        return time.perf_counter() - t0
+
+    return run
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--attn", default="flash", choices=["flash", "oracle"])
+    ap.add_argument("--ce-chunk", type=int, default=512)
+    ap.add_argument("--profile-dir", default=None,
+                    help="also capture a jax.profiler trace of one step")
+    ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    args = ap.parse_args()
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu" and jax.default_backend() != "tpu":
+        print("--device=tpu requested but the backend is "
+              f"{jax.default_backend()}", file=sys.stderr)
+        raise SystemExit(1)
+
+    cd = jnp.bfloat16 if args.dtype == "bfloat16" else None
+    model = TransformerLM(vocab=args.vocab, dim=args.dim, heads=args.heads,
+                          depth=args.depth, max_seq=args.seq)
+    opt = make_optimizer(3e-4, opt="adamw", schedule="constant")
+    state = make_lm_state(model, opt, 0)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(
+        rng.integers(0, model.vocab, (args.batch, args.seq + 1)), jnp.int32
+    )
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+    attn_fn = get_attn_fn(args.attn)
+
+    rows = {}
+
+    # full train step (fwd+bwd+update), dense CE — the bench_lm headline.
+    step = make_lm_train_step(model, opt, attn_impl=args.attn,
+                              seq_len=args.seq, compute_dtype=cd,
+                              donate=False)
+    rows["full_step"] = _two_point(_timed_loop(step, state, tokens, targets),
+                                   args.steps)
+
+    # fused chunked-CE step.
+    step_cc = make_lm_train_step(model, opt, attn_impl=args.attn,
+                                 seq_len=args.seq, compute_dtype=cd,
+                                 donate=False, ce_chunk=args.ce_chunk)
+    rows["full_ce_chunked"] = _two_point(
+        _timed_loop(step_cc, state, tokens, targets), args.steps
+    )
+
+    # forward-only ablations.
+    def fwd(attn, no_head):
+        if no_head:
+            def f(p, t, y):
+                feats = model.apply(p, t, attn_fn=attn, compute_dtype=cd,
+                                    return_features=True)
+                return jnp.mean(feats.astype(jnp.float32))
+        else:
+            def f(p, t, y):
+                return lm_loss(model, p, t, y, attn_fn=attn,
+                               compute_dtype=cd)
+        return jax.jit(f)
+
+    rows["fwd_only"] = _two_point(
+        _timed_fwd(fwd(attn_fn, False), state["params"], tokens, targets),
+        args.steps,
+    )
+    rows["fwd_identity_attn"] = _two_point(
+        _timed_fwd(fwd(lambda q, k, v: v, False), state["params"],
+                   tokens, targets),
+        args.steps,
+    )
+    rows["fwd_no_head"] = _two_point(
+        _timed_fwd(fwd(attn_fn, True), state["params"], tokens, targets),
+        args.steps,
+    )
+
+    if args.profile_dir:
+        with jax.profiler.trace(args.profile_dir):
+            _force(step(state, tokens, targets)[1])
+
+    ms = {k: round(v * 1e3, 2) for k, v in rows.items()}
+    derived = {
+        "bwd_update_ms": round(ms["full_step"] - ms["fwd_only"], 2),
+        "attn_fwd_ms": round(ms["fwd_only"] - ms["fwd_identity_attn"], 2),
+        "head_ce_fwd_ms": round(ms["fwd_only"] - ms["fwd_no_head"], 2),
+        "ce_chunk_delta_ms": round(
+            ms["full_ce_chunked"] - ms["full_step"], 2
+        ),
+    }
+    tokens_per_step = args.batch * args.seq
+    flops = lm_flops_per_token(model, args.seq) * tokens_per_step
+    print(json.dumps({
+        "bench": "lm_profile",
+        "model": f"d{args.dim}x{args.depth} h{args.heads} s{args.seq} "
+                 f"v{args.vocab} b{args.batch} {args.dtype}+{args.attn}",
+        **ms, **derived,
+        "tokens_per_s": round(tokens_per_step / rows["full_step"]),
+        "flops_per_step": flops,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,7 @@ import pytest
 from mpi_cuda_cnn_tpu.models.initializers import get_initializer
 from mpi_cuda_cnn_tpu.models.presets import get_model
 from mpi_cuda_cnn_tpu.train.checkpoint import (
+    AsyncCheckpointer,
     latest_checkpoint,
     restore_checkpoint,
     save_checkpoint,
@@ -56,3 +57,53 @@ def test_structure_mismatch_raises(tmp_path):
 
 def test_no_checkpoint_returns_none(tmp_path):
     assert latest_checkpoint(tmp_path / "void") is None
+
+
+@pytest.mark.parametrize("async_", [True, False])
+def test_async_checkpointer_matches_sync(tmp_path, async_):
+    """The background writer must produce byte-identical checkpoints to
+    the synchronous path; wait() guarantees the file has landed."""
+    state = _state()
+    ck = AsyncCheckpointer(tmp_path / "a", async_=async_)
+    ck.save(state, 3)
+    ck.save(state, 6)  # drains the first write before snapshotting
+    ck.wait()
+    assert latest_checkpoint(tmp_path / "a").name == "ckpt_6.npz"
+    restored = restore_checkpoint(
+        latest_checkpoint(tmp_path / "a"), _state(seed=1)
+    )
+    for a, b in zip(jax.tree.leaves(jax.device_get(state)),
+                    jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_async_checkpointer_snapshot_precedes_mutation(tmp_path):
+    """save() must snapshot synchronously: mutating (donating) the state
+    right after save() cannot corrupt the written checkpoint."""
+    state = {"a": jnp.arange(4, dtype=jnp.float32)}
+    ck = AsyncCheckpointer(tmp_path)
+    ck.save(state, 1)
+    state["a"] = state["a"] * 0 - 1  # "donated"/overwritten immediately
+    ck.wait()
+    restored = restore_checkpoint(
+        latest_checkpoint(tmp_path), {"a": jnp.zeros(4)}
+    )
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np.arange(4, dtype=np.float32))
+
+
+def test_async_checkpointer_propagates_errors(tmp_path):
+    """A failed background write re-raises at the next wait() — it cannot
+    pass silently."""
+    target = tmp_path / "f"
+    ck = AsyncCheckpointer(target)
+    ck.save(_state(), 1)
+    ck.wait()
+    # Make the directory unwritable by replacing it with a file.
+    import shutil
+
+    shutil.rmtree(target)
+    target.write_text("not a directory")
+    ck.save(_state(), 2)
+    with pytest.raises(OSError):
+        ck.wait()

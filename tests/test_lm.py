@@ -123,6 +123,36 @@ def test_pick_attn_impl_routing_table(monkeypatch):
     assert pick_attn_impl("flash", 2048, None) == "flash"
 
 
+@pytest.mark.parametrize("dtype", [None, jnp.bfloat16])
+def test_chunked_ce_matches_dense(dtype):
+    """ce_chunk fuses the head into a scanned chunked cross-entropy; it
+    must be an implementation choice, not a different loss: value AND
+    gradients match the dense (B,S,V)-logits path."""
+    from mpi_cuda_cnn_tpu.train.lm import lm_loss
+
+    params = MODEL.init(jax.random.key(1))
+    rng = np.random.default_rng(4)
+    toks = jnp.asarray(rng.integers(0, MODEL.vocab, (2, 33)), jnp.int32)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+
+    def loss(ce_chunk):
+        return lambda p: lm_loss(
+            MODEL, p, tokens, targets, compute_dtype=dtype,
+            ce_chunk=ce_chunk,
+        )
+
+    tol = dict(rtol=2e-5, atol=1e-6) if dtype is None else \
+        dict(rtol=2e-2, atol=2e-3)
+    l_dense, g_dense = jax.value_and_grad(loss(0))(params)
+    l_chunk, g_chunk = jax.value_and_grad(loss(8))(params)
+    np.testing.assert_allclose(float(l_dense), float(l_chunk), **tol)
+    for a, b in zip(jax.tree.leaves(g_dense), jax.tree.leaves(g_chunk)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **tol)
+
+    with pytest.raises(ValueError, match="must divide"):
+        loss(7)(params)
+
+
 def test_flops_accounting_scales():
     small = lm_flops_per_token(MODEL, 128)
     # Double depth ~= double the per-layer FLOPs share.

@@ -77,6 +77,48 @@ def test_mid_epoch_resume_is_bitwise_exact(tmp_path, scan):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.parametrize("mesh_shape", ["data:8", "pipe:2,data:2"])
+def test_mid_epoch_resume_under_mesh(tmp_path, mesh_shape, eight_devices):
+    """Elastic recovery where it will actually be used: the same
+    kill-at-step-6 / resume contract, bitwise, on a DP mesh and on a
+    PP x DP mesh (the state is sharded; restore must re-place it with
+    the live shardings, Trainer.place_state)."""
+    ds = synthetic_stripes(num_train=64, num_test=32)  # 4 steps/epoch
+    cfg_kw = dict(mesh_shape=mesh_shape, scan=True, num_devices=0)
+    cfg_kw["batch_size"] = 16  # divisible by data axis and microbatches
+
+    def params_of(t):
+        return jax.device_get(
+            t.state["flat_params"] if "flat_params" in t.state
+            else t.state["params"]
+        )
+
+    def mk(**kw):
+        c = _cfg(**cfg_kw, **kw)
+        return Trainer(get_model("reference_cnn"), ds, c, metrics=_quiet())
+
+    full = mk()
+    full.train()
+    want = params_of(full)
+
+    ck = tmp_path / "ck"
+    killed = mk(checkpoint_dir=str(ck), checkpoint_every_steps=3)
+    killed.train()
+    kept = ck / "ckpt_6.npz"
+    assert kept.exists(), sorted(p.name for p in ck.iterdir())
+    for p in ck.glob("ckpt_*.npz"):
+        if p != kept:
+            p.unlink()
+
+    resumed = mk(checkpoint_dir=str(ck), resume=True)
+    res = resumed.train()
+    got = params_of(resumed)
+
+    assert res.final_step == full._global_step()
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_scan_and_loop_paths_share_batch_order():
     """The derived (seed, epoch) order must make the scanned and per-batch
     paths interchangeable — same params after one epoch."""
