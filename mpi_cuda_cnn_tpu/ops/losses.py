@@ -30,3 +30,43 @@ def squared_error_total(probs: jnp.ndarray, onehot: jnp.ndarray) -> jnp.ndarray:
     """Reference's etotal metric (cnn.c:275-282): sum of squared residuals."""
     d = probs.astype(jnp.float32) - onehot
     return jnp.sum(d * d) / probs.shape[0]
+
+
+def chunked_ce_mean(feats, head, targets, ce_chunk: int,
+                    compute_dtype=None) -> jnp.ndarray:
+    """Mean next-token NLL from final-LN features WITHOUT materializing
+    the (B, S, V) f32 logits.
+
+    The head matmul runs in S-chunks of `ce_chunk` inside a lax.scan;
+    each chunk's logsumexp + target-logit reduce to (B, chunk) scalars
+    under jax.checkpoint, so backward recomputes the chunk logits
+    instead of saving them — peak extra memory O(B * chunk * V). Dense
+    logits at vocab 8k x s 2k x b 8 are 512 MB of HBM; at 32k+ vocab
+    they stop fitting at all. Numerics match the dense path: matmul in
+    compute dtype with f32 accumulation (preferred_element_type), the
+    softmax algebra in f32 (parity-tested, tests/test_lm.py).
+
+    feats: (B, S, d); head: (d, V) master (f32); targets: (B, S) int32.
+    Shard-local callers (parallel/sp.py) pass their local S — equal
+    shards make the pmean of per-shard means the global mean.
+    """
+    b, s, d = feats.shape
+    if s % ce_chunk:
+        raise ValueError(f"ce_chunk {ce_chunk} must divide seq len {s}")
+    n = s // ce_chunk
+    head = head.astype(compute_dtype) if compute_dtype else head
+
+    def chunk_nll(f_c, t_c):
+        logits = jnp.matmul(f_c, head, preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)               # (B, c)
+        tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return jnp.sum(lse - tgt)
+
+    chunk_nll = jax.checkpoint(chunk_nll)
+    fs = jnp.moveaxis(feats.reshape(b, n, ce_chunk, d), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(b, n, ce_chunk), 1, 0)
+    total, _ = jax.lax.scan(
+        lambda acc, ft: (acc + chunk_nll(*ft), None),
+        jnp.zeros((), jnp.float32), (fs, ts),
+    )
+    return total / (b * s)

@@ -107,6 +107,10 @@ def topk_dispatch(x, gate_w, n_experts: int, capacity: int, k: int = 2):
     dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
     combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
     used = jnp.zeros((n_experts,), jnp.float32)  # kept slots per expert
+    # Python loop over choices: unrolled at trace time, so the compiled
+    # program grows linearly in k. Fine for the MoE regimes this routing
+    # targets (k is 1 or 2 in every shipped config; even 4 is cheap); a
+    # lax.scan would only help at far larger k than any router uses.
     for j in range(k):
         onehot = jax.nn.one_hot(idx[:, j], n_experts, dtype=jnp.float32)
         pos = (jnp.cumsum(onehot, axis=0) - 1.0 + used[None, :]) * onehot

@@ -345,6 +345,7 @@ def make_sp_lm_train_step(
     remat: bool = False,
     moe_aux_weight: float = 0.01,
     compute_dtype=None,
+    ce_chunk: int = 0,
 ):
     """Jitted causal-LM train step with the sequence dim sharded on `axis`
     (long-context training: each device holds S/P tokens of activations)
@@ -356,6 +357,11 @@ def make_sp_lm_train_step(
     or Ulysses body with absolute positions recovered from the axis index.
     Gradients/metrics pmean over every populated mesh axis (they are
     means over tokens, and shards are equal-sized).
+
+    ce_chunk > 0 computes the shard-local loss with the fused chunked
+    cross-entropy (ops/losses.chunked_ce_mean) — the natural pairing for
+    long context, where even the SHARD-local (B, S/P, V) f32 logits are
+    large; must divide the per-shard sequence S/P.
 
     Returns step(state, tokens, targets) -> (state, {"loss": ...}).
     """
@@ -397,12 +403,31 @@ def make_sp_lm_train_step(
         pos_offset = lax.axis_index(axis) * s_local
         attn = partial(attn_body, axis=axis, causal=True)
 
+        if ce_chunk and s_local % ce_chunk:
+            raise ValueError(
+                f"ce_chunk {ce_chunk} must divide the per-shard sequence "
+                f"{s_local} (global S={s_local * n_seq} over {axis}="
+                f"{n_seq})"
+            )
+
         def loss_fn(params):
             # MoE blocks (if the model has any) run expert-parallel over
             # the SAME 'seq' axis the sequence is sharded on (EP x SP:
             # each device holds E/P experts AND S/P tokens;
             # parallel/ep.py's all_to_alls route between them). Dense
             # models return aux = 0.
+            if ce_chunk:
+                from ..ops.losses import chunked_ce_mean
+
+                feats, aux = model.apply(
+                    params, tokens, attn_fn=attn, pos_offset=pos_offset,
+                    remat=remat, moe_axis=axis, return_aux=True,
+                    compute_dtype=compute_dtype, return_features=True,
+                )
+                nll = chunked_ce_mean(
+                    feats, params["head"], targets, ce_chunk, compute_dtype
+                )
+                return nll + moe_aux_weight * aux
             logits, aux = model.apply(
                 params, tokens, attn_fn=attn, pos_offset=pos_offset,
                 remat=remat, moe_axis=axis, return_aux=True,

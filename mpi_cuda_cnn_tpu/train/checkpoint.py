@@ -96,6 +96,16 @@ class AsyncCheckpointer:
             fut, self._pending = self._pending, None
             fut.result()
 
+    def close(self) -> None:
+        """Drain and release the worker thread. Further save() calls fall
+        back to the synchronous path, so close() is safe mid-lifecycle
+        (trainers close at the end of train(); a later ad-hoc save still
+        works)."""
+        self.wait()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
 
 def _list_checkpoints(ckpt_dir: Path) -> list[Path]:
     found = [(int(m.group(1)), p) for p in ckpt_dir.glob("ckpt_*.npz")

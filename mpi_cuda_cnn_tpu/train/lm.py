@@ -96,39 +96,17 @@ def lm_loss(
     ce_chunk must divide S; 0 keeps the dense path.
     """
     if ce_chunk:
+        from ..ops.losses import chunked_ce_mean
+
         feats, aux = model.apply(
             params, tokens, attn_fn=attn_fn, remat=remat,
             compute_dtype=compute_dtype, return_aux=True,
             return_features=True,
         )
-        b, s, d = feats.shape
-        if s % ce_chunk:
-            raise ValueError(f"ce_chunk {ce_chunk} must divide seq len {s}")
-        n = s // ce_chunk
-        head = params["head"].astype(compute_dtype) if compute_dtype \
-            else params["head"]
-
-        def chunk_nll(f_c, t_c):
-            # (B, c, d) @ (d, V) in compute dtype, f32 accumulation via
-            # preferred_element_type (same numerics contract as the dense
-            # head matmul, which also feeds an f32 softmax).
-            logits = jnp.matmul(
-                f_c, head, preferred_element_type=jnp.float32
-            )
-            lse = jax.nn.logsumexp(logits, axis=-1)           # (B, c)
-            tgt = jnp.take_along_axis(
-                logits, t_c[..., None], axis=-1
-            )[..., 0]
-            return jnp.sum(lse - tgt)
-
-        chunk_nll = jax.checkpoint(chunk_nll)
-        fs = jnp.moveaxis(feats.reshape(b, n, ce_chunk, d), 1, 0)
-        ts = jnp.moveaxis(targets.reshape(b, n, ce_chunk), 1, 0)
-        total, _ = jax.lax.scan(
-            lambda acc, ft: (acc + chunk_nll(*ft), None),
-            jnp.zeros((), jnp.float32), (fs, ts),
+        nll = chunked_ce_mean(
+            feats, params["head"], targets, ce_chunk, compute_dtype
         )
-        return total / (b * s) + moe_aux_weight * aux
+        return nll + moe_aux_weight * aux
     logits, aux = model.apply(
         params, tokens, attn_fn=attn_fn, remat=remat,
         compute_dtype=compute_dtype, return_aux=True,

@@ -135,11 +135,11 @@ class LMTrainer:
         self._compute_dtype = compute_dtype
 
         if self.n_seq > 1:
-            if cfg.ce_chunk:
+            if cfg.ce_chunk and (cfg.seq_len // self.n_seq) % cfg.ce_chunk:
                 raise ValueError(
-                    "--ce-chunk applies to the plain/DP step only; the "
-                    "SP step computes its loss shard-local over the seq "
-                    "axis (drop the flag or the 'seq' mesh axis)"
+                    f"--ce-chunk {cfg.ce_chunk} must divide the per-shard "
+                    f"sequence {cfg.seq_len // self.n_seq} (seq_len "
+                    f"{cfg.seq_len} over seq:{self.n_seq})"
                 )
             impl = cfg.attn_impl
             if impl in ("auto", "flash"):
@@ -154,6 +154,7 @@ class LMTrainer:
                 self.model, self.optimizer, self.mesh, impl=impl,
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
                 remat=cfg.remat, compute_dtype=compute_dtype,
+                ce_chunk=cfg.ce_chunk,
             )
         else:
             self.attn_impl = pick_attn_impl(
@@ -243,7 +244,7 @@ class LMTrainer:
         loss = float(m["loss"]) if m is not None else loss
         if cfg.checkpoint_dir:
             self._ckpt.save(self.state, cfg.steps)
-            self._ckpt.wait()  # the final write must land before eval/return
+            self._ckpt.close()  # final write lands; worker thread released
 
         eval_loss = self.evaluate()
         tok_s = steps_run * cfg.batch_size * cfg.seq_len / max(dt, 1e-9)

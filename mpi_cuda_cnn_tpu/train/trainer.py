@@ -553,7 +553,7 @@ class Trainer:
 
         if cfg.checkpoint_dir:
             self._ckpt.save(self.state, self._global_step())
-            self._ckpt.wait()  # the final write must land before return
+            self._ckpt.close()  # final write lands; worker thread released
         if not (cfg.eval_every and cfg.epochs > start_epoch
                 and cfg.epochs % cfg.eval_every == 0):
             ntests, ncorrect = self.evaluate()

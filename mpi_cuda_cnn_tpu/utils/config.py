@@ -127,9 +127,9 @@ class LMConfig:
     remat: bool = False
     ce_chunk: int = 0                # >0: fused chunked cross-entropy
                                      # (never materializes (B,S,V) f32
-                                     # logits; must divide seq_len).
-                                     # Plain/DP path only — the SP step
-                                     # computes its loss shard-local.
+                                     # logits). Must divide seq_len — the
+                                     # PER-SHARD seq_len under a 'seq'
+                                     # mesh axis (shard-local chunked CE).
     device: str = "auto"
     num_devices: int = 0
     mesh_shape: str = "data"         # e.g. "data:2,seq:4"

@@ -113,7 +113,13 @@ def main():
         raise SystemExit(1)
 
     results = {}
-    for kv in (int(s) for s in args.kv_heads.split(",")):
+    # Normalize requested kv values to their effective head count (0 means
+    # MHA = heads) and dedupe, so e.g. "--kv-heads 0,8" with --heads 8
+    # runs once instead of silently overwriting its own results row.
+    kvs = list(dict.fromkeys(
+        (int(s) or args.heads) for s in args.kv_heads.split(",")
+    ))
+    for kv in kvs:
         model = TransformerLM(
             vocab=args.vocab, dim=args.dim, heads=args.heads,
             depth=args.depth, max_seq=args.max_seq, kv_heads=kv,

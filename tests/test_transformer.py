@@ -80,6 +80,29 @@ def test_sp_step_parity_with_single_device(impl):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_sp_step_with_chunked_ce_matches_dense():
+    """ce_chunk under SP (shard-local fused CE, ops/losses.chunked_ce_mean)
+    must be placement, not math: one SP step with ce_chunk == the same SP
+    step with the dense shard-local logits."""
+    mesh = make_mesh({SEQ_AXIS: 8}, devices=jax.devices()[:8])
+    params = MODEL.init(jax.random.key(5))
+    opt = optax.sgd(0.1)
+    inputs, targets = _data(batch=2, s=65)  # 8 positions per shard
+    state = {"params": params, "opt_state": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
+
+    outs = {}
+    for chunk in (0, 4):
+        step = make_sp_lm_train_step(MODEL, opt, mesh, impl="ring",
+                                     donate=False, ce_chunk=chunk)
+        new_state, metrics = step(state, inputs, targets)
+        outs[chunk] = (float(metrics["loss"]), new_state["params"])
+    np.testing.assert_allclose(outs[0][0], outs[4][0], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs[0][1]), jax.tree.leaves(outs[4][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_sp_step_parity_ring_flash():
     """impl='ring_flash': the fused-kernel ring inside a REAL train step
     (value_and_grad through the custom VJP, optimizer update) matches the
