@@ -15,6 +15,7 @@ native:
 test_native: native
 	$(MAKE) -C native test
 	$(MAKE) -C native test_abi
+	$(MAKE) -C native test_abi_lm
 
 # C driver -> embedded JAX -> the real chip (run on a TPU host).
 test_native_tpu: native

@@ -63,6 +63,48 @@ def evaluate() -> str:
     return json.dumps({"ntests": ntests, "ncorrect": ncorrect})
 
 
+def lm_init(config_json: str) -> str:
+    """LM twin of init(): build an LMTrainer from an LMConfig JSON.
+
+    The C driver's `lm` mode drives the SAME product loop the Python
+    `lm` subcommand uses (train/lm_trainer.py) — one implementation of
+    corpus loading, the mesh dispatch, and checkpointing, reachable from
+    both front ends.
+    """
+    from .cli import _select_device
+    from .train.lm_trainer import LMTrainer
+    from .utils.config import LMConfig
+    from .utils.logging import MetricsLogger, get_logger
+
+    cfg = LMConfig.from_json(config_json)
+    if not _select_device(cfg, get_logger()):
+        raise RuntimeError(f"device {cfg.device!r} unavailable")
+    trainer = LMTrainer(cfg, metrics=MetricsLogger(echo=False))
+    from .train.lm import count_params
+
+    _STATE["lm"] = trainer
+    return json.dumps({
+        "ok": True,
+        "vocab": trainer.model.vocab,
+        "n_params": count_params(trainer.state["params"]),
+    })
+
+
+def lm_train() -> str:
+    """Run the configured LM training (cfg.steps optimizer steps, eval at
+    the end) and return the LMResult as one JSON line."""
+    import dataclasses
+
+    if "lm" not in _STATE:
+        raise RuntimeError("runtime_abi.lm_init() not called")
+    res = _STATE["lm"].train()
+    out = dataclasses.asdict(res)
+    out["tokens_per_s"] = round(out["tokens_per_s"], 1)
+    for k in ("final_loss", "eval_loss", "eval_ppl"):
+        out[k] = round(out[k], 4)
+    return json.dumps(out)
+
+
 def save(path: str) -> str:
     from .train.checkpoint import save_checkpoint
 

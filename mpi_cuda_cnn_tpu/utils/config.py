@@ -17,8 +17,20 @@ import sys
 from pathlib import Path
 
 
+class _JsonConfig:
+    """JSON round-trip shared by both config families (the C ABI's wire
+    format, native/tpu_abi.h)."""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str):
+        return cls(**json.loads(text))
+
+
 @dataclasses.dataclass
-class Config:
+class Config(_JsonConfig):
     # Data: either a registered dataset name, or the reference's 4 IDX paths.
     dataset: str = "synthetic"
     data_dir: str | None = None
@@ -84,16 +96,9 @@ class Config:
     profile_dir: str | None = None
     eval_every: int = 1           # epochs
 
-    def to_json(self) -> str:
-        return json.dumps(dataclasses.asdict(self), indent=2)
-
-    @classmethod
-    def from_json(cls, text: str) -> "Config":
-        return cls(**json.loads(text))
-
 
 @dataclasses.dataclass
-class LMConfig:
+class LMConfig(_JsonConfig):
     """Config for the `lm` subcommand (train/lm_trainer.py) — the
     long-context model family's product surface: transformer size,
     corpus, parallelism mesh (data/seq axes), MoE, attention impl."""
@@ -140,6 +145,7 @@ class LMConfig:
                                      # Config.async_checkpoint)
     resume: bool = False
     log_every: int = 20
+
 
 
 def build_lm_parser() -> argparse.ArgumentParser:

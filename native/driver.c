@@ -15,6 +15,12 @@
  *     --epochs=N --lr=F --batch=N --seed=N --log-every=N
  *     --golden-dir=DIR      (cpu only: dump parity fixtures and exit)
  *     --save=DIR --load=DIR (embedded runtime only: checkpoint round-trip)
+ *
+ *   mctpu lm [options]     — the LM family through the same embedded
+ *     runtime (mct_tpu_lm_init/lm_train -> train/lm_trainer.py):
+ *     --device=tpu|jax|jax-cpu --corpus=STR --dim=N --depth=N --heads=N
+ *     --seq-len=N --steps=N --batch=N --lr=F --seed=N --mesh-shape=STR
+ *     --compute-dtype=float32|bfloat16
  */
 #include "mct.h"
 #include "tpu_abi.h"
@@ -175,8 +181,90 @@ toolong:
     return 100;
 }
 
+static int run_lm(int argc, char **argv)
+{
+    /* Defaults mirror utils/config.py::LMConfig where the C driver sets
+     * them at all; everything else falls to the dataclass defaults. */
+    const char *device = "jax-cpu", *corpus = "synthetic";
+    const char *mesh = "data", *dtype = "float32";
+    int dim = 64, depth = 2, heads = 4, seq = 128, steps = 50, batch = 4;
+    double lr = 3e-4;
+    long long seed = 0;
+
+    for (int i = 2; i < argc; i++) {
+        const char *s = argv[i];
+        if (strncmp(s, "--device=", 9) == 0) device = s + 9;
+        else if (strncmp(s, "--corpus=", 9) == 0) corpus = s + 9;
+        else if (strncmp(s, "--mesh-shape=", 13) == 0) mesh = s + 13;
+        else if (strncmp(s, "--compute-dtype=", 16) == 0) dtype = s + 16;
+        else if (strncmp(s, "--dim=", 6) == 0) dim = atoi(s + 6);
+        else if (strncmp(s, "--depth=", 8) == 0) depth = atoi(s + 8);
+        else if (strncmp(s, "--heads=", 8) == 0) heads = atoi(s + 8);
+        else if (strncmp(s, "--seq-len=", 10) == 0) seq = atoi(s + 10);
+        else if (strncmp(s, "--steps=", 8) == 0) steps = atoi(s + 8);
+        else if (strncmp(s, "--batch=", 8) == 0) batch = atoi(s + 8);
+        else if (strncmp(s, "--lr=", 5) == 0) lr = atof(s + 5);
+        else if (strncmp(s, "--seed=", 7) == 0) seed = atoll(s + 7);
+        else {
+            fprintf(stderr, "mct: unknown lm option %s\n", s);
+            return 100;
+        }
+    }
+    if (dim < 1 || depth < 1 || heads < 1 || seq < 2 || steps < 1 ||
+        batch < 1 || lr <= 0.0) {
+        fprintf(stderr, "mct: invalid lm hyperparameters\n");
+        return 100;
+    }
+    const char *dev = strcmp(device, "jax-cpu") == 0 ? "cpu"
+                    : strcmp(device, "tpu") == 0 ? "tpu" : "auto";
+
+    /* Every user string goes through json_escape_into — a quote or
+     * backslash in any of them must not be able to break out of its
+     * JSON value (no key injection past the C-side validation). */
+    char cfg[2048], buf[1024];
+    size_t pos = 0;
+    const char *svals[3] = {corpus, mesh, dtype};
+    const char *skeys[3] = {"corpus", "mesh_shape", "compute_dtype"};
+    pos += (size_t)snprintf(cfg + pos, sizeof cfg - pos, "{");
+    for (int i = 0; i < 3; i++) {
+        int nw = snprintf(cfg + pos, sizeof cfg - pos,
+                          "%s\"%s\":\"", i ? "," : "", skeys[i]);
+        if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
+            goto toolong;
+        pos += (size_t)nw;
+        if (json_escape_into(cfg, sizeof cfg, &pos, svals[i]))
+            goto toolong;
+        if (pos + 2 >= sizeof cfg)
+            goto toolong;
+        cfg[pos++] = '"';
+        cfg[pos] = '\0';
+    }
+    {
+        int nw = snprintf(cfg + pos, sizeof cfg - pos,
+            ",\"dim\":%d,\"depth\":%d,\"heads\":%d,\"seq_len\":%d,"
+            "\"steps\":%d,\"batch_size\":%d,\"lr\":%g,\"seed\":%lld,"
+            "\"device\":\"%s\",\"log_every\":0,\"lr_schedule\":"
+            "\"constant\",\"warmup_steps\":0}",
+            dim, depth, heads, seq, steps, batch, lr, seed, dev);
+        if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
+            goto toolong;
+    }
+    if (mct_tpu_lm_init(cfg))
+        return 1;
+    if (mct_tpu_lm_train(buf, sizeof buf))
+        return 1;
+    fprintf(stderr, "mct[lm]: %s\n", buf);
+    mct_tpu_shutdown();
+    return 0;
+toolong:
+    fprintf(stderr, "mct: lm config too long\n");
+    return 100;
+}
+
 int main(int argc, char **argv)
 {
+    if (argc > 1 && strcmp(argv[1], "lm") == 0)
+        return run_lm(argc, argv);
     Args a;
     if (parse_args(argc, argv, &a)) {
         fprintf(stderr,

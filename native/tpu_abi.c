@@ -34,8 +34,12 @@ static int call_str_ret(const char *fn, const char *arg, char *buf, int buflen)
     return 0;
 }
 
-int mct_tpu_init(const char *config_json)
+/* Bring up the embedded interpreter + runtime module once; shared by the
+ * CNN and LM init entry points. */
+static int ensure_runtime(void)
 {
+    if (g_mod)
+        return 0;
     if (!Py_IsInitialized()) {
         /* Honor PYTHONPATH etc. so the venv's site-packages resolve; the
          * build target and README document the expected environment. */
@@ -51,6 +55,13 @@ int mct_tpu_init(const char *config_json)
                 "(set PYTHONPATH to the repo root)\n");
         return -1;
     }
+    return 0;
+}
+
+int mct_tpu_init(const char *config_json)
+{
+    if (ensure_runtime())
+        return -1;
     return call_str_ret("init", config_json, NULL, 0);
 }
 
@@ -72,6 +83,18 @@ int mct_tpu_save(const char *path)
 int mct_tpu_load(const char *path)
 {
     return call_str_ret("load", path, NULL, 0);
+}
+
+int mct_tpu_lm_init(const char *config_json)
+{
+    if (ensure_runtime())
+        return -1;
+    return call_str_ret("lm_init", config_json, NULL, 0);
+}
+
+int mct_tpu_lm_train(char *buf, int buflen)
+{
+    return call_str_ret("lm_train", NULL, buf, buflen);
 }
 
 int mct_tpu_shutdown(void)

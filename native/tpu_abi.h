@@ -26,6 +26,14 @@ int mct_tpu_eval(char *buf, int buflen);
 int mct_tpu_save(const char *path);
 int mct_tpu_load(const char *path);
 
+/* LM family (the long-context transformer, train/lm_trainer.py):
+ * lm_init takes an LMConfig JSON (utils/config.py::LMConfig); lm_train
+ * runs the configured steps + eval and writes the result JSON
+ * ({"steps_run":..,"final_loss":..,"eval_ppl":..,"tokens_per_s":..})
+ * into buf. Uses the same embedded runtime as the CNN entry points. */
+int mct_tpu_lm_init(const char *config_json);
+int mct_tpu_lm_train(char *buf, int buflen);
+
 /* Tear down the embedded runtime. */
 int mct_tpu_shutdown(void);
 
