@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode test test_all test_serial test_dp8 test_sp8 test_ep8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode profile_lm test test_all test_serial test_dp8 test_sp8 test_ep8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -105,6 +105,11 @@ bench_lm:
 # MHA vs GQA vs MQA cache sizes (two-point timing; scripts/bench_decode.py).
 bench_decode:
 	$(PY) scripts/bench_decode.py
+
+# Step-time attribution by ablation (full vs fwd-only vs identity-attn vs
+# no-head vs chunked-CE) — where the LM step's milliseconds go.
+profile_lm:
+	$(PY) scripts/profile_lm.py
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

@@ -90,6 +90,25 @@ static int json_escape_into(char *dst, size_t cap, size_t *pos, const char *src)
     return 0;
 }
 
+/* Append `,"key":"<escaped val>"` (no leading comma when first) to the
+ * JSON being built in dst. Returns 0, or -1 on overflow. */
+static int append_json_str(char *dst, size_t cap, size_t *pos,
+                           const char *key, const char *val, int first)
+{
+    int nw = snprintf(dst + *pos, cap - *pos, "%s\"%s\":\"",
+                      first ? "" : ",", key);
+    if (nw < 0 || *pos + (size_t)nw >= cap)
+        return -1;
+    *pos += (size_t)nw;
+    if (json_escape_into(dst, cap, pos, val))
+        return -1;
+    if (*pos + 2 >= cap)
+        return -1;
+    dst[(*pos)++] = '"';
+    dst[*pos] = '\0';
+    return 0;
+}
+
 static int run_cpu(const Args *a)
 {
     McDataset ds;
@@ -136,18 +155,9 @@ static int run_tpu(const Args *a)
     const char *keys[4] = {"train_images", "train_labels",
                            "test_images", "test_labels"};
     pos += (size_t)snprintf(cfg + pos, sizeof cfg - pos, "{\"dataset\":\"idx\"");
-    for (int i = 0; i < 4; i++) {
-        int nw = snprintf(cfg + pos, sizeof cfg - pos, ",\"%s\":\"", keys[i]);
-        if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
+    for (int i = 0; i < 4; i++)
+        if (append_json_str(cfg, sizeof cfg, &pos, keys[i], a->paths[i], 0))
             goto toolong;
-        pos += (size_t)nw;
-        if (json_escape_into(cfg, sizeof cfg, &pos, a->paths[i]))
-            goto toolong;
-        if (pos + 2 >= sizeof cfg)
-            goto toolong;
-        cfg[pos++] = '"';
-        cfg[pos] = '\0';
-    }
     {
         int nw = snprintf(cfg + pos, sizeof cfg - pos,
                           ",\"model\":\"%s\",\"epochs\":%d,\"lr\":%g,"
@@ -218,7 +228,7 @@ static int run_lm(int argc, char **argv)
     const char *dev = strcmp(device, "jax-cpu") == 0 ? "cpu"
                     : strcmp(device, "tpu") == 0 ? "tpu" : "auto";
 
-    /* Every user string goes through json_escape_into — a quote or
+    /* Every user string goes through append_json_str — a quote or
      * backslash in any of them must not be able to break out of its
      * JSON value (no key injection past the C-side validation). */
     char cfg[2048], buf[1024];
@@ -226,19 +236,10 @@ static int run_lm(int argc, char **argv)
     const char *svals[3] = {corpus, mesh, dtype};
     const char *skeys[3] = {"corpus", "mesh_shape", "compute_dtype"};
     pos += (size_t)snprintf(cfg + pos, sizeof cfg - pos, "{");
-    for (int i = 0; i < 3; i++) {
-        int nw = snprintf(cfg + pos, sizeof cfg - pos,
-                          "%s\"%s\":\"", i ? "," : "", skeys[i]);
-        if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
+    for (int i = 0; i < 3; i++)
+        if (append_json_str(cfg, sizeof cfg, &pos, skeys[i], svals[i],
+                            i == 0))
             goto toolong;
-        pos += (size_t)nw;
-        if (json_escape_into(cfg, sizeof cfg, &pos, svals[i]))
-            goto toolong;
-        if (pos + 2 >= sizeof cfg)
-            goto toolong;
-        cfg[pos++] = '"';
-        cfg[pos] = '\0';
-    }
     {
         int nw = snprintf(cfg + pos, sizeof cfg - pos,
             ",\"dim\":%d,\"depth\":%d,\"heads\":%d,\"seq_len\":%d,"

@@ -148,28 +148,43 @@ def main():
     backend = jax.default_backend()
     mfu_valid = backend == "tpu" or args.peak_tflops is not None
 
-    configs = [("bfloat16", "flash")]
-    if not args.quick:
+    # (dtype, attn, ce_chunk) rows. The default matrix ends with the
+    # fused chunked-CE variant of the headline config so the dense-vs-
+    # chunked comparison is measured in the same run; --ce-chunk applies
+    # its value to EVERY row instead.
+    if args.quick:
+        configs = [("bfloat16", "flash", args.ce_chunk)]
+    elif args.ce_chunk:
         configs = [
-            ("float32", "oracle"), ("float32", "flash"),
-            ("bfloat16", "oracle"), ("bfloat16", "flash"),
+            ("float32", "oracle", args.ce_chunk),
+            ("float32", "flash", args.ce_chunk),
+            ("bfloat16", "oracle", args.ce_chunk),
+            ("bfloat16", "flash", args.ce_chunk),
+        ]
+    else:
+        ce_default = 512 if args.seq % 512 == 0 else args.seq
+        configs = [
+            ("float32", "oracle", 0), ("float32", "flash", 0),
+            ("bfloat16", "oracle", 0), ("bfloat16", "flash", 0),
+            ("bfloat16", "flash", ce_default),
         ]
 
     results = {}
     nparams = count_params(model.init(jax.random.key(0)))
-    for dtype_name, impl in configs:
+    for dtype_name, impl, ce in configs:
         cd = jnp.bfloat16 if dtype_name == "bfloat16" else None
         dt, loss = bench_config(
             model, batch=args.batch, seq=args.seq,
             compute_dtype=cd, attn_impl=impl, steps=args.steps,
-            ce_chunk=args.ce_chunk,
+            ce_chunk=ce,
         )
         tok_s = tokens_per_step / dt
         mfu = (
             round(flops_per_step / dt / (peak_for(dtype_name) * 1e12), 4)
             if mfu_valid else None
         )
-        results[f"{dtype_name}+{impl}"] = {
+        key = f"{dtype_name}+{impl}" + (f"+ce{ce}" if ce else "")
+        results[key] = {
             "step_ms": round(dt * 1e3, 2),
             "tokens_per_s": round(tok_s),
             "mfu": mfu,
@@ -177,7 +192,7 @@ def main():
         }
         print(json.dumps({
             "bench": "lm_pretrain", "dtype": dtype_name, "attn": impl,
-            **results[f"{dtype_name}+{impl}"],
+            "ce_chunk": ce, **results[key],
         }))
 
     best = max(results.items(), key=lambda kv: kv[1]["tokens_per_s"])

@@ -1,0 +1,59 @@
+#!/bin/sh
+# One-command TPU measurement capture — run the moment the backend is
+# healthy. Every step has its own timeout (a dead axon tunnel HANGS at
+# init rather than erroring), appends to PERF_capture.jsonl, and a
+# failure of one step does not stop the rest. Order: cheapest probe
+# first, then the VERDICT round-3 captures:
+#   1. backend probe (matmul compiles + runs)
+#   2. GQA flash 5-D grid check (compile + parity + perf; VERDICT #2)
+#   3. bench.py            (headline epoch; VERDICT #1)
+#   4. bench_lm full matrix incl. fused-CE row (MFU table at HEAD)
+#   5. bench_lm d=1024 config (MXU saturation lever; VERDICT #3)
+#   6. bench_lm MoE row    (one measured MoE number; VERDICT #7)
+#   7. bench_decode        (KV-cache tokens/s, GQA cache win; VERDICT #5)
+#   8. profile_lm          (step-time attribution; VERDICT #3)
+#   9. make -C native test_tpu  (C driver on the chip)
+# Usage:  sh scripts/tpu_capture.sh   (from the repo root)
+
+set -u
+OUT=PERF_capture.jsonl
+note() { printf '{"capture_step": "%s", "rc": %d, "utc": "%s"}\n' \
+         "$1" "$2" "$(date -u +%FT%TZ)" >> "$OUT"; }
+
+step() {  # step <name> <timeout_s> <cmd...>
+    name=$1; secs=$2; shift 2
+    echo "== $name (timeout ${secs}s) ==" >&2
+    timeout "$secs" "$@" >> "$OUT" 2>> capture.log
+    rc=$?
+    note "$name" "$rc"
+    return $rc
+}
+
+: > capture.log
+echo "# capture $(date -u +%FT%TZ)" >> "$OUT"
+
+step probe 300 python -c "
+import jax, jax.numpy as jnp, json
+x = jnp.ones((1024,1024), jnp.bfloat16)
+(x@x).block_until_ready()
+print(json.dumps({'probe': 'ok', 'backend': jax.default_backend()}))" \
+    || { echo 'backend unreachable; aborting capture' >&2; exit 1; }
+
+step gqa_flash_check 900 python scripts/check_gqa_flash.py
+step bench_epoch 600 python bench.py
+step bench_lm 1200 python scripts/bench_lm.py
+step bench_lm_d1024 900 python scripts/bench_lm.py --quick --dim 1024 \
+    --depth 8 --heads 16 --batch 4
+step bench_lm_d1024_ce 900 python scripts/bench_lm.py --quick --dim 1024 \
+    --depth 8 --heads 16 --batch 4 --ce-chunk 512
+step bench_lm_moe 900 python scripts/bench_lm.py --quick --moe-experts 8 \
+    --moe-top-k 2
+step bench_decode 900 python scripts/bench_decode.py
+step profile_lm 900 python scripts/profile_lm.py
+# make prints recipes/compiler lines on stdout — keep the JSONL clean by
+# sending this step's stdout to the log; its result is the note() line.
+echo "== native_tpu (timeout 900s) ==" >&2
+timeout 900 make -C native test_tpu >> capture.log 2>&1
+note native_tpu $?
+
+echo "capture done; results in $OUT, stderr in capture.log" >&2
