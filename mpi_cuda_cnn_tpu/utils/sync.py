@@ -30,3 +30,20 @@ def hard_block(tree):
         smallest = min(leaves, key=lambda l: getattr(l, "size", 0))
         np.asarray(jax.device_get(smallest))
     return tree
+
+
+def two_point(run, n: int, *, warmup: int = 1) -> float:
+    """Per-iteration time via (T(2n) - T(n)) / n.
+
+    `run(k)` must execute k DEPENDENT iterations (so XLA cannot overlap
+    or elide them), force completion (hard_block), and return elapsed
+    seconds. The difference cancels every fixed per-call cost — through
+    this environment's remote-TPU tunnel that is a ~100 ms dispatch
+    round-trip per timed window, which a naive T(n)/n would smear across
+    the iterations (PERF.md "Methodology notes"). The warmup call
+    absorbs compilation for both point sizes' cache entries when run(k)
+    compiles per distinct k (callers with per-k programs should warm
+    both sizes themselves).
+    """
+    run(max(warmup, 1))
+    return (run(2 * n) - run(n)) / n

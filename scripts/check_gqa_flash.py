@@ -36,7 +36,7 @@ from mpi_cuda_cnn_tpu.ops.attention import (
     repeat_kv,
 )
 from mpi_cuda_cnn_tpu.ops.pallas_attention import flash_attention
-from mpi_cuda_cnn_tpu.utils.sync import hard_block
+from mpi_cuda_cnn_tpu.utils.sync import hard_block, two_point
 
 
 def _two_point(fn, n):
@@ -48,8 +48,7 @@ def _two_point(fn, n):
         hard_block(out)
         return time.perf_counter() - t0
 
-    run(1)  # compile + warm
-    return (run(2 * n) - run(n)) / n
+    return two_point(run, n)
 
 
 def check_config(*, b, h, hkv, s, d, dtype, bwd, rng):

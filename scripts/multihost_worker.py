@@ -13,6 +13,10 @@ Usage (one line per "host"):
 mode "cnn" (default): the DP CNN step. mode "lm": RING sequence
 parallelism for the transformer LM over the GLOBAL mesh — the k/v blocks
 ppermute across the OS-process boundary (multi-host long context).
+mode "pp": GPipe pipeline parallelism with the stage boundary ON the
+process boundary — a ('pipe': 2, 'data': gdev/2) mesh places stage 0's
+devices in process 0 and stage 1's in process 1, so every microbatch
+activation (and its cotangent in backward) ppermutes between processes.
 
 Every process feeds the SAME global batch (the reference's every-rank-
 loads-the-full-dataset pattern, cnnmpi.c:426-454, made correct); the
@@ -63,6 +67,8 @@ def main() -> int:
 
     if mode == "lm":
         return _lm_main(info)
+    if mode == "pp":
+        return _pp_main(info)
 
     from mpi_cuda_cnn_tpu.models.initializers import get_initializer
     from mpi_cuda_cnn_tpu.models.presets import get_model
@@ -130,6 +136,54 @@ def _lm_main(info) -> int:
     rng = np.random.default_rng(7)  # same seed everywhere -> same tokens
     toks = jnp.asarray(rng.integers(0, 13, (2, 8 * gdev + 1)), jnp.int32)
     _, metrics = step(state, toks[:, :-1], toks[:, 1:])
+    jax.block_until_ready(metrics)
+    print(
+        f"MHOK pid={info.process_index} procs={info.process_count} "
+        f"gdev={gdev} loss={float(metrics['loss']):.6f}",
+        flush=True,
+    )
+    return 0
+
+
+def _pp_main(info) -> int:
+    """2-stage GPipe across the process boundary: with 2 processes and
+    the 'pipe' axis outermost, stage 0 lives entirely in process 0 and
+    stage 1 in process 1 — the forward activation handoff and the
+    backward cotangent handoff both cross OS processes (the multi-host
+    pipeline path; the reference never pipelined at all)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, make_mesh
+    from mpi_cuda_cnn_tpu.parallel.pp import (
+        make_pipeline_plan,
+        make_pp_state,
+        make_pp_train_step,
+        microbatch,
+        pp_shard_batch,
+    )
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+
+    gdev = info.global_devices
+    mesh = make_mesh({PIPE_AXIS: 2, DATA_AXIS: gdev // 2})
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(0), get_initializer("normal"))
+    optimizer = make_optimizer(0.1)
+    plan = make_pipeline_plan(model, 2)
+    state = make_pp_state(plan, params, optimizer, mesh)
+    step = make_pp_train_step(plan, optimizer, mesh, state, donate=False)
+
+    batch = 2 * gdev  # divisible by M x data-axis = 2 x gdev/2
+    rng = np.random.default_rng(7)  # same seed everywhere -> same batch
+    x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
+    y = np.zeros((batch, 10), np.float32)
+    y[np.arange(batch), rng.integers(0, 10, batch)] = 1.0
+    x_mb, y_mb = pp_shard_batch(microbatch(x, jnp.asarray(y), 2), mesh)
+
+    state, metrics = step(state, x_mb, y_mb)
     jax.block_until_ready(metrics)
     print(
         f"MHOK pid={info.process_index} procs={info.process_count} "

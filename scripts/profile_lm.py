@@ -45,15 +45,11 @@ from mpi_cuda_cnn_tpu.train.lm import (
 )
 from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
 from mpi_cuda_cnn_tpu.utils.sync import hard_block as _force
+from mpi_cuda_cnn_tpu.utils.sync import two_point
 
 
 def _two_point(fn, steps):
-    """(T2N - TN)/N with a warmup; fn(n) must run n dependent iterations
-    and force completion."""
-    fn(2)  # compile + warm
-    t_n = fn(steps)
-    t_2n = fn(2 * steps)
-    return (t_2n - t_n) / steps
+    return two_point(fn, steps, warmup=2)
 
 
 def _timed_loop(step_fn, state0, *args):

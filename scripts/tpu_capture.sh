@@ -9,10 +9,11 @@
 #   3. bench.py            (headline epoch; VERDICT #1)
 #   4. bench_lm full matrix incl. fused-CE row (MFU table at HEAD)
 #   5. bench_lm d=1024 config (MXU saturation lever; VERDICT #3)
-#   6. bench_lm MoE row    (one measured MoE number; VERDICT #7)
-#   7. bench_decode        (KV-cache tokens/s, GQA cache win; VERDICT #5)
-#   8. profile_lm          (step-time attribution; VERDICT #3)
-#   9. make -C native test_tpu  (C driver on the chip)
+#   6. bench_lm d=1024 + fused chunked CE (the two levers together)
+#   7. bench_lm MoE row    (one measured MoE number; VERDICT #7)
+#   8. bench_decode        (KV-cache tokens/s, GQA cache win; VERDICT #5)
+#   9. profile_lm          (step-time attribution; VERDICT #3)
+#  10. make -C native test_tpu  (C driver on the chip)
 # Usage:  sh scripts/tpu_capture.sh   (from the repo root)
 
 set -u

@@ -68,3 +68,11 @@ def test_two_process_ring_sp_lm_step():
     LM's k/v blocks ppermute through all 8 global devices split over 2
     processes (multi-host long context, GQA + rope included)."""
     _run_two_process("lm")
+
+
+def test_two_process_pipeline_step():
+    """GPipe with the stage boundary ON the process boundary: the 'pipe'
+    axis is outermost, so stage 0 is process 0 and stage 1 is process 1 —
+    forward activations and backward cotangents ppermute between OS
+    processes."""
+    _run_two_process("pp")
