@@ -348,6 +348,26 @@ class Trainer:
         shardings = jax.tree.map(lambda a: a.sharding, self.state)
         self.state = jax.device_put(host_state, shardings)
 
+    def _use_scan(self) -> bool:
+        """Scanned epochs stage the WHOLE uint8 training set in HBM; for
+        datasets past --scan-max-bytes that is the wrong trade — fall back
+        to the streaming per-batch path (host feeds one batch per step),
+        which bounds device memory at O(batch) regardless of dataset
+        size. Identical math either way (test_scan_and_loop_paths_...)."""
+        if not self.cfg.scan:
+            return False
+        nbytes = self.ds.train_images.nbytes + 4 * self.num_train
+        if nbytes > self.cfg.scan_max_bytes:
+            if not getattr(self, "_scan_fallback_logged", False):
+                self._scan_fallback_logged = True
+                self.log.warning(
+                    "dataset is %.1f GiB > --scan-max-bytes %.1f GiB: "
+                    "streaming per-batch epochs instead of HBM staging",
+                    nbytes / 2**30, self.cfg.scan_max_bytes / 2**30,
+                )
+            return False
+        return True
+
     def run_epoch(self, epoch: int, *, skip_steps: int = 0) -> dict:
         """Run one epoch of the jitted step over the whole training set.
 
@@ -360,7 +380,7 @@ class Trainer:
         stays async (the reference blocks on every sample by construction;
         we must not).
         """
-        if self.cfg.scan:
+        if self._use_scan():
             return self._run_epoch_scanned(epoch, skip_steps=skip_steps)
         cfg = self.cfg
         t0 = time.perf_counter()

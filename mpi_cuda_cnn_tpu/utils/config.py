@@ -77,6 +77,12 @@ class Config(_JsonConfig):
     scan: bool = True             # many-steps-per-dispatch epochs (lax.scan
                                   # over an HBM-resident dataset); off =
                                   # one dispatch per batch
+    scan_max_bytes: int = 2 << 30  # datasets above this fall back to the
+                                  # streaming per-batch path (the scanned
+                                  # epoch stages the whole uint8 set in
+                                  # HBM — perfect for MNIST/CIFAR, wrong
+                                  # for larger-than-HBM corpora); raise
+                                  # it to force staging anyway
 
     # Aux subsystems.
     checkpoint_dir: str | None = None

@@ -39,12 +39,15 @@ from mpi_cuda_cnn_tpu.ops.pallas_attention import flash_attention
 from mpi_cuda_cnn_tpu.utils.sync import hard_block, two_point
 
 
-def _two_point(fn, n):
+def _two_point(fn, n, carry0):
+    """fn(c) -> (out, c'): each iteration consumes the previous carry, so
+    the dispatches are DEPENDENT (two_point's contract — independent
+    dispatches could overlap and under-measure the per-iteration time)."""
     def run(k):
         t0 = time.perf_counter()
-        out = None
+        c, out = carry0, None
         for _ in range(k):
-            out = fn()
+            out, c = fn(c)
         hard_block(out)
         return time.perf_counter() - t0
 
@@ -56,8 +59,13 @@ def check_config(*, b, h, hkv, s, d, dtype, bwd, rng):
     k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), dtype)
     v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), dtype)
 
-    fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
-    out = hard_block(fwd(q, k, v))  # the compile that must not fail
+    # c is a zero scalar threaded through iterations purely as a data
+    # dependency (q + c is numerically q).
+    fwd = jax.jit(
+        lambda q, k, v, c: flash_attention(q + c, k, v, True)
+    )
+    zero = jnp.zeros((), dtype)
+    out = hard_block(fwd(q, k, v, zero))  # the compile that must not fail
 
     # Parity vs the oracle (repeat_kv handles GQA). The quadratic oracle
     # materializes an O(S^2) score tensor — ~2 GB at s=8192 — so large s
@@ -80,16 +88,25 @@ def check_config(*, b, h, hkv, s, d, dtype, bwd, rng):
     rel = err / ref
     ok = rel < tol
 
-    t_fwd = _two_point(lambda: fwd(q, k, v), 3)
+    def fwd_step(c):
+        o = fwd(q, k, v, c)
+        return o, o[0, 0, 0, 0] * 0
+
+    t_fwd = _two_point(fwd_step, 3, zero)
     t_bwd = None
     if bwd:
         grad = jax.jit(jax.grad(
-            lambda q, k, v: jnp.sum(flash_attention(q, k, v, True)
-                                    .astype(jnp.float32) ** 2),
+            lambda q, k, v, c: jnp.sum(flash_attention(q + c, k, v, True)
+                                       .astype(jnp.float32) ** 2),
             argnums=(0, 1, 2),
         ))
-        hard_block(grad(q, k, v))
-        t_bwd = _two_point(lambda: grad(q, k, v), 3)
+        hard_block(grad(q, k, v, zero))
+
+        def bwd_step(c):
+            g = grad(q, k, v, c)
+            return g, g[0][0, 0, 0, 0] * 0
+
+        t_bwd = _two_point(bwd_step, 3, zero)
     return {
         "s": s, "kv_heads": hkv, "dtype": str(jnp.dtype(dtype)),
         "parity_rel_err": round(rel, 6), "parity_ok": ok,

@@ -31,6 +31,33 @@ import sys
 sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parent.parent))
 
 
+def _synthetic_batch(batch):
+    """Same seed in every process -> the SAME global batch everywhere (the
+    reference's every-rank-loads-the-full-dataset pattern, made correct)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
+    y = np.zeros((batch, 10), np.float32)
+    y[np.arange(batch), rng.integers(0, 10, batch)] = 1.0
+    return x, jnp.asarray(y)
+
+
+def _print_mhok(info, metrics) -> int:
+    """The one line tests/test_multihost.py greps; metrics are replicated
+    (P() out-specs), so float() is safe in every process."""
+    import jax
+
+    jax.block_until_ready(metrics)
+    print(
+        f"MHOK pid={info.process_index} procs={info.process_count} "
+        f"gdev={info.global_devices} loss={float(metrics['loss']):.6f}",
+        flush=True,
+    )
+    return 0
+
+
 def main() -> int:
     pid, nproc = int(sys.argv[1]), int(sys.argv[2])
     coordinator = sys.argv[3]
@@ -92,21 +119,11 @@ def main() -> int:
     )
     step = make_dp_train_step(make_loss_fn(model), optimizer, mesh, donate=False)
 
-    batch = 2 * info.global_devices
-    rng = np.random.default_rng(7)  # same seed everywhere -> same batch
-    x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
-    y = np.zeros((batch, 10), np.float32)
-    y[np.arange(batch), rng.integers(0, 10, batch)] = 1.0
-    xs, ys = dp_shard_batch((x, jnp.asarray(y)), mesh)
+    x, y = _synthetic_batch(2 * info.global_devices)
+    xs, ys = dp_shard_batch((x, y), mesh)
 
     state, metrics = step(state, xs, ys)
-    jax.block_until_ready(metrics)
-    print(
-        f"MHOK pid={info.process_index} procs={info.process_count} "
-        f"gdev={info.global_devices} loss={float(metrics['loss']):.6f}",
-        flush=True,
-    )
-    return 0
+    return _print_mhok(info, metrics)
 
 
 def _lm_main(info) -> int:
@@ -136,13 +153,7 @@ def _lm_main(info) -> int:
     rng = np.random.default_rng(7)  # same seed everywhere -> same tokens
     toks = jnp.asarray(rng.integers(0, 13, (2, 8 * gdev + 1)), jnp.int32)
     _, metrics = step(state, toks[:, :-1], toks[:, 1:])
-    jax.block_until_ready(metrics)
-    print(
-        f"MHOK pid={info.process_index} procs={info.process_count} "
-        f"gdev={gdev} loss={float(metrics['loss']):.6f}",
-        flush=True,
-    )
-    return 0
+    return _print_mhok(info, metrics)
 
 
 def _pp_main(info) -> int:
@@ -176,21 +187,11 @@ def _pp_main(info) -> int:
     state = make_pp_state(plan, params, optimizer, mesh)
     step = make_pp_train_step(plan, optimizer, mesh, state, donate=False)
 
-    batch = 2 * gdev  # divisible by M x data-axis = 2 x gdev/2
-    rng = np.random.default_rng(7)  # same seed everywhere -> same batch
-    x = jnp.asarray(rng.random((batch, 28, 28, 1), np.float32))
-    y = np.zeros((batch, 10), np.float32)
-    y[np.arange(batch), rng.integers(0, 10, batch)] = 1.0
-    x_mb, y_mb = pp_shard_batch(microbatch(x, jnp.asarray(y), 2), mesh)
+    x, y = _synthetic_batch(2 * gdev)  # divisible by M x data = 2 x gdev/2
+    x_mb, y_mb = pp_shard_batch(microbatch(x, y, 2), mesh)
 
     state, metrics = step(state, x_mb, y_mb)
-    jax.block_until_ready(metrics)
-    print(
-        f"MHOK pid={info.process_index} procs={info.process_count} "
-        f"gdev={gdev} loss={float(metrics['loss']):.6f}",
-        flush=True,
-    )
-    return 0
+    return _print_mhok(info, metrics)
 
 
 if __name__ == "__main__":

@@ -134,6 +134,25 @@ def test_scan_and_loop_paths_share_batch_order():
                                    rtol=1e-6, atol=1e-7)
 
 
+def test_scan_falls_back_for_oversized_datasets():
+    """A dataset past --scan-max-bytes must stream per-batch (O(batch)
+    device memory) instead of staging the whole set in HBM — same math,
+    different residency; the fallback is a size check, not a crash."""
+    ds = synthetic_stripes(num_train=64, num_test=32)
+    t_small = Trainer(get_model("reference_cnn"), ds, _cfg(epochs=1),
+                      metrics=_quiet())
+    assert t_small._use_scan()
+    t_big = Trainer(get_model("reference_cnn"), ds,
+                    _cfg(epochs=1, scan_max_bytes=1), metrics=_quiet())
+    assert not t_big._use_scan()
+    em = t_big.run_epoch(0)  # runs the streaming path end to end
+    assert np.isfinite(em["loss"])
+    # Explicit --no-scan is unconditional.
+    assert not Trainer(get_model("reference_cnn"), ds,
+                       _cfg(epochs=1, scan=False),
+                       metrics=_quiet())._use_scan()
+
+
 def test_epoch_order_is_stateless():
     ds = synthetic_stripes(num_train=64, num_test=32)
     t1 = Trainer(get_model("reference_cnn"), ds, _cfg(), metrics=_quiet())
