@@ -150,6 +150,7 @@ class Trainer:
             momentum=config.momentum,
             schedule=config.lr_schedule,
             total_steps=total_steps or None,
+            grad_clip=config.grad_clip,
         )
 
         # One keyed init, replicated to every device (fixes the reference's
@@ -348,6 +349,13 @@ class Trainer:
         shardings = jax.tree.map(lambda a: a.sharding, self.state)
         self.state = jax.device_put(host_state, shardings)
 
+    def _dataset_bytes(self) -> int:
+        """What the scanned path would stage: uint8 pixels + int32 labels."""
+        return self.ds.train_images.nbytes + 4 * self.num_train
+
+    def _oversized(self) -> bool:
+        return self._dataset_bytes() > self.cfg.scan_max_bytes
+
     def _use_scan(self) -> bool:
         """Scanned epochs stage the WHOLE uint8 training set in HBM; for
         datasets past --scan-max-bytes that is the wrong trade — fall back
@@ -356,14 +364,14 @@ class Trainer:
         size. Identical math either way (test_scan_and_loop_paths_...)."""
         if not self.cfg.scan:
             return False
-        nbytes = self.ds.train_images.nbytes + 4 * self.num_train
-        if nbytes > self.cfg.scan_max_bytes:
+        if self._oversized():
             if not getattr(self, "_scan_fallback_logged", False):
                 self._scan_fallback_logged = True
                 self.log.warning(
                     "dataset is %.1f GiB > --scan-max-bytes %.1f GiB: "
                     "streaming per-batch epochs instead of HBM staging",
-                    nbytes / 2**30, self.cfg.scan_max_bytes / 2**30,
+                    self._dataset_bytes() / 2**30,
+                    self.cfg.scan_max_bytes / 2**30,
                 )
             return False
         return True
@@ -388,9 +396,19 @@ class Trainer:
         nsteps = 0
         order = self._epoch_order(epoch)
         b = cfg.batch_size
+        # Oversized datasets normalize PER BATCH: the cached train_x/train_y
+        # copies are a 4x float32 blow-up of the whole set — the exact host
+        # materialization this path exists to avoid (see _use_scan).
+        stream = self._oversized()
+        labels = np.asarray(self.ds.train_labels) if stream else None
         for start in range(skip_steps * b, self.num_train - self.num_train % b, b):
             idx = order[start : start + b]
-            batch = self._place_batch(self.train_x[idx], self.train_y[idx])
+            if stream:
+                bx = normalize_images(self.ds.train_images[idx])
+                by = one_hot(labels[idx], self.ds.num_classes)
+            else:
+                bx, by = self.train_x[idx], self.train_y[idx]
+            batch = self._place_batch(bx, by)
             self.state, m = self.train_step(self.state, *batch)
             running = m if running is None else jax.tree.map(jnp.add, running, m)
             nsteps += 1

@@ -46,6 +46,8 @@ class Config(_JsonConfig):
     batch_size: int = 32          # cnn.c:449 (accumulator period)
     momentum: float = 0.0
     lr_schedule: str = "constant"  # constant | cosine
+    grad_clip: float = 0.0        # global-norm clip; 0 (default) disables
+                                  # (same knob as the lm subcommand's)
     seed: int = 0                 # cnn.c:413 srand(0)
     init: str = "normal"          # normal | irwin_hall (reference nrnd, cnn.c:46-49)
     augment: str = "none"         # none | shift | shift-flip (data/augment.py;

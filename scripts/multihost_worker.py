@@ -90,7 +90,6 @@ def main() -> int:
     assert info.process_count == nproc, info
 
     import jax.numpy as jnp
-    import numpy as np
 
     if mode == "lm":
         return _lm_main(info)
