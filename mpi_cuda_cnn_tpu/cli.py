@@ -108,6 +108,15 @@ def run_lm(argv: list[str]) -> int:
         "done: steps=%d eval_ppl=%.3f tokens/s=%.0f",
         result.steps_run, result.eval_ppl, result.tokens_per_s,
     )
+    if cfg.sample_tokens:
+        _, cont = trainer.sample(
+            cfg.sample_tokens, temperature=cfg.sample_temperature,
+            seed=cfg.seed,
+        )
+        # Char-level corpora (self / file / synthetic-mod-251) decode as
+        # bytes; anything out of byte range prints as escapes.
+        text = bytes(int(t) & 0xFF for t in cont)
+        log.info("sample (%d tokens): %r", cfg.sample_tokens, text)
     return 0
 
 

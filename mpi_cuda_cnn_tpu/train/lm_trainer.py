@@ -88,6 +88,14 @@ class LMTrainer:
                 f"corpus ({len(tokens)} tokens) shorter than --seq-len "
                 f"{cfg.seq_len}"
             )
+        # Validate the post-training sample request NOW — its failure
+        # after an hours-long run would lose the run's whole purpose.
+        if cfg.sample_tokens < 0 or cfg.sample_tokens >= cfg.seq_len:
+            raise ValueError(
+                f"--sample-tokens {cfg.sample_tokens} must be in "
+                f"[0, seq_len {cfg.seq_len}) — the prompt needs >= 1 "
+                f"position of the decode budget"
+            )
 
         self.model = TransformerLM(
             vocab=vocab, dim=cfg.dim, heads=cfg.heads, depth=cfg.depth,
@@ -261,6 +269,38 @@ class LMTrainer:
         )
 
     # ------------------------------------------------------------------
+
+    def sample(self, num_tokens: int, *, prompt_len: int | None = None,
+               temperature: float = 0.0, seed: int = 0):
+        """Generate a continuation of the held-out stream with the
+        KV-cache decode path (models/generate.py) — the product surface
+        of inference: prompt from the eval tail, greedy by default.
+
+        Returns (prompt, continuation) as int32 numpy arrays; the CLI
+        decodes them as bytes for char-level corpora.
+        """
+        from ..models.generate import generate
+
+        cfg = self.cfg
+        max_prompt = cfg.seq_len - num_tokens
+        if max_prompt < 1:
+            raise ValueError(
+                f"--sample-tokens {num_tokens} leaves no room for a prompt "
+                f"within seq_len {cfg.seq_len}"
+            )
+        p = min(prompt_len or max(cfg.seq_len // 2, 1), max_prompt)
+        stream = (
+            self.eval_tokens if len(self.eval_tokens) >= p
+            else self.train_tokens
+        )
+        prompt = jnp.asarray(np.asarray(stream[:p])[None, :], jnp.int32)
+        params = jax.device_get(self.state["params"])
+        toks = generate(
+            self.model, params, prompt, num_tokens,
+            temperature=temperature,
+            key=jax.random.key(seed) if temperature > 0 else None,
+        )
+        return np.asarray(prompt[0]), np.asarray(toks[0])
 
     def evaluate(self) -> float:
         """Mean next-token NLL over deterministic windows of the held-out

@@ -153,6 +153,11 @@ class LMConfig(_JsonConfig):
                                      # Config.async_checkpoint)
     resume: bool = False
     log_every: int = 20
+    sample_tokens: int = 0           # >0: after training, generate this
+                                     # many tokens from the held-out
+                                     # stream with the KV-cache decode
+                                     # path and print the continuation
+    sample_temperature: float = 0.0  # 0 = greedy argmax
 
 
 

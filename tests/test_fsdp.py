@@ -128,7 +128,8 @@ def test_fsdp_pp_matches_plain_pp(scan, eight_devices):
 
 def test_fsdp_pp_state_is_row_sharded(eight_devices):
     """The memory claim: each device holds 1/n_data of its stage's packed
-    row (params AND optimizer buffers), not the full row."""
+    row (params AND optimizer buffers), not the full row. Eval must work
+    off the sharded rows too (make_pp_forward gathers them over 'data')."""
     ds = synthetic_stripes(num_train=64, num_test=32)
     cfg = Config(batch_size=32, fsdp=True, mesh_shape="pipe:2,data:4",
                  epochs=1, eval_every=0, log_every=0)
@@ -138,3 +139,5 @@ def test_fsdp_pp_state_is_row_sharded(eight_devices):
     assert p_max % 4 == 0
     shard = flat.addressable_shards[0].data
     assert shard.shape == (S // 2, p_max // 4)
+    ntests, ncorrect = t.evaluate()
+    assert ntests == 32 and 0 <= ncorrect <= ntests

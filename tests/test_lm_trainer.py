@@ -97,5 +97,24 @@ def test_cli_lm_subcommand():
         "--depth", "1", "--heads", "4", "--seq-len", "64", "--steps", "5",
         "--batch-size", "2", "--log-every", "0", "--num-devices", "1",
         "--lr-schedule", "constant", "--warmup-steps", "0",
+        "--sample-tokens", "8",
     ])
     assert rc == 0
+
+
+def test_sample_generates_within_budget():
+    """sample() runs the KV-cache decode path off the trained state:
+    right length, tokens in-vocab, deterministic at temperature 0."""
+    t = LMTrainer(_cfg(steps=3), metrics=MetricsLogger(echo=False))
+    t.train()
+    p, c = t.sample(8)
+    p2, c2 = t.sample(8)
+    assert len(c) == 8 and c.dtype == np.int32
+    assert len(p) + len(c) <= t.cfg.seq_len
+    assert (c >= 0).all() and (c < t.model.vocab).all()
+    np.testing.assert_array_equal(c, c2)  # greedy = deterministic
+    with pytest.raises(ValueError, match="no room"):
+        t.sample(t.cfg.seq_len)
+    # A bad --sample-tokens must fail at SETUP, not after training.
+    with pytest.raises(ValueError, match="sample-tokens"):
+        LMTrainer(_cfg(sample_tokens=64), metrics=MetricsLogger(echo=False))
