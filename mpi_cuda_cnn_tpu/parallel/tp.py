@@ -175,6 +175,104 @@ def make_tp_scan_epoch(
     return jax.jit(epoch, donate_argnums=(0,) if donate else ())
 
 
+def lm_tp_specs(model, mesh, axis: str = MODEL_AXIS) -> dict:
+    """PartitionSpec pytree for a TransformerLM's params — Megatron-style
+    placement expressed as GSPMD shardings (the same design as
+    tp_param_specs for the CNN family; models/transformer.py init):
+
+    - attention/MLP input projections (wqkv | wq/wkv, w1): OUTPUT features
+      over `axis` (column parallel);
+    - attention output / MLP down projections (wo, w2): INPUT dim over
+      `axis` (row parallel — their activation input is already sharded
+      from the previous matmul, so XLA's partitioner keeps the pair
+      collective-free until the residual add's reduce);
+    - token embedding + head: vocab dim over `axis` (the classic
+      vocab-parallel embedding; the loss's full-vocab softmax makes XLA
+      insert the logit gather/reduce);
+    - layernorms, positional table, MoE gate: replicated;
+    - MoE experts: hidden dim over `axis` (w1 (E,d,4d) column, w2 (E,4d,d)
+      row) — TP inside every expert.
+
+    Any dim not divisible by the axis size falls back to replicated for
+    that leaf — the step stays correct (GSPMD), just less sharded.
+    """
+    n = mesh.shape.get(axis, 1)
+
+    def shard(dim_index):
+        """P sharding dimension `dim_index` of a leaf, if divisible."""
+        def spec(leaf):
+            if n <= 1 or leaf.ndim == 0:
+                return P()
+            i = dim_index % leaf.ndim
+            if leaf.shape[i] % n:
+                return P()
+            e = [None] * leaf.ndim
+            e[i] = axis
+            return P(*e)
+        return spec
+
+    # Shapes only — eval_shape traces init without materializing a second
+    # full parameter set (callers already hold the real params).
+    params = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    col, row = shard(-1), shard(-2)
+    vocab0 = shard(0)
+
+    def block_specs(blk):
+        s = {
+            "ln1": jax.tree.map(lambda _: P(), blk["ln1"]),
+            "ln2": jax.tree.map(lambda _: P(), blk["ln2"]),
+            "wo": row(blk["wo"]),
+        }
+        if "wqkv" in blk:
+            s["wqkv"] = col(blk["wqkv"])
+        else:
+            s["wq"] = col(blk["wq"])
+            s["wkv"] = col(blk["wkv"])
+        if "moe" in blk:
+            s["moe"] = {
+                "gate": P(),
+                "w1": col(blk["moe"]["w1"]),
+                "w2": row(blk["moe"]["w2"]),
+            }
+        else:
+            s["w1"] = col(blk["w1"])
+            s["w2"] = row(blk["w2"])
+        return s
+
+    specs = {
+        "tok_emb": vocab0(params["tok_emb"]),
+        "ln_f": jax.tree.map(lambda _: P(), params["ln_f"]),
+        "head": col(params["head"]),
+        "blocks": [block_specs(b) for b in params["blocks"]],
+    }
+    if "pos_emb" in params:
+        specs["pos_emb"] = P()
+    return specs
+
+
+def make_lm_tp_state(model, params, optimizer, mesh,
+                     axis: str = MODEL_AXIS) -> TrainState:
+    """LM train state with TP-sharded params (lm_tp_specs); the optimizer
+    state inherits the shardings leaf-for-leaf. Use with the PLAIN jitted
+    LM step (train/lm.make_lm_train_step) — GSPMD derives the collectives
+    from the placement, exactly like the CNN make_tp_state path."""
+    specs = lm_tp_specs(model, mesh, axis)
+    params = jax.device_put(
+        params,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
+        ),
+    }
+
+
 def shard_batch_2d(batch, mesh, axis: str = DATA_AXIS):
     """Shard a host batch's leading dim over 'data' (replicated over
     'model'): every model-group works on the same samples."""

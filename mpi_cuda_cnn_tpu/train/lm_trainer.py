@@ -23,7 +23,7 @@ import numpy as np
 
 from ..models.transformer import TransformerLM
 from ..parallel.dp import replicate
-from ..parallel.mesh import DATA_AXIS, make_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
 from ..parallel.sp import SEQ_AXIS, make_sp_lm_train_step
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.sync import hard_block
@@ -112,6 +112,14 @@ class LMTrainer:
         self.mesh = mesh
         self.n_seq = self.mesh.shape.get(SEQ_AXIS, 1)
         self.n_data = self.mesh.shape.get(DATA_AXIS, 1)
+        self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
+        if self.n_model > 1 and self.n_seq > 1:
+            raise ValueError(
+                "the LM's 'model' (GSPMD tensor-parallel) and 'seq' "
+                "(shard_map sequence-parallel) axes do not compose yet; "
+                "pick one (TP x DP: data:N,model:M — SP x DP: "
+                "data:N,seq:M)"
+            )
         if cfg.batch_size % self.n_data:
             raise ValueError(
                 f"batch_size {cfg.batch_size} not divisible by data-axis "
@@ -173,9 +181,21 @@ class LMTrainer:
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
             )
-        self.state = replicate(
-            make_lm_state(self.model, self.optimizer, cfg.seed), self.mesh
-        )
+        if self.n_model > 1:
+            # Megatron-style TP as GSPMD placement (parallel/tp.py
+            # lm_tp_specs): the SAME plain jitted step, params sharded
+            # over 'model' — XLA inserts the collectives.
+            from ..parallel.tp import make_lm_tp_state
+
+            params = self.model.init(jax.random.key(cfg.seed))
+            self.state = make_lm_tp_state(
+                self.model, params, self.optimizer, self.mesh
+            )
+        else:
+            self.state = replicate(
+                make_lm_state(self.model, self.optimizer, cfg.seed),
+                self.mesh,
+            )
         self._eval_fn = None
         self._ckpt = (
             AsyncCheckpointer(cfg.checkpoint_dir,
