@@ -130,6 +130,20 @@ class LMTrainer:
                 f"seq_len {cfg.seq_len} not divisible by seq-axis size "
                 f"{self.n_seq}"
             )
+        if cfg.fsdp:
+            # Structural mesh checks belong here, before any step/optimizer
+            # construction — the user should see the mesh error first.
+            if self.n_seq > 1:
+                raise ValueError(
+                    "--fsdp shards params over 'data' via GSPMD and does "
+                    "not compose with the shard_map SP step; drop the "
+                    "'seq' axis or --fsdp"
+                )
+            if self.n_data <= 1:
+                raise ValueError(
+                    "--fsdp needs a 'data' mesh axis of size > 1 "
+                    f"(mesh_shape={cfg.mesh_shape!r})"
+                )
 
         # Cosine needs positive decay_steps: clamp warmup only when it
         # would swallow the whole (short) run, and say so.
@@ -181,7 +195,24 @@ class LMTrainer:
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
             )
-        if self.n_model > 1:
+        if cfg.fsdp:
+            # ZeRO-style sharding for the LM — the same generic spec
+            # machinery as the CNN path (parallel/fsdp.py); with a
+            # 'model' axis present the TP specs are the base and 'data'
+            # takes the largest remaining dim (FSDP x TP). Mesh shape
+            # was validated up front with the other structural checks.
+            from ..parallel.fsdp import make_fsdp_state
+
+            base = None
+            if self.n_model > 1:
+                from ..parallel.tp import lm_tp_specs
+
+                base = lm_tp_specs(self.model, self.mesh)
+            params = self.model.init(jax.random.key(cfg.seed))
+            self.state = make_fsdp_state(
+                params, self.optimizer, self.mesh, base_specs=base
+            )
+        elif self.n_model > 1:
             # Megatron-style TP as GSPMD placement (parallel/tp.py
             # lm_tp_specs): the SAME plain jitted step, params sharded
             # over 'model' — XLA inserts the collectives.

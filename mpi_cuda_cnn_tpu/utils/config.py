@@ -138,6 +138,11 @@ class LMConfig(_JsonConfig):
                                      # meshes map these to ring_flash/ring;
                                      # 'ulysses' forces all-to-all SP)
     remat: bool = False
+    fsdp: bool = False               # ZeRO-style: shard LM params +
+                                     # optimizer state over 'data'
+                                     # (parallel/fsdp.py — generic specs;
+                                     # composes with 'model' TP, rejects
+                                     # a 'seq' axis)
     ce_chunk: int = 0                # >0: fused chunked cross-entropy
                                      # (never materializes (B,S,V) f32
                                      # logits). Must divide seq_len — the
