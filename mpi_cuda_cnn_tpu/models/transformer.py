@@ -135,6 +135,74 @@ class TransformerLM:
             params["blocks"].append(blk)
         return params
 
+    def apply_block(
+        self,
+        blk: dict,
+        x: jnp.ndarray,                # (B, S, dim) activations
+        *,
+        pos: jnp.ndarray,              # (S,) absolute positions
+        attn,                          # (q, k, v) -> o attention callable
+        compute_dtype=None,
+        moe_axis: str | None = None,
+        moe_inference: bool = False,
+    ):
+        """One pre-LN block: attention + MLP (or MoE) with residuals.
+
+        Factored out of apply() so pipeline parallelism (parallel/pp_lm.py)
+        can scan the SAME block computation over its stage's stacked
+        params — one implementation of the block math for every layout.
+        Returns (x, aux) with aux the MoE balance loss (0 for dense).
+        """
+        b, s, _ = x.shape
+        h, hd, hkv = self.heads, self.head_dim, self.n_kv
+        cd = compute_dtype
+        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+
+        y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+        if hkv == h:
+            qkv = y @ w(blk["wqkv"])                # (B, S, 3*dim)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = y @ w(blk["wq"])                    # (B, S, dim)
+            kv = y @ w(blk["wkv"])                  # (B, S, 2*hkv*hd)
+            k, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, hkv, hd)
+        v = v.reshape(b, s, hkv, hd)
+        if self.pos == "rope":
+            q = rope(q, pos)
+            k = rope(k, pos)
+        o = attn(q, k, v).reshape(b, s, h * hd)
+        x = x + (o.astype(x.dtype) @ w(blk["wo"]))
+        y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+        if self.moe_experts:
+            # Expert weights go through the same compute-dtype cast
+            # as the dense matmuls (the router's softmax stays f32
+            # inside moe_mlp); without this the 16d² expert FLOPs
+            # would silently promote back to f32.
+            moe_p = jax.tree.map(w, blk["moe"]) if cd else blk["moe"]
+            if moe_inference:
+                from ..parallel.ep import moe_mlp_inference
+
+                m = moe_mlp_inference(
+                    y.reshape(b * s, self.dim), moe_p,
+                    n_experts=self.moe_experts, top_k=self.moe_top_k,
+                )
+                aux = jnp.zeros(())
+            else:
+                from ..parallel.ep import moe_mlp
+
+                m, aux = moe_mlp(
+                    y.reshape(b * s, self.dim), moe_p,
+                    n_experts=self.moe_experts, axis=moe_axis,
+                    top_k=self.moe_top_k,
+                )
+            return x + m.reshape(b, s, self.dim).astype(x.dtype), aux
+        return (
+            x + jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"]),
+            jnp.zeros(()),
+        )
+
     def apply(
         self,
         params: dict,
@@ -179,49 +247,9 @@ class TransformerLM:
         x = w(x)
 
         def block(blk, x):
-            y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-            if hkv == self.heads:
-                qkv = y @ w(blk["wqkv"])                # (B, S, 3*dim)
-                q, k, v = jnp.split(qkv, 3, axis=-1)
-            else:
-                q = y @ w(blk["wq"])                    # (B, S, dim)
-                kv = y @ w(blk["wkv"])                  # (B, S, 2*hkv*hd)
-                k, v = jnp.split(kv, 2, axis=-1)
-            q = q.reshape(b, s, h, hd)
-            k = k.reshape(b, s, hkv, hd)
-            v = v.reshape(b, s, hkv, hd)
-            if self.pos == "rope":
-                q = rope(q, pos)
-                k = rope(k, pos)
-            o = attn(q, k, v).reshape(b, s, h * hd)
-            x = x + (o.astype(x.dtype) @ w(blk["wo"]))
-            y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
-            if self.moe_experts:
-                # Expert weights go through the same compute-dtype cast
-                # as the dense matmuls (the router's softmax stays f32
-                # inside moe_mlp); without this the 16d² expert FLOPs
-                # would silently promote back to f32.
-                moe_p = jax.tree.map(w, blk["moe"]) if cd else blk["moe"]
-                if moe_inference:
-                    from ..parallel.ep import moe_mlp_inference
-
-                    m = moe_mlp_inference(
-                        y.reshape(b * s, self.dim), moe_p,
-                        n_experts=self.moe_experts, top_k=self.moe_top_k,
-                    )
-                    aux = jnp.zeros(())
-                else:
-                    from ..parallel.ep import moe_mlp
-
-                    m, aux = moe_mlp(
-                        y.reshape(b * s, self.dim), moe_p,
-                        n_experts=self.moe_experts, axis=moe_axis,
-                        top_k=self.moe_top_k,
-                    )
-                return x + m.reshape(b, s, self.dim).astype(x.dtype), aux
-            return (
-                x + jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"]),
-                jnp.zeros(()),
+            return self.apply_block(
+                blk, x, pos=pos, attn=attn, compute_dtype=cd,
+                moe_axis=moe_axis, moe_inference=moe_inference,
             )
 
         if remat:

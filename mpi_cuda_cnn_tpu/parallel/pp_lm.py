@@ -1,0 +1,277 @@
+"""Pipeline parallelism for the transformer LM over a 'pipe' mesh axis.
+
+The CNN pipeline (parallel/pp.py) packs HETEROGENEOUS stages into padded
+flat rows and switches on the stage index. The transformer needs none of
+that machinery: its blocks are UNIFORM pytrees, so
+
+- the L block params stack into leading-dim-L arrays (`stack_blocks`)
+  whose leading dim shards over 'pipe' — each device holds L/P
+  consecutive blocks and `lax.scan`s the SAME block computation
+  (models/transformer.py apply_block — one implementation of the block
+  math for every layout) over its local slice; no lax.switch, no
+  padding;
+- the embedding and final-LN/head are replicated: stage 0 embeds each
+  microbatch as it enters the pipe, the LAST stage applies
+  ln_f + head + causal-LM cross-entropy as microbatches drain; their
+  gradients arrive stage-local and one psum over 'pipe' restores the
+  full value (every other stage contributes zero);
+- one jitted shard_map runs the GPipe schedule: lax.scan over
+  M + P - 1 ticks, each tick runs the local stage then hands its
+  activations to the next stage with lax.ppermute (ICI-neighbor
+  transfer); `jax.grad` differentiates the schedule and the ppermute
+  transpose IS the backward pipeline, exactly as in pp.py;
+- composes with DP on a ('pipe', 'data') mesh: the microbatch dim
+  shards over 'data', gradients pmean over 'data'.
+
+MoE blocks are rejected for now: expert dispatch inside a pipelined
+stage would route bubble ticks through the load-balance loss. Reference
+point: the reference has neither pipelining nor a transformer
+(SURVEY.md §2 "PP: absent"; §5.7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerLM, _layernorm
+from .mesh import DATA_AXIS, PIPE_AXIS
+
+TrainState = dict[str, Any]
+
+
+def stack_blocks(params: dict) -> dict:
+    """{'blocks': [L dicts], ...rest} -> {'blocks': stacked (L, ...),
+    'rest': {...}} — the packed form whose block dim shards over 'pipe'."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params["blocks"])
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+    return {"blocks": stacked, "rest": rest}
+
+
+def unstack_blocks(packed: dict, depth: int) -> dict:
+    """Inverse of stack_blocks — the standard params tree (for eval,
+    decode, and parity against the unpipelined model)."""
+    blocks = [
+        jax.tree.map(lambda a: a[i], packed["blocks"]) for i in range(depth)
+    ]
+    return {**packed["rest"], "blocks": blocks}
+
+
+def _state_specs(state):
+    """PartitionSpecs by PATH: any leaf under a 'blocks' key shards its
+    leading (block) dim over 'pipe'; everything else replicates. Path
+    matching (not shape matching) — a depth-64 model with dim 64 must
+    not confuse a (64, d) embedding row count for the block dim."""
+
+    def spec(path, leaf):
+        under_blocks = any(
+            str(getattr(p, "key", getattr(p, "name", ""))) == "blocks"
+            for p in path
+        )
+        if under_blocks and getattr(leaf, "ndim", 0) >= 1:
+            return P(PIPE_AXIS, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec(path, leaf) for path, leaf in leaves]
+    )
+
+
+def _check_pp_lm(model: TransformerLM, n_pipe: int) -> None:
+    if model.moe_experts:
+        raise ValueError(
+            "pipeline parallelism does not support MoE blocks yet (bubble "
+            "ticks would feed the balance loss); use an EP/SP mesh"
+        )
+    if model.depth % n_pipe:
+        raise ValueError(
+            f"depth {model.depth} not divisible by pipe-axis size {n_pipe}"
+        )
+
+
+def make_pp_lm_state(model: TransformerLM, params, optimizer, mesh
+                     ) -> TrainState:
+    """Pack + place: stacked blocks on their pipe coordinate, the rest
+    replicated; optimizer state created FROM the packed tree inherits the
+    shardings leaf-for-leaf."""
+    _check_pp_lm(model, mesh.shape[PIPE_AXIS])
+    packed = stack_blocks(params)
+    state = {
+        "params": packed,
+        "opt_state": optimizer.init(packed),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    specs = _state_specs(state)
+    return jax.device_put(
+        state,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+
+
+def pp_lm_microbatch(tokens, targets, num_microbatches: int):
+    """(B, S) -> (M, B//M, S) microbatch arrays."""
+    if tokens.shape[0] % num_microbatches:
+        raise ValueError(
+            f"batch {tokens.shape[0]} not divisible by "
+            f"{num_microbatches} microbatches"
+        )
+    split = lambda a: a.reshape((num_microbatches, -1) + a.shape[1:])
+    return split(tokens), split(targets)
+
+
+def _batch_spec(mesh):
+    return P(None, DATA_AXIS) if DATA_AXIS in mesh.axis_names else P(None)
+
+
+def pp_lm_shard_batch(batch, mesh):
+    return jax.device_put(batch, NamedSharding(mesh, _batch_spec(mesh)))
+
+
+def make_pp_lm_train_step(
+    model: TransformerLM,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    state: TrainState,
+    *,
+    num_microbatches: int | None = None,
+    compute_dtype=None,
+    remat: bool = False,
+    donate: bool = True,
+):
+    """Jitted GPipe train step for the LM (state from make_pp_lm_state —
+    its structure supplies the shard_map specs, as in pp.py).
+
+    step(state, toks_mb, tgt_mb) -> (state, {"loss": ...}); toks/tgt are
+    (M, mb, S) int32 placed via pp_lm_shard_batch. Attention inside each
+    stage is the full causal oracle over the UNSHARDED sequence (PP
+    shards blocks and microbatches, not positions — SP is the sequence
+    axis; the two meshes are alternatives by construction).
+    """
+    n_pipe = mesh.shape[PIPE_AXIS]
+    _check_pp_lm(model, n_pipe)
+    has_data = DATA_AXIS in mesh.axis_names
+    M = num_microbatches or n_pipe
+    cd = compute_dtype
+    fwd_perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
+
+    from ..ops.attention import attention
+
+    attn = lambda q, k, v: attention(q, k, v, causal=True)
+
+    def local_loss(packed, toks_mb, tgt_mb):
+        blocks = packed["blocks"]      # local (L/P, ...)
+        rest = packed["rest"]
+        mb, s = toks_mb.shape[1], toks_mb.shape[2]
+        pos = jnp.arange(s)
+        s_idx = lax.axis_index(PIPE_AXIS)
+        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+
+        def embed(tok):
+            x = rest["tok_emb"][tok]
+            if model.pos == "learned":
+                x = x + rest["pos_emb"][pos][None, :, :]
+            return w(x)
+
+        def stage(x):
+            def body(x, blk):
+                x, _ = model.apply_block(
+                    blk, x, pos=pos, attn=attn, compute_dtype=cd
+                )
+                return x, None
+
+            x, _ = lax.scan(body, x, blocks)
+            return x
+
+        if remat:
+            stage = jax.checkpoint(stage)
+
+        def drain_nll(y, tgt):
+            feats = _layernorm(y, rest["ln_f"]["g"], rest["ln_f"]["b"])
+            logits = jnp.matmul(
+                feats, w(rest["head"]), preferred_element_type=jnp.float32
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            return jnp.mean(nll)
+
+        def tick(carry, t):
+            buf, nll_sum = carry
+            # lax.cond, not jnp.where: only stage 0 pays the embedding
+            # gather and only the LAST stage's drained ticks pay the
+            # head matmul + log_softmax (the largest matmul in the
+            # model) — a where() would run them on every stage at every
+            # tick, P*(M+P-1) times instead of M. No collectives inside
+            # either branch, so per-device divergence is safe.
+            inp = lax.cond(
+                s_idx == 0,
+                lambda: embed(toks_mb[jnp.minimum(t, M - 1)]),
+                lambda: buf,
+            )
+            y = stage(inp)
+            out_t = t - (n_pipe - 1)
+            drained = (s_idx == n_pipe - 1) & (out_t >= 0) & (out_t < M)
+            nll = lax.cond(
+                drained,
+                lambda: drain_nll(y, tgt_mb[jnp.clip(out_t, 0, M - 1)]),
+                lambda: jnp.float32(0),
+            )
+            return (lax.ppermute(y, PIPE_AXIS, fwd_perm), nll_sum + nll), None
+
+        d = model.dim
+        buf0 = jnp.zeros(
+            (mb, s, d), cd if cd else jnp.float32
+        )
+        (_, nll_sum), _ = lax.scan(
+            tick, (buf0, jnp.float32(0)), jnp.arange(M + n_pipe - 1)
+        )
+        # Per-microbatch means averaged over microbatches == the global
+        # mean NLL (equal microbatch sizes). Masked: only the last
+        # stage's drained ticks contribute.
+        return nll_sum / M
+
+    def step(state, toks_mb, tgt_mb):
+        loss, grads = jax.value_and_grad(local_loss)(
+            state["params"], toks_mb, tgt_mb
+        )
+        # Block grads are stage-local (each device owns its blocks); the
+        # replicated leaves (embedding, ln_f, head) received only their
+        # OWN stage's contribution — zero everywhere but the stage that
+        # uses them — so one psum over 'pipe' restores the full gradient.
+        grads = {
+            "blocks": grads["blocks"],
+            "rest": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), grads["rest"]
+            ),
+        }
+        loss = lax.psum(loss, PIPE_AXIS)
+        if has_data:
+            grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
+            loss = lax.pmean(loss, DATA_AXIS)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": loss},
+        )
+
+    specs = _state_specs(state)
+    bspec = _batch_spec(mesh)
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(specs, bspec, bspec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -23,7 +23,7 @@ import numpy as np
 
 from ..models.transformer import TransformerLM
 from ..parallel.dp import replicate
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, make_mesh
 from ..parallel.sp import SEQ_AXIS, make_sp_lm_train_step
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.sync import hard_block
@@ -113,12 +113,41 @@ class LMTrainer:
         self.n_seq = self.mesh.shape.get(SEQ_AXIS, 1)
         self.n_data = self.mesh.shape.get(DATA_AXIS, 1)
         self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
+        self.n_pipe = self.mesh.shape.get(PIPE_AXIS, 1)
         if self.n_model > 1 and self.n_seq > 1:
             raise ValueError(
                 "the LM's 'model' (GSPMD tensor-parallel) and 'seq' "
                 "(shard_map sequence-parallel) axes do not compose yet; "
                 "pick one (TP x DP: data:N,model:M — SP x DP: "
                 "data:N,seq:M)"
+            )
+        if self.n_pipe > 1 and (self.n_seq > 1 or self.n_model > 1
+                                or cfg.fsdp):
+            raise ValueError(
+                "the LM's 'pipe' axis composes with 'data' only for now "
+                "(GPipe over stacked blocks, parallel/pp_lm.py); drop "
+                "the seq/model axes and --fsdp or the pipe axis"
+            )
+        if self.n_pipe > 1 and cfg.batch_size % (self.n_pipe * self.n_data):
+            raise ValueError(
+                f"batch_size {cfg.batch_size} not divisible by "
+                f"num_microbatches x data-axis "
+                f"({self.n_pipe} x {self.n_data})"
+            )
+        if self.n_pipe > 1 and cfg.grad_clip:
+            raise ValueError(
+                "--grad-clip does not compose with the pipelined step: "
+                "clip_by_global_norm inside shard_map would clip each "
+                "stage's LOCAL block grads with a different scale (and "
+                "diverge the replicated embedding/head copies); drop the "
+                "flag or the pipe axis"
+            )
+        if self.n_pipe > 1 and cfg.attn_impl not in ("auto", "oracle"):
+            raise ValueError(
+                f"--attn-impl {cfg.attn_impl!r} is not wired into the "
+                "pipelined step (each stage runs full causal attention "
+                "over the unsharded sequence); use auto/oracle or an SP "
+                "mesh for the flash/ring kernels"
             )
         if cfg.batch_size % self.n_data:
             raise ValueError(
@@ -164,7 +193,30 @@ class LMTrainer:
         )
         self._compute_dtype = compute_dtype
 
-        if self.n_seq > 1:
+        if self.n_pipe > 1:
+            # GPipe over stacked transformer blocks (parallel/pp_lm.py):
+            # blocks stage-sharded over 'pipe', microbatches over 'data'.
+            from ..parallel.pp_lm import (
+                make_pp_lm_state,
+                make_pp_lm_train_step,
+            )
+
+            if cfg.ce_chunk:
+                raise ValueError(
+                    "--ce-chunk is not wired into the pipelined LM loss "
+                    "yet (the last stage computes CE per drained "
+                    "microbatch); drop the flag or the pipe axis"
+                )
+            self.attn_impl = "oracle"  # full causal attention per stage
+            params = self.model.init(jax.random.key(cfg.seed))
+            self.state = make_pp_lm_state(
+                self.model, params, self.optimizer, self.mesh
+            )
+            self.train_step = make_pp_lm_train_step(
+                self.model, self.optimizer, self.mesh, self.state,
+                compute_dtype=compute_dtype, remat=cfg.remat,
+            )
+        elif self.n_seq > 1:
             if cfg.ce_chunk and (cfg.seq_len // self.n_seq) % cfg.ce_chunk:
                 raise ValueError(
                     f"--ce-chunk {cfg.ce_chunk} must divide the per-shard "
@@ -195,7 +247,9 @@ class LMTrainer:
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
             )
-        if cfg.fsdp:
+        if self.n_pipe > 1:
+            pass  # state already built with the pipelined step above
+        elif cfg.fsdp:
             # ZeRO-style sharding for the LM — the same generic spec
             # machinery as the CNN path (parallel/fsdp.py); with a
             # 'model' axis present the TP specs are the base and 'data'
@@ -257,14 +311,30 @@ class LMTrainer:
         return jnp.asarray(w[:, :-1]), jnp.asarray(w[:, 1:])
 
     def _place(self, t):
-        """Shard (B, S) over (data, seq) mesh axes."""
+        """Shard (B, S) over (data, seq) mesh axes — or microbatch to
+        (M, mb, S) with mb over 'data' on the pipelined mesh."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if self.n_pipe > 1:
+            from ..parallel.pp_lm import pp_lm_shard_batch
+
+            t = t.reshape((self.n_pipe, -1) + t.shape[1:])
+            return pp_lm_shard_batch(t, self.mesh)
         spec = P(
             DATA_AXIS if self.n_data > 1 else None,
             SEQ_AXIS if self.n_seq > 1 else None,
         )
         return jax.device_put(t, NamedSharding(self.mesh, spec))
+
+    def _host_params(self):
+        """Host copy of the params in the STANDARD tree layout (the
+        pipelined state stores stacked blocks; eval/decode unstack)."""
+        p = jax.device_get(self.state["params"])
+        if "rest" in p:
+            from ..parallel.pp_lm import unstack_blocks
+
+            p = unstack_blocks(p, self.model.depth)
+        return p
 
     def train(self) -> LMResult:
         cfg = self.cfg
@@ -345,7 +415,7 @@ class LMTrainer:
             else self.train_tokens
         )
         prompt = jnp.asarray(np.asarray(stream[:p])[None, :], jnp.int32)
-        params = jax.device_get(self.state["params"])
+        params = self._host_params()
         toks = generate(
             self.model, params, prompt, num_tokens,
             temperature=temperature,
@@ -376,7 +446,7 @@ class LMTrainer:
                 )
 
             self._eval_fn = eval_fn
-        params = jax.device_get(self.state["params"])
+        params = self._host_params()
         losses = []
         for i in range(nwin):
             w = stream[i * s : i * s + s + 1]

@@ -194,6 +194,13 @@ class Trainer:
                     f"add a data axis of size > 1 (mesh_shape="
                     f"{config.mesh_shape!r})"
                 )
+            if config.grad_clip:
+                raise ValueError(
+                    "--grad-clip does not compose with the pipeline path: "
+                    "clip_by_global_norm inside shard_map would clip each "
+                    "stage's LOCAL row with a different scale; drop the "
+                    "flag or the pipe axis"
+                )
             self._pp_M = config.num_microbatches or self.n_pipe
             if config.batch_size % (self._pp_M * n_data):
                 raise ValueError(
