@@ -43,6 +43,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.transformer import TransformerLM, _layernorm
 from .mesh import DATA_AXIS, PIPE_AXIS
 
+# The batch-placement contract is IDENTICAL to the CNN pipeline's —
+# one implementation, re-exported (parallel/pp.py).
+from .pp import _batch_spec
+from .pp import microbatch as pp_lm_microbatch  # noqa: F401
+from .pp import pp_shard_batch as pp_lm_shard_batch  # noqa: F401
+
 TrainState = dict[str, Any]
 
 
@@ -116,23 +122,6 @@ def make_pp_lm_state(model: TransformerLM, params, optimizer, mesh
     )
 
 
-def pp_lm_microbatch(tokens, targets, num_microbatches: int):
-    """(B, S) -> (M, B//M, S) microbatch arrays."""
-    if tokens.shape[0] % num_microbatches:
-        raise ValueError(
-            f"batch {tokens.shape[0]} not divisible by "
-            f"{num_microbatches} microbatches"
-        )
-    split = lambda a: a.reshape((num_microbatches, -1) + a.shape[1:])
-    return split(tokens), split(targets)
-
-
-def _batch_spec(mesh):
-    return P(None, DATA_AXIS) if DATA_AXIS in mesh.axis_names else P(None)
-
-
-def pp_lm_shard_batch(batch, mesh):
-    return jax.device_put(batch, NamedSharding(mesh, _batch_spec(mesh)))
 
 
 def make_pp_lm_train_step(
@@ -170,6 +159,14 @@ def make_pp_lm_train_step(
         blocks = packed["blocks"]      # local (L/P, ...)
         rest = packed["rest"]
         mb, s = toks_mb.shape[1], toks_mb.shape[2]
+        if s > model.max_seq:
+            # Trace-time check (shapes are static): XLA's gather would
+            # silently clamp positions past the pos_emb table — the same
+            # loud failure apply() raises (models/transformer.py), which
+            # this schedule bypasses.
+            raise ValueError(
+                f"sequence length {s} exceeds max_seq {model.max_seq}"
+            )
         pos = jnp.arange(s)
         s_idx = lax.axis_index(PIPE_AXIS)
         w = (lambda t: t.astype(cd)) if cd else (lambda t: t)

@@ -39,6 +39,12 @@ SLOW_TESTS = {
     "test_flash_attention.py::test_flash_bf16_gradients_match_oracle",
     "test_fsdp.py::test_fsdp_pp_matches_plain_pp[True]",
     "test_fsdp.py::test_fsdp_pp_matches_plain_pp[False]",
+    "test_fsdp.py::test_lm_trainer_fsdp_and_fsdp_tp",
+    "test_pp_lm.py::test_pp_lm_remat_matches_plain",
+    "test_pp_lm.py::test_lm_pipeline_checkpoint_resume",
+    "test_pp_lm.py::test_pp_lm_step_matches_serial[mesh_axes1]",
+    "test_pp_lm.py::test_pp_lm_step_matches_serial[mesh_axes2]",
+    "test_tp.py::test_lm_trainer_accepts_model_axis",
     "test_generate.py::test_decode_matches_inference_forward_moe_top2",
     "test_generate.py::test_generate_shapes_and_budget",
     "test_gqa_rope.py::test_gqa_flash_gradients_match_oracle",

@@ -138,3 +138,25 @@ def test_lm_trainer_pipeline_e2e(eight_devices):
     with pytest.raises(ValueError, match="attn-impl"):
         LMTrainer(LMConfig(mesh_shape="pipe:2", attn_impl="flash", **base),
                   metrics=MetricsLogger(echo=False))
+
+
+def test_lm_pipeline_checkpoint_resume(tmp_path, eight_devices):
+    """Checkpoint/resume of the PACKED pipeline state: a run killed at
+    step 5 and resumed finishes with the same step count, and the
+    restored state re-places onto the pipe-sharded layout."""
+    from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+    from mpi_cuda_cnn_tpu.utils.config import LMConfig
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    ck = str(tmp_path / "ck")
+    base = dict(corpus="synthetic", dim=32, depth=2, heads=4, seq_len=64,
+                batch_size=4, log_every=0, lr_schedule="constant",
+                warmup_steps=0, mesh_shape="pipe:2,data:2")
+    LMTrainer(LMConfig(steps=5, checkpoint_dir=ck, checkpoint_every=5,
+                       **base), metrics=MetricsLogger(echo=False)).train()
+    t = LMTrainer(LMConfig(steps=8, checkpoint_dir=ck, resume=True, **base),
+                  metrics=MetricsLogger(echo=False))
+    r = t.train()
+    assert r.steps_run == 3  # resumed at 5, ran to 8
+    wqkv = t.state["params"]["blocks"]["wqkv"]
+    assert wqkv.addressable_shards[0].data.shape[0] == 1  # still sharded
