@@ -33,6 +33,7 @@ SLOW_TESTS = {
     "test_accum_remat.py::test_grad_accum_matches_plain[data:4,model:2]",
     "test_accum_remat.py::test_remat_transformer_grads_match",
     "test_augment.py::test_trainer_augment_on_pp_mesh_is_deterministic",
+    "test_bench_contract.py::test_bench_emits_error_json_when_attempts_time_out",
     "test_ep.py::test_top2_moe_lm_trains",
     "test_ep.py::test_ep_layer_trains",
     "test_ep.py::test_dispatch_at_most_one_slot_per_token",

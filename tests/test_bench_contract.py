@@ -1,0 +1,56 @@
+"""bench.py's capture contract (VERDICT round-2 item 1): the driver's
+BENCH_r*.json must NEVER be rc=124-with-parsed-null again. The parent
+process stays JAX-free and always prints exactly ONE JSON line — success
+metrics or {"error": ...} — within its bounded wall-clock budget, even
+when the backend hangs at init (the round-2 failure mode: a dead axon
+tunnel blocks in C-level code where no Python signal handler runs)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run_bench(env_extra, timeout):
+    env = dict(os.environ, **env_extra)
+    return subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def test_bench_emits_error_json_when_attempts_time_out():
+    """A child attempt that outlives its cap must be KILLED and recorded
+    — the per-attempt cap (12 s, above bench.py's 10 s minimum-budget
+    floor so a real child is spawned) cannot fit the CPU bench's compile
+    + 4 epochs, so the attempt hits subprocess.TimeoutExpired, exactly
+    the hang path that produced round 2's empty capture."""
+    proc = _run_bench(
+        {"BENCH_DEVICE": "cpu", "BENCH_ATTEMPT_TIMEOUT_S": "12",
+         "BENCH_TOTAL_TIMEOUT_S": "26"},
+        timeout=180,
+    )
+    assert proc.returncode == 1
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    assert out["metric"] == "mnist_epoch_wallclock"
+    assert out["value"] is None
+    # The child really ran and really got killed at its cap.
+    assert "timed out after" in out["error"], out["error"]
+
+
+def test_bench_budget_guard_skips_unspawnable_attempts():
+    """A per-attempt budget under the 10 s floor never spawns a doomed
+    child; the capture still ends in one JSON error line, fast."""
+    proc = _run_bench(
+        {"BENCH_DEVICE": "cpu", "BENCH_ATTEMPT_TIMEOUT_S": "2",
+         "BENCH_TOTAL_TIMEOUT_S": "8"},
+        timeout=60,
+    )
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["value"] is None and "budget" in out["error"]
