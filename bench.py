@@ -30,6 +30,11 @@ TOTAL_TIMEOUT_S = float(os.environ.get("BENCH_TOTAL_TIMEOUT_S", 540.0))
 
 
 def _run() -> None:
+    hang = float(os.environ.get("BENCH_CHILD_HANG_S", 0) or 0)
+    if hang:
+        # Test hook (tests/test_bench_contract.py): simulate a backend
+        # that hangs at init, deterministically on any machine.
+        time.sleep(hang)
     dev = os.environ.get("BENCH_DEVICE")
     if dev:
         # The JAX_PLATFORMS env var can be intercepted by a pre-registered

@@ -19,8 +19,9 @@
  *   mctpu lm [options]     — the LM family through the same embedded
  *     runtime (mct_tpu_lm_init/lm_train -> train/lm_trainer.py):
  *     --device=tpu|jax|jax-cpu --corpus=STR --dim=N --depth=N --heads=N
- *     --seq-len=N --steps=N --batch=N --lr=F --seed=N --mesh-shape=STR
- *     --compute-dtype=float32|bfloat16
+ *     --kv-heads=N --pos=learned|rope --moe-experts=N --moe-top-k=N
+ *     --ce-chunk=N --seq-len=N --steps=N --batch=N --lr=F --seed=N
+ *     --mesh-shape=STR --compute-dtype=float32|bfloat16
  */
 #include "mct.h"
 #include "tpu_abi.h"
@@ -196,8 +197,9 @@ static int run_lm(int argc, char **argv)
     /* Defaults mirror utils/config.py::LMConfig where the C driver sets
      * them at all; everything else falls to the dataclass defaults. */
     const char *device = "jax-cpu", *corpus = "synthetic";
-    const char *mesh = "data", *dtype = "float32";
+    const char *mesh = "data", *dtype = "float32", *posenc = "learned";
     int dim = 64, depth = 2, heads = 4, seq = 128, steps = 50, batch = 4;
+    int kv_heads = 0, moe_experts = 0, moe_top_k = 1, ce_chunk = 0;
     double lr = 3e-4;
     long long seed = 0;
 
@@ -207,9 +209,16 @@ static int run_lm(int argc, char **argv)
         else if (strncmp(s, "--corpus=", 9) == 0) corpus = s + 9;
         else if (strncmp(s, "--mesh-shape=", 13) == 0) mesh = s + 13;
         else if (strncmp(s, "--compute-dtype=", 16) == 0) dtype = s + 16;
+        else if (strncmp(s, "--pos=", 6) == 0) posenc = s + 6;
         else if (strncmp(s, "--dim=", 6) == 0) dim = atoi(s + 6);
         else if (strncmp(s, "--depth=", 8) == 0) depth = atoi(s + 8);
         else if (strncmp(s, "--heads=", 8) == 0) heads = atoi(s + 8);
+        else if (strncmp(s, "--kv-heads=", 11) == 0) kv_heads = atoi(s + 11);
+        else if (strncmp(s, "--moe-experts=", 14) == 0)
+            moe_experts = atoi(s + 14);
+        else if (strncmp(s, "--moe-top-k=", 12) == 0)
+            moe_top_k = atoi(s + 12);
+        else if (strncmp(s, "--ce-chunk=", 11) == 0) ce_chunk = atoi(s + 11);
         else if (strncmp(s, "--seq-len=", 10) == 0) seq = atoi(s + 10);
         else if (strncmp(s, "--steps=", 8) == 0) steps = atoi(s + 8);
         else if (strncmp(s, "--batch=", 8) == 0) batch = atoi(s + 8);
@@ -221,7 +230,8 @@ static int run_lm(int argc, char **argv)
         }
     }
     if (dim < 1 || depth < 1 || heads < 1 || seq < 2 || steps < 1 ||
-        batch < 1 || lr <= 0.0) {
+        batch < 1 || lr <= 0.0 || kv_heads < 0 || moe_experts < 0 ||
+        moe_top_k < 1 || ce_chunk < 0) {
         fprintf(stderr, "mct: invalid lm hyperparameters\n");
         return 100;
     }
@@ -233,20 +243,23 @@ static int run_lm(int argc, char **argv)
      * JSON value (no key injection past the C-side validation). */
     char cfg[2048], buf[1024];
     size_t pos = 0;
-    const char *svals[3] = {corpus, mesh, dtype};
-    const char *skeys[3] = {"corpus", "mesh_shape", "compute_dtype"};
+    const char *svals[4] = {corpus, mesh, dtype, posenc};
+    const char *skeys[4] = {"corpus", "mesh_shape", "compute_dtype", "pos"};
     pos += (size_t)snprintf(cfg + pos, sizeof cfg - pos, "{");
-    for (int i = 0; i < 3; i++)
+    for (int i = 0; i < 4; i++)
         if (append_json_str(cfg, sizeof cfg, &pos, skeys[i], svals[i],
                             i == 0))
             goto toolong;
     {
         int nw = snprintf(cfg + pos, sizeof cfg - pos,
-            ",\"dim\":%d,\"depth\":%d,\"heads\":%d,\"seq_len\":%d,"
+            ",\"dim\":%d,\"depth\":%d,\"heads\":%d,\"kv_heads\":%d,"
+            "\"moe_experts\":%d,\"moe_top_k\":%d,\"ce_chunk\":%d,"
+            "\"seq_len\":%d,"
             "\"steps\":%d,\"batch_size\":%d,\"lr\":%g,\"seed\":%lld,"
             "\"device\":\"%s\",\"log_every\":0,\"lr_schedule\":"
             "\"constant\",\"warmup_steps\":0}",
-            dim, depth, heads, seq, steps, batch, lr, seed, dev);
+            dim, depth, heads, kv_heads, moe_experts, moe_top_k, ce_chunk,
+            seq, steps, batch, lr, seed, dev);
         if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
             goto toolong;
     }

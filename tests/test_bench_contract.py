@@ -23,14 +23,15 @@ def _run_bench(env_extra, timeout):
 
 
 def test_bench_emits_error_json_when_attempts_time_out():
-    """A child attempt that outlives its cap must be KILLED and recorded
-    — the per-attempt cap (12 s, above bench.py's 10 s minimum-budget
-    floor so a real child is spawned) cannot fit the CPU bench's compile
-    + 4 epochs, so the attempt hits subprocess.TimeoutExpired, exactly
+    """A child attempt that outlives its cap must be KILLED and recorded.
+    BENCH_CHILD_HANG_S makes the child hang deterministically on any
+    machine (no assumption about how fast the real bench runs); the
+    per-attempt cap sits above bench.py's 10 s minimum-budget floor so a
+    real child is spawned and hits subprocess.TimeoutExpired — exactly
     the hang path that produced round 2's empty capture."""
     proc = _run_bench(
-        {"BENCH_DEVICE": "cpu", "BENCH_ATTEMPT_TIMEOUT_S": "12",
-         "BENCH_TOTAL_TIMEOUT_S": "26"},
+        {"BENCH_DEVICE": "cpu", "BENCH_CHILD_HANG_S": "300",
+         "BENCH_ATTEMPT_TIMEOUT_S": "12", "BENCH_TOTAL_TIMEOUT_S": "26"},
         timeout=180,
     )
     assert proc.returncode == 1
