@@ -1,0 +1,327 @@
+"""Tensor parallelism x sequence parallelism for the transformer LM —
+Megatron sharding INSIDE the ring-attention shard_map.
+
+The GSPMD LM TP (parallel/tp.py lm_tp_specs) and the shard_map SP step
+(parallel/sp.py) cannot compose directly: GSPMD places collectives by
+propagation through a jitted global program, while the SP step is an
+explicit per-device program. This module writes the Megatron block
+explicitly so both axes live in ONE shard_map:
+
+- a ('data'?, 'seq', 'model') mesh: positions shard over 'seq' (ring
+  attention rotates k/v blocks exactly as in sp.py — fewer heads per
+  device, same schedule), heads/MLP-hidden shard over 'model';
+- weights are stored head-structured so plain PartitionSpecs slice them
+  cleanly: wqkv (dim, 3, H, hd) and wo (H, hd, dim) put 'model' on the
+  H dim (`to_tp_layout`/`from_tp_layout` convert to/from the standard
+  tree for checkpoints, eval, and parity tests);
+- the classic f/g pair: `_tp_copy` is identity forward / psum-over-
+  'model' backward, placed at each parallel region's input (the
+  replicated activation is consumed by every model rank, so its true
+  cotangent is the SUM of the rank-local ones), and an explicit
+  `lax.psum` joins each region's partial outputs before the residual
+  add (column-parallel qkv/w1, row-parallel wo/w2 — the pair's
+  forward is collective-free in between);
+- the loss (final LN + head + CE over the LOCAL sequence shard) is
+  computed identically on every model rank from the replicated
+  activations, so replicated-leaf gradients arrive exact on every rank
+  and sliced-leaf gradients are exact per slice — the step's pmean
+  stays over ('data', 'seq') only, exactly as in sp.py.
+
+The reference has neither axis (SURVEY.md §2 checklist, §5.7); this is
+the long-context Megatron layout TPU pods actually train with.
+Restrictions (checked loudly): dense MLP only (no MoE), heads and
+kv_heads divisible by the 'model' axis, dims divisible for w1/w2.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerLM, _layernorm
+from ..ops.attention import rope
+from .mesh import DATA_AXIS, MODEL_AXIS
+from .sp import SEQ_AXIS, ring_attention
+
+TrainState = dict[str, Any]
+
+
+def _make_tp_pair(axis: str):
+    """Megatron's f/g pair, BOTH as custom VJPs.
+
+    f (tp_copy): identity forward, psum backward — a replicated
+    activation enters a model-parallel region, so its true cotangent is
+    the sum of the rank-local ones.
+    g (tp_reduce): psum forward, identity backward — the region's
+    partial outputs join into the replicated value, whose cotangent
+    passes to each rank unchanged.
+
+    g MUST be a custom VJP, not a bare lax.psum: under shard_map's
+    manual mode JAX cannot see that psum's output is replicated, so the
+    autodiff transpose of psum is ANOTHER psum — which multiplies every
+    upstream cotangent by the axis size (measured: every block gradient
+    off by exactly that pattern with a bare psum; head/ln_f, downstream
+    of the last join, stayed exact)."""
+
+    @jax.custom_vjp
+    def tp_copy(x):
+        return x
+
+    tp_copy.defvjp(lambda x: (x, None),
+                   lambda _, g: (lax.psum(g, axis),))
+
+    @jax.custom_vjp
+    def tp_reduce(x):
+        return lax.psum(x, axis)
+
+    tp_reduce.defvjp(lambda x: (lax.psum(x, axis), None),
+                     lambda _, g: (g,))
+
+    return tp_copy, tp_reduce
+
+
+def to_tp_layout(params: dict, model: TransformerLM) -> dict:
+    """Standard params -> head-structured layout: wqkv (d, 3, H, hd),
+    wq (d, H, hd), wkv (d, 2, Hkv, hd), wo (H, hd, d). Pure reshapes —
+    bitwise-invertible (from_tp_layout)."""
+    d, h, hd, hkv = model.dim, model.heads, model.head_dim, model.n_kv
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["blocks"] = []
+    for blk in params["blocks"]:
+        b = dict(blk)
+        if "wqkv" in b:
+            b["wqkv"] = b["wqkv"].reshape(d, 3, h, hd)
+        else:
+            b["wq"] = b["wq"].reshape(d, h, hd)
+            b["wkv"] = b["wkv"].reshape(d, 2, hkv, hd)
+        b["wo"] = b["wo"].reshape(h, hd, d)
+        out["blocks"].append(b)
+    return out
+
+
+def from_tp_layout(params: dict, model: TransformerLM) -> dict:
+    """Inverse of to_tp_layout (for checkpoints/eval/decode)."""
+    d, h, hd, hkv = model.dim, model.heads, model.head_dim, model.n_kv
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["blocks"] = []
+    for blk in params["blocks"]:
+        b = dict(blk)
+        if "wqkv" in b:
+            b["wqkv"] = b["wqkv"].reshape(d, 3 * h * hd)
+        else:
+            b["wq"] = b["wq"].reshape(d, h * hd)
+            b["wkv"] = b["wkv"].reshape(d, 2 * hkv * hd)
+        b["wo"] = b["wo"].reshape(h * hd, d)
+        out["blocks"].append(b)
+    return out
+
+
+def _check_tp_sp(model: TransformerLM, n_tp: int) -> None:
+    if model.moe_experts:
+        raise ValueError(
+            "TP x SP supports dense MLP blocks only (MoE routes tokens "
+            "per expert — use the EP x SP mesh instead)"
+        )
+    if model.heads % n_tp or model.n_kv % n_tp:
+        raise ValueError(
+            f"the model-axis size {n_tp} must divide both heads "
+            f"{model.heads} and kv_heads {model.n_kv}"
+        )
+    if (4 * model.dim) % n_tp:
+        raise ValueError(
+            f"MLP hidden {4 * model.dim} not divisible by model-axis "
+            f"size {n_tp}"
+        )
+
+
+def tp_sp_param_specs(model: TransformerLM, params_tp: dict) -> dict:
+    """PartitionSpecs for the head-structured tree: 'model' on the H dim
+    of wqkv/wq/wkv/wo, on w1's columns and w2's rows; all else
+    replicated (the 'seq'/'data' axes never shard parameters)."""
+    spec_map = {
+        "wqkv": P(None, None, MODEL_AXIS, None),
+        "wq": P(None, MODEL_AXIS, None),
+        "wkv": P(None, None, MODEL_AXIS, None),
+        "wo": P(MODEL_AXIS, None, None),
+        "w1": P(None, MODEL_AXIS),
+        "w2": P(MODEL_AXIS, None),
+    }
+    out = {k: jax.tree.map(lambda _: P(), v)
+           for k, v in params_tp.items() if k != "blocks"}
+    out["blocks"] = [
+        {k: spec_map.get(k, jax.tree.map(lambda _: P(), v))
+         for k, v in blk.items()}
+        for blk in params_tp["blocks"]
+    ]
+    return out
+
+
+def make_tp_sp_state(model: TransformerLM, params, optimizer, mesh
+                     ) -> tuple[TrainState, Any]:
+    """Head-structured, model-sliced train state; optimizer buffers
+    inherit the shardings leaf-for-leaf."""
+    _check_tp_sp(model, mesh.shape[MODEL_AXIS])
+    params_tp = to_tp_layout(params, model)
+    state = {
+        "params": params_tp,
+        "opt_state": optimizer.init(params_tp),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+    # Specs for the whole state: params get the structured specs; the
+    # optimizer tree mirrors the params tree leaf-for-leaf (optax), so
+    # the same specs apply by path; scalars replicate.
+    pspecs = tp_sp_param_specs(model, params_tp)
+
+    def state_specs(st):
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(st)
+        pspec_flat = {
+            tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path):
+                s
+            for path, s in jax.tree_util.tree_flatten_with_path(
+                pspecs, is_leaf=lambda x: isinstance(x, P)
+            )[0]
+        }
+
+        def spec_for(path, leaf):
+            keys = tuple(
+                str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+            )
+            # Match the params-relative suffix: opt_state nests the
+            # params tree under transformation wrappers.
+            for k, s in pspec_flat.items():
+                if keys[-len(k):] == k and getattr(leaf, "ndim", 0) == len(s):
+                    return s
+            return P()
+
+        return jax.tree_util.tree_unflatten(
+            treedef, [spec_for(p, l) for p, l in leaves]
+        )
+
+    specs = state_specs(state)
+    return jax.device_put(
+        state,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    ), specs
+
+
+def make_tp_sp_lm_train_step(
+    model: TransformerLM,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    state_specs,
+    *,
+    data_axis: str | None = None,
+    compute_dtype=None,
+    remat: bool = False,
+    donate: bool = True,
+    ce_chunk: int = 0,
+):
+    """Jitted Megatron x ring train step.
+
+    step(state, tokens, targets) -> (state, {"loss": ...}); tokens (B, S)
+    sharded (data?, seq) like the plain SP step. Inside: ring attention
+    over 'seq' with H/n_tp local heads, column/row-parallel matmuls over
+    'model' with the f/psum pair, loss on the local sequence shard.
+    """
+    _check_tp_sp(model, mesh.shape[MODEL_AXIS])
+    n_seq = mesh.shape[SEQ_AXIS]
+    reduce_axes = tuple(a for a in (data_axis, SEQ_AXIS) if a)
+    cd = compute_dtype
+    tp_copy, tp_reduce = _make_tp_pair(MODEL_AXIS)
+
+    def local_loss(params, tokens, targets):
+        b, s_local = tokens.shape
+        if s_local * n_seq > model.max_seq:
+            raise ValueError(
+                f"global sequence {s_local * n_seq} exceeds "
+                f"max_seq {model.max_seq}"
+            )
+        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+        hd = model.head_dim
+        pos = lax.axis_index(SEQ_AXIS) * s_local + jnp.arange(s_local)
+
+        x = params["tok_emb"][tokens]
+        if model.pos == "learned":
+            x = x + params["pos_emb"][pos][None, :, :]
+        x = w(x)
+
+        def block(blk, x):
+            # Attention region: column-parallel qkv (local heads), ring
+            # attention over 'seq' on the local heads, row-parallel wo.
+            y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+            y = tp_copy(y)
+            if "wqkv" in blk:
+                qkv = jnp.einsum("bsd,dchx->bschx", y, w(blk["wqkv"]))
+                q, k, v = (qkv[:, :, i] for i in range(3))
+            else:
+                q = jnp.einsum("bsd,dhx->bshx", y, w(blk["wq"]))
+                kv = jnp.einsum("bsd,dchx->bschx", y, w(blk["wkv"]))
+                k, v = kv[:, :, 0], kv[:, :, 1]
+            if model.pos == "rope":
+                q = rope(q, pos)
+                k = rope(k, pos)
+            o = ring_attention(q, k, v, axis=SEQ_AXIS, causal=True)
+            part = jnp.einsum("bshx,hxd->bsd", o.astype(x.dtype),
+                              w(blk["wo"]))
+            x = x + tp_reduce(part)
+            # MLP region: column-parallel w1, row-parallel w2.
+            y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+            y = tp_copy(y)
+            part = jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"])
+            return x + tp_reduce(part)
+
+        if remat:
+            block = jax.checkpoint(block)
+        for blk in params["blocks"]:
+            x = block(blk, x)
+        feats = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+        if ce_chunk:
+            from ..ops.losses import chunked_ce_mean
+
+            return chunked_ce_mean(
+                feats, params["head"], targets, ce_chunk, cd
+            )
+        logits = jnp.matmul(
+            feats, w(params["head"]), preferred_element_type=jnp.float32
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def step(state, tokens, targets):
+        loss, grads = jax.value_and_grad(local_loss)(
+            state["params"], tokens, targets
+        )
+        # Sliced leaves: exact per slice. Replicated leaves: identical on
+        # every model rank (the loss consumed replicated activations).
+        # Only the data/seq shards hold DIFFERENT samples -> pmean there,
+        # never over 'model' (it would average unrelated slices).
+        grads = jax.tree.map(lambda g: lax.pmean(g, reduce_axes), grads)
+        loss = lax.pmean(loss, reduce_axes)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": loss},
+        )
+
+    bspec = P(data_axis, SEQ_AXIS) if data_axis else P(None, SEQ_AXIS)
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(state_specs, bspec, bspec),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -115,12 +115,28 @@ class LMTrainer:
         self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
         self.n_pipe = self.mesh.shape.get(PIPE_AXIS, 1)
         if self.n_model > 1 and self.n_seq > 1:
-            raise ValueError(
-                "the LM's 'model' (GSPMD tensor-parallel) and 'seq' "
-                "(shard_map sequence-parallel) axes do not compose yet; "
-                "pick one (TP x DP: data:N,model:M — SP x DP: "
-                "data:N,seq:M)"
-            )
+            # TP x SP (parallel/tp_sp.py): Megatron inside the ring
+            # shard_map. Structural checks (MoE, divisibility) fire at
+            # state construction via _check_tp_sp.
+            if cfg.fsdp:
+                raise ValueError(
+                    "--fsdp does not compose with the TP x SP shard_map "
+                    "step; drop it or use data:N,model:M"
+                )
+            if cfg.attn_impl not in ("auto", "oracle", "ring"):
+                raise ValueError(
+                    f"--attn-impl {cfg.attn_impl!r} is not wired into "
+                    "TP x SP (its stage runs ring attention on the "
+                    "local heads); use auto"
+                )
+            if cfg.grad_clip:
+                raise ValueError(
+                    "--grad-clip does not compose with TP x SP: "
+                    "clip_by_global_norm inside shard_map would compute "
+                    "each model rank's clip scale from its PARTIAL "
+                    "weight-slice norm, silently corrupting the "
+                    "replicated leaves; drop the flag or the model axis"
+                )
         if self.n_pipe > 1 and (self.n_seq > 1 or self.n_model > 1
                                 or cfg.fsdp):
             raise ValueError(
@@ -193,6 +209,13 @@ class LMTrainer:
         )
         self._compute_dtype = compute_dtype
 
+        if cfg.ce_chunk and self.n_seq > 1 and \
+                (cfg.seq_len // self.n_seq) % cfg.ce_chunk:
+            raise ValueError(
+                f"--ce-chunk {cfg.ce_chunk} must divide the per-shard "
+                f"sequence {cfg.seq_len // self.n_seq} (seq_len "
+                f"{cfg.seq_len} over seq:{self.n_seq})"
+            )
         if self.n_pipe > 1:
             # GPipe over stacked transformer blocks (parallel/pp_lm.py):
             # blocks stage-sharded over 'pipe', microbatches over 'data'.
@@ -216,13 +239,24 @@ class LMTrainer:
                 self.model, self.optimizer, self.mesh, self.state,
                 compute_dtype=compute_dtype, remat=cfg.remat,
             )
+        elif self.n_seq > 1 and self.n_model > 1:
+            from ..parallel.tp_sp import (
+                make_tp_sp_lm_train_step,
+                make_tp_sp_state,
+            )
+
+            self.attn_impl = "ring"
+            params = self.model.init(jax.random.key(cfg.seed))
+            self.state, specs = make_tp_sp_state(
+                self.model, params, self.optimizer, self.mesh
+            )
+            self.train_step = make_tp_sp_lm_train_step(
+                self.model, self.optimizer, self.mesh, specs,
+                data_axis=DATA_AXIS if self.n_data > 1 else None,
+                compute_dtype=compute_dtype, remat=cfg.remat,
+                ce_chunk=cfg.ce_chunk,
+            )
         elif self.n_seq > 1:
-            if cfg.ce_chunk and (cfg.seq_len // self.n_seq) % cfg.ce_chunk:
-                raise ValueError(
-                    f"--ce-chunk {cfg.ce_chunk} must divide the per-shard "
-                    f"sequence {cfg.seq_len // self.n_seq} (seq_len "
-                    f"{cfg.seq_len} over seq:{self.n_seq})"
-                )
             impl = cfg.attn_impl
             if impl in ("auto", "flash"):
                 # ring_flash needs 128-aligned shards; plain ring otherwise.
@@ -247,8 +281,8 @@ class LMTrainer:
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
             )
-        if self.n_pipe > 1:
-            pass  # state already built with the pipelined step above
+        if self.n_pipe > 1 or (self.n_model > 1 and self.n_seq > 1):
+            pass  # state already built with its step above (PP / TP x SP)
         elif cfg.fsdp:
             # ZeRO-style sharding for the LM — the same generic spec
             # machinery as the CNN path (parallel/fsdp.py); with a
@@ -327,13 +361,19 @@ class LMTrainer:
         return jax.device_put(t, NamedSharding(self.mesh, spec))
 
     def _host_params(self):
-        """Host copy of the params in the STANDARD tree layout (the
-        pipelined state stores stacked blocks; eval/decode unstack)."""
+        """Host copy of the params in the STANDARD tree layout: the
+        pipelined state stores stacked blocks (unstack), the TP x SP
+        state stores head-structured weights (un-reshape) — eval and
+        decode consume the standard tree either way."""
         p = jax.device_get(self.state["params"])
         if "rest" in p:
             from ..parallel.pp_lm import unstack_blocks
 
             p = unstack_blocks(p, self.model.depth)
+        elif p["blocks"] and p["blocks"][0]["wo"].ndim == 3:
+            from ..parallel.tp_sp import from_tp_layout
+
+            p = from_tp_layout(p, self.model)
         return p
 
     def train(self) -> LMResult:

@@ -46,6 +46,9 @@ SLOW_TESTS = {
     "test_pp_lm.py::test_pp_lm_step_matches_serial[mesh_axes1]",
     "test_pp_lm.py::test_pp_lm_step_matches_serial[mesh_axes2]",
     "test_tp.py::test_lm_trainer_accepts_model_axis",
+    "test_tp_sp.py::test_tp_sp_step_matches_serial[2-rope-mesh_axes1]",
+    "test_tp_sp.py::test_tp_sp_step_matches_serial[0-learned-mesh_axes2]",
+    "test_tp_sp.py::test_tp_sp_step_matches_serial[0-learned-mesh_axes3]",
     "test_generate.py::test_decode_matches_inference_forward_moe_top2",
     "test_generate.py::test_generate_shapes_and_budget",
     "test_gqa_rope.py::test_gqa_flash_gradients_match_oracle",

@@ -237,12 +237,22 @@ def test_lm_trainer_accepts_model_axis(eight_devices):
     assert r.steps_run == 10 and np.isfinite(r.final_loss)
 
 
-def test_lm_model_and_seq_axes_reject(eight_devices):
+def test_lm_model_and_seq_axes_route_to_tp_sp(eight_devices):
+    """A model+seq mesh routes to the Megatron x ring step (round 3:
+    parallel/tp_sp.py — the former hard rejection); incompatible knobs
+    still fail loudly at setup."""
     from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
     from mpi_cuda_cnn_tpu.utils.config import LMConfig
 
-    cfg = LMConfig(corpus="synthetic", dim=32, depth=1, heads=4,
-                   seq_len=64, steps=5, batch_size=4,
-                   mesh_shape="model:2,seq:4")
-    with pytest.raises(ValueError, match="do not compose"):
-        LMTrainer(cfg, metrics=MetricsLogger(echo=False))
+    base = dict(corpus="synthetic", dim=32, depth=1, heads=4,
+                seq_len=64, steps=3, batch_size=4, log_every=0,
+                lr_schedule="constant", warmup_steps=0)
+    t = LMTrainer(LMConfig(mesh_shape="model:2,seq:4", **base),
+                  metrics=MetricsLogger(echo=False))
+    assert t.attn_impl == "ring"
+    with pytest.raises(ValueError, match="fsdp"):
+        LMTrainer(LMConfig(mesh_shape="model:2,seq:4", fsdp=True, **base),
+                  metrics=MetricsLogger(echo=False))
+    with pytest.raises(ValueError, match="attn-impl"):
+        LMTrainer(LMConfig(mesh_shape="model:2,seq:4", attn_impl="flash",
+                           **base), metrics=MetricsLogger(echo=False))
