@@ -47,7 +47,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.transformer import TransformerLM, _layernorm
 from ..ops.attention import rope
 from .mesh import DATA_AXIS, MODEL_AXIS
-from .sp import SEQ_AXIS, ring_attention
+from .sp import SEQ_AXIS, ring_attention, ring_flash_attention
 
 TrainState = dict[str, Any]
 
@@ -223,15 +223,27 @@ def make_tp_sp_lm_train_step(
     remat: bool = False,
     donate: bool = True,
     ce_chunk: int = 0,
+    impl: str = "ring",
 ):
     """Jitted Megatron x ring train step.
 
     step(state, tokens, targets) -> (state, {"loss": ...}); tokens (B, S)
     sharded (data?, seq) like the plain SP step. Inside: ring attention
-    over 'seq' with H/n_tp local heads, column/row-parallel matmuls over
-    'model' with the f/psum pair, loss on the local sequence shard.
+    over 'seq' with H/n_tp local heads (`impl="ring_flash"` folds each
+    hop with the fused Pallas flash kernel — the on-chip configuration;
+    needs 128-aligned per-shard sequences like the plain SP step),
+    column/row-parallel matmuls over 'model' with the f/psum pair, loss
+    on the local sequence shard.
     """
     _check_tp_sp(model, mesh.shape[MODEL_AXIS])
+    if impl == "ring":
+        attn_body = ring_attention
+    elif impl == "ring_flash":
+        attn_body = ring_flash_attention
+    else:
+        raise ValueError(
+            f"unknown TP x SP impl {impl!r}; 'ring' or 'ring_flash'"
+        )
     n_seq = mesh.shape[SEQ_AXIS]
     reduce_axes = tuple(a for a in (data_axis, SEQ_AXIS) if a)
     cd = compute_dtype
@@ -243,6 +255,16 @@ def make_tp_sp_lm_train_step(
             raise ValueError(
                 f"global sequence {s_local * n_seq} exceeds "
                 f"max_seq {model.max_seq}"
+            )
+        if impl == "ring_flash" and s_local % 128:
+            # Fail with GLOBAL context — the kernel's own check would
+            # name only the confusing shard-local length (same guard as
+            # the plain SP step, parallel/sp.py).
+            raise ValueError(
+                f"impl='ring_flash' needs the per-shard sequence to be a"
+                f" multiple of 128 (flash block granularity): global"
+                f" S={s_local * n_seq} over seq={n_seq} devices gives"
+                f" s_local={s_local}"
             )
         w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
         hd = model.head_dim
@@ -268,7 +290,7 @@ def make_tp_sp_lm_train_step(
             if model.pos == "rope":
                 q = rope(q, pos)
                 k = rope(k, pos)
-            o = ring_attention(q, k, v, axis=SEQ_AXIS, causal=True)
+            o = attn_body(q, k, v, axis=SEQ_AXIS, causal=True)
             part = jnp.einsum("bshx,hxd->bsd", o.astype(x.dtype),
                               w(blk["wo"]))
             x = x + tp_reduce(part)

@@ -57,6 +57,16 @@ def load_corpus(spec: str, package_root: Path | None = None) -> np.ndarray:
     return np.frombuffer(data, np.uint8).astype(np.int32)
 
 
+def _pick_ring_impl(seq_len: int, n_seq: int) -> str:
+    """Shared auto rule for the sequence-parallel fold: the fused flash
+    kernel on a real TPU with 128-aligned per-shard sequences (its block
+    granularity), the plain jnp ring otherwise. One definition for the
+    SP and TP x SP branches — the two must never drift."""
+    on_tpu = jax.default_backend() == "tpu"
+    return "ring_flash" if on_tpu and (seq_len // n_seq) % 128 == 0 \
+        else "ring"
+
+
 @dataclasses.dataclass
 class LMResult:
     steps_run: int
@@ -123,11 +133,12 @@ class LMTrainer:
                     "--fsdp does not compose with the TP x SP shard_map "
                     "step; drop it or use data:N,model:M"
                 )
-            if cfg.attn_impl not in ("auto", "oracle", "ring"):
+            if cfg.attn_impl not in ("auto", "oracle", "ring",
+                                     "ring_flash", "flash"):
                 raise ValueError(
                     f"--attn-impl {cfg.attn_impl!r} is not wired into "
-                    "TP x SP (its stage runs ring attention on the "
-                    "local heads); use auto"
+                    "TP x SP (its stage runs ring/ring_flash attention "
+                    "on the local heads); use auto"
                 )
             if cfg.grad_clip:
                 raise ValueError(
@@ -245,7 +256,14 @@ class LMTrainer:
                 make_tp_sp_state,
             )
 
-            self.attn_impl = "ring"
+            # Honor an explicit choice; "auto"/"flash" use the shared
+            # rule, "oracle" maps to the exact jnp ring.
+            impl = cfg.attn_impl
+            if impl in ("auto", "flash"):
+                impl = _pick_ring_impl(cfg.seq_len, self.n_seq)
+            elif impl == "oracle":
+                impl = "ring"
+            self.attn_impl = impl
             params = self.model.init(jax.random.key(cfg.seed))
             self.state, specs = make_tp_sp_state(
                 self.model, params, self.optimizer, self.mesh
@@ -254,15 +272,12 @@ class LMTrainer:
                 self.model, self.optimizer, self.mesh, specs,
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
                 compute_dtype=compute_dtype, remat=cfg.remat,
-                ce_chunk=cfg.ce_chunk,
+                ce_chunk=cfg.ce_chunk, impl=self.attn_impl,
             )
         elif self.n_seq > 1:
             impl = cfg.attn_impl
             if impl in ("auto", "flash"):
-                # ring_flash needs 128-aligned shards; plain ring otherwise.
-                on_tpu = jax.default_backend() == "tpu"
-                local = cfg.seq_len // self.n_seq
-                impl = "ring_flash" if on_tpu and local % 128 == 0 else "ring"
+                impl = _pick_ring_impl(cfg.seq_len, self.n_seq)
             elif impl == "oracle":
                 impl = "ring"
             self.attn_impl = impl

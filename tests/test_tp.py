@@ -254,5 +254,9 @@ def test_lm_model_and_seq_axes_route_to_tp_sp(eight_devices):
         LMTrainer(LMConfig(mesh_shape="model:2,seq:4", fsdp=True, **base),
                   metrics=MetricsLogger(echo=False))
     with pytest.raises(ValueError, match="attn-impl"):
-        LMTrainer(LMConfig(mesh_shape="model:2,seq:4", attn_impl="flash",
+        LMTrainer(LMConfig(mesh_shape="model:2,seq:4", attn_impl="ulysses",
                            **base), metrics=MetricsLogger(echo=False))
+    # An explicit ring/ring_flash request is honored, not auto-overridden.
+    t2 = LMTrainer(LMConfig(mesh_shape="model:2,seq:2", attn_impl="ring",
+                            **base), metrics=MetricsLogger(echo=False))
+    assert t2.attn_impl == "ring"
