@@ -134,6 +134,7 @@ def make_pp_lm_train_step(
     compute_dtype=None,
     remat: bool = False,
     donate: bool = True,
+    grad_clip: float = 0.0,
 ):
     """Jitted GPipe train step for the LM (state from make_pp_lm_state —
     its structure supplies the shard_map specs, as in pp.py).
@@ -252,6 +253,22 @@ def make_pp_lm_train_step(
         if has_data:
             grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
             loss = lax.pmean(loss, DATA_AXIS)
+        if grad_clip > 0:
+            # Cross-stage global norm, each logical parameter once: the
+            # block slices are DISJOINT over 'pipe' (psum their squared
+            # norms), the psum-repaired rest is identical on every stage
+            # (count once). The scale comes out identical on every rank;
+            # the clip semantics live in ONE shared helper.
+            from ..train.optimizer import clip_grads_by_global_sq
+
+            def sq(tree):
+                return sum(
+                    jnp.sum(jnp.square(g).astype(jnp.float32))
+                    for g in jax.tree.leaves(tree)
+                )
+
+            gn2 = lax.psum(sq(grads["blocks"]), PIPE_AXIS) + sq(grads["rest"])
+            grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

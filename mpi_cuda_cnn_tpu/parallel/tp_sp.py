@@ -224,6 +224,7 @@ def make_tp_sp_lm_train_step(
     donate: bool = True,
     ce_chunk: int = 0,
     impl: str = "ring",
+    grad_clip: float = 0.0,
 ):
     """Jitted Megatron x ring train step.
 
@@ -318,6 +319,30 @@ def make_tp_sp_lm_train_step(
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
 
+    # Sliced block leaves are DISJOINT over 'model' (each rank holds its
+    # own slice of one logical parameter); everything else is replicated
+    # (identical on every rank). The global gradient norm must count each
+    # logical parameter exactly once: psum the sliced leaves' squared
+    # norms over 'model', add the replicated leaves' once. Which leaves
+    # are sliced is DERIVED from the very PartitionSpecs the step shards
+    # with (MODEL_AXIS present), so the two can never drift.
+    _param_spec_leaves = jax.tree_util.tree_leaves(
+        state_specs["params"], is_leaf=lambda x: isinstance(x, P)
+    )
+
+    def _global_grad_sq(grads):
+        grad_leaves = jax.tree_util.tree_leaves(grads)
+        assert len(grad_leaves) == len(_param_spec_leaves)
+        sliced = jnp.float32(0)
+        rep = jnp.float32(0)
+        for g, s in zip(grad_leaves, _param_spec_leaves):
+            term = jnp.sum(jnp.square(g).astype(jnp.float32))
+            if MODEL_AXIS in tuple(s):
+                sliced = sliced + term
+            else:
+                rep = rep + term
+        return lax.psum(sliced, MODEL_AXIS) + rep
+
     def step(state, tokens, targets):
         loss, grads = jax.value_and_grad(local_loss)(
             state["params"], tokens, targets
@@ -328,6 +353,14 @@ def make_tp_sp_lm_train_step(
         # never over 'model' (it would average unrelated slices).
         grads = jax.tree.map(lambda g: lax.pmean(g, reduce_axes), grads)
         loss = lax.pmean(loss, reduce_axes)
+        if grad_clip > 0:
+            # The CROSS-RANK norm is identical on every rank (psum +
+            # replicated sums), so the clip scale is too.
+            from ..train.optimizer import clip_grads_by_global_sq
+
+            grads = clip_grads_by_global_sq(
+                grads, _global_grad_sq(grads), grad_clip
+            )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

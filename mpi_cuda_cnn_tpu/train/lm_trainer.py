@@ -140,14 +140,6 @@ class LMTrainer:
                     "TP x SP (its stage runs ring/ring_flash attention "
                     "on the local heads); use auto"
                 )
-            if cfg.grad_clip:
-                raise ValueError(
-                    "--grad-clip does not compose with TP x SP: "
-                    "clip_by_global_norm inside shard_map would compute "
-                    "each model rank's clip scale from its PARTIAL "
-                    "weight-slice norm, silently corrupting the "
-                    "replicated leaves; drop the flag or the model axis"
-                )
         if self.n_pipe > 1 and (self.n_seq > 1 or self.n_model > 1
                                 or cfg.fsdp):
             raise ValueError(
@@ -160,14 +152,6 @@ class LMTrainer:
                 f"batch_size {cfg.batch_size} not divisible by "
                 f"num_microbatches x data-axis "
                 f"({self.n_pipe} x {self.n_data})"
-            )
-        if self.n_pipe > 1 and cfg.grad_clip:
-            raise ValueError(
-                "--grad-clip does not compose with the pipelined step: "
-                "clip_by_global_norm inside shard_map would clip each "
-                "stage's LOCAL block grads with a different scale (and "
-                "diverge the replicated embedding/head copies); drop the "
-                "flag or the pipe axis"
             )
         if self.n_pipe > 1 and cfg.attn_impl not in ("auto", "oracle"):
             raise ValueError(
@@ -210,10 +194,17 @@ class LMTrainer:
                 "warmup_steps %d >= steps %d; clamped to %d",
                 cfg.warmup_steps, cfg.steps, warmup,
             )
+        # The pipelined and Megatron x ring steps clip IN-STEP with a
+        # cross-rank-correct global norm (their params are sharded, so
+        # optax's per-rank clip_by_global_norm would compute a partial
+        # norm); everywhere else the optax transform does it.
+        clip_in_step = self.n_pipe > 1 or (self.n_model > 1
+                                           and self.n_seq > 1)
         self.optimizer = make_optimizer(
             cfg.lr, opt="adamw", schedule=cfg.lr_schedule,
             total_steps=cfg.steps or None, warmup_steps=warmup,
-            weight_decay=cfg.weight_decay, grad_clip=cfg.grad_clip,
+            weight_decay=cfg.weight_decay,
+            grad_clip=0.0 if clip_in_step else cfg.grad_clip,
         )
         compute_dtype = (
             jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
@@ -249,6 +240,7 @@ class LMTrainer:
             self.train_step = make_pp_lm_train_step(
                 self.model, self.optimizer, self.mesh, self.state,
                 compute_dtype=compute_dtype, remat=cfg.remat,
+                grad_clip=cfg.grad_clip,
             )
         elif self.n_seq > 1 and self.n_model > 1:
             from ..parallel.tp_sp import (
@@ -273,6 +265,7 @@ class LMTrainer:
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
                 compute_dtype=compute_dtype, remat=cfg.remat,
                 ce_chunk=cfg.ce_chunk, impl=self.attn_impl,
+                grad_clip=cfg.grad_clip,
             )
         elif self.n_seq > 1:
             impl = cfg.attn_impl

@@ -16,6 +16,25 @@ from __future__ import annotations
 import optax
 
 
+def clip_grads_by_global_sq(grads, sq_norm, clip: float):
+    """optax.clip_by_global_norm semantics from a PRE-COMPUTED squared
+    norm: g * clip / max(norm, clip).
+
+    The sharded-param shard_map steps (parallel/pp_lm.py,
+    parallel/tp_sp.py) cannot use the optax transform — it would compute
+    a per-rank PARTIAL norm — so they assemble the cross-rank squared
+    norm themselves (psum of disjoint slices + replicated leaves once)
+    and share this one clip application; the semantics must never drift
+    between meshes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    norm = jnp.sqrt(sq_norm)
+    scale = (clip / jnp.maximum(norm, clip)).astype(jnp.float32)
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
 def make_optimizer(
     lr: float = 0.1,
     *,

@@ -132,12 +132,39 @@ def test_lm_trainer_pipeline_e2e(eight_devices):
                   metrics=MetricsLogger(echo=False))
     # Knobs that would silently mis-compose with the pipelined step fail
     # loudly at setup instead.
-    with pytest.raises(ValueError, match="grad-clip"):
-        LMTrainer(LMConfig(mesh_shape="pipe:2", grad_clip=1.0, **base),
-                  metrics=MetricsLogger(echo=False))
     with pytest.raises(ValueError, match="attn-impl"):
         LMTrainer(LMConfig(mesh_shape="pipe:2", attn_impl="flash", **base),
                   metrics=MetricsLogger(echo=False))
+
+
+def test_pp_lm_grad_clip_matches_serial(eight_devices):
+    """--grad-clip under the pipelined step: the in-step cross-stage
+    global-norm clip (block slices psummed over 'pipe', the repaired
+    rest counted once) must equal the serial step's optax
+    clip_by_global_norm — with a clip small enough to actually engage."""
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+
+    model, _, tokens, targets = _pieces()
+    clip = 0.05
+    serial_opt = make_optimizer(0.1, grad_clip=clip)
+    serial_step = make_lm_train_step(model, serial_opt, attn_impl="oracle",
+                                     seq_len=32, donate=False)
+    want_state, _ = serial_step(make_lm_state(model, serial_opt, seed=0),
+                                tokens, targets)
+
+    mesh = make_mesh({PIPE_AXIS: 2, DATA_AXIS: 2}, devices=jax.devices()[:4])
+    plain_opt = make_optimizer(0.1)  # clip happens IN the step
+    params = model.init(jax.random.key(0))
+    state = make_pp_lm_state(model, params, plain_opt, mesh)
+    step = make_pp_lm_train_step(model, plain_opt, mesh, state,
+                                 donate=False, grad_clip=clip)
+    mb = pp_lm_shard_batch(pp_lm_microbatch(tokens, targets, 2), mesh)
+    got_state, _ = step(state, *mb)
+    got = unstack_blocks(jax.device_get(got_state["params"]), model.depth)
+    for a, b in zip(jax.tree.leaves(got),
+                    jax.tree.leaves(jax.device_get(want_state["params"]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
 
 
 def test_lm_pipeline_checkpoint_resume(tmp_path, eight_devices):

@@ -154,3 +154,35 @@ def test_tp_sp_ring_flash_matches_serial(eight_devices):
                     jax.tree.leaves(jax.device_get(want_state["params"]))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_tp_sp_grad_clip_matches_serial(eight_devices):
+    """--grad-clip under TP x SP: the in-step global-norm clip (sliced
+    leaves psummed over 'model', replicated leaves counted once) must
+    equal the serial step's optax clip_by_global_norm."""
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+
+    model, _, tokens, targets = _pieces()
+    clip = 0.05
+    serial_opt = make_optimizer(0.1, grad_clip=clip)
+    serial_step = make_lm_train_step(model, serial_opt, attn_impl="oracle",
+                                     seq_len=32, donate=False)
+    want_state, _ = serial_step(make_lm_state(model, serial_opt, seed=0),
+                                tokens, targets)
+
+    mesh = make_mesh({SEQ_AXIS: 2, MODEL_AXIS: 2}, devices=jax.devices()[:4])
+    plain_opt = make_optimizer(0.1)  # clip happens IN the step
+    params = model.init(jax.random.key(0))
+    state, specs = make_tp_sp_state(model, params, plain_opt, mesh)
+    step = make_tp_sp_lm_train_step(model, plain_opt, mesh, specs,
+                                    donate=False, grad_clip=clip)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bs = NamedSharding(mesh, P(None, SEQ_AXIS))
+    got_state, _ = step(state, jax.device_put(tokens, bs),
+                        jax.device_put(targets, bs))
+    got = from_tp_layout(jax.device_get(got_state["params"]), model)
+    for a, b in zip(jax.tree.leaves(got),
+                    jax.tree.leaves(jax.device_get(want_state["params"]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
