@@ -135,15 +135,22 @@ def make_pp_lm_train_step(
     remat: bool = False,
     donate: bool = True,
     grad_clip: float = 0.0,
+    attn_impl: str = "oracle",
+    ce_chunk: int = 0,
 ):
     """Jitted GPipe train step for the LM (state from make_pp_lm_state —
     its structure supplies the shard_map specs, as in pp.py).
 
     step(state, toks_mb, tgt_mb) -> (state, {"loss": ...}); toks/tgt are
-    (M, mb, S) int32 placed via pp_lm_shard_batch. Attention inside each
-    stage is the full causal oracle over the UNSHARDED sequence (PP
-    shards blocks and microbatches, not positions — SP is the sequence
-    axis; the two meshes are alternatives by construction).
+    (M, mb, S) int32 placed via pp_lm_shard_batch. Each stage sees the
+    UNSHARDED sequence (PP shards blocks and microbatches, not positions),
+    so the plain fused flash kernel drops straight in: `attn_impl`
+    routes "flash" to the Pallas pair, "oracle" to the quadratic jnp
+    reference — no ring machinery needed (that is SP's job). `ce_chunk`
+    fuses the last stage's drain head-matmul into the chunked CE
+    (ops/losses.py chunked_ce_mean), so the (mb, S, V) f32 logits are
+    never materialized per drained microbatch — PP exists for big
+    models, which is exactly where the logits bill binds.
     """
     n_pipe = mesh.shape[PIPE_AXIS]
     _check_pp_lm(model, n_pipe)
@@ -152,9 +159,9 @@ def make_pp_lm_train_step(
     cd = compute_dtype
     fwd_perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
 
-    from ..ops.attention import attention
+    from ..train.lm import get_attn_fn
 
-    attn = lambda q, k, v: attention(q, k, v, causal=True)
+    attn = get_attn_fn(attn_impl)
 
     def local_loss(packed, toks_mb, tgt_mb):
         blocks = packed["blocks"]      # local (L/P, ...)
@@ -193,6 +200,11 @@ def make_pp_lm_train_step(
 
         def drain_nll(y, tgt):
             feats = _layernorm(y, rest["ln_f"]["g"], rest["ln_f"]["b"])
+            if ce_chunk:
+                from ..ops.losses import chunked_ce_mean
+
+                return chunked_ce_mean(feats, rest["head"], tgt,
+                                       ce_chunk, cd)
             logits = jnp.matmul(
                 feats, w(rest["head"]), preferred_element_type=jnp.float32
             )

@@ -68,7 +68,10 @@ def get_attn_fn(impl: str):
         from ..ops.attention import attention
 
         return lambda q, k, v: attention(q, k, v, causal=True)
-    raise ValueError(f"unknown attention impl {impl!r}; 'flash'|'oracle'|'auto'")
+    raise ValueError(
+        f"unknown attention impl {impl!r}; use 'flash' or 'oracle' "
+        "(resolve 'auto' with pick_attn_impl first)"
+    )
 
 
 def lm_loss(

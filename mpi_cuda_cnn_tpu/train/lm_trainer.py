@@ -153,12 +153,12 @@ class LMTrainer:
                 f"num_microbatches x data-axis "
                 f"({self.n_pipe} x {self.n_data})"
             )
-        if self.n_pipe > 1 and cfg.attn_impl not in ("auto", "oracle"):
+        if self.n_pipe > 1 and cfg.attn_impl not in ("auto", "oracle",
+                                                     "flash"):
             raise ValueError(
-                f"--attn-impl {cfg.attn_impl!r} is not wired into the "
-                "pipelined step (each stage runs full causal attention "
-                "over the unsharded sequence); use auto/oracle or an SP "
-                "mesh for the flash/ring kernels"
+                f"--attn-impl {cfg.attn_impl!r} needs a 'seq' mesh axis "
+                "(ring attention shards positions); the pipelined stages "
+                "see the full sequence — use auto, flash, or oracle"
             )
         if cfg.batch_size % self.n_data:
             raise ValueError(
@@ -211,8 +211,7 @@ class LMTrainer:
         )
         self._compute_dtype = compute_dtype
 
-        if cfg.ce_chunk and self.n_seq > 1 and \
-                (cfg.seq_len // self.n_seq) % cfg.ce_chunk:
+        if cfg.ce_chunk and (cfg.seq_len // self.n_seq) % cfg.ce_chunk:
             raise ValueError(
                 f"--ce-chunk {cfg.ce_chunk} must divide the per-shard "
                 f"sequence {cfg.seq_len // self.n_seq} (seq_len "
@@ -226,13 +225,11 @@ class LMTrainer:
                 make_pp_lm_train_step,
             )
 
-            if cfg.ce_chunk:
-                raise ValueError(
-                    "--ce-chunk is not wired into the pipelined LM loss "
-                    "yet (the last stage computes CE per drained "
-                    "microbatch); drop the flag or the pipe axis"
-                )
-            self.attn_impl = "oracle"  # full causal attention per stage
+            # Each stage sees the full sequence, so the plain attention
+            # router applies unchanged — flash per stage on TPU.
+            self.attn_impl = pick_attn_impl(
+                cfg.attn_impl, cfg.seq_len, compute_dtype
+            )
             params = self.model.init(jax.random.key(cfg.seed))
             self.state = make_pp_lm_state(
                 self.model, params, self.optimizer, self.mesh
@@ -240,7 +237,8 @@ class LMTrainer:
             self.train_step = make_pp_lm_train_step(
                 self.model, self.optimizer, self.mesh, self.state,
                 compute_dtype=compute_dtype, remat=cfg.remat,
-                grad_clip=cfg.grad_clip,
+                grad_clip=cfg.grad_clip, attn_impl=self.attn_impl,
+                ce_chunk=cfg.ce_chunk,
             )
         elif self.n_seq > 1 and self.n_model > 1:
             from ..parallel.tp_sp import (

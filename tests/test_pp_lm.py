@@ -130,11 +130,71 @@ def test_lm_trainer_pipeline_e2e(eight_devices):
     with pytest.raises(ValueError, match="composes with 'data' only"):
         LMTrainer(LMConfig(mesh_shape="pipe:2,seq:2", **base),
                   metrics=MetricsLogger(echo=False))
-    # Knobs that would silently mis-compose with the pipelined step fail
-    # loudly at setup instead.
+    # Ring impls shard positions, which the pipelined stages don't —
+    # they fail loudly at setup; flash/oracle are routed per stage.
     with pytest.raises(ValueError, match="attn-impl"):
-        LMTrainer(LMConfig(mesh_shape="pipe:2", attn_impl="flash", **base),
+        LMTrainer(LMConfig(mesh_shape="pipe:2", attn_impl="ring", **base),
                   metrics=MetricsLogger(echo=False))
+    # --ce-chunk composes with the pipe axis (chunked drain CE) but the
+    # chunk must divide the sequence.
+    with pytest.raises(ValueError, match="ce-chunk"):
+        LMTrainer(LMConfig(mesh_shape="pipe:2", ce_chunk=48, **base),
+                  metrics=MetricsLogger(echo=False))
+    t = LMTrainer(LMConfig(mesh_shape="pipe:2,data:2", ce_chunk=16, **base),
+                  metrics=MetricsLogger(echo=False))
+    r = t.train()
+    assert r.steps_run == 8 and np.isfinite(r.eval_ppl)
+
+
+def test_pp_lm_flash_matches_oracle(eight_devices):
+    """attn_impl='flash' inside the pipelined stages == the oracle: the
+    stages see the UNSHARDED sequence, so the fused kernel drops in with
+    no ring machinery (VERDICT r3 item 3 — the kernel the path used to
+    force to oracle). S=128 = the kernel's block granularity."""
+    model = TransformerLM(vocab=32, dim=64, heads=2, depth=2, max_seq=128)
+    opt = optax.sgd(0.1)
+    rng = np.random.default_rng(7)
+    toks = jnp.asarray(rng.integers(0, 32, (4, 129)), jnp.int32)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+    mesh = make_mesh({PIPE_AXIS: 2}, devices=jax.devices()[:2])
+    params = model.init(jax.random.key(0))
+    outs = {}
+    for impl in ("oracle", "flash"):
+        state = make_pp_lm_state(model, params, opt, mesh)
+        step = make_pp_lm_train_step(model, opt, mesh, state,
+                                     donate=False, attn_impl=impl)
+        mb = pp_lm_shard_batch(pp_lm_microbatch(tokens, targets, 2), mesh)
+        ns, m = step(state, *mb)
+        outs[impl] = (float(m["loss"]), jax.device_get(ns["params"]))
+    np.testing.assert_allclose(outs["flash"][0], outs["oracle"][0],
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs["flash"][1]),
+                    jax.tree.leaves(outs["oracle"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_pp_lm_ce_chunk_matches_dense(eight_devices):
+    """--ce-chunk under the pipe axis: the last stage's chunked drain CE
+    (never materializing the (mb, S, V) logits) == the dense drain, loss
+    and updated params (VERDICT r3 item 4)."""
+    model, opt, tokens, targets = _pieces()
+    mesh = make_mesh({PIPE_AXIS: 2, DATA_AXIS: 2}, devices=jax.devices()[:4])
+    params = model.init(jax.random.key(0))
+    outs = {}
+    for chunk in (0, 16):
+        state = make_pp_lm_state(model, params, opt, mesh)
+        step = make_pp_lm_train_step(model, opt, mesh, state,
+                                     donate=False, ce_chunk=chunk)
+        mb = pp_lm_shard_batch(pp_lm_microbatch(tokens, targets, 2), mesh)
+        ns, m = step(state, *mb)
+        outs[chunk] = (float(m["loss"]), jax.device_get(ns["params"]))
+    np.testing.assert_allclose(outs[16][0], outs[0][0], rtol=1e-5,
+                               atol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs[16][1]),
+                    jax.tree.leaves(outs[0][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
 
 
 def test_pp_lm_grad_clip_matches_serial(eight_devices):
