@@ -507,7 +507,8 @@ def microbatch(x, y, num_microbatches: int):
 
 
 def _make_step_body(plan: PipelinePlan, optimizer, mesh,
-                    augment=None, aug_seed: int = 0):
+                    augment=None, aug_seed: int = 0,
+                    grad_clip: float = 0.0):
     """The per-device PP(+DP) train-step body shared by the one-batch step
     and the scanned epoch (the PP twin of dp._make_step_body).
 
@@ -523,6 +524,17 @@ def _make_step_body(plan: PipelinePlan, optimizer, mesh,
     shards (the DP pmean) and hands each device exactly its shard's
     slice (the ZeRO reduce-scatter) — master params + optimizer state
     stay sharded; only the transient gathered row is ever full-width.
+
+    grad_clip > 0 clips IN-STEP with the cross-rank global norm (the
+    packed rows are sharded, so optax's clip_by_global_norm would see a
+    per-rank partial norm): stage rows are disjoint over 'pipe' (psum
+    their squared norms); under TP the sliced segments are disjoint over
+    'model' (psum) while the psum-repaired replicated segments are
+    identical on every model rank (count once, via the same rep_mask the
+    repair uses); under FSDP the post-scatter slices are disjoint over
+    'data' (psum). The scale application lives in the ONE shared helper
+    (train/optimizer.py clip_grads_by_global_sq) so the semantics cannot
+    drift from the LM steps'.
     """
     local_loss = _make_local_loss(plan)
     tp = plan.n_model > 1
@@ -576,6 +588,31 @@ def _make_step_body(plan: PipelinePlan, optimizer, mesh,
             loss, etot, acc = (
                 jax.lax.pmean(m, DATA_AXIS) for m in (loss, etot, acc)
             )
+        if grad_clip > 0:
+            from ..train.optimizer import clip_grads_by_global_sq
+
+            sq = jnp.square(grads).astype(jnp.float32)
+            if tp:
+                row = rep_mask[jax.lax.axis_index(PIPE_AXIS)]
+                if plan.fsdp:
+                    # Post-scatter grads hold the 1/n_data slice of the
+                    # row's last dim — align the full-width mask to it.
+                    w = grads.shape[-1]
+                    row = jax.lax.dynamic_slice_in_dim(
+                        row, jax.lax.axis_index(DATA_AXIS) * w, w, -1
+                    )
+                g2 = jax.lax.psum(jnp.sum(sq * (1.0 - row)), MODEL_AXIS) \
+                    + jnp.sum(sq * row)
+            else:
+                g2 = jnp.sum(sq)
+            gn2 = jax.lax.psum(g2, PIPE_AXIS)
+            if plan.fsdp:
+                # Data shards are disjoint slices — the rep-segment
+                # pieces too (each data rank holds different positions
+                # of the repaired copy), so one psum completes BOTH
+                # sums above.
+                gn2 = jax.lax.psum(gn2, DATA_AXIS)
+            grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["flat_params"]
         )
@@ -596,6 +633,7 @@ def make_pp_train_step(
     donate: bool = True,
     augment=None,
     aug_seed: int = 0,
+    grad_clip: float = 0.0,
 ):
     """Build the jitted PP(+DP) train step.
 
@@ -604,7 +642,8 @@ def make_pp_train_step(
     steps' {loss, etotal, acc} means, so the Trainer can treat all three
     parallel modes uniformly.
     """
-    step = _make_step_body(plan, optimizer, mesh, augment, aug_seed)
+    step = _make_step_body(plan, optimizer, mesh, augment, aug_seed,
+                           grad_clip)
     specs = _state_specs(state, plan.n_stages, plan.n_model, plan.fsdp)
     bspec = _batch_spec(mesh)
     sharded = jax.shard_map(
@@ -628,6 +667,7 @@ def make_pp_scan_epoch(
     donate: bool = True,
     augment=None,
     aug_seed: int = 0,
+    grad_clip: float = 0.0,
 ):
     """Scanned-epoch twin of dp.make_dp_scan_epoch for the pipelined path:
     lax.scan over a batch-index permutation with the uint8 dataset
@@ -641,7 +681,8 @@ def make_pp_scan_epoch(
     """
     from ..data.pipeline import PIXEL_SCALE
 
-    step = _make_step_body(plan, optimizer, mesh, augment, aug_seed)
+    step = _make_step_body(plan, optimizer, mesh, augment, aug_seed,
+                           grad_clip)
     M = num_microbatches
 
     def epoch(state: TrainState, images, labels, perm):

@@ -145,12 +145,17 @@ class Trainer:
 
         self.steps_per_epoch = self.num_train // config.batch_size
         total_steps = self.steps_per_epoch * config.epochs
+        # The pipelined step clips IN-STEP with a cross-rank-correct
+        # global norm (its packed rows are sharded, so optax's
+        # clip_by_global_norm would compute a per-rank partial norm) —
+        # same split as the LM trainer's sharded-param paths.
+        pp_clip = self.mesh.shape.get(PIPE_AXIS, 1) > 1
         self.optimizer = make_optimizer(
             config.lr,
             momentum=config.momentum,
             schedule=config.lr_schedule,
             total_steps=total_steps or None,
-            grad_clip=config.grad_clip,
+            grad_clip=0.0 if pp_clip else config.grad_clip,
         )
 
         # One keyed init, replicated to every device (fixes the reference's
@@ -194,13 +199,6 @@ class Trainer:
                     f"add a data axis of size > 1 (mesh_shape="
                     f"{config.mesh_shape!r})"
                 )
-            if config.grad_clip:
-                raise ValueError(
-                    "--grad-clip does not compose with the pipeline path: "
-                    "clip_by_global_norm inside shard_map would clip each "
-                    "stage's LOCAL row with a different scale; drop the "
-                    "flag or the pipe axis"
-                )
             self._pp_M = config.num_microbatches or self.n_pipe
             if config.batch_size % (self._pp_M * n_data):
                 raise ValueError(
@@ -220,6 +218,7 @@ class Trainer:
                 self._pp_plan, self.optimizer, self.mesh, self.state,
                 donate=config.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
+                grad_clip=config.grad_clip,
             )
             self.eval_step = make_pp_forward(self._pp_plan, self.mesh)
         elif self.n_model > 1 or config.fsdp:
@@ -469,6 +468,7 @@ class Trainer:
                 self._pp_plan, self.optimizer, self.mesh, self.state,
                 self.ds.num_classes, self._pp_M, donate=self.cfg.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
+                grad_clip=self.cfg.grad_clip,
             )
         elif self.n_model > 1 or self.cfg.fsdp:
             # Both GSPMD paths (TP-sharded or FSDP-sharded params) scan

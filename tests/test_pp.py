@@ -14,7 +14,12 @@ import pytest
 
 from mpi_cuda_cnn_tpu.models.initializers import get_initializer
 from mpi_cuda_cnn_tpu.models.layers import Conv, Dense, Flatten, Sequential
-from mpi_cuda_cnn_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, make_mesh
+from mpi_cuda_cnn_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    make_mesh,
+)
 from mpi_cuda_cnn_tpu.parallel.pp import (
     make_pipeline_plan,
     make_pp_forward,
@@ -158,6 +163,54 @@ def test_pp_forward_matches_apply(setup, eight_devices, rng):
         np.asarray(ref_logits),
         rtol=1e-5, atol=1e-6,
     )
+
+
+@pytest.mark.parametrize("mesh_axes,n_model,fsdp", [
+    ({PIPE_AXIS: 2}, 1, False),
+    ({PIPE_AXIS: 2, DATA_AXIS: 2}, 1, False),
+    ({PIPE_AXIS: 2, MODEL_AXIS: 2}, 2, False),
+    ({PIPE_AXIS: 2, DATA_AXIS: 2}, 1, True),
+    ({PIPE_AXIS: 2, MODEL_AXIS: 2, DATA_AXIS: 2}, 2, True),
+])
+def test_pp_grad_clip_matches_optax(setup, eight_devices, rng,
+                                    mesh_axes, n_model, fsdp):
+    """--grad-clip on the pipelined path (VERDICT r3 item 5): the in-step
+    cross-rank global-norm clip — stage rows psummed over 'pipe', sliced
+    TP segments over 'model', FSDP slices over 'data', the psum-repaired
+    replicated segments counted once — must equal optax's
+    clip_by_global_norm on the serial gradient, with a clip small enough
+    to engage."""
+    import optax
+
+    model, params = setup
+    x, y = _data(rng)
+    clip = 0.05
+    loss_fn = make_loss_fn(model)
+    _, ref_grads = jax.value_and_grad(loss_fn, has_aux=True)(params, x, y)
+    serial_opt = make_optimizer(0.1, grad_clip=clip)
+    updates, _ = serial_opt.update(ref_grads, serial_opt.init(params), params)
+    ref_next = optax.apply_updates(params, updates)
+
+    n = int(np.prod(list(mesh_axes.values())))
+    mesh = make_mesh(mesh_axes, devices=eight_devices[:n])
+    n_data = mesh_axes.get(DATA_AXIS, 1)
+    plan = make_pipeline_plan(model, 2, n_model=n_model,
+                              fsdp_degree=n_data if fsdp else 1)
+    opt = make_optimizer(0.1)  # clip happens IN the step
+    state = make_pp_state(plan, params, opt, mesh)
+    step = make_pp_train_step(plan, opt, mesh, state, donate=False,
+                              grad_clip=clip)
+    x_mb, y_mb = pp_shard_batch(microbatch(x, y, 2), mesh)
+    new_state, _ = step(state, x_mb, y_mb)
+
+    pp_next = unpack_params(plan, jax.device_get(new_state["flat_params"]))
+    for a, b in zip(ref_next, pp_next):
+        jax.tree.map(
+            lambda u, v: np.testing.assert_allclose(
+                np.asarray(u), np.asarray(v), rtol=1e-5, atol=1e-6
+            ),
+            a, b,
+        )
 
 
 def test_pp_training_reduces_loss(setup, eight_devices, rng):
