@@ -181,22 +181,35 @@ def make_tp_sp_state(model: TransformerLM, params, optimizer, mesh
 
     def state_specs(st):
         leaves, treedef = jax.tree_util.tree_flatten_with_path(st)
+        # Pair each param's FULL path-in-params with (spec, exact shape):
+        # opt_state nests the params tree under transformation wrappers,
+        # so a params-mirroring buffer's path ends with the full param
+        # path. Requiring the exact shape too (not rank, the old
+        # heuristic) means a wrapper's own buffer can only be mis-specced
+        # if it aliases BOTH the complete path suffix and the shape of a
+        # param — at which point it is that param's mirror in all but
+        # name (advisor r3: suffix+ndim could sliver-match e.g. a
+        # same-rank buffer nested under a 'blocks'/'w1'-like key).
+        params_flat = jax.tree_util.tree_flatten_with_path(
+            params_tp
+        )[0]
+        spec_flat = jax.tree_util.tree_flatten_with_path(
+            pspecs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+        assert len(params_flat) == len(spec_flat)
         pspec_flat = {
-            tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path):
-                s
-            for path, s in jax.tree_util.tree_flatten_with_path(
-                pspecs, is_leaf=lambda x: isinstance(x, P)
-            )[0]
+            tuple(str(getattr(p, "key", getattr(p, "idx", p)))
+                  for p in ppath): (s, tuple(pleaf.shape))
+            for (ppath, pleaf), (_, s) in zip(params_flat, spec_flat)
         }
 
         def spec_for(path, leaf):
             keys = tuple(
                 str(getattr(p, "key", getattr(p, "idx", p))) for p in path
             )
-            # Match the params-relative suffix: opt_state nests the
-            # params tree under transformation wrappers.
-            for k, s in pspec_flat.items():
-                if keys[-len(k):] == k and getattr(leaf, "ndim", 0) == len(s):
+            for k, (s, shp) in pspec_flat.items():
+                if keys[-len(k):] == k and \
+                        tuple(getattr(leaf, "shape", ())) == shp:
                     return s
             return P()
 

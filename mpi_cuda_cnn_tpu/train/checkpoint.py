@@ -106,6 +106,39 @@ class AsyncCheckpointer:
             self._executor.shutdown(wait=True)
             self._executor = None
 
+    # Context-manager + finalizer support: the trainers close() in a
+    # finally around their loop, so an in-flight write's failure re-raises
+    # (chained) even when train() itself raises between saves; __del__ is
+    # the last-resort net for a dropped object — it cannot raise, so it
+    # logs the lost error and releases the worker thread.
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            if self._pending is not None:
+                def _log_failure(fut):
+                    err = fut.exception()
+                    if err is not None:
+                        import logging
+
+                        logging.getLogger("mpi_cuda_cnn_tpu").error(
+                            "async checkpoint write failed (object "
+                            "dropped before wait/close): %r", err,
+                        )
+
+                # Fires immediately if already done, else when the
+                # write lands — the in-flight case is exactly the one
+                # a dropped object would otherwise lose.
+                self._pending.add_done_callback(_log_failure)
+            if self._executor is not None:
+                self._executor.shutdown(wait=False)
+        except Exception:
+            pass  # interpreter teardown: never raise from __del__
+
 
 def _list_checkpoints(ckpt_dir: Path) -> list[Path]:
     found = [(int(m.group(1)), p) for p in ckpt_dir.glob("ckpt_*.npz")

@@ -401,25 +401,30 @@ class LMTrainer:
         t0 = time.perf_counter()
         loss = float("nan")
         m = None
-        for step in range(start_step, cfg.steps):
-            tokens, targets = self._sample_batch(step)
-            self.state, m = self.train_step(
-                self.state, self._place(tokens), self._place(targets)
-            )
-            if cfg.log_every and (step + 1) % cfg.log_every == 0:
-                loss = float(m["loss"])
-                self.metrics.log("train", step=step + 1, loss=loss)
-            if cfg.checkpoint_dir and cfg.checkpoint_every and (
-                (step + 1) % cfg.checkpoint_every == 0
-            ):
-                self._ckpt.save(self.state, step + 1)
-        hard_block(self.state)
-        dt = time.perf_counter() - t0
+        try:
+            for step in range(start_step, cfg.steps):
+                tokens, targets = self._sample_batch(step)
+                self.state, m = self.train_step(
+                    self.state, self._place(tokens), self._place(targets)
+                )
+                if cfg.log_every and (step + 1) % cfg.log_every == 0:
+                    loss = float(m["loss"])
+                    self.metrics.log("train", step=step + 1, loss=loss)
+                if cfg.checkpoint_dir and cfg.checkpoint_every and (
+                    (step + 1) % cfg.checkpoint_every == 0
+                ):
+                    self._ckpt.save(self.state, step + 1)
+            hard_block(self.state)
+            dt = time.perf_counter() - t0
+            if cfg.checkpoint_dir:
+                self._ckpt.save(self.state, cfg.steps)
+        finally:
+            # Even on an exceptional exit the in-flight write drains and
+            # its failure re-raises (chained) — it cannot be dropped.
+            if self._ckpt is not None:
+                self._ckpt.close()
         steps_run = cfg.steps - start_step
         loss = float(m["loss"]) if m is not None else loss
-        if cfg.checkpoint_dir:
-            self._ckpt.save(self.state, cfg.steps)
-            self._ckpt.close()  # final write lands; worker thread released
 
         eval_loss = self.evaluate()
         tok_s = steps_run * cfg.batch_size * cfg.seq_len / max(dt, 1e-9)

@@ -577,28 +577,36 @@ class Trainer:
         epoch_seconds: list[float] = []
         result_acc, ncorrect = 0.0, 0
 
-        with profile_trace(cfg.profile_dir):
-            for epoch in range(start_epoch, cfg.epochs):
-                timer.start()
-                em = self.run_epoch(epoch, skip_steps=skip_steps)
-                skip_steps = 0  # only the resumed epoch is partial
-                timer.stop(em["steps"])
-                epoch_seconds.append(em["seconds"])
-                self.metrics.log("epoch", epoch=epoch, seconds=em["seconds"])
+        try:
+            with profile_trace(cfg.profile_dir):
+                for epoch in range(start_epoch, cfg.epochs):
+                    timer.start()
+                    em = self.run_epoch(epoch, skip_steps=skip_steps)
+                    skip_steps = 0  # only the resumed epoch is partial
+                    timer.stop(em["steps"])
+                    epoch_seconds.append(em["seconds"])
+                    self.metrics.log("epoch", epoch=epoch,
+                                     seconds=em["seconds"])
 
-                if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                    ntests, ncorrect = self.evaluate()
-                    result_acc = ncorrect / ntests
-                    self.metrics.log("eval", epoch=epoch, ntests=ntests,
-                                     ncorrect=ncorrect, accuracy=result_acc)
-                if cfg.checkpoint_dir and cfg.checkpoint_every and (
-                    (epoch + 1) % cfg.checkpoint_every == 0
-                ):
-                    self._ckpt.save(self.state, self._global_step())
+                    if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                        ntests, ncorrect = self.evaluate()
+                        result_acc = ncorrect / ntests
+                        self.metrics.log("eval", epoch=epoch, ntests=ntests,
+                                         ncorrect=ncorrect,
+                                         accuracy=result_acc)
+                    if cfg.checkpoint_dir and cfg.checkpoint_every and (
+                        (epoch + 1) % cfg.checkpoint_every == 0
+                    ):
+                        self._ckpt.save(self.state, self._global_step())
 
-        if cfg.checkpoint_dir:
-            self._ckpt.save(self.state, self._global_step())
-            self._ckpt.close()  # final write lands; worker thread released
+            if cfg.checkpoint_dir:
+                self._ckpt.save(self.state, self._global_step())
+        finally:
+            # Drains the in-flight write even on an exceptional exit, so
+            # its failure re-raises (chained) instead of dying with the
+            # worker thread; on the normal path this is the usual close.
+            if self._ckpt is not None:
+                self._ckpt.close()
         if not (cfg.eval_every and cfg.epochs > start_epoch
                 and cfg.epochs % cfg.eval_every == 0):
             ntests, ncorrect = self.evaluate()
