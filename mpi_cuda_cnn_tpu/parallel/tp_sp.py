@@ -196,11 +196,11 @@ def make_tp_sp_state(model: TransformerLM, params, optimizer, mesh
         spec_flat = jax.tree_util.tree_flatten_with_path(
             pspecs, is_leaf=lambda x: isinstance(x, P)
         )[0]
-        assert len(params_flat) == len(spec_flat)
         pspec_flat = {
             tuple(str(getattr(p, "key", getattr(p, "idx", p)))
                   for p in ppath): (s, tuple(pleaf.shape))
-            for (ppath, pleaf), (_, s) in zip(params_flat, spec_flat)
+            for (ppath, pleaf), (_, s) in zip(params_flat, spec_flat,
+                                              strict=True)
         }
 
         def spec_for(path, leaf):
