@@ -23,10 +23,12 @@ that machinery: its blocks are UNIFORM pytrees, so
 - composes with DP on a ('pipe', 'data') mesh: the microbatch dim
   shards over 'data', gradients pmean over 'data'.
 
-MoE blocks are rejected for now: expert dispatch inside a pipelined
-stage would route bubble ticks through the load-balance loss. Reference
-point: the reference has neither pipelining nor a transformer
-(SURVEY.md §2 "PP: absent"; §5.7).
+MoE blocks compose too: each stage's blocks dispatch locally (experts
+replicated within the stage, tokens routed per data shard) and the
+balance loss is accumulated ONLY on a stage's valid ticks — a bubble
+tick runs garbage activations through the router, so its statistics are
+masked out of the gradient. Reference point: the reference has neither
+pipelining nor a transformer (SURVEY.md §2 "PP: absent"; §5.7).
 """
 
 from __future__ import annotations
@@ -91,11 +93,6 @@ def _state_specs(state):
 
 
 def _check_pp_lm(model: TransformerLM, n_pipe: int) -> None:
-    if model.moe_experts:
-        raise ValueError(
-            "pipeline parallelism does not support MoE blocks yet (bubble "
-            "ticks would feed the balance loss); use an EP/SP mesh"
-        )
     if model.depth % n_pipe:
         raise ValueError(
             f"depth {model.depth} not divisible by pipe-axis size {n_pipe}"
@@ -124,6 +121,116 @@ def make_pp_lm_state(model: TransformerLM, params, optimizer, mesh
 
 
 
+def make_gpipe_local_loss(model, *, M: int, n_pipe: int, compute_dtype,
+                          remat: bool, ce_chunk: int, stage_body,
+                          moe_aux_weight: float = 0.01):
+    """The GPipe schedule, shared by the plain pipelined step (below)
+    and the TP x PP step (parallel/tp_pp_lm.py) — ONE implementation of
+    the embed / tick / ppermute / drain machinery, parameterized by
+    `stage_body(local_blocks, x, pos) -> (x, aux)` (the only thing the
+    two meshes disagree on: a plain apply_block scan vs the Megatron
+    block on the local head slice; aux is the stage's summed MoE
+    balance loss, 0 for dense blocks).
+
+    Returns local_loss(packed, toks_mb, tgt_mb) -> masked mean NLL plus
+    the aux term — the NLL is nonzero only on the last stage's drained
+    ticks, the aux only on each stage's VALID ticks (a bubble tick runs
+    garbage activations through the router; its balance loss must not
+    reach the gradient) — callers psum it over 'pipe'. MoE aux is
+    per-microbatch (averaged over M), the same estimator every
+    microbatched/sharded trainer uses: the Switch loss is a mean-of-
+    products over tokens, so it only equals the serial full-batch value
+    at M=1 (pinned by the parity test).
+    """
+    cd = compute_dtype
+
+    def local_loss(packed, toks_mb, tgt_mb):
+        blocks = packed["blocks"]      # local (L/P, ...)
+        rest = packed["rest"]
+        mb, s = toks_mb.shape[1], toks_mb.shape[2]
+        if s > model.max_seq:
+            # Trace-time check (shapes are static): XLA's gather would
+            # silently clamp positions past the pos_emb table — the same
+            # loud failure apply() raises (models/transformer.py), which
+            # this schedule bypasses.
+            raise ValueError(
+                f"sequence length {s} exceeds max_seq {model.max_seq}"
+            )
+        pos = jnp.arange(s)
+        s_idx = lax.axis_index(PIPE_AXIS)
+        fwd_perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
+        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+
+        def embed(tok):
+            x = rest["tok_emb"][tok]
+            if model.pos == "learned":
+                x = x + rest["pos_emb"][pos][None, :, :]
+            return w(x)
+
+        stage = lambda x: stage_body(blocks, x, pos)
+        if remat:
+            stage = jax.checkpoint(stage)
+
+        def drain_nll(y, tgt):
+            feats = _layernorm(y, rest["ln_f"]["g"], rest["ln_f"]["b"])
+            if ce_chunk:
+                from ..ops.losses import chunked_ce_mean
+
+                return chunked_ce_mean(feats, rest["head"], tgt,
+                                       ce_chunk, cd)
+            logits = jnp.matmul(
+                feats, w(rest["head"]), preferred_element_type=jnp.float32
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            return jnp.mean(nll)
+
+        def tick(carry, t):
+            buf, nll_sum, aux_sum = carry
+            # lax.cond, not jnp.where: only stage 0 pays the embedding
+            # gather and only the LAST stage's drained ticks pay the
+            # head matmul + log_softmax (the largest matmul in the
+            # model) — a where() would run them on every stage at every
+            # tick, P*(M+P-1) times instead of M. No collectives inside
+            # either branch (under TP x PP the model ranks run the
+            # branches identically on replicated activations), so the
+            # per-device divergence is safe.
+            inp = lax.cond(
+                s_idx == 0,
+                lambda: embed(toks_mb[jnp.minimum(t, M - 1)]),
+                lambda: buf,
+            )
+            y, aux = stage(inp)
+            # Stage s processes microbatch t - s at tick t; anything
+            # else is a bubble whose router statistics are garbage.
+            valid = (t - s_idx >= 0) & (t - s_idx < M)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+            out_t = t - (n_pipe - 1)
+            drained = (s_idx == n_pipe - 1) & (out_t >= 0) & (out_t < M)
+            nll = lax.cond(
+                drained,
+                lambda: drain_nll(y, tgt_mb[jnp.clip(out_t, 0, M - 1)]),
+                lambda: jnp.float32(0),
+            )
+            return (lax.ppermute(y, PIPE_AXIS, fwd_perm),
+                    nll_sum + nll, aux_sum), None
+
+        buf0 = jnp.zeros(
+            (mb, s, model.dim), cd if cd else jnp.float32
+        )
+        (_, nll_sum, aux_sum), _ = lax.scan(
+            tick, (buf0, jnp.float32(0), jnp.float32(0)),
+            jnp.arange(M + n_pipe - 1)
+        )
+        # Per-microbatch means averaged over microbatches == the global
+        # mean NLL (equal microbatch sizes). Masked: the NLL only on the
+        # last stage's drained ticks, the aux on every stage's valid
+        # ticks — the caller's psum over 'pipe' assembles both.
+        return (nll_sum + moe_aux_weight * aux_sum) / M
+
+    return local_loss
+
+
 def make_pp_lm_train_step(
     model: TransformerLM,
     optimizer: optax.GradientTransformation,
@@ -137,6 +244,7 @@ def make_pp_lm_train_step(
     grad_clip: float = 0.0,
     attn_impl: str = "oracle",
     ce_chunk: int = 0,
+    moe_aux_weight: float = 0.01,
 ):
     """Jitted GPipe train step for the LM (state from make_pp_lm_state —
     its structure supplies the shard_map specs, as in pp.py).
@@ -157,95 +265,27 @@ def make_pp_lm_train_step(
     has_data = DATA_AXIS in mesh.axis_names
     M = num_microbatches or n_pipe
     cd = compute_dtype
-    fwd_perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
 
     from ..train.lm import get_attn_fn
 
     attn = get_attn_fn(attn_impl)
 
-    def local_loss(packed, toks_mb, tgt_mb):
-        blocks = packed["blocks"]      # local (L/P, ...)
-        rest = packed["rest"]
-        mb, s = toks_mb.shape[1], toks_mb.shape[2]
-        if s > model.max_seq:
-            # Trace-time check (shapes are static): XLA's gather would
-            # silently clamp positions past the pos_emb table — the same
-            # loud failure apply() raises (models/transformer.py), which
-            # this schedule bypasses.
-            raise ValueError(
-                f"sequence length {s} exceeds max_seq {model.max_seq}"
+    def stage_body(blocks, x, pos):
+        def body(carry, blk):
+            x, aux = carry
+            x, a = model.apply_block(
+                blk, x, pos=pos, attn=attn, compute_dtype=cd
             )
-        pos = jnp.arange(s)
-        s_idx = lax.axis_index(PIPE_AXIS)
-        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+            return (x, aux + a), None
 
-        def embed(tok):
-            x = rest["tok_emb"][tok]
-            if model.pos == "learned":
-                x = x + rest["pos_emb"][pos][None, :, :]
-            return w(x)
+        (x, aux), _ = lax.scan(body, (x, jnp.float32(0)), blocks)
+        return x, aux
 
-        def stage(x):
-            def body(x, blk):
-                x, _ = model.apply_block(
-                    blk, x, pos=pos, attn=attn, compute_dtype=cd
-                )
-                return x, None
-
-            x, _ = lax.scan(body, x, blocks)
-            return x
-
-        if remat:
-            stage = jax.checkpoint(stage)
-
-        def drain_nll(y, tgt):
-            feats = _layernorm(y, rest["ln_f"]["g"], rest["ln_f"]["b"])
-            if ce_chunk:
-                from ..ops.losses import chunked_ce_mean
-
-                return chunked_ce_mean(feats, rest["head"], tgt,
-                                       ce_chunk, cd)
-            logits = jnp.matmul(
-                feats, w(rest["head"]), preferred_element_type=jnp.float32
-            )
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
-            return jnp.mean(nll)
-
-        def tick(carry, t):
-            buf, nll_sum = carry
-            # lax.cond, not jnp.where: only stage 0 pays the embedding
-            # gather and only the LAST stage's drained ticks pay the
-            # head matmul + log_softmax (the largest matmul in the
-            # model) — a where() would run them on every stage at every
-            # tick, P*(M+P-1) times instead of M. No collectives inside
-            # either branch, so per-device divergence is safe.
-            inp = lax.cond(
-                s_idx == 0,
-                lambda: embed(toks_mb[jnp.minimum(t, M - 1)]),
-                lambda: buf,
-            )
-            y = stage(inp)
-            out_t = t - (n_pipe - 1)
-            drained = (s_idx == n_pipe - 1) & (out_t >= 0) & (out_t < M)
-            nll = lax.cond(
-                drained,
-                lambda: drain_nll(y, tgt_mb[jnp.clip(out_t, 0, M - 1)]),
-                lambda: jnp.float32(0),
-            )
-            return (lax.ppermute(y, PIPE_AXIS, fwd_perm), nll_sum + nll), None
-
-        d = model.dim
-        buf0 = jnp.zeros(
-            (mb, s, d), cd if cd else jnp.float32
-        )
-        (_, nll_sum), _ = lax.scan(
-            tick, (buf0, jnp.float32(0)), jnp.arange(M + n_pipe - 1)
-        )
-        # Per-microbatch means averaged over microbatches == the global
-        # mean NLL (equal microbatch sizes). Masked: only the last
-        # stage's drained ticks contribute.
-        return nll_sum / M
+    local_loss = make_gpipe_local_loss(
+        model, M=M, n_pipe=n_pipe, compute_dtype=cd, remat=remat,
+        ce_chunk=ce_chunk, stage_body=stage_body,
+        moe_aux_weight=moe_aux_weight,
+    )
 
     def step(state, toks_mb, tgt_mb):
         loss, grads = jax.value_and_grad(local_loss)(

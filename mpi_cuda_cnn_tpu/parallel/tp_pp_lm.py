@@ -1,0 +1,250 @@
+"""Tensor parallelism x pipeline parallelism for the transformer LM —
+Megatron sharding INSIDE the GPipe stages, over a ('pipe', 'model'
+[, 'data']) mesh.
+
+This is the classic 3D large-model layout (TP inside a node where the
+interconnect is fastest, PP across, DP outside): the LM pipeline
+(parallel/pp_lm.py) shards stacked blocks over 'pipe' and microbatches
+over 'data'; this module additionally slices each block's heads and MLP
+hidden over 'model', so one stage's block scan runs the SHARED Megatron
+block (parallel/tp_sp.py tp_block_apply — the same f/g custom-VJP pair
+and column/row regions as the TP x SP step, with full-sequence
+attention instead of the ring):
+
+- packed params: {'blocks': stacked (L, ...) head-structured leaves
+  (to_tp_layout then stack_blocks), 'rest': replicated}. Block leaves
+  shard 'pipe' on the leading (block) dim and 'model' on their head/
+  hidden dim — wqkv (L, d, 3, H, hd) puts 'model' on H;
+- activations are replicated over 'model' between regions (the f/g
+  contract), so the GPipe ppermute over 'pipe' and the stage-0 embed /
+  last-stage drain are untouched from pp_lm.py: every model rank runs
+  them identically, and replicated-leaf gradients arrive exact on every
+  rank (tp_sp.py's analysis), needing only pp_lm's psum over 'pipe';
+- sliced-leaf gradients are exact per slice — never reduced over
+  'model' (that would average unrelated slices); 'data' still pmeans
+  everything.
+
+The reference has none of these axes (SURVEY.md §2 checklist "PP:
+absent", §5.7); composing them is where TPU pods actually train GPT-
+scale models. Restrictions inherited and checked loudly: dense MLP only
+(MoE -> EP meshes), depth % n_pipe == 0, heads/kv_heads/4d % n_model
+== 0.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerLM
+from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from .pp import _batch_spec
+from .pp_lm import (
+    _check_pp_lm,
+    make_gpipe_local_loss,
+    stack_blocks,
+    unstack_blocks,
+)
+from .tp_sp import (
+    TP_SPEC_TAILS,
+    _check_tp_sp,
+    _make_tp_pair,
+    from_tp_layout,
+    to_tp_layout,
+    tp_block_apply,
+)
+
+TrainState = dict[str, Any]
+
+# 'model' placement per head-structured block leaf, AFTER stacking (the
+# leading dim is the block dim, sharded over 'pipe') — tp_sp's single
+# sliced-leaf table, not a copy: both the sharding specs below and the
+# grad-clip norm classification key off it, so the two meshes cannot
+# drift.
+_TP_TAIL = TP_SPEC_TAILS
+
+
+def _state_specs(state):
+    """Specs by PATH over the whole packed state (params + mirrored
+    optimizer buffers): a leaf under 'blocks' shards its leading dim
+    over 'pipe' and, when its final key names a sliced weight AND its
+    rank matches that weight's stacked rank, its head/hidden dim over
+    'model'; everything else replicates. The rank guard keeps a
+    same-named scalar wrapper buffer from inheriting a sliced spec."""
+
+    def spec(path, leaf):
+        keys = [str(getattr(p, "key", getattr(p, "name", ""))) for p in path]
+        ndim = getattr(leaf, "ndim", 0)
+        if "blocks" in keys and ndim >= 1:
+            tail = _TP_TAIL.get(keys[-1])
+            if tail is not None and ndim == len(tail) + 1:
+                return P(PIPE_AXIS, *tail)
+            return P(PIPE_AXIS, *([None] * (ndim - 1)))
+        return P()
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec(path, leaf) for path, leaf in leaves]
+    )
+
+
+def _check_tp_pp(model: TransformerLM, n_pipe: int, n_tp: int) -> None:
+    _check_pp_lm(model, n_pipe)
+    _check_tp_sp(model, n_tp)
+
+
+def make_tp_pp_lm_state(model: TransformerLM, params, optimizer, mesh
+                        ) -> TrainState:
+    """Standard params -> head-structured TP layout -> stacked blocks,
+    placed pipe x model sharded; optimizer buffers inherit leaf-for-leaf
+    (path-matched, like pp_lm)."""
+    _check_tp_pp(model, mesh.shape[PIPE_AXIS], mesh.shape[MODEL_AXIS])
+    packed = stack_blocks(to_tp_layout(params, model))
+    state = {
+        "params": packed,
+        "opt_state": optimizer.init(packed),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    specs = _state_specs(state)
+    return jax.device_put(
+        state,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+
+
+def unstack_tp_blocks(packed: dict, model: TransformerLM) -> dict:
+    """Packed pipe x model layout -> the standard params tree (for eval,
+    decode, checkpoint-portability, and parity tests)."""
+    return from_tp_layout(unstack_blocks(packed, model.depth), model)
+
+
+def make_tp_pp_lm_train_step(
+    model: TransformerLM,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    state: TrainState,
+    *,
+    num_microbatches: int | None = None,
+    compute_dtype=None,
+    remat: bool = False,
+    donate: bool = True,
+    grad_clip: float = 0.0,
+    attn_impl: str = "oracle",
+    ce_chunk: int = 0,
+):
+    """Jitted GPipe x Megatron train step.
+
+    step(state, toks_mb, tgt_mb) -> (state, {"loss": ...}); toks/tgt are
+    (M, mb, S) int32 placed via pp_lm_shard_batch (the batch contract is
+    pp_lm's — 'model' never shards data). Each tick scans the shared
+    Megatron block over the stage's local block slice with full-sequence
+    attention on the local heads; attn_impl routes "flash"/"oracle"
+    exactly as in the plain pipelined step, ce_chunk fuses the drain CE.
+    """
+    n_pipe = mesh.shape[PIPE_AXIS]
+    n_tp = mesh.shape[MODEL_AXIS]
+    _check_tp_pp(model, n_pipe, n_tp)
+    has_data = DATA_AXIS in mesh.axis_names
+    M = num_microbatches or n_pipe
+    cd = compute_dtype
+
+    from ..train.lm import get_attn_fn
+
+    attn = get_attn_fn(attn_impl)
+    tp_copy, tp_reduce = _make_tp_pair(MODEL_AXIS)
+    w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+
+    def stage_body(blocks, x, pos):
+        def body(x, blk):
+            x = tp_block_apply(
+                blk, x, attn=attn,
+                rope_pos=pos if model.pos == "rope" else None,
+                w=w, tp_copy=tp_copy, tp_reduce=tp_reduce,
+            )
+            return x, None
+
+        x, _ = lax.scan(body, x, blocks)
+        return x, jnp.float32(0)  # dense blocks only (_check_tp_sp)
+
+    # The whole GPipe schedule (embed / tick / ppermute / drain) is
+    # pp_lm's, verbatim — the model ranks run it identically on
+    # replicated activations; only the stage body is Megatron-sliced.
+    local_loss = make_gpipe_local_loss(
+        model, M=M, n_pipe=n_pipe, compute_dtype=cd, remat=remat,
+        ce_chunk=ce_chunk, stage_body=stage_body,
+    )
+
+    def step(state, toks_mb, tgt_mb):
+        loss, grads = jax.value_and_grad(local_loss)(
+            state["params"], toks_mb, tgt_mb
+        )
+        # Block grads: stage-local over 'pipe'; over 'model', sliced
+        # leaves are exact per slice and replicated leaves (ln) are
+        # identical on every rank (tp_sp.py's gradient analysis) — no
+        # 'model' reduction. The rest tree got only its OWN stage's
+        # contribution: psum over 'pipe' restores it, identically on
+        # every model rank.
+        grads = {
+            "blocks": grads["blocks"],
+            "rest": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), grads["rest"]
+            ),
+        }
+        loss = lax.psum(loss, PIPE_AXIS)
+        if has_data:
+            grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
+            loss = lax.pmean(loss, DATA_AXIS)
+        if grad_clip > 0:
+            # Each logical parameter once: sliced block leaves are
+            # disjoint over BOTH 'pipe' and 'model'; ln block leaves are
+            # disjoint over 'pipe' only (identical across 'model'); the
+            # repaired rest is identical everywhere. Which block leaves
+            # are sliced is derived from the same _TP_TAIL the state is
+            # sharded with.
+            from ..train.optimizer import clip_grads_by_global_sq
+
+            sliced = jnp.float32(0)
+            rep = jnp.float32(0)
+            for path, g in jax.tree_util.tree_flatten_with_path(
+                grads["blocks"]
+            )[0]:
+                keys = [str(getattr(p, "key", getattr(p, "name", "")))
+                        for p in path]
+                term = jnp.sum(jnp.square(g).astype(jnp.float32))
+                tail = _TP_TAIL.get(keys[-1])
+                if tail is not None and g.ndim == len(tail) + 1:
+                    sliced = sliced + term
+                else:
+                    rep = rep + term
+            g2 = lax.psum(sliced, MODEL_AXIS) + rep
+            gn2 = lax.psum(g2, PIPE_AXIS) + sum(
+                jnp.sum(jnp.square(g).astype(jnp.float32))
+                for g in jax.tree.leaves(grads["rest"])
+            )
+            grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": loss},
+        )
+
+    specs = _state_specs(state)
+    bspec = _batch_spec(mesh)
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(specs, bspec, bspec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -122,6 +122,42 @@ def from_tp_layout(params: dict, model: TransformerLM) -> dict:
     return out
 
 
+def tp_block_apply(blk, x, *, attn, rope_pos, w, tp_copy, tp_reduce):
+    """One Megatron transformer block on the LOCAL heads/hidden slice.
+
+    Column-parallel qkv projection (each model rank computes H/n_tp
+    heads), `attn(q, k, v)` on them, row-parallel wo joined by
+    tp_reduce; column-parallel w1 / row-parallel w2 for the MLP. The
+    attention callable is the ONLY thing the TP x SP step (ring
+    attention over 'seq') and the TP x PP step (full-sequence attention
+    per pipeline stage) disagree on — one block implementation serves
+    both, so the Megatron math can never drift between meshes.
+
+    blk: head-structured leaves (to_tp_layout), already sliced to this
+    rank. rope_pos: position ids for rotary (None = learned/absolute,
+    applied by the caller). w: the compute-dtype cast.
+    """
+    y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+    y = tp_copy(y)
+    if "wqkv" in blk:
+        qkv = jnp.einsum("bsd,dchx->bschx", y, w(blk["wqkv"]))
+        q, k, v = (qkv[:, :, i] for i in range(3))
+    else:
+        q = jnp.einsum("bsd,dhx->bshx", y, w(blk["wq"]))
+        kv = jnp.einsum("bsd,dchx->bschx", y, w(blk["wkv"]))
+        k, v = kv[:, :, 0], kv[:, :, 1]
+    if rope_pos is not None:
+        q = rope(q, rope_pos)
+        k = rope(k, rope_pos)
+    o = attn(q, k, v)
+    part = jnp.einsum("bshx,hxd->bsd", o.astype(x.dtype), w(blk["wo"]))
+    x = x + tp_reduce(part)
+    y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+    y = tp_copy(y)
+    part = jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"])
+    return x + tp_reduce(part)
+
+
 def _check_tp_sp(model: TransformerLM, n_tp: int) -> None:
     if model.moe_experts:
         raise ValueError(
@@ -140,18 +176,27 @@ def _check_tp_sp(model: TransformerLM, n_tp: int) -> None:
         )
 
 
+# 'model' placement per head-structured block leaf — THE single table of
+# which weights are Megatron-sliced and on which dim. tp_sp_param_specs
+# consumes it directly; the TP x PP module (parallel/tp_pp_lm.py)
+# prepends the stacked-block 'pipe' dim to the same tuples, so a new or
+# reshaped sliced leaf added here automatically shards (and norm-counts)
+# correctly on BOTH meshes.
+TP_SPEC_TAILS = {
+    "wqkv": (None, None, MODEL_AXIS, None),
+    "wq": (None, MODEL_AXIS, None),
+    "wkv": (None, None, MODEL_AXIS, None),
+    "wo": (MODEL_AXIS, None, None),
+    "w1": (None, MODEL_AXIS),
+    "w2": (MODEL_AXIS, None),
+}
+
+
 def tp_sp_param_specs(model: TransformerLM, params_tp: dict) -> dict:
     """PartitionSpecs for the head-structured tree: 'model' on the H dim
     of wqkv/wq/wkv/wo, on w1's columns and w2's rows; all else
     replicated (the 'seq'/'data' axes never shard parameters)."""
-    spec_map = {
-        "wqkv": P(None, None, MODEL_AXIS, None),
-        "wq": P(None, MODEL_AXIS, None),
-        "wkv": P(None, None, MODEL_AXIS, None),
-        "wo": P(MODEL_AXIS, None, None),
-        "w1": P(None, MODEL_AXIS),
-        "w2": P(MODEL_AXIS, None),
-    }
+    spec_map = {k: P(*t) for k, t in TP_SPEC_TAILS.items()}
     out = {k: jax.tree.map(lambda _: P(), v)
            for k, v in params_tp.items() if k != "blocks"}
     out["blocks"] = [
@@ -290,29 +335,16 @@ def make_tp_sp_lm_train_step(
         x = w(x)
 
         def block(blk, x):
-            # Attention region: column-parallel qkv (local heads), ring
-            # attention over 'seq' on the local heads, row-parallel wo.
-            y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-            y = tp_copy(y)
-            if "wqkv" in blk:
-                qkv = jnp.einsum("bsd,dchx->bschx", y, w(blk["wqkv"]))
-                q, k, v = (qkv[:, :, i] for i in range(3))
-            else:
-                q = jnp.einsum("bsd,dhx->bshx", y, w(blk["wq"]))
-                kv = jnp.einsum("bsd,dchx->bschx", y, w(blk["wkv"]))
-                k, v = kv[:, :, 0], kv[:, :, 1]
-            if model.pos == "rope":
-                q = rope(q, pos)
-                k = rope(k, pos)
-            o = attn_body(q, k, v, axis=SEQ_AXIS, causal=True)
-            part = jnp.einsum("bshx,hxd->bsd", o.astype(x.dtype),
-                              w(blk["wo"]))
-            x = x + tp_reduce(part)
-            # MLP region: column-parallel w1, row-parallel w2.
-            y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
-            y = tp_copy(y)
-            part = jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"])
-            return x + tp_reduce(part)
+            # Ring attention over 'seq' on the local heads; Megatron
+            # column/row regions live in the shared block applier.
+            return tp_block_apply(
+                blk, x,
+                attn=lambda q, k, v: attn_body(
+                    q, k, v, axis=SEQ_AXIS, causal=True
+                ),
+                rope_pos=pos if model.pos == "rope" else None,
+                w=w, tp_copy=tp_copy, tp_reduce=tp_reduce,
+            )
 
         if remat:
             block = jax.checkpoint(block)

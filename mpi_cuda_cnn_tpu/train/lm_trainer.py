@@ -140,12 +140,12 @@ class LMTrainer:
                     "TP x SP (its stage runs ring/ring_flash attention "
                     "on the local heads); use auto"
                 )
-        if self.n_pipe > 1 and (self.n_seq > 1 or self.n_model > 1
-                                or cfg.fsdp):
+        if self.n_pipe > 1 and (self.n_seq > 1 or cfg.fsdp):
             raise ValueError(
-                "the LM's 'pipe' axis composes with 'data' only for now "
-                "(GPipe over stacked blocks, parallel/pp_lm.py); drop "
-                "the seq/model axes and --fsdp or the pipe axis"
+                "the LM's 'pipe' axis composes with 'data' and 'model' "
+                "(GPipe over stacked blocks, parallel/pp_lm.py; Megatron "
+                "inside the stages, parallel/tp_pp_lm.py) but not with "
+                "'seq' or --fsdp; drop those or the pipe axis"
             )
         if self.n_pipe > 1 and cfg.batch_size % (self.n_pipe * self.n_data):
             raise ValueError(
@@ -231,10 +231,20 @@ class LMTrainer:
                 cfg.attn_impl, cfg.seq_len, compute_dtype
             )
             params = self.model.init(jax.random.key(cfg.seed))
-            self.state = make_pp_lm_state(
+            if self.n_model > 1:
+                # TP x PP (x DP): Megatron inside the GPipe stages —
+                # the 3D layout (parallel/tp_pp_lm.py).
+                from ..parallel.tp_pp_lm import (
+                    make_tp_pp_lm_state as make_state,
+                    make_tp_pp_lm_train_step as make_step,
+                )
+            else:
+                make_state, make_step = make_pp_lm_state, \
+                    make_pp_lm_train_step
+            self.state = make_state(
                 self.model, params, self.optimizer, self.mesh
             )
-            self.train_step = make_pp_lm_train_step(
+            self.train_step = make_step(
                 self.model, self.optimizer, self.mesh, self.state,
                 compute_dtype=compute_dtype, remat=cfg.remat,
                 grad_clip=cfg.grad_clip, attn_impl=self.attn_impl,
@@ -373,9 +383,16 @@ class LMTrainer:
         decode consume the standard tree either way."""
         p = jax.device_get(self.state["params"])
         if "rest" in p:
-            from ..parallel.pp_lm import unstack_blocks
+            # Stacked wo is (L, h*hd, d); the TP x PP packed layout is
+            # additionally head-structured: (L, H, hd, d).
+            if p["blocks"]["wo"].ndim == 4:
+                from ..parallel.tp_pp_lm import unstack_tp_blocks
 
-            p = unstack_blocks(p, self.model.depth)
+                p = unstack_tp_blocks(p, self.model)
+            else:
+                from ..parallel.pp_lm import unstack_blocks
+
+                p = unstack_blocks(p, self.model.depth)
         elif p["blocks"] and p["blocks"][0]["wo"].ndim == 3:
             from ..parallel.tp_sp import from_tp_layout
 

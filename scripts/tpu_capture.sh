@@ -41,6 +41,7 @@ print(json.dumps({'probe': 'ok', 'backend': jax.default_backend()}))" \
     || { echo 'backend unreachable; aborting capture' >&2; exit 1; }
 
 step gqa_flash_check 900 python scripts/check_gqa_flash.py
+step f32_crossover 900 python scripts/bench_crossover.py
 step bench_epoch 600 python bench.py
 step bench_lm 1200 python scripts/bench_lm.py
 step bench_lm_d1024 900 python scripts/bench_lm.py --quick --dim 1024 \
