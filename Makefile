@@ -24,12 +24,13 @@ test_native_tpu: native
 # Unit/integration suite (CPU, 8 virtual devices — set in tests/conftest.py).
 # Fast default: the heavy tests in conftest.SLOW_TESTS are skipped and the
 # run fans out over cores (pytest-xdist -n auto; each worker gets its own
-# 8-virtual-device jax). Measured 2026-07-31 (round 4, 192 fast tests):
-# 4:37 SERIAL on a 1-core box — the fast set now meets the 5-min bar
-# WITHOUT xdist; multicore boxes divide further. Every skipped subsystem
-# keeps a fast representative; `make test_all` is the full superset
-# (320 tests, ~28 min serial). pytest-xdist is optional: fan out when
-# importable, serial otherwise.
+# 8-virtual-device jax). Measured 2026-07-31 (round 4, ~190 fast
+# tests): 4:35-5:00 SERIAL across repeat runs on a loaded 1-core box —
+# the fast set meets the 5-min bar WITHOUT xdist; multicore boxes
+# divide further. Every skipped subsystem keeps a fast representative
+# (or a dryrun_multichip path with a serial-parity assert); `make
+# test_all` is the full superset (~325 tests, ~28 min serial).
+# pytest-xdist is optional: fan out when importable, serial otherwise.
 XDIST := $(shell $(PY) -c "import xdist" 2>/dev/null && echo "-n auto")
 
 test:

@@ -346,17 +346,30 @@ def make_sp_lm_train_step(
     moe_aux_weight: float = 0.01,
     compute_dtype=None,
     ce_chunk: int = 0,
+    state_specs=None,
+    grad_clip: float = 0.0,
 ):
     """Jitted causal-LM train step with the sequence dim sharded on `axis`
     (long-context training: each device holds S/P tokens of activations)
     and, optionally, the batch dim sharded on `data_axis` (SP x DP).
 
-    Params are replicated; tokens/targets are (B, S) int32 sharded
-    (data_axis, axis). Inside shard_map the model runs on its sequence
-    shard — embeddings/LN/MLP are per-position, and attention is the ring
-    or Ulysses body with absolute positions recovered from the axis index.
-    Gradients/metrics pmean over every populated mesh axis (they are
-    means over tokens, and shards are equal-sized).
+    Params are replicated by default; tokens/targets are (B, S) int32
+    sharded (data_axis, axis). Inside shard_map the model runs on its
+    sequence shard — embeddings/LN/MLP are per-position, and attention
+    is the ring or Ulysses body with absolute positions recovered from
+    the axis index. Gradients/metrics pmean over every populated mesh
+    axis (they are means over tokens, and shards are equal-sized).
+
+    state_specs enables FSDP x SP (ZeRO x ring — the long-context
+    memory pairing): pass the state's PartitionSpec tree (params sharded
+    over `data_axis` on their largest dim, parallel/fsdp.fsdp_specs; the
+    trainer derives it from the placed state). The step then all-gathers
+    each data-sharded leaf over 'data' before use and one
+    psum_scatter/n_data per gradient leaf is both the DP mean and the
+    ZeRO reduce-scatter — master params + optimizer state stay sharded,
+    exactly the pp.py FSDP pattern inside the SP shard_map. With
+    state_specs, --grad-clip must clip IN-STEP (`grad_clip`): optax's
+    clip would see a per-rank partial norm of the scattered grads.
 
     ce_chunk > 0 computes the shard-local loss with the fused chunked
     cross-entropy (ops/losses.chunked_ce_mean) — the natural pairing for
@@ -366,6 +379,23 @@ def make_sp_lm_train_step(
     Returns step(state, tokens, targets) -> (state, {"loss": ...}).
     """
     import optax
+
+    fsdp = state_specs is not None
+    if fsdp and not data_axis:
+        raise ValueError("FSDP x SP shards params over 'data'; the mesh "
+                         "needs a data axis of size > 1")
+    if grad_clip > 0 and not fsdp:
+        raise ValueError(
+            "grad_clip is the FSDP x SP in-step clip (the scattered "
+            "grads' norm is per-rank partial); with replicated params "
+            "use the optax clip_by_global_norm transform instead"
+        )
+    pspecs = state_specs["params"] if fsdp else None
+    n_data = mesh.shape.get(data_axis, 1) if data_axis else 1
+
+    def _data_dim(spec) -> int | None:
+        return (tuple(spec).index(data_axis)
+                if data_axis in tuple(spec) else None)
 
     if impl == "ring":
         attn_body = ring_attention
@@ -437,9 +467,54 @@ def make_sp_lm_train_step(
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
             return jnp.mean(nll) + moe_aux_weight * aux
 
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
-        grads = lax.pmean(grads, reduce_axes)
-        loss = lax.pmean(loss, reduce_axes)
+        if fsdp:
+            # Gather the full weights transiently; differentiate w.r.t.
+            # the FULL tree so each gradient leaf is full-width before
+            # the scatter.
+            full = jax.tree.map(
+                lambda p, s: (
+                    lax.all_gather(p, data_axis, axis=_data_dim(s),
+                                   tiled=True)
+                    if _data_dim(s) is not None else p
+                ),
+                state["params"], pspecs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            loss, grads = jax.value_and_grad(loss_fn)(full)
+            # Sharded leaves: psum_scatter/n = DP mean + ZeRO scatter
+            # back to this rank's slice. Replicated leaves: plain pmean.
+            # Everything then pmeans over 'seq' (equal shards).
+            grads = jax.tree.map(
+                lambda g, s: lax.pmean(
+                    lax.psum_scatter(
+                        g, data_axis, scatter_dimension=_data_dim(s),
+                        tiled=True,
+                    ) / n_data
+                    if _data_dim(s) is not None
+                    else lax.pmean(g, data_axis),
+                    axis,
+                ),
+                grads, pspecs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            loss = lax.pmean(loss, reduce_axes)
+            if grad_clip > 0:
+                # Scattered slices are disjoint over 'data' (psum);
+                # replicated leaves are identical everywhere after the
+                # pmeans (count once). Both the classification and the
+                # clip application live in the shared helpers.
+                from ..train.optimizer import (
+                    clip_grads_by_global_sq,
+                    split_grad_sq,
+                )
+
+                sliced, rep = split_grad_sq(grads, pspecs, data_axis)
+                gn2 = lax.psum(sliced, data_axis) + rep
+                grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            grads = lax.pmean(grads, reduce_axes)
+            loss = lax.pmean(loss, reduce_axes)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )
@@ -450,11 +525,12 @@ def make_sp_lm_train_step(
         )
 
     batch_spec = P(data_axis, axis)
+    sspec = state_specs if fsdp else P()
     sharded = jax.shard_map(
         step,
         mesh=mesh,
-        in_specs=(P(), batch_spec, batch_spec),
-        out_specs=(P(), P()),
+        in_specs=(sspec, batch_spec, batch_spec),
+        out_specs=(sspec, P()),
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

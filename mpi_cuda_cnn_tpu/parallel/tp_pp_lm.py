@@ -179,6 +179,8 @@ def make_tp_pp_lm_train_step(
         model, M=M, n_pipe=n_pipe, compute_dtype=cd, remat=remat,
         ce_chunk=ce_chunk, stage_body=stage_body,
     )
+    specs = _state_specs(state)  # shard_map specs AND the clip's
+    #                              sliced-leaf classification below
 
     def step(state, toks_mb, tgt_mb):
         loss, grads = jax.value_and_grad(local_loss)(
@@ -204,24 +206,17 @@ def make_tp_pp_lm_train_step(
             # Each logical parameter once: sliced block leaves are
             # disjoint over BOTH 'pipe' and 'model'; ln block leaves are
             # disjoint over 'pipe' only (identical across 'model'); the
-            # repaired rest is identical everywhere. Which block leaves
-            # are sliced is derived from the same _TP_TAIL the state is
-            # sharded with.
-            from ..train.optimizer import clip_grads_by_global_sq
+            # repaired rest is identical everywhere. The sliced-vs-
+            # replicated classification is the shared helper's, keyed
+            # off the SAME specs the state is sharded with.
+            from ..train.optimizer import (
+                clip_grads_by_global_sq,
+                split_grad_sq,
+            )
 
-            sliced = jnp.float32(0)
-            rep = jnp.float32(0)
-            for path, g in jax.tree_util.tree_flatten_with_path(
-                grads["blocks"]
-            )[0]:
-                keys = [str(getattr(p, "key", getattr(p, "name", "")))
-                        for p in path]
-                term = jnp.sum(jnp.square(g).astype(jnp.float32))
-                tail = _TP_TAIL.get(keys[-1])
-                if tail is not None and g.ndim == len(tail) + 1:
-                    sliced = sliced + term
-                else:
-                    rep = rep + term
+            sliced, rep = split_grad_sq(
+                grads["blocks"], specs["params"]["blocks"], MODEL_AXIS
+            )
             g2 = lax.psum(sliced, MODEL_AXIS) + rep
             gn2 = lax.psum(g2, PIPE_AXIS) + sum(
                 jnp.sum(jnp.square(g).astype(jnp.float32))
@@ -238,7 +233,6 @@ def make_tp_pp_lm_train_step(
             {"loss": loss},
         )
 
-    specs = _state_specs(state)
     bspec = _batch_spec(mesh)
     sharded = jax.shard_map(
         step,

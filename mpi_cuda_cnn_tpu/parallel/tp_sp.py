@@ -364,28 +364,17 @@ def make_tp_sp_lm_train_step(
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
         return jnp.mean(nll)
 
-    # Sliced block leaves are DISJOINT over 'model' (each rank holds its
-    # own slice of one logical parameter); everything else is replicated
-    # (identical on every rank). The global gradient norm must count each
-    # logical parameter exactly once: psum the sliced leaves' squared
-    # norms over 'model', add the replicated leaves' once. Which leaves
-    # are sliced is DERIVED from the very PartitionSpecs the step shards
-    # with (MODEL_AXIS present), so the two can never drift.
-    _param_spec_leaves = jax.tree_util.tree_leaves(
-        state_specs["params"], is_leaf=lambda x: isinstance(x, P)
-    )
-
+    # The global gradient norm must count each logical parameter exactly
+    # once: psum the sliced leaves' squared norms over 'model', add the
+    # replicated leaves' once. The classification lives in the ONE
+    # shared helper (train/optimizer.split_grad_sq) and is derived from
+    # the very PartitionSpecs the step shards with, so it can never
+    # drift from the other sharded-param meshes'.
     def _global_grad_sq(grads):
-        grad_leaves = jax.tree_util.tree_leaves(grads)
-        assert len(grad_leaves) == len(_param_spec_leaves)
-        sliced = jnp.float32(0)
-        rep = jnp.float32(0)
-        for g, s in zip(grad_leaves, _param_spec_leaves):
-            term = jnp.sum(jnp.square(g).astype(jnp.float32))
-            if MODEL_AXIS in tuple(s):
-                sliced = sliced + term
-            else:
-                rep = rep + term
+        from ..train.optimizer import split_grad_sq
+
+        sliced, rep = split_grad_sq(grads, state_specs["params"],
+                                    MODEL_AXIS)
         return lax.psum(sliced, MODEL_AXIS) + rep
 
     def step(state, tokens, targets):

@@ -170,20 +170,15 @@ class LMTrainer:
                 f"seq_len {cfg.seq_len} not divisible by seq-axis size "
                 f"{self.n_seq}"
             )
-        if cfg.fsdp:
-            # Structural mesh checks belong here, before any step/optimizer
-            # construction — the user should see the mesh error first.
-            if self.n_seq > 1:
-                raise ValueError(
-                    "--fsdp shards params over 'data' via GSPMD and does "
-                    "not compose with the shard_map SP step; drop the "
-                    "'seq' axis or --fsdp"
-                )
-            if self.n_data <= 1:
-                raise ValueError(
-                    "--fsdp needs a 'data' mesh axis of size > 1 "
-                    f"(mesh_shape={cfg.mesh_shape!r})"
-                )
+        if cfg.fsdp and self.n_data <= 1:
+            # Structural mesh check belongs here, before any
+            # step/optimizer construction — the user should see the mesh
+            # error first. (fsdp + 'seq' composes: ZeRO x ring inside
+            # the SP shard_map, parallel/sp.py state_specs.)
+            raise ValueError(
+                "--fsdp needs a 'data' mesh axis of size > 1 "
+                f"(mesh_shape={cfg.mesh_shape!r})"
+            )
 
         # Cosine needs positive decay_steps: clamp warmup only when it
         # would swallow the whole (short) run, and say so.
@@ -194,12 +189,14 @@ class LMTrainer:
                 "warmup_steps %d >= steps %d; clamped to %d",
                 cfg.warmup_steps, cfg.steps, warmup,
             )
-        # The pipelined and Megatron x ring steps clip IN-STEP with a
-        # cross-rank-correct global norm (their params are sharded, so
-        # optax's per-rank clip_by_global_norm would compute a partial
-        # norm); everywhere else the optax transform does it.
-        clip_in_step = self.n_pipe > 1 or (self.n_model > 1
-                                           and self.n_seq > 1)
+        # The pipelined, Megatron x ring, and ZeRO x ring steps clip
+        # IN-STEP with a cross-rank-correct global norm (their params
+        # are sharded, so optax's per-rank clip_by_global_norm would
+        # compute a partial norm); everywhere else the optax transform
+        # does it.
+        clip_in_step = self.n_pipe > 1 or self.n_seq > 1 and (
+            self.n_model > 1 or cfg.fsdp
+        )
         self.optimizer = make_optimizer(
             cfg.lr, opt="adamw", schedule=cfg.lr_schedule,
             total_steps=cfg.steps or None, warmup_steps=warmup,
@@ -282,11 +279,35 @@ class LMTrainer:
             elif impl == "oracle":
                 impl = "ring"
             self.attn_impl = impl
+            sp_specs = None
+            if cfg.fsdp:
+                # ZeRO x ring: state placed by the generic FSDP specs
+                # (largest dim over 'data'); the step consumes the
+                # placement's own spec tree, so the two cannot disagree.
+                from ..parallel.fsdp import make_fsdp_state
+
+                params = self.model.init(jax.random.key(cfg.seed))
+                self.state = make_fsdp_state(
+                    params, self.optimizer, self.mesh
+                )
+                # Fresh scalar optimizer leaves (e.g. adamw's count)
+                # carry SingleDeviceSharding, not NamedSharding — they
+                # are replicated by construction.
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sp_specs = jax.tree.map(
+                    lambda a: (
+                        a.sharding.spec
+                        if isinstance(a.sharding, NamedSharding) else P()
+                    ),
+                    self.state,
+                )
             self.train_step = make_sp_lm_train_step(
                 self.model, self.optimizer, self.mesh, impl=impl,
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
                 remat=cfg.remat, compute_dtype=compute_dtype,
-                ce_chunk=cfg.ce_chunk,
+                ce_chunk=cfg.ce_chunk, state_specs=sp_specs,
+                grad_clip=cfg.grad_clip if cfg.fsdp else 0.0,
             )
         else:
             self.attn_impl = pick_attn_impl(
@@ -297,8 +318,9 @@ class LMTrainer:
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
             )
-        if self.n_pipe > 1 or (self.n_model > 1 and self.n_seq > 1):
-            pass  # state already built with its step above (PP / TP x SP)
+        if self.n_pipe > 1 or self.n_seq > 1 and (self.n_model > 1
+                                                  or cfg.fsdp):
+            pass  # state already built above (PP / TP x SP / FSDP x SP)
         elif cfg.fsdp:
             # ZeRO-style sharding for the LM — the same generic spec
             # machinery as the CNN path (parallel/fsdp.py); with a

@@ -35,6 +35,37 @@ def clip_grads_by_global_sq(grads, sq_norm, clip: float):
     return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
 
 
+def split_grad_sq(grads, specs, axis: str):
+    """(sliced_sq, replicated_sq): the squared-gradient sum in f32,
+    split by whether `axis` appears in each leaf's PartitionSpec.
+
+    The one classification every sharded-param step's in-step grad-clip
+    uses (parallel/tp_sp.py over 'model', parallel/sp.py's FSDP branch
+    over 'data', parallel/tp_pp_lm.py over 'model' within the stacked
+    blocks): sliced leaves are DISJOINT over `axis` — the caller psums
+    their bucket there — while replicated leaves are identical on every
+    rank of it and count once. Keeping the walk here, next to
+    clip_grads_by_global_sq, means the norm accounting cannot drift
+    between meshes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    sliced = jnp.float32(0)
+    rep = jnp.float32(0)
+    for g, s in zip(
+        jax.tree.leaves(grads),
+        jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+        strict=True,
+    ):
+        term = jnp.sum(jnp.square(g).astype(jnp.float32))
+        if axis in tuple(s):
+            sliced = sliced + term
+        else:
+            rep = rep + term
+    return sliced, rep
+
+
 def make_optimizer(
     lr: float = 0.1,
     *,

@@ -79,6 +79,13 @@ SLOW_TESTS = {
     "test_pp_lm.py::test_pp_lm_ce_chunk_matches_dense",
     "test_pp_lm.py::test_pp_lm_moe_single_microbatch_matches_serial",
     "test_flash_attention.py::test_flash_gradients_match_oracle[512-True]",
+    "test_fsdp.py::test_lm_trainer_fsdp_sp_e2e",
+    # Both FSDP x SP parity variants are slow; the driver's dryrun path
+    # 13 runs the same step with a serial-parity assert every round, so
+    # the composition keeps default-gate coverage outside pytest.
+    "test_fsdp.py::test_lm_fsdp_sp_matches_replicated_sp[0.05]",
+    "test_fsdp.py::test_lm_fsdp_sp_matches_replicated_sp[0.0]",
+    "test_fsdp.py::test_lm_fsdp_step_matches_replicated",
     "test_step_resume.py::test_mid_epoch_resume_under_mesh[data:8]",
     "test_models.py::test_residual_unprojectable_shape_rejected",
     "test_pp.py::test_pp_grad_clip_matches_optax[mesh_axes1-1-False]",

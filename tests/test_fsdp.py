@@ -196,9 +196,87 @@ def test_lm_fsdp_step_matches_replicated(eight_devices):
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("clip", [0.0, 0.05])
+def test_lm_fsdp_sp_matches_replicated_sp(clip, eight_devices):
+    """FSDP x SP (ZeRO x ring): the manual all_gather / psum_scatter
+    pair inside the SP shard_map must be placement, not math — one step
+    with data-sharded params on data:2,seq:2 equals the replicated-param
+    SP step (loss + params), the state is really sharded, and (clip
+    variant, slow set) the in-step cross-rank grad-clip equals optax's
+    clip on the replicated path."""
+    import jax.numpy as jnp
+    import optax
+
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.parallel.dp import replicate
+    from mpi_cuda_cnn_tpu.parallel.sp import SEQ_AXIS, make_sp_lm_train_step
+    from mpi_cuda_cnn_tpu.train.lm import make_lm_state
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+
+    model = TransformerLM(vocab=32, dim=32, heads=4, depth=2, max_seq=64)
+    mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 2}, devices=jax.devices()[:4])
+    rng = np.random.default_rng(8)
+    toks = jnp.asarray(rng.integers(0, 32, (4, 33)), jnp.int32)
+    from jax.sharding import NamedSharding
+
+    bspec = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    tokens = jax.device_put(toks[:, :-1], bspec)
+    targets = jax.device_put(toks[:, 1:], bspec)
+
+    opt = make_optimizer(0.1, grad_clip=clip)  # optax-side clip
+    rep_step = make_sp_lm_train_step(
+        model, opt, mesh, impl="ring", data_axis=DATA_AXIS,
+        donate=False,
+    )
+    rep_state = replicate(make_lm_state(model, opt, seed=0), mesh)
+    want_state, want_m = rep_step(rep_state, tokens, targets)
+
+    plain_opt = make_optimizer(0.1)  # clip happens IN the step
+    z_state = make_fsdp_state(
+        model.init(jax.random.key(0)),
+        plain_opt if clip else opt, mesh,
+    )
+    w1 = z_state["params"]["blocks"][0]["w1"]  # (32, 128): 128 over 2
+    assert w1.addressable_shards[0].data.shape == (32, 128 // 2)
+    specs = jax.tree.map(lambda a: a.sharding.spec, z_state)
+    z_step = make_sp_lm_train_step(
+        model, plain_opt if clip else opt, mesh, impl="ring",
+        data_axis=DATA_AXIS, donate=False, state_specs=specs,
+        grad_clip=clip,
+    )
+    got_state, got_m = z_step(z_state, tokens, targets)
+    np.testing.assert_allclose(float(got_m["loss"]),
+                               float(want_m["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(got_state["params"])),
+        jax.tree.leaves(jax.device_get(want_state["params"])),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_lm_trainer_fsdp_sp_e2e(eight_devices):
+    """The lm product loop trains with --fsdp on a data:2,seq:2 mesh
+    (ZeRO x ring through the trainer), including eval and decode."""
+    from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+    from mpi_cuda_cnn_tpu.utils.config import LMConfig
+
+    cfg = LMConfig(corpus="synthetic", dim=32, depth=2, heads=4,
+                   seq_len=64, steps=6, batch_size=4, log_every=0,
+                   lr_schedule="constant", warmup_steps=0, fsdp=True,
+                   grad_clip=1.0, mesh_shape="data:2,seq:2",
+                   sample_tokens=4)
+    t = LMTrainer(cfg, metrics=_quiet())
+    r = t.train()
+    assert r.steps_run == 6 and np.isfinite(r.eval_ppl)
+    _, cont = t.sample(4)
+    assert len(cont) == 4
+
+
 def test_lm_trainer_fsdp_and_fsdp_tp(eight_devices):
     """The lm product loop trains under --fsdp on data:8 AND under
-    FSDP x TP on data:2,model:4; a 'seq' axis with --fsdp is rejected."""
+    FSDP x TP on data:2,model:4; TP x SP with --fsdp stays rejected
+    (fsdp + 'seq' alone composes — test_lm_trainer_fsdp_sp_e2e)."""
     from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
     from mpi_cuda_cnn_tpu.utils.config import LMConfig
 
@@ -219,5 +297,5 @@ def test_lm_trainer_fsdp_and_fsdp_tp(eight_devices):
         r = t.train()
         assert r.steps_run == 8 and np.isfinite(r.final_loss)
     with pytest.raises(ValueError, match="does not compose"):
-        LMTrainer(LMConfig(mesh_shape="data:2,seq:4", **base),
+        LMTrainer(LMConfig(mesh_shape="seq:2,model:2,data:2", **base),
                   metrics=_quiet())
