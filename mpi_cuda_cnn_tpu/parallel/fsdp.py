@@ -23,7 +23,26 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS
 
-__all__ = ["fsdp_specs", "shard_params_fsdp", "make_fsdp_state"]
+__all__ = ["fsdp_specs", "shard_params_fsdp", "make_fsdp_state",
+           "state_specs"]
+
+
+def state_specs(state):
+    """The PartitionSpec tree of a PLACED state — what a shard_map step
+    consumes as in/out specs (parallel/sp.py state_specs). Read from the
+    placement itself so the two can never disagree; freshly created
+    scalar leaves (SingleDeviceSharding — e.g. adamw's count, made by
+    optimizer.init outside any device_put) are replicated by
+    construction."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(
+        lambda a: (
+            a.sharding.spec
+            if isinstance(a.sharding, NamedSharding) else P()
+        ),
+        state,
+    )
 
 
 def fsdp_specs(params, mesh, axis: str = DATA_AXIS, base_specs=None):

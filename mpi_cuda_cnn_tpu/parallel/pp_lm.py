@@ -123,7 +123,8 @@ def make_pp_lm_state(model: TransformerLM, params, optimizer, mesh
 
 def make_gpipe_local_loss(model, *, M: int, n_pipe: int, compute_dtype,
                           remat: bool, ce_chunk: int, stage_body,
-                          moe_aux_weight: float = 0.01):
+                          moe_aux_weight: float = 0.01,
+                          seq_axis: str | None = None, n_seq: int = 1):
     """The GPipe schedule, shared by the plain pipelined step (below)
     and the TP x PP step (parallel/tp_pp_lm.py) — ONE implementation of
     the embed / tick / ppermute / drain machinery, parameterized by
@@ -141,6 +142,13 @@ def make_gpipe_local_loss(model, *, M: int, n_pipe: int, compute_dtype,
     microbatched/sharded trainer uses: the Switch loss is a mean-of-
     products over tokens, so it only equals the serial full-batch value
     at M=1 (pinned by the parity test).
+
+    seq_axis/n_seq put the schedule under SEQUENCE parallelism too
+    (SP x PP): each device's buffers hold the (mb, S/n_seq, d) local
+    shard, positions carry the shard's absolute offset, and the stage
+    body runs ring attention over `seq_axis` — the ppermute pipeline
+    handoff and the drain are per-seq-rank local, so nothing else
+    changes. The caller pmeans loss/grads over 'seq' (equal shards).
     """
     cd = compute_dtype
 
@@ -148,15 +156,19 @@ def make_gpipe_local_loss(model, *, M: int, n_pipe: int, compute_dtype,
         blocks = packed["blocks"]      # local (L/P, ...)
         rest = packed["rest"]
         mb, s = toks_mb.shape[1], toks_mb.shape[2]
-        if s > model.max_seq:
+        if s * n_seq > model.max_seq:
             # Trace-time check (shapes are static): XLA's gather would
             # silently clamp positions past the pos_emb table — the same
             # loud failure apply() raises (models/transformer.py), which
-            # this schedule bypasses.
+            # this schedule bypasses. Under SP, s is the LOCAL shard;
+            # the bound is on the global sequence.
             raise ValueError(
-                f"sequence length {s} exceeds max_seq {model.max_seq}"
+                f"sequence length {s * n_seq} exceeds max_seq "
+                f"{model.max_seq}"
             )
         pos = jnp.arange(s)
+        if seq_axis is not None:
+            pos = lax.axis_index(seq_axis) * s + pos
         s_idx = lax.axis_index(PIPE_AXIS)
         fwd_perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
         w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
@@ -229,6 +241,146 @@ def make_gpipe_local_loss(model, *, M: int, n_pipe: int, compute_dtype,
         return (nll_sum + moe_aux_weight * aux_sum) / M
 
     return local_loss
+
+
+def sp_pp_shard_batch(t, mesh):
+    """Place (M, mb, S) microbatched int32 tokens for the SP x PP step:
+    microbatches over 'data' (when present), positions over 'seq'."""
+    from jax.sharding import NamedSharding
+
+    from .sp import SEQ_AXIS
+
+    spec = P(None, DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
+             SEQ_AXIS)
+    return jax.device_put(t, NamedSharding(mesh, spec))
+
+
+def make_sp_pp_lm_train_step(
+    model: TransformerLM,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    state: TrainState,
+    *,
+    num_microbatches: int | None = None,
+    compute_dtype=None,
+    remat: bool = False,
+    donate: bool = True,
+    grad_clip: float = 0.0,
+    impl: str = "ring",
+    ce_chunk: int = 0,
+    moe_aux_weight: float = 0.01,
+):
+    """Jitted GPipe x ring-attention train step over a ('pipe', 'seq'
+    [, 'data']) mesh — long sequences THROUGH a pipelined model: blocks
+    stage-sharded over 'pipe' (make_pp_lm_state, unchanged — 'seq'
+    never shards parameters), positions sharded over 'seq', each
+    stage's attention the ring (or ring-flash fold) on its local shard.
+    The schedule is the shared make_gpipe_local_loss with a seq offset;
+    loss/grads additionally pmean over ('seq'[, 'data']) exactly as in
+    the plain SP step (parallel/sp.py). MoE blocks ride along
+    expert-parallel over the SAME 'seq' axis (EP x SP inside each
+    stage), aux masked on bubble ticks as in the plain pipelined step.
+
+    step(state, toks_mb, tgt_mb) -> (state, {"loss": ...}); toks/tgt
+    (M, mb, S) int32 placed via sp_pp_shard_batch.
+    """
+    from .sp import SEQ_AXIS, ring_attention, ring_flash_attention
+
+    n_pipe = mesh.shape[PIPE_AXIS]
+    n_seq = mesh.shape[SEQ_AXIS]
+    _check_pp_lm(model, n_pipe)
+    has_data = DATA_AXIS in mesh.axis_names
+    M = num_microbatches or n_pipe
+    cd = compute_dtype
+    reduce_axes = (SEQ_AXIS, DATA_AXIS) if has_data else (SEQ_AXIS,)
+
+    if impl == "ring":
+        attn_body = ring_attention
+    elif impl == "ring_flash":
+        attn_body = ring_flash_attention
+    else:
+        raise ValueError(
+            f"unknown SP x PP impl {impl!r}; 'ring' or 'ring_flash' "
+            "(each stage's attention is the sequence-sharded ring)"
+        )
+
+    def attn(q, k, v):
+        if impl == "ring_flash" and q.shape[1] % 128:
+            # Fail with GLOBAL context — the kernel's own check would
+            # name only the confusing shard-local length (same guard as
+            # the plain SP step, parallel/sp.py).
+            raise ValueError(
+                f"impl='ring_flash' needs the per-shard sequence to be a"
+                f" multiple of 128 (flash block granularity): global"
+                f" S={q.shape[1] * n_seq} over seq={n_seq} devices gives"
+                f" s_local={q.shape[1]}"
+            )
+        return attn_body(q, k, v, axis=SEQ_AXIS, causal=True)
+
+    def stage_body(blocks, x, pos):
+        def body(carry, blk):
+            x, aux = carry
+            x, a = model.apply_block(
+                blk, x, pos=pos, attn=attn, compute_dtype=cd,
+                moe_axis=SEQ_AXIS,
+            )
+            return (x, aux + a), None
+
+        (x, aux), _ = lax.scan(body, (x, jnp.float32(0)), blocks)
+        return x, aux
+
+    local_loss = make_gpipe_local_loss(
+        model, M=M, n_pipe=n_pipe, compute_dtype=cd, remat=remat,
+        ce_chunk=ce_chunk, stage_body=stage_body,
+        moe_aux_weight=moe_aux_weight, seq_axis=SEQ_AXIS, n_seq=n_seq,
+    )
+
+    def step(state, toks_mb, tgt_mb):
+        loss, grads = jax.value_and_grad(local_loss)(
+            state["params"], toks_mb, tgt_mb
+        )
+        # 'pipe' assembly exactly as in the plain pipelined step; then
+        # the SP reduction: seq (and data) shards hold different tokens
+        # of the same logical batch -> pmean everything over them.
+        grads = {
+            "blocks": grads["blocks"],
+            "rest": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), grads["rest"]
+            ),
+        }
+        loss = lax.psum(loss, PIPE_AXIS)
+        grads = jax.tree.map(lambda g: lax.pmean(g, reduce_axes), grads)
+        loss = lax.pmean(loss, reduce_axes)
+        if grad_clip > 0:
+            # After the pmeans, block rows are disjoint over 'pipe' only
+            # (replicated across seq/data); the repaired rest counts
+            # once — the same assembly as the plain pipelined step,
+            # through the same shared reducers.
+            from ..train.optimizer import clip_grads_by_global_sq, grad_sq
+
+            gn2 = lax.psum(grad_sq(grads["blocks"]), PIPE_AXIS) \
+                + grad_sq(grads["rest"])
+            grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": loss},
+        )
+
+    specs = _state_specs(state)
+    bspec = P(None, DATA_AXIS if has_data else None, SEQ_AXIS)
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(specs, bspec, bspec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_pp_lm_train_step(
@@ -311,15 +463,10 @@ def make_pp_lm_train_step(
             # norms), the psum-repaired rest is identical on every stage
             # (count once). The scale comes out identical on every rank;
             # the clip semantics live in ONE shared helper.
-            from ..train.optimizer import clip_grads_by_global_sq
+            from ..train.optimizer import clip_grads_by_global_sq, grad_sq
 
-            def sq(tree):
-                return sum(
-                    jnp.sum(jnp.square(g).astype(jnp.float32))
-                    for g in jax.tree.leaves(tree)
-                )
-
-            gn2 = lax.psum(sq(grads["blocks"]), PIPE_AXIS) + sq(grads["rest"])
+            gn2 = lax.psum(grad_sq(grads["blocks"]), PIPE_AXIS) \
+                + grad_sq(grads["rest"])
             grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]

@@ -140,12 +140,13 @@ class LMTrainer:
                     "TP x SP (its stage runs ring/ring_flash attention "
                     "on the local heads); use auto"
                 )
-        if self.n_pipe > 1 and (self.n_seq > 1 or cfg.fsdp):
+        if self.n_pipe > 1 and (cfg.fsdp or
+                                (self.n_seq > 1 and self.n_model > 1)):
             raise ValueError(
-                "the LM's 'pipe' axis composes with 'data' and 'model' "
-                "(GPipe over stacked blocks, parallel/pp_lm.py; Megatron "
-                "inside the stages, parallel/tp_pp_lm.py) but not with "
-                "'seq' or --fsdp; drop those or the pipe axis"
+                "the LM's 'pipe' axis composes with 'data', 'model' "
+                "(parallel/tp_pp_lm.py), OR 'seq' (parallel/pp_lm.py "
+                "make_sp_pp_lm_train_step) — not with --fsdp or with "
+                "'model' and 'seq' together; drop those or the pipe axis"
             )
         if self.n_pipe > 1 and cfg.batch_size % (self.n_pipe * self.n_data):
             raise ValueError(
@@ -153,8 +154,8 @@ class LMTrainer:
                 f"num_microbatches x data-axis "
                 f"({self.n_pipe} x {self.n_data})"
             )
-        if self.n_pipe > 1 and cfg.attn_impl not in ("auto", "oracle",
-                                                     "flash"):
+        if self.n_pipe > 1 and self.n_seq == 1 and \
+                cfg.attn_impl not in ("auto", "oracle", "flash"):
             raise ValueError(
                 f"--attn-impl {cfg.attn_impl!r} needs a 'seq' mesh axis "
                 "(ring attention shards positions); the pipelined stages "
@@ -222,31 +223,53 @@ class LMTrainer:
                 make_pp_lm_train_step,
             )
 
-            # Each stage sees the full sequence, so the plain attention
-            # router applies unchanged — flash per stage on TPU.
-            self.attn_impl = pick_attn_impl(
-                cfg.attn_impl, cfg.seq_len, compute_dtype
-            )
             params = self.model.init(jax.random.key(cfg.seed))
-            if self.n_model > 1:
-                # TP x PP (x DP): Megatron inside the GPipe stages —
-                # the 3D layout (parallel/tp_pp_lm.py).
-                from ..parallel.tp_pp_lm import (
-                    make_tp_pp_lm_state as make_state,
-                    make_tp_pp_lm_train_step as make_step,
+            if self.n_seq > 1:
+                # SP x PP (x DP): long sequences THROUGH a pipelined
+                # model — ring attention inside each GPipe stage.
+                from ..parallel.pp_lm import make_sp_pp_lm_train_step
+
+                impl = cfg.attn_impl
+                if impl in ("auto", "flash"):
+                    impl = _pick_ring_impl(cfg.seq_len, self.n_seq)
+                elif impl == "oracle":
+                    impl = "ring"
+                self.attn_impl = impl
+                self.state = make_pp_lm_state(
+                    self.model, params, self.optimizer, self.mesh
+                )
+                self.train_step = make_sp_pp_lm_train_step(
+                    self.model, self.optimizer, self.mesh, self.state,
+                    compute_dtype=compute_dtype, remat=cfg.remat,
+                    grad_clip=cfg.grad_clip, impl=impl,
+                    ce_chunk=cfg.ce_chunk,
                 )
             else:
-                make_state, make_step = make_pp_lm_state, \
-                    make_pp_lm_train_step
-            self.state = make_state(
-                self.model, params, self.optimizer, self.mesh
-            )
-            self.train_step = make_step(
-                self.model, self.optimizer, self.mesh, self.state,
-                compute_dtype=compute_dtype, remat=cfg.remat,
-                grad_clip=cfg.grad_clip, attn_impl=self.attn_impl,
-                ce_chunk=cfg.ce_chunk,
-            )
+                # Each stage sees the full sequence, so the plain
+                # attention router applies unchanged — flash per stage
+                # on TPU.
+                self.attn_impl = pick_attn_impl(
+                    cfg.attn_impl, cfg.seq_len, compute_dtype
+                )
+                if self.n_model > 1:
+                    # TP x PP (x DP): Megatron inside the GPipe stages —
+                    # the 3D layout (parallel/tp_pp_lm.py).
+                    from ..parallel.tp_pp_lm import (
+                        make_tp_pp_lm_state as make_state,
+                        make_tp_pp_lm_train_step as make_step,
+                    )
+                else:
+                    make_state, make_step = make_pp_lm_state, \
+                        make_pp_lm_train_step
+                self.state = make_state(
+                    self.model, params, self.optimizer, self.mesh
+                )
+                self.train_step = make_step(
+                    self.model, self.optimizer, self.mesh, self.state,
+                    compute_dtype=compute_dtype, remat=cfg.remat,
+                    grad_clip=cfg.grad_clip, attn_impl=self.attn_impl,
+                    ce_chunk=cfg.ce_chunk,
+                )
         elif self.n_seq > 1 and self.n_model > 1:
             from ..parallel.tp_sp import (
                 make_tp_sp_lm_train_step,
@@ -284,24 +307,13 @@ class LMTrainer:
                 # ZeRO x ring: state placed by the generic FSDP specs
                 # (largest dim over 'data'); the step consumes the
                 # placement's own spec tree, so the two cannot disagree.
-                from ..parallel.fsdp import make_fsdp_state
+                from ..parallel.fsdp import make_fsdp_state, state_specs
 
                 params = self.model.init(jax.random.key(cfg.seed))
                 self.state = make_fsdp_state(
                     params, self.optimizer, self.mesh
                 )
-                # Fresh scalar optimizer leaves (e.g. adamw's count)
-                # carry SingleDeviceSharding, not NamedSharding — they
-                # are replicated by construction.
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                sp_specs = jax.tree.map(
-                    lambda a: (
-                        a.sharding.spec
-                        if isinstance(a.sharding, NamedSharding) else P()
-                    ),
-                    self.state,
-                )
+                sp_specs = state_specs(self.state)
             self.train_step = make_sp_lm_train_step(
                 self.model, self.optimizer, self.mesh, impl=impl,
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
@@ -388,10 +400,15 @@ class LMTrainer:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if self.n_pipe > 1:
-            from ..parallel.pp_lm import pp_lm_shard_batch
+            from ..parallel.pp_lm import (
+                pp_lm_shard_batch,
+                sp_pp_shard_batch,
+            )
 
             t = t.reshape((self.n_pipe, -1) + t.shape[1:])
-            return pp_lm_shard_batch(t, self.mesh)
+            place = (sp_pp_shard_batch if self.n_seq > 1
+                     else pp_lm_shard_batch)
+            return place(t, self.mesh)
         spec = P(
             DATA_AXIS if self.n_data > 1 else None,
             SEQ_AXIS if self.n_seq > 1 else None,

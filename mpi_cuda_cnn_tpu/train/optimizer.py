@@ -35,6 +35,19 @@ def clip_grads_by_global_sq(grads, sq_norm, clip: float):
     return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
 
 
+def grad_sq(tree):
+    """Sum of squared gradients across a pytree, accumulated in f32 —
+    the other half of the in-step clip-norm assembly (split_grad_sq
+    classifies; this reduces a bucket whose sharding is uniform)."""
+    import jax
+    import jax.numpy as jnp
+
+    return sum(
+        jnp.sum(jnp.square(g).astype(jnp.float32))
+        for g in jax.tree.leaves(tree)
+    )
+
+
 def split_grad_sq(grads, specs, axis: str):
     """(sliced_sq, replicated_sq): the squared-gradient sum in f32,
     split by whether `axis` appears in each leaf's PartitionSpec.

@@ -86,6 +86,9 @@ SLOW_TESTS = {
     "test_fsdp.py::test_lm_fsdp_sp_matches_replicated_sp[0.05]",
     "test_fsdp.py::test_lm_fsdp_sp_matches_replicated_sp[0.0]",
     "test_fsdp.py::test_lm_fsdp_step_matches_replicated",
+    "test_pp_lm.py::test_sp_pp_lm_step_matches_serial[mesh_axes1]",
+    "test_pp_lm.py::test_lm_trainer_sp_pp_e2e",
+    "test_pp_lm.py::test_sp_pp_lm_moe_trains",
     "test_step_resume.py::test_mid_epoch_resume_under_mesh[data:8]",
     "test_models.py::test_residual_unprojectable_shape_rejected",
     "test_pp.py::test_pp_grad_clip_matches_optax[mesh_axes1-1-False]",

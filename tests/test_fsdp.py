@@ -236,9 +236,11 @@ def test_lm_fsdp_sp_matches_replicated_sp(clip, eight_devices):
         model.init(jax.random.key(0)),
         plain_opt if clip else opt, mesh,
     )
+    from mpi_cuda_cnn_tpu.parallel.fsdp import state_specs
+
     w1 = z_state["params"]["blocks"][0]["w1"]  # (32, 128): 128 over 2
     assert w1.addressable_shards[0].data.shape == (32, 128 // 2)
-    specs = jax.tree.map(lambda a: a.sharding.spec, z_state)
+    specs = state_specs(z_state)
     z_step = make_sp_lm_train_step(
         model, plain_opt if clip else opt, mesh, impl="ring",
         data_axis=DATA_AXIS, donate=False, state_specs=specs,
