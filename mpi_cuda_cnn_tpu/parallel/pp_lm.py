@@ -243,16 +243,87 @@ def make_gpipe_local_loss(model, *, M: int, n_pipe: int, compute_dtype,
     return local_loss
 
 
-def sp_pp_shard_batch(t, mesh):
-    """Place (M, mb, S) microbatched int32 tokens for the SP x PP step:
-    microbatches over 'data' (when present), positions over 'seq'."""
-    from jax.sharding import NamedSharding
-
+def sp_pp_batch_spec(mesh) -> P:
+    """The (M, mb, S) batch PartitionSpec when the mesh has a 'seq'
+    axis: microbatches over 'data' (when present), positions over
+    'seq'. ONE definition consumed by the placement helper below AND by
+    every seq-carrying pipelined step's shard_map in_specs (here and
+    tp_pp_lm.py) — the two sides of the contract cannot drift."""
     from .sp import SEQ_AXIS
 
-    spec = P(None, DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
+    return P(None, DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
              SEQ_AXIS)
-    return jax.device_put(t, NamedSharding(mesh, spec))
+
+
+def sp_pp_shard_batch(t, mesh):
+    """Place (M, mb, S) microbatched int32 tokens for the SP x PP step."""
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(t, NamedSharding(mesh, sp_pp_batch_spec(mesh)))
+
+
+def _jit_pp_step(optimizer, local_loss, state, mesh, *, reduce_axes,
+                 grad_clip, donate, bspec):
+    """The pipelined step assembly shared by the plain PP and SP x PP
+    makers (tp_pp_lm.py has its own — the 'model' axis changes the norm
+    classification): psum the masked loss and the rest-tree gradients
+    over 'pipe', pmean everything over `reduce_axes` (the axes whose
+    shards hold different tokens — ('data'?) plain, ('seq'[, 'data'])
+    under SP), in-step cross-rank clip (block rows disjoint over 'pipe',
+    the repaired rest once), optimizer update, shard_map + jit."""
+
+    def step(state, toks_mb, tgt_mb):
+        loss, grads = jax.value_and_grad(local_loss)(
+            state["params"], toks_mb, tgt_mb
+        )
+        # Block grads are stage-local (each device owns its blocks); the
+        # replicated leaves (embedding, ln_f, head) received only their
+        # OWN stage's contribution — zero everywhere but the stage that
+        # uses them — so one psum over 'pipe' restores the full gradient.
+        grads = {
+            "blocks": grads["blocks"],
+            "rest": jax.tree.map(
+                lambda g: lax.psum(g, PIPE_AXIS), grads["rest"]
+            ),
+        }
+        loss = lax.psum(loss, PIPE_AXIS)
+        if reduce_axes:
+            grads = jax.tree.map(
+                lambda g: lax.pmean(g, reduce_axes), grads
+            )
+            loss = lax.pmean(loss, reduce_axes)
+        if grad_clip > 0:
+            # Cross-stage global norm, each logical parameter once: the
+            # block slices are DISJOINT over 'pipe' (psum their squared
+            # norms), the psum-repaired rest is identical on every stage
+            # (count once) — and after the pmeans everything is
+            # replicated across reduce_axes. The scale comes out
+            # identical on every rank; the semantics live in the shared
+            # helpers.
+            from ..train.optimizer import clip_grads_by_global_sq, grad_sq
+
+            gn2 = lax.psum(grad_sq(grads["blocks"]), PIPE_AXIS) \
+                + grad_sq(grads["rest"])
+            grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": loss},
+        )
+
+    specs = _state_specs(state)
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(specs, bspec, bspec),
+        out_specs=(specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_sp_pp_lm_train_step(
@@ -334,53 +405,10 @@ def make_sp_pp_lm_train_step(
         ce_chunk=ce_chunk, stage_body=stage_body,
         moe_aux_weight=moe_aux_weight, seq_axis=SEQ_AXIS, n_seq=n_seq,
     )
-
-    def step(state, toks_mb, tgt_mb):
-        loss, grads = jax.value_and_grad(local_loss)(
-            state["params"], toks_mb, tgt_mb
-        )
-        # 'pipe' assembly exactly as in the plain pipelined step; then
-        # the SP reduction: seq (and data) shards hold different tokens
-        # of the same logical batch -> pmean everything over them.
-        grads = {
-            "blocks": grads["blocks"],
-            "rest": jax.tree.map(
-                lambda g: lax.psum(g, PIPE_AXIS), grads["rest"]
-            ),
-        }
-        loss = lax.psum(loss, PIPE_AXIS)
-        grads = jax.tree.map(lambda g: lax.pmean(g, reduce_axes), grads)
-        loss = lax.pmean(loss, reduce_axes)
-        if grad_clip > 0:
-            # After the pmeans, block rows are disjoint over 'pipe' only
-            # (replicated across seq/data); the repaired rest counts
-            # once — the same assembly as the plain pipelined step,
-            # through the same shared reducers.
-            from ..train.optimizer import clip_grads_by_global_sq, grad_sq
-
-            gn2 = lax.psum(grad_sq(grads["blocks"]), PIPE_AXIS) \
-                + grad_sq(grads["rest"])
-            grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["params"]
-        )
-        params = optax.apply_updates(state["params"], updates)
-        return (
-            {"params": params, "opt_state": opt_state,
-             "step": state["step"] + 1},
-            {"loss": loss},
-        )
-
-    specs = _state_specs(state)
-    bspec = P(None, DATA_AXIS if has_data else None, SEQ_AXIS)
-    sharded = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(specs, bspec, bspec),
-        out_specs=(specs, P()),
-        check_vma=False,
+    return _jit_pp_step(
+        optimizer, local_loss, state, mesh, reduce_axes=reduce_axes,
+        grad_clip=grad_clip, donate=donate, bspec=sp_pp_batch_spec(mesh),
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def make_pp_lm_train_step(
@@ -438,53 +466,8 @@ def make_pp_lm_train_step(
         ce_chunk=ce_chunk, stage_body=stage_body,
         moe_aux_weight=moe_aux_weight,
     )
-
-    def step(state, toks_mb, tgt_mb):
-        loss, grads = jax.value_and_grad(local_loss)(
-            state["params"], toks_mb, tgt_mb
-        )
-        # Block grads are stage-local (each device owns its blocks); the
-        # replicated leaves (embedding, ln_f, head) received only their
-        # OWN stage's contribution — zero everywhere but the stage that
-        # uses them — so one psum over 'pipe' restores the full gradient.
-        grads = {
-            "blocks": grads["blocks"],
-            "rest": jax.tree.map(
-                lambda g: lax.psum(g, PIPE_AXIS), grads["rest"]
-            ),
-        }
-        loss = lax.psum(loss, PIPE_AXIS)
-        if has_data:
-            grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
-            loss = lax.pmean(loss, DATA_AXIS)
-        if grad_clip > 0:
-            # Cross-stage global norm, each logical parameter once: the
-            # block slices are DISJOINT over 'pipe' (psum their squared
-            # norms), the psum-repaired rest is identical on every stage
-            # (count once). The scale comes out identical on every rank;
-            # the clip semantics live in ONE shared helper.
-            from ..train.optimizer import clip_grads_by_global_sq, grad_sq
-
-            gn2 = lax.psum(grad_sq(grads["blocks"]), PIPE_AXIS) \
-                + grad_sq(grads["rest"])
-            grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["params"]
-        )
-        params = optax.apply_updates(state["params"], updates)
-        return (
-            {"params": params, "opt_state": opt_state,
-             "step": state["step"] + 1},
-            {"loss": loss},
-        )
-
-    specs = _state_specs(state)
-    bspec = _batch_spec(mesh)
-    sharded = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(specs, bspec, bspec),
-        out_specs=(specs, P()),
-        check_vma=False,
+    return _jit_pp_step(
+        optimizer, local_loss, state, mesh,
+        reduce_axes=(DATA_AXIS,) if has_data else (),
+        grad_clip=grad_clip, donate=donate, bspec=_batch_spec(mesh),
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

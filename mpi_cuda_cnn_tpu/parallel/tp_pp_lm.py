@@ -138,25 +138,57 @@ def make_tp_pp_lm_train_step(
     attn_impl: str = "oracle",
     ce_chunk: int = 0,
 ):
-    """Jitted GPipe x Megatron train step.
+    """Jitted GPipe x Megatron train step — with a 'seq' mesh axis, the
+    FULL 4D layout (pipe x model x seq x data).
 
     step(state, toks_mb, tgt_mb) -> (state, {"loss": ...}); toks/tgt are
-    (M, mb, S) int32 placed via pp_lm_shard_batch (the batch contract is
-    pp_lm's — 'model' never shards data). Each tick scans the shared
-    Megatron block over the stage's local block slice with full-sequence
-    attention on the local heads; attn_impl routes "flash"/"oracle"
-    exactly as in the plain pipelined step, ce_chunk fuses the drain CE.
+    (M, mb, S) int32 placed via pp_lm_shard_batch ('model' never shards
+    data; with a 'seq' axis use pp_lm.sp_pp_shard_batch — positions
+    shard over it). Each tick scans the shared Megatron block over the
+    stage's local block slice; attention on the local heads is
+    full-sequence ("flash"/"oracle" routed exactly as in the plain
+    pipelined step) or, when the mesh has a 'seq' axis, the ring /
+    ring-flash fold over it on the sequence shard — tp_sp.py's exact
+    configuration (ring on H/n_tp local heads) riding the GPipe
+    schedule's seq offset. ce_chunk fuses the drain CE either way;
+    loss/grads additionally pmean over ('seq'[, 'data']).
     """
+    from .sp import SEQ_AXIS, ring_attention, ring_flash_attention
+
     n_pipe = mesh.shape[PIPE_AXIS]
     n_tp = mesh.shape[MODEL_AXIS]
+    n_seq = mesh.shape.get(SEQ_AXIS, 1)
     _check_tp_pp(model, n_pipe, n_tp)
     has_data = DATA_AXIS in mesh.axis_names
     M = num_microbatches or n_pipe
     cd = compute_dtype
 
-    from ..train.lm import get_attn_fn
+    if n_seq > 1:
+        if attn_impl == "ring":
+            attn_body = ring_attention
+        elif attn_impl == "ring_flash":
+            attn_body = ring_flash_attention
+        else:
+            raise ValueError(
+                f"attn_impl {attn_impl!r} with a 'seq' axis must be "
+                "'ring' or 'ring_flash' (positions are sharded; each "
+                "stage's attention is the sequence fold on the local "
+                "heads)"
+            )
 
-    attn = get_attn_fn(attn_impl)
+        def attn(q, k, v):
+            if attn_impl == "ring_flash" and q.shape[1] % 128:
+                raise ValueError(
+                    f"attn_impl='ring_flash' needs the per-shard "
+                    f"sequence to be a multiple of 128: global "
+                    f"S={q.shape[1] * n_seq} over seq={n_seq} devices "
+                    f"gives s_local={q.shape[1]}"
+                )
+            return attn_body(q, k, v, axis=SEQ_AXIS, causal=True)
+    else:
+        from ..train.lm import get_attn_fn
+
+        attn = get_attn_fn(attn_impl)
     tp_copy, tp_reduce = _make_tp_pair(MODEL_AXIS)
     w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
 
@@ -175,9 +207,12 @@ def make_tp_pp_lm_train_step(
     # The whole GPipe schedule (embed / tick / ppermute / drain) is
     # pp_lm's, verbatim — the model ranks run it identically on
     # replicated activations; only the stage body is Megatron-sliced.
+    # With a 'seq' axis the schedule's buffers hold the local sequence
+    # shard and positions carry its absolute offset.
     local_loss = make_gpipe_local_loss(
         model, M=M, n_pipe=n_pipe, compute_dtype=cd, remat=remat,
         ce_chunk=ce_chunk, stage_body=stage_body,
+        seq_axis=SEQ_AXIS if n_seq > 1 else None, n_seq=n_seq,
     )
     specs = _state_specs(state)  # shard_map specs AND the clip's
     #                              sliced-leaf classification below
@@ -199,18 +234,29 @@ def make_tp_pp_lm_train_step(
             ),
         }
         loss = lax.psum(loss, PIPE_AXIS)
-        if has_data:
-            grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
-            loss = lax.pmean(loss, DATA_AXIS)
+        # seq (and data) shards hold different tokens of the same
+        # logical batch -> pmean everything over them, exactly as in
+        # the plain SP step; never over 'model'.
+        reduce_axes = tuple(
+            a for a, on in ((SEQ_AXIS, n_seq > 1), (DATA_AXIS, has_data))
+            if on
+        )
+        if reduce_axes:
+            grads = jax.tree.map(
+                lambda g: lax.pmean(g, reduce_axes), grads
+            )
+            loss = lax.pmean(loss, reduce_axes)
         if grad_clip > 0:
             # Each logical parameter once: sliced block leaves are
             # disjoint over BOTH 'pipe' and 'model'; ln block leaves are
             # disjoint over 'pipe' only (identical across 'model'); the
-            # repaired rest is identical everywhere. The sliced-vs-
-            # replicated classification is the shared helper's, keyed
-            # off the SAME specs the state is sharded with.
+            # repaired rest is identical everywhere (post-pmean, all of
+            # it replicated across seq/data). The sliced-vs-replicated
+            # classification is the shared helper's, keyed off the SAME
+            # specs the state is sharded with.
             from ..train.optimizer import (
                 clip_grads_by_global_sq,
+                grad_sq,
                 split_grad_sq,
             )
 
@@ -218,10 +264,7 @@ def make_tp_pp_lm_train_step(
                 grads["blocks"], specs["params"]["blocks"], MODEL_AXIS
             )
             g2 = lax.psum(sliced, MODEL_AXIS) + rep
-            gn2 = lax.psum(g2, PIPE_AXIS) + sum(
-                jnp.sum(jnp.square(g).astype(jnp.float32))
-                for g in jax.tree.leaves(grads["rest"])
-            )
+            gn2 = lax.psum(g2, PIPE_AXIS) + grad_sq(grads["rest"])
             grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
@@ -233,7 +276,12 @@ def make_tp_pp_lm_train_step(
             {"loss": loss},
         )
 
-    bspec = _batch_spec(mesh)
+    if n_seq > 1:
+        from .pp_lm import sp_pp_batch_spec
+
+        bspec = sp_pp_batch_spec(mesh)
+    else:
+        bspec = _batch_spec(mesh)
     sharded = jax.shard_map(
         step,
         mesh=mesh,

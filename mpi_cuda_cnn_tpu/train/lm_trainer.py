@@ -140,13 +140,12 @@ class LMTrainer:
                     "TP x SP (its stage runs ring/ring_flash attention "
                     "on the local heads); use auto"
                 )
-        if self.n_pipe > 1 and (cfg.fsdp or
-                                (self.n_seq > 1 and self.n_model > 1)):
+        if self.n_pipe > 1 and cfg.fsdp:
             raise ValueError(
-                "the LM's 'pipe' axis composes with 'data', 'model' "
-                "(parallel/tp_pp_lm.py), OR 'seq' (parallel/pp_lm.py "
-                "make_sp_pp_lm_train_step) — not with --fsdp or with "
-                "'model' and 'seq' together; drop those or the pipe axis"
+                "the LM's 'pipe' axis composes with 'data', 'model', and "
+                "'seq' (up to the full 4D pipe x model x seq x data mesh; "
+                "parallel/pp_lm.py, tp_pp_lm.py) but not with --fsdp; "
+                "drop the flag or the pipe axis"
             )
         if self.n_pipe > 1 and cfg.batch_size % (self.n_pipe * self.n_data):
             raise ValueError(
@@ -226,24 +225,42 @@ class LMTrainer:
             params = self.model.init(jax.random.key(cfg.seed))
             if self.n_seq > 1:
                 # SP x PP (x DP): long sequences THROUGH a pipelined
-                # model — ring attention inside each GPipe stage.
-                from ..parallel.pp_lm import make_sp_pp_lm_train_step
-
+                # model — ring attention inside each GPipe stage; with a
+                # 'model' axis too, the FULL 4D mesh (Megatron blocks,
+                # ring on the local heads).
                 impl = cfg.attn_impl
                 if impl in ("auto", "flash"):
                     impl = _pick_ring_impl(cfg.seq_len, self.n_seq)
                 elif impl == "oracle":
                     impl = "ring"
                 self.attn_impl = impl
-                self.state = make_pp_lm_state(
-                    self.model, params, self.optimizer, self.mesh
-                )
-                self.train_step = make_sp_pp_lm_train_step(
-                    self.model, self.optimizer, self.mesh, self.state,
-                    compute_dtype=compute_dtype, remat=cfg.remat,
-                    grad_clip=cfg.grad_clip, impl=impl,
-                    ce_chunk=cfg.ce_chunk,
-                )
+                if self.n_model > 1:
+                    from ..parallel.tp_pp_lm import (
+                        make_tp_pp_lm_state,
+                        make_tp_pp_lm_train_step,
+                    )
+
+                    self.state = make_tp_pp_lm_state(
+                        self.model, params, self.optimizer, self.mesh
+                    )
+                    self.train_step = make_tp_pp_lm_train_step(
+                        self.model, self.optimizer, self.mesh, self.state,
+                        compute_dtype=compute_dtype, remat=cfg.remat,
+                        grad_clip=cfg.grad_clip, attn_impl=impl,
+                        ce_chunk=cfg.ce_chunk,
+                    )
+                else:
+                    from ..parallel.pp_lm import make_sp_pp_lm_train_step
+
+                    self.state = make_pp_lm_state(
+                        self.model, params, self.optimizer, self.mesh
+                    )
+                    self.train_step = make_sp_pp_lm_train_step(
+                        self.model, self.optimizer, self.mesh, self.state,
+                        compute_dtype=compute_dtype, remat=cfg.remat,
+                        grad_clip=cfg.grad_clip, impl=impl,
+                        ce_chunk=cfg.ce_chunk,
+                    )
             else:
                 # Each stage sees the full sequence, so the plain
                 # attention router applies unchanged — flash per stage
