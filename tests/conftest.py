@@ -93,6 +93,7 @@ SLOW_TESTS = {
     # asserted) every round besides these slow twins.
     "test_tp_pp_lm.py::test_tp_pp_lm_4d_matches_serial",
     "test_tp_pp_lm.py::test_lm_trainer_4d_e2e",
+    "test_tp_pp_lm.py::test_tp_pp_lm_checkpoint_resume",
     "test_step_resume.py::test_mid_epoch_resume_under_mesh[data:8]",
     "test_models.py::test_residual_unprojectable_shape_rejected",
     "test_pp.py::test_pp_grad_clip_matches_optax[mesh_axes1-1-False]",

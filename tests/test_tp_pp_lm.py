@@ -163,6 +163,40 @@ def test_lm_trainer_4d_e2e(eight_devices):
     assert len(cont) == 4
 
 
+def test_tp_pp_lm_checkpoint_resume(tmp_path, eight_devices):
+    """Checkpoint/resume of the pipe x model PACKED + head-structured
+    state: a run killed at step 4 and resumed finishes with the same
+    step count, the restored state re-places onto the pipe x model
+    sharded layout, and — cross-layout portability — the SAME checkpoint
+    restores into a 4D pipe:2,model:2,seq:2 run (the 'seq' axis never
+    shards parameters, so the state trees are identical)."""
+    from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+    from mpi_cuda_cnn_tpu.utils.config import LMConfig
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    ck = str(tmp_path / "ck")
+    base = dict(corpus="synthetic", dim=32, depth=2, heads=4, seq_len=64,
+                batch_size=4, log_every=0, lr_schedule="constant",
+                warmup_steps=0)
+    LMTrainer(LMConfig(steps=4, checkpoint_dir=ck, checkpoint_every=4,
+                       mesh_shape="pipe:2,model:2", **base),
+              metrics=MetricsLogger(echo=False)).train()
+    t = LMTrainer(LMConfig(steps=7, checkpoint_dir=ck, resume=True,
+                           mesh_shape="pipe:2,model:2", **base),
+                  metrics=MetricsLogger(echo=False))
+    r = t.train()
+    assert r.steps_run == 3  # resumed at 4, ran to 7
+    wo = t.state["params"]["blocks"]["wo"]  # (L, H, hd, d)
+    shard = wo.addressable_shards[0].data
+    assert shard.shape[0] == 1 and shard.shape[1] == 2  # pipe x model
+
+    t4 = LMTrainer(LMConfig(steps=9, checkpoint_dir=ck, resume=True,
+                            mesh_shape="pipe:2,model:2,seq:2", **base),
+                   metrics=MetricsLogger(echo=False))
+    r4 = t4.train()
+    assert r4.steps_run == 2 and np.isfinite(r4.eval_ppl)
+
+
 def test_tp_pp_lm_rejects_bad_configs(eight_devices):
     model, opt, _, _ = _pieces(heads=2)
     mesh = make_mesh({PIPE_AXIS: 2, MODEL_AXIS: 4},
