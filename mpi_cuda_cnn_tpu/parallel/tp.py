@@ -256,14 +256,7 @@ def make_lm_tp_state(model, params, optimizer, mesh,
     state inherits the shardings leaf-for-leaf. Use with the PLAIN jitted
     LM step (train/lm.make_lm_train_step) — GSPMD derives the collectives
     from the placement, exactly like the CNN make_tp_state path."""
-    specs = lm_tp_specs(model, mesh, axis)
-    params = jax.device_put(
-        params,
-        jax.tree.map(
-            lambda s: NamedSharding(mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P),
-        ),
-    )
+    params = shard_lm_params(model, params, mesh, axis)
     return {
         "params": params,
         "opt_state": optimizer.init(params),
@@ -271,6 +264,27 @@ def make_lm_tp_state(model, params, optimizer, mesh,
             jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
         ),
     }
+
+
+def shard_lm_params(model, params, mesh, axis: str = MODEL_AXIS):
+    """Place a STANDARD-layout params tree with the Megatron TP
+    shardings (lm_tp_specs) — the sharded-INFERENCE entry point.
+
+    generate()'s prefill + KV-cached decode scan (models/generate.py) is
+    a plain jitted program, so GSPMD partitions the whole serving path
+    from this placement alone: column/row-parallel projections per
+    decode step, the KV cache head-sharded over `axis` because it is
+    built from the sharded k/v projections — no decode-code changes.
+    Decode-parity tested against single-device generate
+    (tests/test_tp.py)."""
+    specs = lm_tp_specs(model, mesh, axis)
+    return jax.device_put(
+        params,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )
 
 
 def shard_batch_2d(batch, mesh, axis: str = DATA_AXIS):

@@ -539,7 +539,23 @@ class LMTrainer:
             else self.train_tokens
         )
         prompt = jnp.asarray(np.asarray(stream[:p])[None, :], jnp.int32)
-        params = self._host_params()
+        live = self.state["params"]
+        if "rest" not in live and not (
+            live["blocks"] and live["blocks"][0]["wo"].ndim == 3
+        ):
+            # Standard-layout state (DP / TP / FSDP / SP): decode
+            # STRAIGHT off the live placement — GSPMD partitions the
+            # scan from it (sharded serving), no host round-trip.
+            params = live
+        else:
+            # Packed (PP) / head-structured (TP x SP) layouts: convert
+            # on host, then re-place with the Megatron TP shardings when
+            # the mesh has a model axis (KV cache head-sharded).
+            params = self._host_params()
+            if self.n_model > 1:
+                from ..parallel.tp import shard_lm_params
+
+                params = shard_lm_params(self.model, params, self.mesh)
         toks = generate(
             self.model, params, prompt, num_tokens,
             temperature=temperature,

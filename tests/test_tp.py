@@ -260,3 +260,41 @@ def test_lm_model_and_seq_axes_route_to_tp_sp(eight_devices):
     t2 = LMTrainer(LMConfig(mesh_shape="model:2,seq:2", attn_impl="ring",
                             **base), metrics=MetricsLogger(echo=False))
     assert t2.attn_impl == "ring"
+
+
+def test_tp_sharded_decode_matches_single_device(eight_devices):
+    """Sharded serving (parallel/tp.shard_lm_params): generate()'s
+    prefill + KV-cached decode scan partitioned by GSPMD from the
+    Megatron placement alone must emit EXACTLY the single-device tokens
+    (greedy), with the weights really sharded over 'model'."""
+    from mpi_cuda_cnn_tpu.models.generate import generate
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.parallel.tp import shard_lm_params
+
+    from mpi_cuda_cnn_tpu.models.generate import prefill
+
+    model = TransformerLM(vocab=32, dim=32, heads=4, depth=2, max_seq=32)
+    params = model.init(jax.random.key(3))
+    rng = np.random.default_rng(4)
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 8)), jnp.int32)
+
+    want = generate(model, params, prompt, 8)
+
+    mesh = make_mesh({MODEL_AXIS: 4}, devices=jax.devices()[:4])
+    tp_params = shard_lm_params(model, params, mesh)
+    w1 = tp_params["blocks"][0]["w1"]  # (32, 128): columns over 4
+    assert w1.addressable_shards[0].data.shape == (32, 128 // 4)
+
+    # Row-parallel matmuls change float reduction order, so guard the
+    # greedy-token equality: the prefill logits must agree to float
+    # tolerance AND the single-device top-2 gap must dwarf that noise
+    # (random init at vocab 32: gaps ~1e-1 vs reduction noise ~1e-6).
+    lw, _ = prefill(model, params, prompt)
+    lg, _ = prefill(model, tp_params, prompt)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(lw),
+                               rtol=1e-5, atol=1e-5)
+    top2 = np.sort(np.asarray(lw), axis=-1)[:, -2:]
+    assert (top2[:, 1] - top2[:, 0]).min() > 1e-3
+
+    got = generate(model, tp_params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
