@@ -95,6 +95,8 @@ def main() -> int:
         return _lm_main(info)
     if mode == "pp":
         return _pp_main(info)
+    if mode == "4d":
+        return _4d_main(info)
 
     from mpi_cuda_cnn_tpu.models.initializers import get_initializer
     from mpi_cuda_cnn_tpu.models.presets import get_model
@@ -190,6 +192,48 @@ def _pp_main(info) -> int:
     x_mb, y_mb = pp_shard_batch(microbatch(x, y, 2), mesh)
 
     state, metrics = step(state, x_mb, y_mb)
+    return _print_mhok(info, metrics)
+
+
+def _4d_main(info) -> int:
+    """The LM's full pipe x model x seq mesh split over 2 OS processes:
+    'pipe' outermost puts the GPipe stage boundary ON the process
+    boundary, while the Megatron psums (over 'model') and the ring
+    attention ppermutes (over 'seq') run within each process — the
+    layout a real pod uses (TP/SP inside a host on ICI, PP across on
+    DCN). Every collective family the framework has crosses or rides
+    the distributed runtime in ONE step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.parallel.mesh import MODEL_AXIS, PIPE_AXIS, make_mesh
+    from mpi_cuda_cnn_tpu.parallel.pp_lm import (
+        pp_lm_microbatch,
+        sp_pp_shard_batch,
+    )
+    from mpi_cuda_cnn_tpu.parallel.sp import SEQ_AXIS
+    from mpi_cuda_cnn_tpu.parallel.tp_pp_lm import (
+        make_tp_pp_lm_state,
+        make_tp_pp_lm_train_step,
+    )
+
+    assert info.global_devices == 8, info
+    mesh = make_mesh({PIPE_AXIS: 2, MODEL_AXIS: 2, SEQ_AXIS: 2})
+    model = TransformerLM(vocab=13, dim=16, heads=2, depth=2, max_seq=16)
+    params = model.init(jax.random.key(0))
+    opt = optax.sgd(0.1)
+    state = make_tp_pp_lm_state(model, params, opt, mesh)
+    step = make_tp_pp_lm_train_step(model, opt, mesh, state,
+                                    donate=False, attn_impl="ring")
+    rng = np.random.default_rng(7)  # same seed everywhere -> same tokens
+    toks = jnp.asarray(rng.integers(0, 13, (2, 17)), jnp.int32)
+    mb = sp_pp_shard_batch(
+        pp_lm_microbatch(toks[:, :-1], toks[:, 1:], 2), mesh
+    )
+    state, metrics = step(state, *mb)
     return _print_mhok(info, metrics)
 
 

@@ -76,3 +76,12 @@ def test_two_process_pipeline_step():
     forward activations and backward cotangents ppermute between OS
     processes."""
     _run_two_process("pp")
+
+
+def test_two_process_4d_lm_step():
+    """The LM's pipe:2,model:2,seq:2 mesh split over 2 OS processes —
+    the stage handoff crosses the process boundary while the Megatron
+    psums and ring-attention ppermutes run within each process (the
+    real-pod layout: TP/SP on ICI, PP across hosts); both processes
+    must print the identical loss."""
+    _run_two_process("4d")
