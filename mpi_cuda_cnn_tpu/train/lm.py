@@ -130,6 +130,7 @@ def make_lm_train_step(
     donate: bool = True,
     moe_aux_weight: float = 0.01,
     ce_chunk: int = 0,
+    grad_accum: int = 1,
 ):
     """step(state, tokens, targets) -> (state, {"loss": ...}), jitted.
 
@@ -138,6 +139,16 @@ def make_lm_train_step(
     train/checkpoint.py unchanged). Under a multi-device mesh, place the
     state replicated (or FSDP-sharded) and the batch data-sharded; jit
     inserts the psums (GSPMD).
+
+    grad_accum > 1 accumulates per-micro-batch value_and_grad inside a
+    lax.scan (parallel/dp.py _local_grads — the ONE accumulation
+    implementation, shared with the CNN path): the backward runs
+    micro-batch-by-micro-batch (no autodiff THROUGH the scan), so peak
+    activation memory is one micro-batch's while the optimizer sees the
+    exact full-batch mean gradient (equal micro-batches make the mean
+    of means the batch mean; parity-tested — MoE's per-chunk routing
+    statistics are the same estimator change as every microbatched
+    trainer's). Must divide the batch.
     """
     import optax
 
@@ -150,9 +161,22 @@ def make_lm_train_step(
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, tokens, targets):
-        l, grads = jax.value_and_grad(
-            lambda p: loss(p, tokens, targets)
-        )(state["params"])
+        if grad_accum > 1 and tokens.shape[0] % grad_accum:
+            raise ValueError(
+                f"batch {tokens.shape[0]} not divisible by grad_accum "
+                f"{grad_accum}"
+            )
+        # ONE accumulation implementation for both families: dp.py's
+        # helper carries the interleaved micro-split (a contiguous split
+        # would hand each micro-batch to a single device under GSPMD
+        # batch sharding) and the scan that keeps one micro-batch of
+        # activations live.
+        from ..parallel.dp import _local_grads
+
+        l, _, grads = _local_grads(
+            lambda p, t, g: (loss(p, t, g), jnp.float32(0)),
+            state["params"], tokens, targets, grad_accum,
+        )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

@@ -165,6 +165,19 @@ class LMTrainer:
                 f"batch_size {cfg.batch_size} not divisible by data-axis "
                 f"size {self.n_data}"
             )
+        if cfg.grad_accum > 1:
+            if self.n_seq > 1 or self.n_pipe > 1:
+                raise ValueError(
+                    "--grad-accum runs on the plain/TP/FSDP GSPMD step "
+                    "only; the 'pipe' axis already accumulates over "
+                    "--num-microbatches and the shard_map SP steps "
+                    "don't chunk — drop the flag or those axes"
+                )
+            if (cfg.batch_size // self.n_data) % cfg.grad_accum:
+                raise ValueError(
+                    f"per-device batch {cfg.batch_size // self.n_data} "
+                    f"not divisible by grad_accum {cfg.grad_accum}"
+                )
         if cfg.seq_len % self.n_seq:
             raise ValueError(
                 f"seq_len {cfg.seq_len} not divisible by seq-axis size "
@@ -346,6 +359,7 @@ class LMTrainer:
                 self.model, self.optimizer, attn_impl=self.attn_impl,
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
+                grad_accum=cfg.grad_accum,
             )
         if self.n_pipe > 1 or self.n_seq > 1 and (self.n_model > 1
                                                   or cfg.fsdp):
@@ -431,6 +445,16 @@ class LMTrainer:
             SEQ_AXIS if self.n_seq > 1 else None,
         )
         return jax.device_put(t, NamedSharding(self.mesh, spec))
+
+    def _standard_layout(self) -> bool:
+        """True when the live state's params are already the standard
+        tree (DP / TP / FSDP / SP placements) — eval and decode can run
+        straight off the placement, GSPMD partitioning them; the packed
+        (PP) and head-structured (TP x SP) layouts need _host_params."""
+        p = self.state["params"]
+        return "rest" not in p and not (
+            p["blocks"] and p["blocks"][0]["wo"].ndim == 3
+        )
 
     def _host_params(self):
         """Host copy of the params in the STANDARD tree layout: the
@@ -539,14 +563,10 @@ class LMTrainer:
             else self.train_tokens
         )
         prompt = jnp.asarray(np.asarray(stream[:p])[None, :], jnp.int32)
-        live = self.state["params"]
-        if "rest" not in live and not (
-            live["blocks"] and live["blocks"][0]["wo"].ndim == 3
-        ):
-            # Standard-layout state (DP / TP / FSDP / SP): decode
-            # STRAIGHT off the live placement — GSPMD partitions the
-            # scan from it (sharded serving), no host round-trip.
-            params = live
+        if self._standard_layout():
+            # Decode STRAIGHT off the live placement — GSPMD partitions
+            # the scan from it (sharded serving), no host round-trip.
+            params = self.state["params"]
         else:
             # Packed (PP) / head-structured (TP x SP) layouts: convert
             # on host, then re-place with the Megatron TP shardings when
@@ -586,7 +606,10 @@ class LMTrainer:
                 )
 
             self._eval_fn = eval_fn
-        params = self._host_params()
+        params = (
+            self.state["params"] if self._standard_layout()
+            else self._host_params()
+        )
         losses = []
         for i in range(nwin):
             w = stream[i * s : i * s + s + 1]

@@ -131,6 +131,12 @@ class LMConfig(_JsonConfig):
     grad_clip: float = 0.0        # global-norm clip; 0 (default) disables
                                   # — off by default so existing configs
                                   # reproduce; 1.0 is the usual LM choice
+    grad_accum: int = 1           # chunks accumulated per optimizer step
+                                  # (per-chunk value_and_grad inside a
+                                  # scan: peak activation memory is ONE
+                                  # chunk). Plain/TP/FSDP meshes; the
+                                  # shard_map paths reject it ('pipe'
+                                  # already microbatches)
     seed: int = 0
 
     compute_dtype: str = "float32"   # bfloat16 = MXU-native matmuls
@@ -141,8 +147,9 @@ class LMConfig(_JsonConfig):
     fsdp: bool = False               # ZeRO-style: shard LM params +
                                      # optimizer state over 'data'
                                      # (parallel/fsdp.py — generic specs;
-                                     # composes with 'model' TP, rejects
-                                     # a 'seq' axis)
+                                     # composes with 'model' TP and with
+                                     # a 'seq' axis — ZeRO x ring,
+                                     # parallel/sp.py state_specs)
     ce_chunk: int = 0                # >0: fused chunked cross-entropy
                                      # (never materializes (B,S,V) f32
                                      # logits). Must divide seq_len — the
