@@ -47,7 +47,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.transformer import TransformerLM, _layernorm
 from ..ops.attention import rope
 from .mesh import DATA_AXIS, MODEL_AXIS
-from .sp import SEQ_AXIS, ring_attention, ring_flash_attention
+from .sp import (
+    SEQ_AXIS,
+    ring_attention,
+    ring_flash_attention,
+    ulysses_attention,
+)
 
 TrainState = dict[str, Any]
 
@@ -299,9 +304,23 @@ def make_tp_sp_lm_train_step(
         attn_body = ring_attention
     elif impl == "ring_flash":
         attn_body = ring_flash_attention
+    elif impl == "ulysses":
+        # Ulysses all-to-alls the LOCAL (already TP-sliced) heads across
+        # 'seq': each device ends with the full sequence for
+        # H/(n_tp*n_seq) heads — both axes shard the head dim.
+        attn_body = ulysses_attention
+        n_tp = mesh.shape[MODEL_AXIS]
+        local_heads = model.heads // n_tp
+        if local_heads % mesh.shape[SEQ_AXIS]:
+            raise ValueError(
+                f"impl='ulysses' under TP x SP needs the TP-local heads "
+                f"({model.heads}/{n_tp} = {local_heads}) divisible by "
+                f"the seq-axis size {mesh.shape[SEQ_AXIS]}; use ring"
+            )
     else:
         raise ValueError(
-            f"unknown TP x SP impl {impl!r}; 'ring' or 'ring_flash'"
+            f"unknown TP x SP impl {impl!r}; 'ring', 'ring_flash', or "
+            "'ulysses'"
         )
     n_seq = mesh.shape[SEQ_AXIS]
     reduce_axes = tuple(a for a in (data_axis, SEQ_AXIS) if a)

@@ -133,12 +133,15 @@ class LMTrainer:
                     "--fsdp does not compose with the TP x SP shard_map "
                     "step; drop it or use data:N,model:M"
                 )
-            if cfg.attn_impl not in ("auto", "oracle", "ring",
-                                     "ring_flash", "flash"):
+            allowed = ("auto", "oracle", "ring", "ring_flash", "flash")
+            if self.n_pipe == 1:
+                allowed += ("ulysses",)  # pipelined stages: ring only
+            if cfg.attn_impl not in allowed:
                 raise ValueError(
                     f"--attn-impl {cfg.attn_impl!r} is not wired into "
-                    "TP x SP (its stage runs ring/ring_flash attention "
-                    "on the local heads); use auto"
+                    "this mesh (TP x SP runs ring/ring_flash/ulysses on "
+                    "the local heads; with a 'pipe' axis, ring/"
+                    "ring_flash only); use auto"
                 )
         if self.n_pipe > 1 and cfg.fsdp:
             raise ValueError(
@@ -585,7 +588,10 @@ class LMTrainer:
 
     def evaluate(self) -> float:
         """Mean next-token NLL over deterministic windows of the held-out
-        tail (single-device forward — eval is tiny next to training)."""
+        tail. Standard-layout states feed the LIVE placement into the
+        jitted forward (GSPMD partitions it — DP/TP/FSDP/SP); packed and
+        head-structured states convert on host first (eval is tiny next
+        to training either way)."""
         cfg = self.cfg
         s = cfg.seq_len
         stream = self.eval_tokens

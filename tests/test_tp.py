@@ -253,9 +253,16 @@ def test_lm_model_and_seq_axes_route_to_tp_sp(eight_devices):
     with pytest.raises(ValueError, match="fsdp"):
         LMTrainer(LMConfig(mesh_shape="model:2,seq:4", fsdp=True, **base),
                   metrics=MetricsLogger(echo=False))
-    with pytest.raises(ValueError, match="attn-impl"):
+    # Ulysses composes with TP x SP now (round 4) — but its divisibility
+    # (TP-local heads % n_seq) still fails loudly: 4/2 = 2 local heads
+    # over seq:4.
+    with pytest.raises(ValueError, match="ulysses"):
         LMTrainer(LMConfig(mesh_shape="model:2,seq:4", attn_impl="ulysses",
                            **base), metrics=MetricsLogger(echo=False))
+    t3 = LMTrainer(LMConfig(mesh_shape="model:2,seq:2",
+                            attn_impl="ulysses", **base),
+                   metrics=MetricsLogger(echo=False))
+    assert t3.attn_impl == "ulysses"
     # An explicit ring/ring_flash request is honored, not auto-overridden.
     t2 = LMTrainer(LMConfig(mesh_shape="model:2,seq:2", attn_impl="ring",
                             **base), metrics=MetricsLogger(echo=False))

@@ -186,3 +186,49 @@ def test_tp_sp_grad_clip_matches_serial(eight_devices):
                     jax.tree.leaves(jax.device_get(want_state["params"]))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_tp_sp_ulysses_matches_serial(eight_devices):
+    """impl='ulysses' INSIDE the Megatron block (the former rejection):
+    the all-to-all trades the local sequence for a further head split —
+    each device holds the full sequence for H/(n_tp*n_seq) heads — and
+    must still equal the serial step exactly. Divisibility is checked
+    loudly (TP-local heads % n_seq)."""
+    import pytest
+
+    model = TransformerLM(vocab=17, dim=32, heads=4, depth=1, max_seq=64)
+    opt = optax.sgd(0.1)
+    rng = np.random.default_rng(12)
+    toks = jnp.asarray(rng.integers(0, 17, (2, 33)), jnp.int32)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+    mesh = make_mesh({SEQ_AXIS: 2, MODEL_AXIS: 2}, devices=jax.devices()[:4])
+
+    serial_step = make_lm_train_step(model, opt, attn_impl="oracle",
+                                     seq_len=32, donate=False)
+    want_state, want_m = serial_step(make_lm_state(model, opt, seed=0),
+                                     tokens, targets)
+
+    params = model.init(jax.random.key(0))
+    state, specs = make_tp_sp_state(model, params, opt, mesh)
+    step = make_tp_sp_lm_train_step(model, opt, mesh, specs,
+                                    donate=False, impl="ulysses")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bs = NamedSharding(mesh, P(None, SEQ_AXIS))
+    got_state, got_m = step(state, jax.device_put(tokens, bs),
+                            jax.device_put(targets, bs))
+    np.testing.assert_allclose(float(got_m["loss"]), float(want_m["loss"]),
+                               rtol=1e-5, atol=1e-6)
+    got = from_tp_layout(jax.device_get(got_state["params"]), model)
+    for a, b in zip(jax.tree.leaves(got),
+                    jax.tree.leaves(jax.device_get(want_state["params"]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+    # 2 heads / model:2 = 1 local head, not divisible by seq:2 -> loud.
+    narrow = TransformerLM(vocab=17, dim=32, heads=2, depth=1, max_seq=64)
+    _, nspecs = make_tp_sp_state(narrow, narrow.init(jax.random.key(0)),
+                                 opt, mesh)
+    with pytest.raises(ValueError, match="ulysses"):
+        make_tp_sp_lm_train_step(narrow, opt, mesh, nspecs,
+                                 donate=False, impl="ulysses")
