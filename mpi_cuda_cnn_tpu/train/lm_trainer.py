@@ -606,9 +606,13 @@ class LMTrainer:
 
             @jax.jit
             def eval_fn(params, tokens, targets):
+                # ce_chunk rides along: the batched windows would
+                # otherwise materialize (nwin, S, V) f32 logits on
+                # exactly the configs the flag exists for.
                 return lm_loss(
                     self.model, params, tokens, targets, attn_fn=attn_fn,
                     compute_dtype=self._compute_dtype, moe_aux_weight=0.0,
+                    ce_chunk=self.cfg.ce_chunk,
                 )
 
             self._eval_fn = eval_fn
@@ -616,10 +620,15 @@ class LMTrainer:
             self.state["params"] if self._standard_layout()
             else self._host_params()
         )
-        losses = []
-        for i in range(nwin):
-            w = stream[i * s : i * s + s + 1]
-            losses.append(float(self._eval_fn(
-                params, jnp.asarray(w[None, :-1]), jnp.asarray(w[None, 1:])
-            )))
-        return float(np.mean(losses)) if losses else float("nan")
+        if nwin == 0:
+            return float("nan")
+        # ONE batched forward over all windows (equal sizes make the
+        # batch-mean NLL the mean of per-window means) instead of a
+        # dispatch per window — 8x fewer host round-trips through the
+        # tunnel, and the eval_fn jit cache sees one shape.
+        wins = np.stack([
+            np.asarray(stream[i * s : i * s + s + 1]) for i in range(nwin)
+        ])
+        return float(self._eval_fn(
+            params, jnp.asarray(wins[:, :-1]), jnp.asarray(wins[:, 1:])
+        ))
