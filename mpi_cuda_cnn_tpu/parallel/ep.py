@@ -282,3 +282,78 @@ def make_moe_layer(mesh, *, n_experts, capacity_factor=1.25, axis=EXPERT_AXIS,
         )(params, x)
 
     return jax.jit(fn)
+
+
+def make_ep_lm_train_step(
+    model,
+    optimizer,
+    mesh,
+    *,
+    data_axis: str | None = None,
+    attn_impl: str = "oracle",
+    donate: bool = True,
+    remat: bool = False,
+    moe_aux_weight: float = 0.01,
+    compute_dtype=None,
+    ce_chunk: int = 0,
+):
+    """Expert-parallel LM training WITHOUT a sequence axis — the
+    standard Switch/GShard deployment (EP x DP): tokens shard their
+    BATCH dim over ('data'?, 'expert') jointly, so attention and every
+    dense op run as plain data parallelism across both axes, while each
+    MoE block's dispatch all_to_alls tokens to the expert shards over
+    'expert' (each rank computes E/P experts; parallel/sp.py's EP x SP
+    rides the 'seq' axis instead — this path serves MoE scale when the
+    sequence fits one device). Params replicated; grads/loss pmean over
+    both axes (different tokens per shard).
+
+    step(state, tokens, targets) -> (state, {"loss": ...}); tokens
+    (B, S) int32 with B sharded over (data, expert).
+    """
+    import optax
+
+    from ..train.lm import get_attn_fn, lm_loss
+
+    if not model.moe_experts:
+        raise ValueError(
+            "an 'expert' mesh axis needs an MoE model (--moe-experts); "
+            "for dense models the axis is just data parallelism — use "
+            "a 'data' axis"
+        )
+    n_exp = mesh.shape[EXPERT_AXIS]
+    if model.moe_experts % n_exp:
+        raise ValueError(
+            f"experts {model.moe_experts} not divisible by expert-axis "
+            f"size {n_exp}"
+        )
+    attn_fn = get_attn_fn(attn_impl)
+    reduce_axes = tuple(a for a in (data_axis, EXPERT_AXIS) if a)
+
+    def step(state, tokens, targets):
+        loss, grads = jax.value_and_grad(lambda p: lm_loss(
+            model, p, tokens, targets, attn_fn=attn_fn,
+            compute_dtype=compute_dtype, remat=remat,
+            moe_aux_weight=moe_aux_weight, ce_chunk=ce_chunk,
+            moe_axis=EXPERT_AXIS,
+        ))(state["params"])
+        grads = lax.pmean(grads, reduce_axes)
+        loss = lax.pmean(loss, reduce_axes)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": loss},
+        )
+
+    bspec = P((data_axis, EXPERT_AXIS) if data_axis else EXPERT_AXIS)
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), bspec, bspec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

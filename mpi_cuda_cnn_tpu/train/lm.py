@@ -85,9 +85,13 @@ def lm_loss(
     remat: bool = False,
     moe_aux_weight: float = 0.01,
     ce_chunk: int = 0,
+    moe_axis: str | None = None,
 ):
     """Mean next-token NLL (+ the Switch aux loss when the model is MoE).
     tokens/targets: (B, S) int32. The loss softmax always runs in f32.
+    moe_axis names a mesh axis for expert-parallel dispatch inside a
+    shard_map caller (parallel/ep.py make_ep_lm_train_step); None keeps
+    the local dense dispatch.
 
     ce_chunk > 0 fuses the head matmul into a chunked cross-entropy: the
     final-LN features go through the head in S-chunks of that size inside
@@ -104,7 +108,7 @@ def lm_loss(
         feats, aux = model.apply(
             params, tokens, attn_fn=attn_fn, remat=remat,
             compute_dtype=compute_dtype, return_aux=True,
-            return_features=True,
+            return_features=True, moe_axis=moe_axis,
         )
         nll = chunked_ce_mean(
             feats, params["head"], targets, ce_chunk, compute_dtype
@@ -112,7 +116,7 @@ def lm_loss(
         return nll + moe_aux_weight * aux
     logits, aux = model.apply(
         params, tokens, attn_fn=attn_fn, remat=remat,
-        compute_dtype=compute_dtype, return_aux=True,
+        compute_dtype=compute_dtype, return_aux=True, moe_axis=moe_axis,
     )
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)

@@ -120,10 +120,26 @@ class LMTrainer:
             axes = parse_mesh_shape(cfg.mesh_shape, ndev)
             mesh = make_mesh(axes, devices=jax.devices()[:ndev])
         self.mesh = mesh
+        from ..parallel.ep import EXPERT_AXIS
+
         self.n_seq = self.mesh.shape.get(SEQ_AXIS, 1)
         self.n_data = self.mesh.shape.get(DATA_AXIS, 1)
         self.n_model = self.mesh.shape.get(MODEL_AXIS, 1)
         self.n_pipe = self.mesh.shape.get(PIPE_AXIS, 1)
+        self.n_expert = self.mesh.shape.get(EXPERT_AXIS, 1)
+        if self.n_expert > 1 and (self.n_seq > 1 or self.n_model > 1
+                                  or self.n_pipe > 1 or cfg.fsdp):
+            raise ValueError(
+                "an 'expert' mesh axis composes with 'data' only "
+                "(EP x DP, parallel/ep.py make_ep_lm_train_step); MoE "
+                "under a 'seq' axis rides EP x SP instead — drop the "
+                "other axes/--fsdp or the expert axis"
+            )
+        if cfg.batch_size % (self.n_data * self.n_expert):
+            raise ValueError(
+                f"batch_size {cfg.batch_size} not divisible by "
+                f"data x expert shards ({self.n_data} x {self.n_expert})"
+            )
         if self.n_model > 1 and self.n_seq > 1:
             # TP x SP (parallel/tp_sp.py): Megatron inside the ring
             # shard_map. Structural checks (MoE, divisibility) fire at
@@ -169,11 +185,11 @@ class LMTrainer:
                 f"size {self.n_data}"
             )
         if cfg.grad_accum > 1:
-            if self.n_seq > 1 or self.n_pipe > 1:
+            if self.n_seq > 1 or self.n_pipe > 1 or self.n_expert > 1:
                 raise ValueError(
                     "--grad-accum runs on the plain/TP/FSDP GSPMD step "
                     "only; the 'pipe' axis already accumulates over "
-                    "--num-microbatches and the shard_map SP steps "
+                    "--num-microbatches and the shard_map SP/EP steps "
                     "don't chunk — drop the flag or those axes"
                 )
             if (cfg.batch_size // self.n_data) % cfg.grad_accum:
@@ -328,6 +344,20 @@ class LMTrainer:
                 ce_chunk=cfg.ce_chunk, impl=self.attn_impl,
                 grad_clip=cfg.grad_clip,
             )
+        elif self.n_expert > 1:
+            # EP x DP: batch sharded over (data, expert) jointly; the
+            # MoE dispatch all_to_alls over 'expert' inside the step.
+            from ..parallel.ep import make_ep_lm_train_step
+
+            self.attn_impl = pick_attn_impl(
+                cfg.attn_impl, cfg.seq_len, compute_dtype
+            )
+            self.train_step = make_ep_lm_train_step(
+                self.model, self.optimizer, self.mesh,
+                data_axis=DATA_AXIS if self.n_data > 1 else None,
+                attn_impl=self.attn_impl, remat=cfg.remat,
+                compute_dtype=compute_dtype, ce_chunk=cfg.ce_chunk,
+            )
         elif self.n_seq > 1:
             impl = cfg.attn_impl
             if impl in ("auto", "flash"):
@@ -443,8 +473,15 @@ class LMTrainer:
             place = (sp_pp_shard_batch if self.n_seq > 1
                      else pp_lm_shard_batch)
             return place(t, self.mesh)
+        from ..parallel.ep import EXPERT_AXIS
+
+        batch_axes = tuple(
+            a for a, n in ((DATA_AXIS, self.n_data),
+                           (EXPERT_AXIS, self.n_expert)) if n > 1
+        )
         spec = P(
-            DATA_AXIS if self.n_data > 1 else None,
+            batch_axes if len(batch_axes) > 1
+            else (batch_axes[0] if batch_axes else None),
             SEQ_AXIS if self.n_seq > 1 else None,
         )
         return jax.device_put(t, NamedSharding(self.mesh, spec))

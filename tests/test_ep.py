@@ -258,3 +258,34 @@ def test_top2_inference_weights_two_experts():
     assert y1.shape == y2.shape == x.shape
     # k=2 mixes a second expert: outputs must differ from pure top-1.
     assert float(jnp.max(jnp.abs(y1 - y2))) > 1e-4
+
+
+def test_ep_dp_lm_trains(eight_devices):
+    """EP x DP WITHOUT a sequence axis (parallel/ep.py
+    make_ep_lm_train_step — the standard Switch deployment): batch
+    sharded over (data, expert) jointly, MoE dispatch all_to_alling
+    over 'expert'; the product loop trains, eval/decode work off the
+    replicated state, and the composition/requirement checks fail
+    loudly."""
+    import pytest
+
+    from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+    from mpi_cuda_cnn_tpu.utils.config import LMConfig
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    base = dict(corpus="synthetic", dim=32, depth=2, heads=4, seq_len=64,
+                steps=8, batch_size=8, log_every=0,
+                lr_schedule="constant", warmup_steps=0, sample_tokens=4)
+    t = LMTrainer(LMConfig(mesh_shape="data:2,expert:4", moe_experts=4,
+                           **base), metrics=MetricsLogger(echo=False))
+    r = t.train()
+    assert r.steps_run == 8 and np.isfinite(r.eval_ppl)
+    _, cont = t.sample(4)
+    assert len(cont) == 4
+
+    with pytest.raises(ValueError, match="expert"):  # dense model
+        LMTrainer(LMConfig(mesh_shape="expert:4", **base),
+                  metrics=MetricsLogger(echo=False))
+    with pytest.raises(ValueError, match="composes with 'data' only"):
+        LMTrainer(LMConfig(mesh_shape="expert:2,seq:2", moe_experts=4,
+                           **base), metrics=MetricsLogger(echo=False))
