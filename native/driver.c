@@ -20,12 +20,14 @@
  *     runtime (mct_tpu_lm_init/lm_train -> train/lm_trainer.py):
  *     --device=tpu|jax|jax-cpu --corpus=STR --dim=N --depth=N --heads=N
  *     --kv-heads=N --pos=learned|rope --moe-experts=N --moe-top-k=N
- *     --ce-chunk=N --seq-len=N --steps=N --batch=N --lr=F --seed=N
+ *     --ce-chunk=N --grad-accum=N --grad-clip=F
+ *     --seq-len=N --steps=N --batch=N --lr=F --seed=N
  *     --mesh-shape=STR --compute-dtype=float32|bfloat16
  */
 #include "mct.h"
 #include "tpu_abi.h"
 
+#include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -200,7 +202,8 @@ static int run_lm(int argc, char **argv)
     const char *mesh = "data", *dtype = "float32", *posenc = "learned";
     int dim = 64, depth = 2, heads = 4, seq = 128, steps = 50, batch = 4;
     int kv_heads = 0, moe_experts = 0, moe_top_k = 1, ce_chunk = 0;
-    double lr = 3e-4;
+    int grad_accum = 1;
+    double lr = 3e-4, grad_clip = 0.0;
     long long seed = 0;
 
     for (int i = 2; i < argc; i++) {
@@ -223,15 +226,23 @@ static int run_lm(int argc, char **argv)
         else if (strncmp(s, "--steps=", 8) == 0) steps = atoi(s + 8);
         else if (strncmp(s, "--batch=", 8) == 0) batch = atoi(s + 8);
         else if (strncmp(s, "--lr=", 5) == 0) lr = atof(s + 5);
+        else if (strncmp(s, "--grad-accum=", 13) == 0)
+            grad_accum = atoi(s + 13);
+        else if (strncmp(s, "--grad-clip=", 12) == 0)
+            grad_clip = atof(s + 12);
         else if (strncmp(s, "--seed=", 7) == 0) seed = atoll(s + 7);
         else {
             fprintf(stderr, "mct: unknown lm option %s\n", s);
             return 100;
         }
     }
+    /* !(x >= 0) rather than x < 0: NaN fails BOTH orderings, and a
+     * non-finite value would reach snprintf's %g as "nan"/"inf" — not
+     * JSON — surfacing as an opaque parse error instead of exit 100. */
     if (dim < 1 || depth < 1 || heads < 1 || seq < 2 || steps < 1 ||
-        batch < 1 || lr <= 0.0 || kv_heads < 0 || moe_experts < 0 ||
-        moe_top_k < 1 || ce_chunk < 0) {
+        batch < 1 || !(lr > 0.0) || !isfinite(lr) || kv_heads < 0 ||
+        moe_experts < 0 || moe_top_k < 1 || ce_chunk < 0 ||
+        grad_accum < 1 || !(grad_clip >= 0.0) || !isfinite(grad_clip)) {
         fprintf(stderr, "mct: invalid lm hyperparameters\n");
         return 100;
     }
@@ -254,12 +265,12 @@ static int run_lm(int argc, char **argv)
         int nw = snprintf(cfg + pos, sizeof cfg - pos,
             ",\"dim\":%d,\"depth\":%d,\"heads\":%d,\"kv_heads\":%d,"
             "\"moe_experts\":%d,\"moe_top_k\":%d,\"ce_chunk\":%d,"
-            "\"seq_len\":%d,"
+            "\"grad_accum\":%d,\"grad_clip\":%g,\"seq_len\":%d,"
             "\"steps\":%d,\"batch_size\":%d,\"lr\":%g,\"seed\":%lld,"
             "\"device\":\"%s\",\"log_every\":0,\"lr_schedule\":"
             "\"constant\",\"warmup_steps\":0}",
             dim, depth, heads, kv_heads, moe_experts, moe_top_k, ce_chunk,
-            seq, steps, batch, lr, seed, dev);
+            grad_accum, grad_clip, seq, steps, batch, lr, seed, dev);
         if (nw < 0 || pos + (size_t)nw >= sizeof cfg)
             goto toolong;
     }
