@@ -228,8 +228,18 @@ static int run_lm(int argc, char **argv)
         else if (strncmp(s, "--lr=", 5) == 0) lr = atof(s + 5);
         else if (strncmp(s, "--grad-accum=", 13) == 0)
             grad_accum = atoi(s + 13);
-        else if (strncmp(s, "--grad-clip=", 12) == 0)
-            grad_clip = atof(s + 12);
+        else if (strncmp(s, "--grad-clip=", 12) == 0) {
+            /* strtod + end-pointer, not atof: 0 is a LEGAL clip value
+             * (disabled), so a malformed number silently parsing to 0
+             * would turn a typo into "no clipping" — the one numeric
+             * flag where garbage cannot be caught by a range check. */
+            char *end;
+            grad_clip = strtod(s + 12, &end);
+            if (end == s + 12 || *end != '\0') {
+                fprintf(stderr, "mct: bad --grad-clip value %s\n", s + 12);
+                return 100;
+            }
+        }
         else if (strncmp(s, "--seed=", 7) == 0) seed = atoll(s + 7);
         else {
             fprintf(stderr, "mct: unknown lm option %s\n", s);
