@@ -92,6 +92,18 @@ def _local_grads(loss_fn: Callable, params, x, y, grad_accum: int):
     return jax.tree.map(lambda t: t / a, totals)
 
 
+def local_grads_no_aux(loss_fn, params, x, y, grad_accum: int):
+    """(loss, grads) for an aux-free scalar loss_fn(params, x, y) —
+    the one shim over _local_grads the LM steps share (train/lm.py,
+    parallel/sp.py, parallel/ep.py) instead of each faking an aux."""
+
+    loss, _, grads = _local_grads(
+        lambda p, a, b: (loss_fn(p, a, b), jnp.float32(0)),
+        params, x, y, grad_accum,
+    )
+    return loss, grads
+
+
 def _make_step_body(
     loss_fn: Callable,
     optimizer,

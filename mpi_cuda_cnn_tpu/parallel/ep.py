@@ -296,6 +296,7 @@ def make_ep_lm_train_step(
     moe_aux_weight: float = 0.01,
     compute_dtype=None,
     ce_chunk: int = 0,
+    grad_accum: int = 1,
 ):
     """Expert-parallel LM training WITHOUT a sequence axis — the
     standard Switch/GShard deployment (EP x DP): tokens shard their
@@ -330,12 +331,26 @@ def make_ep_lm_train_step(
     reduce_axes = tuple(a for a in (data_axis, EXPERT_AXIS) if a)
 
     def step(state, tokens, targets):
-        loss, grads = jax.value_and_grad(lambda p: lm_loss(
-            model, p, tokens, targets, attn_fn=attn_fn,
-            compute_dtype=compute_dtype, remat=remat,
-            moe_aux_weight=moe_aux_weight, ce_chunk=ce_chunk,
-            moe_axis=EXPERT_AXIS,
-        ))(state["params"])
+        # dp.py's shared accumulation; the dispatch all_to_alls run
+        # uniformly per micro-batch on every rank. Per-micro-batch
+        # expert capacity is a (documented) estimator change, exactly
+        # like every microbatched MoE trainer.
+        if grad_accum > 1 and tokens.shape[0] % grad_accum:
+            raise ValueError(
+                f"per-shard batch {tokens.shape[0]} not divisible by "
+                f"grad_accum {grad_accum}"
+            )
+        from .dp import local_grads_no_aux
+
+        loss, grads = local_grads_no_aux(
+            lambda p, t, g: lm_loss(
+                model, p, t, g, attn_fn=attn_fn,
+                compute_dtype=compute_dtype, remat=remat,
+                moe_aux_weight=moe_aux_weight, ce_chunk=ce_chunk,
+                moe_axis=EXPERT_AXIS,
+            ),
+            state["params"], tokens, targets, grad_accum,
+        )
         grads = lax.pmean(grads, reduce_axes)
         loss = lax.pmean(loss, reduce_axes)
         updates, opt_state = optimizer.update(

@@ -348,6 +348,7 @@ def make_sp_lm_train_step(
     ce_chunk: int = 0,
     state_specs=None,
     grad_clip: float = 0.0,
+    grad_accum: int = 1,
 ):
     """Jitted causal-LM train step with the sequence dim sharded on `axis`
     (long-context training: each device holds S/P tokens of activations)
@@ -375,6 +376,12 @@ def make_sp_lm_train_step(
     cross-entropy (ops/losses.chunked_ce_mean) — the natural pairing for
     long context, where even the SHARD-local (B, S/P, V) f32 logits are
     large; must divide the per-shard sequence S/P.
+
+    grad_accum > 1 accumulates per-micro-batch gradients via dp.py's
+    shared helper (interleaved split of the LOCAL batch dim, one
+    micro-batch of activations live); the ring collectives run
+    uniformly per micro-batch on every rank. Must divide the per-shard
+    batch.
 
     Returns step(state, tokens, targets) -> (state, {"loss": ...}).
     """
@@ -440,7 +447,7 @@ def make_sp_lm_train_step(
                 f"{n_seq})"
             )
 
-        def loss_fn(params):
+        def loss_fn(params, tokens, targets):
             # MoE blocks (if the model has any) run expert-parallel over
             # the SAME 'seq' axis the sequence is sharded on (EP x SP:
             # each device holds E/P experts AND S/P tokens;
@@ -467,6 +474,20 @@ def make_sp_lm_train_step(
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
             return jnp.mean(nll) + moe_aux_weight * aux
 
+        # dp.py's shared accumulation (interleaved micro-split, one
+        # micro-batch of activations live); the ring/all-to-all
+        # collectives run uniformly per micro-batch on every rank, so
+        # accumulation inside shard_map is safe.
+        if grad_accum > 1 and tokens.shape[0] % grad_accum:
+            raise ValueError(
+                f"per-shard batch {tokens.shape[0]} not divisible by "
+                f"grad_accum {grad_accum}"
+            )
+        from .dp import local_grads_no_aux
+
+        def grads_of(p, tk, tg):
+            return local_grads_no_aux(loss_fn, p, tk, tg, grad_accum)
+
         if fsdp:
             # Gather the full weights transiently; differentiate w.r.t.
             # the FULL tree so each gradient leaf is full-width before
@@ -480,7 +501,7 @@ def make_sp_lm_train_step(
                 state["params"], pspecs,
                 is_leaf=lambda x: isinstance(x, P),
             )
-            loss, grads = jax.value_and_grad(loss_fn)(full)
+            loss, grads = grads_of(full, tokens, targets)
             # Sharded leaves: psum_scatter/n = DP mean + ZeRO scatter
             # back to this rank's slice. Replicated leaves: plain pmean.
             # Everything then pmeans over 'seq' (equal shards).
@@ -512,7 +533,7 @@ def make_sp_lm_train_step(
                 gn2 = lax.psum(sliced, data_axis) + rep
                 grads = clip_grads_by_global_sq(grads, gn2, grad_clip)
         else:
-            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            loss, grads = grads_of(state["params"], tokens, targets)
             grads = lax.pmean(grads, reduce_axes)
             loss = lax.pmean(loss, reduce_axes)
         updates, opt_state = optimizer.update(

@@ -175,11 +175,10 @@ def make_lm_train_step(
         # would hand each micro-batch to a single device under GSPMD
         # batch sharding) and the scan that keeps one micro-batch of
         # activations live.
-        from ..parallel.dp import _local_grads
+        from ..parallel.dp import local_grads_no_aux
 
-        l, _, grads = _local_grads(
-            lambda p, t, g: (loss(p, t, g), jnp.float32(0)),
-            state["params"], tokens, targets, grad_accum,
+        l, grads = local_grads_no_aux(
+            loss, state["params"], tokens, targets, grad_accum
         )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]

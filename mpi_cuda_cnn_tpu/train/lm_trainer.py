@@ -185,17 +185,19 @@ class LMTrainer:
                 f"size {self.n_data}"
             )
         if cfg.grad_accum > 1:
-            if self.n_seq > 1 or self.n_pipe > 1 or self.n_expert > 1:
+            if self.n_pipe > 1 or (self.n_seq > 1 and self.n_model > 1):
                 raise ValueError(
-                    "--grad-accum runs on the plain/TP/FSDP GSPMD step "
-                    "only; the 'pipe' axis already accumulates over "
-                    "--num-microbatches and the shard_map SP/EP steps "
-                    "don't chunk — drop the flag or those axes"
+                    "--grad-accum is not wired into this mesh: the "
+                    "'pipe' axis already accumulates over "
+                    "--num-microbatches, and the TP x SP step doesn't "
+                    "chunk — drop the flag or those axes (plain/TP/"
+                    "FSDP/SP/EP meshes all accept it)"
                 )
-            if (cfg.batch_size // self.n_data) % cfg.grad_accum:
+            per_shard = cfg.batch_size // (self.n_data * self.n_expert)
+            if per_shard % cfg.grad_accum:
                 raise ValueError(
-                    f"per-device batch {cfg.batch_size // self.n_data} "
-                    f"not divisible by grad_accum {cfg.grad_accum}"
+                    f"per-shard batch {per_shard} not divisible by "
+                    f"grad_accum {cfg.grad_accum}"
                 )
         if cfg.seq_len % self.n_seq:
             raise ValueError(
@@ -357,6 +359,7 @@ class LMTrainer:
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
                 attn_impl=self.attn_impl, remat=cfg.remat,
                 compute_dtype=compute_dtype, ce_chunk=cfg.ce_chunk,
+                grad_accum=cfg.grad_accum,
             )
         elif self.n_seq > 1:
             impl = cfg.attn_impl
@@ -383,6 +386,7 @@ class LMTrainer:
                 remat=cfg.remat, compute_dtype=compute_dtype,
                 ce_chunk=cfg.ce_chunk, state_specs=sp_specs,
                 grad_clip=cfg.grad_clip if cfg.fsdp else 0.0,
+                grad_accum=cfg.grad_accum,
             )
         else:
             self.attn_impl = pick_attn_impl(

@@ -283,6 +283,14 @@ def test_ep_dp_lm_trains(eight_devices):
     _, cont = t.sample(4)
     assert len(cont) == 4
 
+    # --grad-accum rides the EP shard_map too (per-micro-batch capacity
+    # is the documented estimator change).
+    t2 = LMTrainer(LMConfig(mesh_shape="data:2,expert:2", moe_experts=4,
+                            grad_accum=2, **base),
+                   metrics=MetricsLogger(echo=False))
+    r2 = t2.train()
+    assert r2.steps_run == 8 and np.isfinite(r2.final_loss)
+
     with pytest.raises(ValueError, match="expert"):  # dense model
         LMTrainer(LMConfig(mesh_shape="expert:4", **base),
                   metrics=MetricsLogger(echo=False))
