@@ -237,8 +237,11 @@ def make_gpipe_local_loss(model, *, M: int, n_pipe: int, compute_dtype,
         # Per-microbatch means averaged over microbatches == the global
         # mean NLL (equal microbatch sizes). Masked: the NLL only on the
         # last stage's drained ticks, the aux on every stage's valid
-        # ticks — the caller's psum over 'pipe' assembles both.
-        return (nll_sum + moe_aux_weight * aux_sum) / M
+        # ticks — the caller's psum over 'pipe' assembles both. The raw
+        # aux mean rides along as the has_aux extra so a TP caller
+        # (whose moe_aux_weight is 1/n_tp-scaled for gradient
+        # correctness) can repair the metric value.
+        return (nll_sum + moe_aux_weight * aux_sum) / M, aux_sum / M
 
     return local_loss
 
@@ -273,9 +276,11 @@ def _jit_pp_step(optimizer, local_loss, state, mesh, *, reduce_axes,
     the repaired rest once), optimizer update, shard_map + jit."""
 
     def step(state, toks_mb, tgt_mb):
-        loss, grads = jax.value_and_grad(local_loss)(
-            state["params"], toks_mb, tgt_mb
-        )
+        (loss, _aux), grads = jax.value_and_grad(
+            local_loss, has_aux=True
+        )(state["params"], toks_mb, tgt_mb)
+        # (aux is already inside `loss` at full weight here — the
+        # has_aux extra only matters to the TP x PP caller's metric.)
         # Block grads are stage-local (each device owns its blocks); the
         # replicated leaves (embedding, ln_f, head) received only their
         # OWN stage's contribution — zero everywhere but the stage that

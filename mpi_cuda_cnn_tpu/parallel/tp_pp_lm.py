@@ -51,6 +51,7 @@ from .pp_lm import (
     unstack_blocks,
 )
 from .tp_sp import (
+    MOE_SPEC_TAILS,
     TP_SPEC_TAILS,
     _check_tp_sp,
     _make_tp_pair,
@@ -67,6 +68,7 @@ TrainState = dict[str, Any]
 # grad-clip norm classification key off it, so the two meshes cannot
 # drift.
 _TP_TAIL = TP_SPEC_TAILS
+_MOE_TAIL = MOE_SPEC_TAILS
 
 
 def _state_specs(state):
@@ -81,7 +83,11 @@ def _state_specs(state):
         keys = [str(getattr(p, "key", getattr(p, "name", ""))) for p in path]
         ndim = getattr(leaf, "ndim", 0)
         if "blocks" in keys and ndim >= 1:
-            tail = _TP_TAIL.get(keys[-1])
+            # MoE leaves live under blk["moe"] and reuse the w1/w2 names
+            # with different ranks — the nested-key check keeps the two
+            # tables from cross-matching.
+            table = _MOE_TAIL if "moe" in keys else _TP_TAIL
+            tail = table.get(keys[-1])
             if tail is not None and ndim == len(tail) + 1:
                 return P(PIPE_AXIS, *tail)
             return P(PIPE_AXIS, *([None] * (ndim - 1)))
@@ -137,6 +143,7 @@ def make_tp_pp_lm_train_step(
     grad_clip: float = 0.0,
     attn_impl: str = "oracle",
     ce_chunk: int = 0,
+    moe_aux_weight: float = 0.01,
 ):
     """Jitted GPipe x Megatron train step — with a 'seq' mesh axis, the
     FULL 4D layout (pipe x model x seq x data).
@@ -193,34 +200,42 @@ def make_tp_pp_lm_train_step(
     w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
 
     def stage_body(blocks, x, pos):
-        def body(x, blk):
-            x = tp_block_apply(
+        def body(carry, blk):
+            x, aux = carry
+            x, a = tp_block_apply(
                 blk, x, attn=attn,
                 rope_pos=pos if model.pos == "rope" else None,
                 w=w, tp_copy=tp_copy, tp_reduce=tp_reduce,
+                moe_top_k=model.moe_top_k,
             )
-            return x, None
+            return (x, aux + a), None
 
-        x, _ = lax.scan(body, x, blocks)
-        return x, jnp.float32(0)  # dense blocks only (_check_tp_sp)
+        (x, aux), _ = lax.scan(body, (x, jnp.float32(0)), blocks)
+        return x, aux
 
     # The whole GPipe schedule (embed / tick / ppermute / drain) is
     # pp_lm's, verbatim — the model ranks run it identically on
     # replicated activations; only the stage body is Megatron-sliced.
     # With a 'seq' axis the schedule's buffers hold the local sequence
     # shard and positions carry its absolute offset.
+    # MoE aux at weight/n_tp in the differentiated loss: every upstream
+    # value reaches it through tp_copy (psum backward) and the aux is
+    # replicated across 'model' — 1/n_tp makes the psum restore exactly
+    # one contribution; the metric gets the missing share back below.
     local_loss = make_gpipe_local_loss(
         model, M=M, n_pipe=n_pipe, compute_dtype=cd, remat=remat,
         ce_chunk=ce_chunk, stage_body=stage_body,
         seq_axis=SEQ_AXIS if n_seq > 1 else None, n_seq=n_seq,
+        moe_aux_weight=moe_aux_weight / n_tp,
     )
     specs = _state_specs(state)  # shard_map specs AND the clip's
     #                              sliced-leaf classification below
 
     def step(state, toks_mb, tgt_mb):
-        loss, grads = jax.value_and_grad(local_loss)(
-            state["params"], toks_mb, tgt_mb
-        )
+        (loss, aux), grads = jax.value_and_grad(
+            local_loss, has_aux=True
+        )(state["params"], toks_mb, tgt_mb)
+        loss = loss + moe_aux_weight * (1.0 - 1.0 / n_tp) * aux
         # Block grads: stage-local over 'pipe'; over 'model', sliced
         # leaves are exact per slice and replicated leaves (ln) are
         # identical on every rank (tp_sp.py's gradient analysis) — no

@@ -28,9 +28,12 @@ explicitly so both axes live in ONE shard_map:
   stays over ('data', 'seq') only, exactly as in sp.py.
 
 The reference has neither axis (SURVEY.md §2 checklist, §5.7); this is
-the long-context Megatron layout TPU pods actually train with.
-Restrictions (checked loudly): dense MLP only (no MoE), heads and
-kv_heads divisible by the 'model' axis, dims divisible for w1/w2.
+the long-context Megatron layout TPU pods actually train with. MoE
+blocks compose (round 4): TP runs INSIDE every expert — hidden-sliced
+w1/w2, the replicated router entering the region through tp_copy, the
+aux loss 1/n_tp-weighted in the differentiated local loss (see
+tp_block_apply). Restrictions (checked loudly): heads and kv_heads
+divisible by the 'model' axis, dims divisible for w1/w2.
 """
 
 from __future__ import annotations
@@ -127,20 +130,30 @@ def from_tp_layout(params: dict, model: TransformerLM) -> dict:
     return out
 
 
-def tp_block_apply(blk, x, *, attn, rope_pos, w, tp_copy, tp_reduce):
+def tp_block_apply(blk, x, *, attn, rope_pos, w, tp_copy, tp_reduce,
+                   moe_top_k: int = 1):
     """One Megatron transformer block on the LOCAL heads/hidden slice.
 
     Column-parallel qkv projection (each model rank computes H/n_tp
     heads), `attn(q, k, v)` on them, row-parallel wo joined by
-    tp_reduce; column-parallel w1 / row-parallel w2 for the MLP. The
-    attention callable is the ONLY thing the TP x SP step (ring
-    attention over 'seq') and the TP x PP step (full-sequence attention
-    per pipeline stage) disagree on — one block implementation serves
-    both, so the Megatron math can never drift between meshes.
+    tp_reduce; column-parallel w1 / row-parallel w2 for the MLP. MoE
+    blocks run TP INSIDE every expert: the router and dispatch are
+    computed identically on every model rank (replicated gate), each
+    rank's expert FFN uses its hidden slice (gelu is elementwise on the
+    slice), and tp_reduce completes the per-expert partial sums after
+    the combine — the same column/row algebra as the dense MLP, per
+    expert. The attention callable is the ONLY thing the TP x SP step
+    (ring attention over 'seq') and the TP x PP step (full-sequence
+    attention per pipeline stage) disagree on — one block
+    implementation serves both, so the Megatron math can never drift
+    between meshes.
 
     blk: head-structured leaves (to_tp_layout), already sliced to this
     rank. rope_pos: position ids for rotary (None = learned/absolute,
     applied by the caller). w: the compute-dtype cast.
+
+    Returns (x, aux) — aux the MoE balance loss (0 for dense), computed
+    identically on every model rank.
     """
     y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
     y = tp_copy(y)
@@ -159,16 +172,34 @@ def tp_block_apply(blk, x, *, attn, rope_pos, w, tp_copy, tp_reduce):
     x = x + tp_reduce(part)
     y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
     y = tp_copy(y)
+    if "moe" in blk:
+        from .ep import moe_mlp
+
+        b, s, d = y.shape
+        moe_p = jax.tree.map(w, blk["moe"])
+        # The gate is replicated but consumed INSIDE the parallel
+        # region: its combine-path cotangents are rank-partial (each
+        # rank weights its own expert-output slice), so like any
+        # region input it must enter through tp_copy — the psum in
+        # backward assembles the full gradient. The aux path is the
+        # exception (computed identically on every rank); the CALLER
+        # accounts for it by weighting aux with 1/n_tp in the local
+        # loss so the same psum restores exactly one contribution
+        # (see make_tp_sp_lm_train_step / tp_pp_lm).
+        moe_p["gate"] = tp_copy(moe_p["gate"])
+        # axis=None: dispatch over the LOCAL tokens with the full
+        # (replicated) gate; w1/w2 hold the hidden SLICE, so the
+        # combine's output is this rank's partial sum.
+        part, aux = moe_mlp(
+            y.reshape(b * s, d), moe_p,
+            n_experts=moe_p["w1"].shape[0], top_k=moe_top_k, axis=None,
+        )
+        return x + tp_reduce(part.reshape(b, s, d).astype(x.dtype)), aux
     part = jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"])
-    return x + tp_reduce(part)
+    return x + tp_reduce(part), jnp.float32(0)
 
 
 def _check_tp_sp(model: TransformerLM, n_tp: int) -> None:
-    if model.moe_experts:
-        raise ValueError(
-            "TP x SP supports dense MLP blocks only (MoE routes tokens "
-            "per expert — use the EP x SP mesh instead)"
-        )
     if model.heads % n_tp or model.n_kv % n_tp:
         raise ValueError(
             f"the model-axis size {n_tp} must divide both heads "
@@ -196,17 +227,35 @@ TP_SPEC_TAILS = {
     "w2": (MODEL_AXIS, None),
 }
 
+# MoE block leaves (under blk["moe"]): TP INSIDE every expert — w1
+# (E, d, 4d) column-parallel on hidden, w2 (E, 4d, d) row-parallel, the
+# router gate replicated (dispatch is computed identically on every
+# model rank). The gelu is elementwise on the hidden slice, so each
+# rank's expert FFN produces a partial sum the caller's tp_reduce
+# completes — the exact dense-MLP Megatron trick, per expert.
+MOE_SPEC_TAILS = {
+    "w1": (None, None, MODEL_AXIS),
+    "w2": (None, MODEL_AXIS, None),
+}
+
 
 def tp_sp_param_specs(model: TransformerLM, params_tp: dict) -> dict:
     """PartitionSpecs for the head-structured tree: 'model' on the H dim
     of wqkv/wq/wkv/wo, on w1's columns and w2's rows; all else
     replicated (the 'seq'/'data' axes never shard parameters)."""
     spec_map = {k: P(*t) for k, t in TP_SPEC_TAILS.items()}
+    moe_map = {k: P(*t) for k, t in MOE_SPEC_TAILS.items()}
+
+    def blk_spec(k, v):
+        if k == "moe":
+            return {mk: moe_map.get(mk, jax.tree.map(lambda _: P(), mv))
+                    for mk, mv in v.items()}
+        return spec_map.get(k, jax.tree.map(lambda _: P(), v))
+
     out = {k: jax.tree.map(lambda _: P(), v)
            for k, v in params_tp.items() if k != "blocks"}
     out["blocks"] = [
-        {k: spec_map.get(k, jax.tree.map(lambda _: P(), v))
-         for k, v in blk.items()}
+        {k: blk_spec(k, v) for k, v in blk.items()}
         for blk in params_tp["blocks"]
     ]
     return out
@@ -288,6 +337,7 @@ def make_tp_sp_lm_train_step(
     ce_chunk: int = 0,
     impl: str = "ring",
     grad_clip: float = 0.0,
+    moe_aux_weight: float = 0.01,
 ):
     """Jitted Megatron x ring train step.
 
@@ -297,7 +347,9 @@ def make_tp_sp_lm_train_step(
     hop with the fused Pallas flash kernel — the on-chip configuration;
     needs 128-aligned per-shard sequences like the plain SP step),
     column/row-parallel matmuls over 'model' with the f/psum pair, loss
-    on the local sequence shard.
+    on the local sequence shard. MoE blocks run TP inside every expert
+    (tp_block_apply) with shard-local dispatch — the same estimator as
+    every sharded MoE trainer.
     """
     _check_tp_sp(model, mesh.shape[MODEL_AXIS])
     if impl == "ring":
@@ -323,6 +375,7 @@ def make_tp_sp_lm_train_step(
             "'ulysses'"
         )
     n_seq = mesh.shape[SEQ_AXIS]
+    n_tp = mesh.shape[MODEL_AXIS]
     reduce_axes = tuple(a for a in (data_axis, SEQ_AXIS) if a)
     cd = compute_dtype
     tp_copy, tp_reduce = _make_tp_pair(MODEL_AXIS)
@@ -363,25 +416,37 @@ def make_tp_sp_lm_train_step(
                 ),
                 rope_pos=pos if model.pos == "rope" else None,
                 w=w, tp_copy=tp_copy, tp_reduce=tp_reduce,
+                moe_top_k=model.moe_top_k,
             )
 
         if remat:
             block = jax.checkpoint(block)
+        aux_total = jnp.float32(0)
         for blk in params["blocks"]:
-            x = block(blk, x)
+            x, aux = block(blk, x)
+            aux_total = aux_total + aux
         feats = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
         if ce_chunk:
             from ..ops.losses import chunked_ce_mean
 
-            return chunked_ce_mean(
+            nll_term = chunked_ce_mean(
                 feats, params["head"], targets, ce_chunk, cd
             )
-        logits = jnp.matmul(
-            feats, w(params["head"]), preferred_element_type=jnp.float32
-        )
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return jnp.mean(nll)
+        else:
+            logits = jnp.matmul(
+                feats, w(params["head"]),
+                preferred_element_type=jnp.float32
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            nll_term = jnp.mean(nll)
+        # MoE aux enters the DIFFERENTIATED loss at weight/n_tp: every
+        # upstream activation/param reaches it through a tp_copy whose
+        # backward psums over 'model', and the aux is computed
+        # identically on every rank — 1/n_tp makes the psum restore
+        # exactly one contribution. The METRIC gets the missing
+        # (1 - 1/n_tp) share added back outside the grad (step below).
+        return nll_term + (moe_aux_weight / n_tp) * aux_total, aux_total
 
     # The global gradient norm must count each logical parameter exactly
     # once: psum the sliced leaves' squared norms over 'model', add the
@@ -397,9 +462,13 @@ def make_tp_sp_lm_train_step(
         return lax.psum(sliced, MODEL_AXIS) + rep
 
     def step(state, tokens, targets):
-        loss, grads = jax.value_and_grad(local_loss)(
-            state["params"], tokens, targets
-        )
+        (loss, aux), grads = jax.value_and_grad(
+            local_loss, has_aux=True
+        )(state["params"], tokens, targets)
+        # The metric gets the aux share the 1/n_tp grad-weighting left
+        # out — the reported loss equals nll + moe_aux_weight * aux
+        # exactly (aux is replicated across 'model').
+        loss = loss + moe_aux_weight * (1.0 - 1.0 / n_tp) * aux
         # Sliced leaves: exact per slice. Replicated leaves: identical on
         # every model rank (the loss consumed replicated activations).
         # Only the data/seq shards hold DIFFERENT samples -> pmean there,

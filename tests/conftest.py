@@ -158,6 +158,8 @@ SLOW_TESTS = {
     "test_tp_sp.py::test_tp_sp_ulysses_matches_serial",
     "test_ep.py::test_ep_dp_lm_trains",
     "test_accum_remat.py::test_sp_grad_accum_matches_plain",
+    "test_tp_pp_lm.py::test_tp_pp_lm_moe_m1_matches_serial",
+    "test_tp_sp.py::test_tp_sp_moe_trains",
     "test_pallas.py::test_conv_bf16_parity[4-14-14-16-3-32-2-1]",
     "test_pallas.py::test_conv_bf16_parity[4-28-28-1-3-16-2-1]",
     "test_pallas.py::test_model_pallas_backend_trains",
