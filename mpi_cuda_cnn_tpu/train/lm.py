@@ -28,13 +28,20 @@ import jax.numpy as jnp
 from ..models.transformer import TransformerLM
 
 
-# Measured f32 oracle/flash crossover (PERF.md "LM pretraining" table,
-# one v5e): at s=2048 f32+flash LOSES to the f32 oracle (215.9 vs
-# 194.4 ms/step — the HIGHEST-precision dots the f32 kernel uses for its
-# accuracy contract run the MXU at 1/4 rate), while by s=8192 flash wins
-# (12-16 vs ~21 ms fwd; the oracle starts paying O(S^2) HBM). The
-# crossover sits between; route f32 to the oracle below this bound.
-_F32_FLASH_MIN_SEQ = 4096
+# Measured f32 oracle/flash crossover (scripts/bench_crossover.py on one
+# v5e, round 4, HEAD kernels — full f32 train step at b=2, depth=4,
+# two-point timing):
+#   s=2048: flash 28.2 vs oracle 31.1 ms   s=4096: 87.4 vs 91.6
+#   s=3072: flash 61.5 vs oracle 61.6      s=6144: 160.4 vs 183.1
+# Flash wins at every measured point from s=2048 up (the round-2 kernels
+# lost at 2048; the bf16-native operand change closed that). The margin
+# near 2048 is shape-dependent — the SAME capture's bench_lm matrix at
+# b=8, depth=8 has f32 flash LOSING s=2048 by 8% (212.8 vs 195.9 ms) —
+# so this bound is a ±10%-band tiebreak, not a cliff; f32 is the
+# accuracy configuration either way (throughput runs use bf16, where
+# flash wins 2.2x outright). Below 2048 is unmeasured — route the
+# oracle there.
+_F32_FLASH_MIN_SEQ = 2048
 
 
 def pick_attn_impl(impl: str, seq_len: int, compute_dtype=None) -> str:

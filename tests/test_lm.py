@@ -103,18 +103,21 @@ def test_pick_attn_impl(monkeypatch):
 
 
 def test_pick_attn_impl_routing_table(monkeypatch):
-    """Pin "auto" to PERF.md's measured crossovers (one v5e): bf16 ->
-    flash at any 128-aligned s (wins 2.0x at s=2048); f32 -> oracle below
-    s=4096 (flash loses 215.9 vs 194.4 ms at 2048), flash from 4096 up
-    (wins by s=8192); unaligned s -> oracle always."""
+    """Pin "auto" to the measured crossovers (one v5e): bf16 -> flash at
+    any 128-aligned s (wins 2.2x at s=2048, round-4 capture: 56.4 vs
+    125.7 ms/step); f32 -> flash from s=2048 up
+    (round-4 bench_crossover: flash wins every point in {2048, 3072,
+    4096, 6144}, e.g. 28.2 vs 31.1 ms at 2048), oracle below 2048
+    (unmeasured territory, conservative); unaligned s -> oracle always."""
     from mpi_cuda_cnn_tpu.train import lm as lm_mod
 
     monkeypatch.setattr(lm_mod.jax, "default_backend", lambda: "tpu")
     bf16 = jnp.bfloat16
     assert pick_attn_impl("auto", 2048, bf16) == "flash"
     assert pick_attn_impl("auto", 128, bf16) == "flash"
-    assert pick_attn_impl("auto", 2048, None) == "oracle"       # f32 short
-    assert pick_attn_impl("auto", 2048, jnp.float32) == "oracle"
+    assert pick_attn_impl("auto", 1024, None) == "oracle"       # f32 short
+    assert pick_attn_impl("auto", 1024, jnp.float32) == "oracle"
+    assert pick_attn_impl("auto", 2048, None) == "flash"        # f32 crossover
     assert pick_attn_impl("auto", 4096, None) == "flash"        # f32 long
     assert pick_attn_impl("auto", 8192, jnp.float32) == "flash"
     assert pick_attn_impl("auto", 2000, bf16) == "oracle"       # unaligned

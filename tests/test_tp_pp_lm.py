@@ -143,15 +143,16 @@ def test_tp_pp_lm_4d_matches_serial(eight_devices):
                                    rtol=2e-4, atol=2e-5)
 
     # MoE on the FULL 4D mesh (ring fold + per-seq-shard local
-    # dispatch): training-tested — finite, decreasing loss.
-    from mpi_cuda_cnn_tpu.parallel.pp_lm import sp_pp_shard_batch
-
-    mesh4d = make_mesh({PIPE_AXIS: 2, MODEL_AXIS: 2, "seq": 2},
-                       devices=jax.devices()[:8])
-    state4 = make_tp_pp_lm_state(model, params, opt, mesh4d)
-    step4 = make_tp_pp_lm_train_step(model, opt, mesh4d, state4,
+    # dispatch): training-tested — finite, decreasing loss. A real MoE
+    # model (experts sliced over 'model' inside the stacked stages), not
+    # the dense one from _pieces.
+    moe_model = TransformerLM(vocab=32, dim=32, heads=4, depth=4,
+                              max_seq=64, moe_experts=2)
+    moe_params = moe_model.init(jax.random.key(0))
+    state4 = make_tp_pp_lm_state(moe_model, moe_params, opt, mesh)
+    step4 = make_tp_pp_lm_train_step(moe_model, opt, mesh, state4,
                                      donate=False, attn_impl="ring")
-    mb4 = sp_pp_shard_batch(pp_lm_microbatch(tokens, targets, 2), mesh4d)
+    mb4 = sp_pp_shard_batch(pp_lm_microbatch(tokens, targets, 2), mesh)
     first = None
     for _ in range(8):
         state4, m4 = step4(state4, *mb4)
