@@ -48,6 +48,12 @@ class TransformerLM:
 
     Sizes are kept explicit; heads must divide dim. The MLP expansion is
     the standard 4x.
+
+    TPU sizing note (measured, PERF.md round-4 MFU ladder): prefer
+    head_dim = dim/heads = 128 — the flash kernel's QK^T and PV dots
+    contract over head_dim, and 128 fills the MXU's lanes exactly
+    (head_dim 64 half-fills them: h=16 -> h=8 at d=1024 alone was
+    +13.5 MFU points, 44.9% -> 58.4%).
     """
 
     vocab: int = 64

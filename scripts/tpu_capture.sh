@@ -11,6 +11,7 @@
 #   5. bench_lm d=1024 config (MXU saturation lever; VERDICT #3)
 #   6. bench_lm d=1024 + fused chunked CE (the two levers together)
 #   7. bench_lm MoE row    (one measured MoE number; VERDICT #7)
+#   7b. bench_lm flagship  (head_dim-128 MFU config — 67.8% measured r4)
 #   8. bench_decode        (KV-cache tokens/s, GQA cache win; VERDICT #5)
 #   9. profile_lm          (step-time attribution; VERDICT #3)
 #  10. make -C native test_tpu  (C driver on the chip)
@@ -50,6 +51,8 @@ step bench_lm_d1024_ce 900 python scripts/bench_lm.py --quick --dim 1024 \
     --depth 8 --heads 16 --batch 4 --ce-chunk 512
 step bench_lm_moe 900 python scripts/bench_lm.py --quick --moe-experts 8 \
     --moe-top-k 2
+step bench_lm_flagship 900 python scripts/bench_lm.py --quick --dim 4096 \
+    --depth 3 --heads 32 --batch 2
 step bench_decode 900 python scripts/bench_decode.py
 step profile_lm 900 python scripts/profile_lm.py
 # make prints recipes/compiler lines on stdout — keep the JSONL clean by
