@@ -17,8 +17,12 @@ small leaf (a step counter, a scalar loss) to exist in the synced tree.
 
 from __future__ import annotations
 
+import time
+
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def hard_block(tree):
@@ -47,3 +51,55 @@ def two_point(run, n: int, *, warmup: int = 1) -> float:
     """
     run(max(warmup, 1))
     return (run(2 * n) - run(n)) / n
+
+
+def scan_two_point(fn, n: int, *args, reps: int = 3) -> float:
+    """Per-call seconds of `fn(*args)` via two-point ON-DEVICE scans.
+
+    The one shared implementation of the benchmark-timing recipe (both
+    measurement corrections in this repo's history were exactly this
+    logic drifting per script — scripts/bench_conv_shapes.py round 2,
+    scripts/bench_attention.py round 4):
+
+    - each sample times a jitted `lax.scan` of n and of 2n iterations
+      and reports (T(2n) − T(n)) / n, so the fixed per-window cost
+      (through this environment's tunnel: ~100 ms of dispatch + forced
+      host read) cancels instead of being smeared across n;
+    - the scan body perturbs the first operand per step (defeats CSE)
+      and accumulates a f32 sum of the output (defeats DCE); the
+      `float()` on the result is the hard sync (a host fetch cannot
+      complete before the value exists — see hard_block above);
+    - the returned value is the MEDIAN of `reps` samples: sub-10%
+      differences are not resolvable from one sample through a jittery
+      tunnel.
+
+    `fn` must accept `fn(args[0]', *args[1:])` where args[0]' has
+    args[0]'s shape and dtype (the perturbation is computed in f32 and
+    cast back, so bf16 operands stay bf16).
+    """
+
+    def make(m):
+        @jax.jit
+        def run(args):
+            def body(acc, i):
+                a0 = args[0] * (1.0 + i * 1e-9).astype(args[0].dtype)
+                out = fn(a0, *args[1:])
+                return acc + jnp.sum(out.astype(jnp.float32)), None
+
+            acc, _ = lax.scan(body, jnp.zeros((), jnp.float32),
+                              jnp.arange(m, dtype=jnp.float32))
+            return acc
+
+        return run
+
+    run_n, run_2n = make(n), make(2 * n)
+    float(run_n(args)), float(run_2n(args))  # compile + warm both sizes
+    samples = []
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        float(run_n(args))
+        t1 = time.perf_counter()
+        float(run_2n(args))
+        t2 = time.perf_counter()
+        samples.append(((t2 - t1) - (t1 - t0)) / n)
+    return sorted(samples)[len(samples) // 2]

@@ -1,12 +1,15 @@
 """Device-time benchmark for the attention paths (PERF.md methodology).
 
-Times each implementation as a `lax.scan` of N calls inside ONE jit —
-inputs perturbed per step (defeats CSE), outputs summed (defeats DCE),
-`float()` on the result (forces completion through this environment's
-TPU tunnel; block_until_ready alone can return early). Prints one line
-per implementation.
+Times each implementation with `utils/sync.scan_two_point`: jitted
+`lax.scan` windows of n and 2n calls, per-call time = (T(2n) − T(n)) / n
+(the tunnel's fixed ~100 ms window cost cancels), median of 3 samples.
+The original single-window scan-of-3 harness smeared that fixed cost
+across 3 iterations and overstated the s=8192 flash forward 8x (37.6 vs
+4.6 ms) — the round-4 measurement correction in PERF.md. Prints one
+line per implementation.
 
-Usage: python scripts/bench_attention.py [--seq 32768] [--iters 3]
+Usage: python scripts/bench_attention.py [--seq 32768] [--iters 10]
+                                         [--dtype bfloat16] [--head-dim 128]
 """
 
 from __future__ import annotations
@@ -14,7 +17,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -22,38 +24,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
-
-def device_time(fn, n, *args):
-    """Mean seconds per call of fn(*args) over n on-device iterations."""
-
-    @jax.jit
-    def run(args):
-        def body(acc, i):
-            # Perturb the first operand so each iteration is fresh work.
-            a0 = args[0] * (1.0 + i * 1e-9)
-            out = fn(a0, *args[1:])
-            return acc + jnp.sum(out.astype(jnp.float32)), None
-
-        acc, _ = lax.scan(body, jnp.zeros((), jnp.float32),
-                          jnp.arange(n, dtype=jnp.float32))
-        return acc
-
-    float(run(args))  # compile + warmup
-    t0 = time.perf_counter()
-    float(run(args))
-    return (time.perf_counter() - t0) / n
+from mpi_cuda_cnn_tpu.utils.sync import scan_two_point as device_time
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=32768)
-    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=10,
+                    help="n for the two-point (T(2n)-T(n))/n windows")
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--block", type=int, default=1024,
                     help="block size for the jnp blockwise path")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
     args = ap.parse_args()
 
     from mpi_cuda_cnn_tpu.ops.attention import blockwise_attention
@@ -61,8 +46,9 @@ def main() -> None:
     from mpi_cuda_cnn_tpu.parallel.sp import make_ring_flash_attention
 
     b, s, h, d = 1, args.seq, args.heads, args.head_dim
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     rng = np.random.default_rng(0)
-    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), dt)
                for _ in range(3))
     n = args.iters
 

@@ -1,11 +1,10 @@
 """Per-op conv benchmark: XLA emitter vs the Pallas direct kernels.
 
 Produces the per-shape table in PERF.md ("Pallas conv/dense kernels:
-per-shape analysis"). Device time = lax.scan of `--iters` calls inside
-one jit with a perturbed carry (defeats CSE) and a summed output fetched
-to host (forces completion through the tunnel; block_until_ready alone
-returns at enqueue here — utils/sync.py). The fixed tunnel round-trip
-(~110 ms) amortizes across iterations; 200 is enough to make it noise.
+per-shape analysis"). Timing = `utils/sync.scan_two_point` (the shared
+two-point on-device-scan recipe: (T(2N) - T(N)) / N over jitted scans,
+median of 3 — the fixed ~110 ms tunnel round-trip per window cancels
+exactly instead of needing to be amortized).
 
     python scripts/bench_conv_shapes.py [--iters 200]
 """
@@ -15,7 +14,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -26,6 +24,7 @@ import numpy as np
 
 from mpi_cuda_cnn_tpu.ops.conv import conv2d
 from mpi_cuda_cnn_tpu.ops.pallas_ops import conv2d_pallas
+from mpi_cuda_cnn_tpu.utils.sync import scan_two_point
 
 # The round-1 verdict's question shapes: cifar3conv/vgg_small layers +
 # the reference's own conv1.
@@ -38,35 +37,15 @@ SHAPES = [
 ]
 
 
-def _timed(fn, x, w, iters):
-    @jax.jit
-    def run(x0, wt):
-        def body(c, _):
-            y = fn(c, wt)
-            return c + 1e-6, jnp.sum(y.astype(jnp.float32))
-
-        _, ys = jax.lax.scan(body, x0, None, length=iters)
-        return jnp.sum(ys)
-
-    float(run(x, w))  # compile + warm
-    t0 = time.perf_counter()
-    float(run(x, w))
-    return time.perf_counter() - t0
-
-
 def dev_time(fn, x, w, iters, reps=3):
-    """Per-op ms via TWO-POINT measurement: time scans of N and 2N
-    iterations and report (T2N - TN) / N — the fixed per-dispatch cost
-    (the tunnel's ~100 ms round-trip, which would otherwise add
+    """Per-op ms via the shared two-point scan recipe
+    (utils/sync.scan_two_point): (T(2N) - T(N)) / N over jitted
+    on-device scans, median of `reps` — the fixed per-window dispatch
+    cost (the tunnel's ~100 ms round-trip, which would otherwise add
     ~0.5 ms/op at N=200 and compress every ratio toward 1.0) cancels
-    exactly. Median of `reps` repetitions (sub-10% differences are not
-    resolvable from one sample through a jittery tunnel)."""
-    samples = []
-    for _ in range(reps):
-        t1 = _timed(fn, x, w, iters)
-        t2 = _timed(fn, x, w, 2 * iters)
-        samples.append((t2 - t1) / iters * 1e3)
-    return sorted(samples)[len(samples) // 2]
+    exactly, and sub-10% differences are not resolvable from one sample
+    through a jittery tunnel."""
+    return scan_two_point(fn, iters, x, w, reps=reps) * 1e3
 
 
 def main():
