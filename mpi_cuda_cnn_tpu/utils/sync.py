@@ -36,46 +36,51 @@ def hard_block(tree):
     return tree
 
 
-def two_point(run, n: int, *, warmup: int = 1) -> float:
-    """Per-iteration time via (T(2n) - T(n)) / n.
+def two_point(run, n: int, *, warmup: int = 1, reps: int = 3) -> float:
+    """Per-iteration time: median of `reps` samples of (T(2n) - T(n)) / n.
+
+    THE two-point core — every benchmark in the repo routes through this
+    one function (scan_two_point below, scripts/bench_lm,
+    scripts/check_gqa_flash, scripts/profile_lm): both measurement
+    corrections in the repo's history were exactly this logic drifting
+    per script.
 
     `run(k)` must execute k DEPENDENT iterations (so XLA cannot overlap
-    or elide them), force completion (hard_block), and return elapsed
-    seconds. The difference cancels every fixed per-call cost — through
-    this environment's remote-TPU tunnel that is a ~100 ms dispatch
-    round-trip per timed window, which a naive T(n)/n would smear across
-    the iterations (PERF.md "Methodology notes"). The warmup call
-    absorbs compilation for both point sizes' cache entries when run(k)
-    compiles per distinct k (callers with per-k programs should warm
-    both sizes themselves).
+    or elide them), force completion (hard_block / a host fetch), and
+    return elapsed seconds. The T(2n) - T(n) difference cancels every
+    fixed per-window cost — through this environment's remote-TPU tunnel
+    that is a ~100 ms dispatch round-trip per timed window, which a
+    naive T(n)/n would smear across the iterations (PERF.md "Methodology
+    notes"). The MEDIAN over `reps` window pairs absorbs backend
+    transients (observed: a single pair reading 15x slow while the next
+    was normal); sub-10% differences are not resolvable from one sample.
+    The warmup call absorbs compilation for run(k)'s cache entries;
+    callers whose per-k programs compile per distinct k should warm both
+    sizes themselves and pass warmup=0.
     """
-    run(max(warmup, 1))
-    return (run(2 * n) - run(n)) / n
+    if warmup:
+        run(warmup)
+    samples = []
+    for _ in range(max(reps, 1)):
+        t1 = run(n)
+        t2 = run(2 * n)
+        samples.append((t2 - t1) / n)
+    return sorted(samples)[len(samples) // 2]
 
 
 def scan_two_point(fn, n: int, *args, reps: int = 3) -> float:
-    """Per-call seconds of `fn(*args)` via two-point ON-DEVICE scans.
+    """Per-call seconds of `fn(*args)` via `two_point` over ON-DEVICE
+    scan windows — the micro-op form of the shared recipe (scripts/
+    bench_attention.py, bench_conv_shapes.py):
 
-    The one shared implementation of the benchmark-timing recipe (both
-    measurement corrections in this repo's history were exactly this
-    logic drifting per script — scripts/bench_conv_shapes.py round 2,
-    scripts/bench_attention.py round 4):
-
-    - each sample times a jitted `lax.scan` of n and of 2n iterations
-      and reports (T(2n) − T(n)) / n, so the fixed per-window cost
-      (through this environment's tunnel: ~100 ms of dispatch + forced
-      host read) cancels instead of being smeared across n;
-    - the scan body perturbs the first operand per step (defeats CSE)
-      and accumulates a f32 sum of the output (defeats DCE); the
-      `float()` on the result is the hard sync (a host fetch cannot
-      complete before the value exists — see hard_block above);
-    - the returned value is the MEDIAN of `reps` samples: sub-10%
-      differences are not resolvable from one sample through a jittery
-      tunnel.
-
-    `fn` must accept `fn(args[0]', *args[1:])` where args[0]' has
-    args[0]'s shape and dtype (the perturbation is computed in f32 and
-    cast back, so bf16 operands stay bf16).
+    - a window of m calls is one jitted `lax.scan` of m iterations; the
+      body perturbs the first operand per step (defeats CSE; the factor
+      is computed in f32 then CAST BACK so bf16 operands stay bf16 —
+      naive `x * (1 + i*1e-9)` promotes to f32 and benches the wrong
+      kernel) and accumulates a f32 sum of the output (defeats DCE);
+    - `float()` on the scan result is the hard sync (a host fetch
+      cannot complete before the value exists — see hard_block above);
+    - window cancellation + median over `reps` come from `two_point`.
     """
 
     def make(m):
@@ -92,14 +97,13 @@ def scan_two_point(fn, n: int, *args, reps: int = 3) -> float:
 
         return run
 
-    run_n, run_2n = make(n), make(2 * n)
-    float(run_n(args)), float(run_2n(args))  # compile + warm both sizes
-    samples = []
-    for _ in range(max(reps, 1)):
+    progs = {m: make(m) for m in (n, 2 * n)}
+    for p in progs.values():  # compile + warm both sizes
+        float(p(args))
+
+    def run(m):
         t0 = time.perf_counter()
-        float(run_n(args))
-        t1 = time.perf_counter()
-        float(run_2n(args))
-        t2 = time.perf_counter()
-        samples.append(((t2 - t1) - (t1 - t0)) / n)
-    return sorted(samples)[len(samples) // 2]
+        float(progs[m](args))
+        return time.perf_counter() - t0
+
+    return two_point(run, n, warmup=0, reps=reps)

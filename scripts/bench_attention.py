@@ -39,6 +39,10 @@ def main() -> None:
                     help="block size for the jnp blockwise path")
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    ap.add_argument("--bwd", action="store_true",
+                    help="time fwd+bwd (gradients of sum(o^2) wrt "
+                         "q, k, v) instead of the forward alone — the "
+                         "PERF.md fused-backward table's command")
     args = ap.parse_args()
 
     from mpi_cuda_cnn_tpu.ops.attention import blockwise_attention
@@ -51,9 +55,25 @@ def main() -> None:
     q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), dt)
                for _ in range(3))
     n = args.iters
+    tag = "fwd+bwd" if args.bwd else "causal "
 
-    t = device_time(partial(flash_attention, causal=True), n, q, k, v)
-    print(f"flash_attention   causal s={s}: {t * 1000:8.1f} ms/call")
+    def measured(fn):
+        """The forward itself, or fwd+bwd of sum(o^2): the grads come
+        back as one stacked array so scan_two_point's output-sum DCE
+        defeat covers all three."""
+        if not args.bwd:
+            return fn
+        g = jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2),
+        )
+        return lambda q, k, v: jnp.stack(
+            [jnp.sum(t.astype(jnp.float32)) for t in g(q, k, v)]
+        )
+
+    t = device_time(measured(partial(flash_attention, causal=True)),
+                    n, q, k, v)
+    print(f"flash_attention   {tag} s={s}: {t * 1000:8.1f} ms/call")
 
     # Ring-flash over however many devices are visible (p=1 on one chip:
     # the ring reduces to one diag fold — kernel cost + one merge).
@@ -62,14 +82,15 @@ def main() -> None:
     devs = jax.devices()
     mesh = jax.sharding.Mesh(np.array(devs), ("seq",))
     ring = make_ring_flash_attention(mesh)
-    t = device_time(partial(ring, causal=True), n, q, k, v)
-    print(f"ring_flash (p={len(devs)}) causal s={s}: {t * 1000:8.1f} ms/call")
+    t = device_time(measured(partial(ring, causal=True)), n, q, k, v)
+    print(f"ring_flash (p={len(devs)}) {tag} s={s}: {t * 1000:8.1f} ms/call")
 
     t = device_time(
-        partial(blockwise_attention, block_size=args.block, causal=True),
+        measured(partial(blockwise_attention, block_size=args.block,
+                         causal=True)),
         n, q, k, v,
     )
-    print(f"jnp blockwise b{args.block} causal s={s}: {t * 1000:8.1f} ms/call")
+    print(f"jnp blockwise b{args.block} {tag} s={s}: {t * 1000:8.1f} ms/call")
 
 
 if __name__ == "__main__":

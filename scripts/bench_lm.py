@@ -36,6 +36,7 @@ from mpi_cuda_cnn_tpu.train.lm import (
     make_lm_train_step,
 )
 from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+from mpi_cuda_cnn_tpu.utils.sync import two_point
 
 # Peak dense matmul throughput used as the MFU denominator.
 PEAK_TFLOPS = {"tpu_v5e_bf16": 197.0, "tpu_v5e_f32": 49.0}
@@ -73,13 +74,18 @@ def bench_config(model, *, batch, seq, compute_dtype, attn_impl,
         state, m = step_fn(state, tokens, targets)
     float(m["loss"])
 
-    # Two-point timing: the tunnel adds a fixed ~100 ms round-trip per
-    # timed window; (T2N - TN)/N cancels it instead of smearing it
-    # across the steps (~5 ms/step at N=20 — enough to bias ratios).
-    state, t1, _ = run(state, steps)
-    state, t2, loss = run(state, 2 * steps)
-    dt = (t2 - t1) / steps
-    return dt, loss
+    # Shared two-point core (utils/sync.two_point): (T2N - TN)/N cancels
+    # the tunnel's fixed ~100 ms window cost, median-of-3 absorbs backend
+    # transients (observed round 4: one s=8192 sample pair read 15x
+    # slow, the re-run was normal). warmup=0 — warmed above.
+    box = {"state": state, "loss": None}
+
+    def timed(k):
+        box["state"], dt, box["loss"] = run(box["state"], k)
+        return dt
+
+    dt = two_point(timed, steps, warmup=0)
+    return dt, box["loss"]
 
 
 def main():
