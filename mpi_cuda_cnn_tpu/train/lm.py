@@ -30,18 +30,18 @@ from ..models.transformer import TransformerLM
 
 # Measured f32 oracle/flash crossover (scripts/bench_crossover.py on one
 # v5e, round 4, HEAD kernels — full f32 train step at b=2, depth=4,
-# two-point timing):
-#   s=2048: flash 28.2 vs oracle 31.1 ms   s=4096: 87.4 vs 91.6
-#   s=3072: flash 61.5 vs oracle 61.6      s=6144: 160.4 vs 183.1
-# Flash wins at every measured point from s=2048 up (the round-2 kernels
-# lost at 2048; the bf16-native operand change closed that). The margin
-# near 2048 is shape-dependent — the SAME capture's bench_lm matrix at
-# b=8, depth=8 has f32 flash LOSING s=2048 by 8% (212.8 vs 195.9 ms) —
-# so this bound is a ±10%-band tiebreak, not a cliff; f32 is the
-# accuracy configuration either way (throughput runs use bf16, where
-# flash wins 2.2x outright). Below 2048 is unmeasured — route the
-# oracle there.
-_F32_FLASH_MIN_SEQ = 2048
+# two-point timing, TWO independent captures):
+#   s=2048: flash 28.2 vs 31.1 ms, then 32.7 vs 30.9  <- flips run-to-run
+#   s=3072: flash 61.5 vs 61.6,    then 57.3 vs 57.6  <- flash, both runs
+#   s=4096: flash 87.4 vs 91.6,    then 87.8 vs 95.5
+#   s=6144: flash 160.4 vs 183.1,  then 161.1 vs 178.0
+# The bound sits where flash wins RELIABLY: s=2048 is a coin flip within
+# the tunnel's noise band (bench_lm's b=8/depth=8 matrix also had the
+# oracle up 8% there), so it routes to the oracle — also the f32
+# accuracy story — and every measured point from 3072 up routes to
+# flash. Throughput runs use bf16, where flash wins 2.2x outright at
+# every 128-aligned length.
+_F32_FLASH_MIN_SEQ = 3072
 
 
 def pick_attn_impl(impl: str, seq_len: int, compute_dtype=None) -> str:

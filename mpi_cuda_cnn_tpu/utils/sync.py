@@ -68,6 +68,24 @@ def two_point(run, n: int, *, warmup: int = 1, reps: int = 3) -> float:
     return sorted(samples)[len(samples) // 2]
 
 
+def grad_stacked(fn):
+    """fwd+bwd measurement target for `scan_two_point`: gradients of
+    sum(fn(*args)²) wrt every positional arg, stacked into ONE array so
+    the scan body's output-sum DCE defeat covers all gradient leaves.
+    One definition for every script that times a backward
+    (bench_attention --bwd, check_gqa_flash) — the grad-stack idiom
+    must not drift per script any more than the window recipe."""
+
+    def wrapped(*args):
+        g = jax.grad(
+            lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2),
+            argnums=tuple(range(len(args))),
+        )(*args)
+        return jnp.stack([jnp.sum(t.astype(jnp.float32)) for t in g])
+
+    return wrapped
+
+
 def scan_two_point(fn, n: int, *args, reps: int = 3) -> float:
     """Per-call seconds of `fn(*args)` via `two_point` over ON-DEVICE
     scan windows — the micro-op form of the shared recipe (scripts/

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpi_cuda_cnn_tpu.utils.sync import grad_stacked
 from mpi_cuda_cnn_tpu.utils.sync import scan_two_point as device_time
 
 
@@ -58,18 +59,9 @@ def main() -> None:
     tag = "fwd+bwd" if args.bwd else "causal "
 
     def measured(fn):
-        """The forward itself, or fwd+bwd of sum(o^2): the grads come
-        back as one stacked array so scan_two_point's output-sum DCE
-        defeat covers all three."""
-        if not args.bwd:
-            return fn
-        g = jax.grad(
-            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
-            argnums=(0, 1, 2),
-        )
-        return lambda q, k, v: jnp.stack(
-            [jnp.sum(t.astype(jnp.float32)) for t in g(q, k, v)]
-        )
+        """The forward itself, or fwd+bwd of sum(o²) via the shared
+        grad_stacked wrapper (utils/sync.py)."""
+        return grad_stacked(fn) if args.bwd else fn
 
     t = device_time(measured(partial(flash_attention, causal=True)),
                     n, q, k, v)

@@ -40,6 +40,7 @@ from mpi_cuda_cnn_tpu.models.generate import generate, prefill
 from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
 from mpi_cuda_cnn_tpu.train.lm import count_params
 from mpi_cuda_cnn_tpu.utils.sync import hard_block as _force
+from mpi_cuda_cnn_tpu.utils.sync import two_point
 
 
 def bench_decode_config(model, *, batch, prompt_len, gen_tokens, seed=0):
@@ -55,13 +56,11 @@ def bench_decode_config(model, *, batch, prompt_len, gen_tokens, seed=0):
         _force(toks)
         return time.perf_counter() - t0
 
-    # Warm both compile-cache entries, then two-point with min-of-2 per
-    # point (the minimum is the steady state; dispatch jitter only adds).
+    # Warm both compile-cache entries (generate() compiles per n), then
+    # the shared two-point core: window cancellation + median-of-3.
     timed_gen(gen_tokens)
     timed_gen(2 * gen_tokens)
-    t_n = min(timed_gen(gen_tokens), timed_gen(gen_tokens))
-    t_2n = min(timed_gen(2 * gen_tokens), timed_gen(2 * gen_tokens))
-    per_tok = (t_2n - t_n) / gen_tokens
+    per_tok = two_point(timed_gen, gen_tokens, warmup=0)
 
     # Prefill alone (jitted once here; generate()'s fused program includes
     # it, which is exactly why the two-point difference above excludes it).
@@ -75,10 +74,7 @@ def bench_decode_config(model, *, batch, prompt_len, gen_tokens, seed=0):
         _force(out)
         return time.perf_counter() - t0
 
-    loops = 4
-    pf_n = timed_pf(loops)
-    pf_2n = timed_pf(2 * loops)
-    prefill_s = (pf_2n - pf_n) / loops
+    prefill_s = two_point(timed_pf, 4, warmup=0)
     return per_tok, prefill_s
 
 
@@ -92,7 +88,7 @@ def main():
     ap.add_argument("--vocab", type=int, default=8192)
     ap.add_argument("--max-seq", type=int, default=2048)
     ap.add_argument("--prompt", type=int, default=1024)
-    ap.add_argument("--tokens", type=int, default=128,
+    ap.add_argument("--tokens", type=int, default=256,
                     help="N for the two-point (N, 2N) decode timing; "
                          "prompt + 2N must fit --max-seq")
     ap.add_argument("--batch", type=int, default=8)

@@ -20,7 +20,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 
 import os
 
@@ -36,22 +35,11 @@ from mpi_cuda_cnn_tpu.ops.attention import (
     repeat_kv,
 )
 from mpi_cuda_cnn_tpu.ops.pallas_attention import flash_attention
-from mpi_cuda_cnn_tpu.utils.sync import hard_block, two_point
-
-
-def _two_point(fn, n, carry0):
-    """fn(c) -> (out, c'): each iteration consumes the previous carry, so
-    the dispatches are DEPENDENT (two_point's contract — independent
-    dispatches could overlap and under-measure the per-iteration time)."""
-    def run(k):
-        t0 = time.perf_counter()
-        c, out = carry0, None
-        for _ in range(k):
-            out, c = fn(c)
-        hard_block(out)
-        return time.perf_counter() - t0
-
-    return two_point(run, n)
+from mpi_cuda_cnn_tpu.utils.sync import (
+    grad_stacked,
+    hard_block,
+    scan_two_point,
+)
 
 
 def check_config(*, b, h, hkv, s, d, dtype, bwd, rng):
@@ -88,25 +76,15 @@ def check_config(*, b, h, hkv, s, d, dtype, bwd, rng):
     rel = err / ref
     ok = rel < tol
 
-    def fwd_step(c):
-        o = fwd(q, k, v, c)
-        return o, o[0, 0, 0, 0] * 0
-
-    t_fwd = _two_point(fwd_step, 3, zero)
+    # Timing via the shared on-device-scan recipe (host-dispatch chains
+    # cannot resolve these sub-10 ms kernels through the tunnel's jitter
+    # — observed negative columns at n=3 AND n=25); the fwd+bwd target
+    # is the shared grad_stacked wrapper.
+    fwd_fn = lambda q, k, v: flash_attention(q, k, v, True)
+    t_fwd = scan_two_point(fwd_fn, 25, q, k, v)
     t_bwd = None
     if bwd:
-        grad = jax.jit(jax.grad(
-            lambda q, k, v, c: jnp.sum(flash_attention(q + c, k, v, True)
-                                       .astype(jnp.float32) ** 2),
-            argnums=(0, 1, 2),
-        ))
-        hard_block(grad(q, k, v, zero))
-
-        def bwd_step(c):
-            g = grad(q, k, v, c)
-            return g, g[0][0, 0, 0, 0] * 0
-
-        t_bwd = _two_point(bwd_step, 3, zero)
+        t_bwd = scan_two_point(grad_stacked(fwd_fn), 10, q, k, v)
     return {
         "s": s, "kv_heads": hkv, "dtype": str(jnp.dtype(dtype)),
         "parity_rel_err": round(rel, 6), "parity_ok": ok,

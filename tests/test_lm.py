@@ -105,10 +105,11 @@ def test_pick_attn_impl(monkeypatch):
 def test_pick_attn_impl_routing_table(monkeypatch):
     """Pin "auto" to the measured crossovers (one v5e): bf16 -> flash at
     any 128-aligned s (wins 2.2x at s=2048, round-4 capture: 56.4 vs
-    125.7 ms/step); f32 -> flash from s=2048 up
-    (round-4 bench_crossover: flash wins every point in {2048, 3072,
-    4096, 6144}, e.g. 28.2 vs 31.1 ms at 2048), oracle below 2048
-    (unmeasured territory, conservative); unaligned s -> oracle always."""
+    125.7 ms/step); f32 -> flash from s=3072 up (round-4
+    bench_crossover, two captures: flash wins both runs at every point
+    in {3072, 4096, 6144}; s=2048 flips run-to-run, so it routes to the
+    oracle with the rest of the short/noise band); unaligned s ->
+    oracle always."""
     from mpi_cuda_cnn_tpu.train import lm as lm_mod
 
     monkeypatch.setattr(lm_mod.jax, "default_backend", lambda: "tpu")
@@ -116,8 +117,9 @@ def test_pick_attn_impl_routing_table(monkeypatch):
     assert pick_attn_impl("auto", 2048, bf16) == "flash"
     assert pick_attn_impl("auto", 128, bf16) == "flash"
     assert pick_attn_impl("auto", 1024, None) == "oracle"       # f32 short
-    assert pick_attn_impl("auto", 1024, jnp.float32) == "oracle"
-    assert pick_attn_impl("auto", 2048, None) == "flash"        # f32 crossover
+    assert pick_attn_impl("auto", 2048, None) == "oracle"       # f32 flip zone
+    assert pick_attn_impl("auto", 2048, jnp.float32) == "oracle"
+    assert pick_attn_impl("auto", 3072, None) == "flash"        # f32 crossover
     assert pick_attn_impl("auto", 4096, None) == "flash"        # f32 long
     assert pick_attn_impl("auto", 8192, jnp.float32) == "flash"
     assert pick_attn_impl("auto", 2000, bf16) == "oracle"       # unaligned
