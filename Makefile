@@ -25,11 +25,12 @@ test_native_tpu: native
 # Fast default: the heavy tests in conftest.SLOW_TESTS are skipped and the
 # run fans out over cores (pytest-xdist -n auto; each worker gets its own
 # 8-virtual-device jax). Measured 2026-07-31 (round 4, ~190 fast
-# tests): 4:35-5:00 SERIAL across repeat runs on a loaded 1-core box —
-# the fast set meets the 5-min bar WITHOUT xdist; multicore boxes
-# divide further. Every skipped subsystem keeps a fast representative
+# tests): 4:35-5:00 SERIAL across repeat runs on a loaded 1-core box
+# (5:30 once while TPU benches shared the box) — the fast set meets the
+# 5-min bar WITHOUT xdist on a quiet box; multicore boxes divide
+# further. Every skipped subsystem keeps a fast representative
 # (or a dryrun_multichip path with a serial-parity assert); `make
-# test_all` is the full superset (~325 tests, ~28 min serial).
+# test_all` is the full superset (338 tests, 32:00 measured serial).
 # pytest-xdist is optional: fan out when importable, serial otherwise.
 XDIST := $(shell $(PY) -c "import xdist" 2>/dev/null && echo "-n auto")
 
