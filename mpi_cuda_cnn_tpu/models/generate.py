@@ -35,20 +35,28 @@ from ..ops.attention import NEG_INF, attention, rope
 from .transformer import TransformerLM, _layernorm
 
 
-def init_cache(model: TransformerLM, batch: int) -> list[dict]:
+def init_cache(model: TransformerLM, batch: int,
+               dtype=jnp.float32) -> list[dict]:
     """Empty per-block KV buffers, static (B, max_seq, Hkv, head_dim) —
     under GQA the cache shrinks by heads/kv_heads (the reason serving
-    stacks use GQA: cache bytes bound decode batch size)."""
+    stacks use GQA: cache bytes bound decode batch size). `dtype`
+    bfloat16 halves the cache again: decode is cache-READ-bound (PERF.md
+    decode table — tokens/s tracks cache bytes almost linearly), so the
+    storage dtype is a bandwidth lever independent of GQA; scores and
+    softmax stay f32 either way (_attend_cached accumulates in f32)."""
     shape = (batch, model.max_seq, model.n_kv, model.head_dim)
     return [
-        {"k": jnp.zeros(shape, jnp.float32), "v": jnp.zeros(shape, jnp.float32)}
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(model.depth)
     ]
 
 
-def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
+def prefill(model: TransformerLM, params, prompt: jnp.ndarray,
+            cache_dtype=jnp.float32):
     """Batched prompt pass: ONE model.apply call whose attn_fn captures
-    each block's K/V into max_seq-sized cache buffers.
+    each block's K/V into max_seq-sized cache buffers (stored as
+    `cache_dtype`; the prompt pass itself still attends at full
+    precision — only the cache the DECODE steps read is quantized).
 
     Returns (logits_last: (B, vocab), cache).
     """
@@ -61,11 +69,11 @@ def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
     def capture_attn(q, k, v):
         cache.append({
             "k": lax.dynamic_update_slice(
-                jnp.zeros(full, jnp.float32), k.astype(jnp.float32),
+                jnp.zeros(full, cache_dtype), k.astype(cache_dtype),
                 (0, 0, 0, 0),
             ),
             "v": lax.dynamic_update_slice(
-                jnp.zeros(full, jnp.float32), v.astype(jnp.float32),
+                jnp.zeros(full, cache_dtype), v.astype(cache_dtype),
                 (0, 0, 0, 0),
             ),
         })
@@ -74,7 +82,10 @@ def prefill(model: TransformerLM, params, prompt: jnp.ndarray):
     logits = model.apply(
         params, prompt, attn_fn=capture_attn, moe_inference=True
     )
-    return logits[:, -1, :], cache
+    # f32 logits regardless of the weights dtype (bf16 serving weights
+    # would otherwise produce bf16 logits here and f32 in decode_step —
+    # the generate scan carries logits, so the two must agree).
+    return logits[:, -1, :].astype(jnp.float32), cache
 
 
 def _attend_cached(q, ck, cv, pos):
@@ -150,15 +161,16 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
         else:
             x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return (x @ params["head"])[:, 0, :], new_cache
+    return (x @ params["head"])[:, 0, :].astype(jnp.float32), new_cache
 
 
 @functools.lru_cache(maxsize=64)
 def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
-                  temperature: float):
-    """One jitted prefill+scan program per (model, shape, sampling)
-    combination — repeat generate() calls hit this cache instead of
-    retracing."""
+                  temperature: float, cache_dtype: str):
+    """One jitted prefill+scan program per (model, shape, sampling,
+    cache dtype) combination — repeat generate() calls hit this cache
+    instead of retracing."""
+    cdt = jnp.dtype(cache_dtype)
 
     def sample(logits, k):
         if temperature <= 0:
@@ -179,7 +191,7 @@ def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
 
     @jax.jit
     def run(params, prompt, key):
-        logits, cache = prefill(model, params, prompt)
+        logits, cache = prefill(model, params, prompt, cache_dtype=cdt)
         # Scan N-1 steps (each samples from the carried logits, then runs
         # the forward that produces the NEXT logits); the final token only
         # needs a sample, not another forward.
@@ -202,13 +214,16 @@ def generate(
     *,
     temperature: float = 0.0,
     key: jax.Array | None = None,
+    cache_dtype="float32",
 ):
     """Prefill the prompt (one batched forward), then sample `num_tokens`
     continuations with the KV-cached decode scan.
 
     Returns (B, num_tokens) int32. Greedy argmax at temperature 0,
     categorical sampling otherwise (key required). Prompt length +
-    num_tokens must fit max_seq.
+    num_tokens must fit max_seq. `cache_dtype` "bfloat16" halves the KV
+    cache bytes decode reads per token (attention scores/softmax stay
+    f32); f32 is the exactness default the parity tests pin.
     """
     b, s0 = prompt.shape
     if num_tokens < 1:
@@ -222,5 +237,6 @@ def generate(
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.key(0)  # unused at temperature 0
-    run = _compiled_run(model, s0, num_tokens, float(temperature))
+    run = _compiled_run(model, s0, num_tokens, float(temperature),
+                        str(jnp.dtype(cache_dtype)))
     return run(params, prompt, key)

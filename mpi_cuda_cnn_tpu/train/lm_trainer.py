@@ -106,6 +106,14 @@ class LMTrainer:
                 f"[0, seq_len {cfg.seq_len}) — the prompt needs >= 1 "
                 f"position of the decode budget"
             )
+        if cfg.decode_cache_dtype not in ("float32", "bfloat16"):
+            # Same rationale: the auto-generated flag parser is type=str,
+            # so a typo ('bf16') would otherwise surface only at
+            # sampling time, after the whole run.
+            raise ValueError(
+                f"--decode-cache-dtype {cfg.decode_cache_dtype!r} must "
+                "be 'float32' or 'bfloat16'"
+            )
 
         self.model = TransformerLM(
             vocab=vocab, dim=cfg.dim, heads=cfg.heads, depth=cfg.depth,
@@ -624,6 +632,7 @@ class LMTrainer:
             self.model, params, prompt, num_tokens,
             temperature=temperature,
             key=jax.random.key(seed) if temperature > 0 else None,
+            cache_dtype=cfg.decode_cache_dtype,
         )
         return np.asarray(prompt[0]), np.asarray(toks[0])
 

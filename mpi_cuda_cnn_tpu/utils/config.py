@@ -170,6 +170,10 @@ class LMConfig(_JsonConfig):
                                      # stream with the KV-cache decode
                                      # path and print the continuation
     sample_temperature: float = 0.0  # 0 = greedy argmax
+    decode_cache_dtype: str = "float32"  # "bfloat16" halves the decode
+                                     # KV-cache bytes (decode is cache-
+                                     # read-bound: PERF.md decode table);
+                                     # f32 = exactness default
 
 
 

@@ -43,8 +43,18 @@ from mpi_cuda_cnn_tpu.utils.sync import hard_block as _force
 from mpi_cuda_cnn_tpu.utils.sync import two_point
 
 
-def bench_decode_config(model, *, batch, prompt_len, gen_tokens, seed=0):
+def bench_decode_config(model, *, batch, prompt_len, gen_tokens,
+                        cache_dtype="float32", weights_dtype="float32",
+                        seed=0):
     params = model.init(jax.random.key(seed))
+    if weights_dtype != "float32":
+        # Serving-weights cast: decode reads every weight once per token
+        # (~4 bytes/param in f32 — the dominant HBM stream once the
+        # cache is GQA- and bf16-shrunk); bf16 halves it.
+        wdt = jnp.dtype(weights_dtype)
+        params = jax.tree.map(
+            lambda a: a.astype(wdt) if a.dtype == jnp.float32 else a, params
+        )
     rng = np.random.default_rng(seed)
     prompt = jnp.asarray(
         rng.integers(0, model.vocab, (batch, prompt_len)), jnp.int32
@@ -52,7 +62,7 @@ def bench_decode_config(model, *, batch, prompt_len, gen_tokens, seed=0):
 
     def timed_gen(n):
         t0 = time.perf_counter()
-        toks = generate(model, params, prompt, n)
+        toks = generate(model, params, prompt, n, cache_dtype=cache_dtype)
         _force(toks)
         return time.perf_counter() - t0
 
@@ -64,7 +74,8 @@ def bench_decode_config(model, *, batch, prompt_len, gen_tokens, seed=0):
 
     # Prefill alone (jitted once here; generate()'s fused program includes
     # it, which is exactly why the two-point difference above excludes it).
-    pf = jax.jit(lambda p, t: prefill(model, p, t)[0])
+    cdt = jnp.dtype(cache_dtype)
+    pf = jax.jit(lambda p, t: prefill(model, p, t, cache_dtype=cdt)[0])
     _force(pf(params, prompt))
 
     def timed_pf(loops):
@@ -92,6 +103,14 @@ def main():
                     help="N for the two-point (N, 2N) decode timing; "
                          "prompt + 2N must fit --max-seq")
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--cache-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="KV-cache storage dtype; bfloat16 halves the "
+                         "bytes decode reads per token")
+    ap.add_argument("--weights-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="serving weights dtype; decode reads every "
+                         "weight once per token")
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     args = ap.parse_args()
 
@@ -122,15 +141,19 @@ def main():
         )
         per_tok, prefill_s = bench_decode_config(
             model, batch=args.batch, prompt_len=args.prompt,
-            gen_tokens=args.tokens,
+            gen_tokens=args.tokens, cache_dtype=args.cache_dtype,
+            weights_dtype=args.weights_dtype,
         )
         hkv = model.n_kv
-        # f32 cache k+v bytes actually resident per decoded token's attention
+        # cache k+v bytes actually resident per decoded token's attention
+        itemsize = jnp.dtype(args.cache_dtype).itemsize
         cache_mb = (
-            args.batch * args.max_seq * hkv * model.head_dim * 4 * 2
+            args.batch * args.max_seq * hkv * model.head_dim * itemsize * 2
             * args.depth / 1e6
         )
         label = f"kv{hkv}" + ("(MHA)" if hkv == args.heads else "")
+        if args.cache_dtype != "float32":
+            label += f"+{args.cache_dtype}"
         # A non-positive two-point delta means the per-token cost is below
         # the timer's noise floor at these shapes — report null, never a
         # negative throughput.
@@ -143,6 +166,8 @@ def main():
         }
         print(json.dumps({
             "bench": "lm_decode", "kv_heads": hkv,
+            "cache_dtype": args.cache_dtype,
+            "weights_dtype": args.weights_dtype,
             "params": count_params(model.init(jax.random.key(0))),
             **results[label],
         }))
