@@ -43,7 +43,7 @@ def init_cache(model: TransformerLM, batch: int,
     bfloat16 halves the cache again: decode is cache-READ-bound (PERF.md
     decode table — tokens/s tracks cache bytes almost linearly), so the
     storage dtype is a bandwidth lever independent of GQA; scores and
-    softmax stay f32 either way (_attend_cached accumulates in f32)."""
+    softmax stay f32 either way (decode_block accumulates in f32)."""
     shape = (batch, model.max_seq, model.n_kv, model.head_dim)
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
@@ -88,30 +88,10 @@ def prefill(model: TransformerLM, params, prompt: jnp.ndarray,
     return logits[:, -1, :].astype(jnp.float32), cache
 
 
-def _attend_cached(q, ck, cv, pos):
-    """q: (B, 1, H, D) at position `pos`; ck/cv: (B, max_seq, Hkv, D)
-    with positions > pos unwritten (Hkv <= H: GQA). Masked softmax over
-    the valid prefix."""
-    b, one, h, d = q.shape
-    hkv = ck.shape[2]
-    g = h // hkv
-    qg = q.reshape(b, one, hkv, g, d)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    logits = jnp.einsum(
-        "bqhgd,bkhd->bhgqk", qg, ck, preferred_element_type=jnp.float32
-    ) * scale                                       # (B, Hkv, g, 1, max_seq)
-    valid = jnp.arange(ck.shape[1]) <= pos          # (max_seq,)
-    logits = jnp.where(valid[None, None, None, None, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum(
-        "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,
-        preferred_element_type=jnp.float32,
-    )
-    return out.reshape(b, one, h, d).astype(q.dtype)
-
-
 def decode_step(model: TransformerLM, params, tok, pos, cache):
-    """One token through the model using/updating the cache.
+    """One token through the model using/updating the cache — the k=1
+    case of decode_block (one forward implementation; the speculative
+    path's greedy-exactness depends on the two never drifting).
 
     tok: (B,) int32 current tokens; pos: their position — a traced scalar
     inside generate()'s scan (bounds are enforced there; a concrete
@@ -120,12 +100,28 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
     """
     if isinstance(pos, int) and pos >= model.max_seq:
         raise ValueError(f"position {pos} out of range (max_seq {model.max_seq})")
-    b = tok.shape[0]
+    logits, new_cache = decode_block(model, params, tok[:, None], pos, cache)
+    return logits[:, 0, :], new_cache
+
+
+def decode_block(model: TransformerLM, params, toks, pos, cache):
+    """k tokens through the model at positions [pos, pos+k): the block
+    form of decode_step, for speculative verification — ONE forward
+    scores k candidate tokens instead of k sequential decode steps.
+
+    toks: (B, k) int32; pos: start position (traced scalar OK). Writes
+    all k cache slots FIRST, then attends each row i over keys
+    <= pos+i — so within-block causality holds and any stale entries
+    beyond the accepted prefix from a previous speculative round are
+    either overwritten here or masked by the row bound.
+    Returns (logits: (B, k, vocab), new_cache).
+    """
+    b, kk = toks.shape
     h, hd, hkv = model.heads, model.head_dim, model.n_kv
-    x = params["tok_emb"][tok]                            # (B, dim)
+    x = params["tok_emb"][toks]                           # (B, k, dim)
+    positions = pos + jnp.arange(kk)
     if model.pos == "learned":
-        x = x + params["pos_emb"][pos]
-    x = x[:, None, :]                                     # (B, 1, dim)
+        x = x + params["pos_emb"][positions]
     new_cache = []
     for blk, c in zip(params["blocks"], cache):
         y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
@@ -135,33 +131,47 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
         else:
             q = y @ blk["wq"]
             k, v = jnp.split(y @ blk["wkv"], 2, axis=-1)
-        q = q.reshape(b, 1, h, hd)
-        k = k.reshape(b, 1, hkv, hd)
-        v = v.reshape(b, 1, hkv, hd)
+        q = q.reshape(b, kk, h, hd)
+        k = k.reshape(b, kk, hkv, hd)
+        v = v.reshape(b, kk, hkv, hd)
         if model.pos == "rope":
-            # One-position rotation: positions arg is the (1,)-vector
-            # [pos] (traced scalars broadcast fine).
-            p1 = jnp.reshape(pos, (1,))
-            q = rope(q, p1)
-            k = rope(k, p1)
-        ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype), (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype), (0, pos, 0, 0))
+            q = rope(q, positions)
+            k = rope(k, positions)
+        ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
+                                      (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
+                                      (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
-        o = _attend_cached(q, ck, cv, pos).reshape(b, 1, h * hd)
+        # Rows attend over the cached prefix + the block's causal part:
+        # row i sees keys at positions <= pos+i.
+        g = h // hkv
+        qg = q.reshape(b, kk, hkv, g, hd)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        logits = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, ck, preferred_element_type=jnp.float32
+        ) * scale                                 # (B, Hkv, g, k, max_seq)
+        valid = (jnp.arange(ck.shape[1])[None, :]
+                 <= positions[:, None])           # (k, max_seq)
+        logits = jnp.where(valid[None, None, None, :, :], logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,
+            preferred_element_type=jnp.float32,
+        ).reshape(b, kk, h * hd).astype(x.dtype)
         x = x + o @ blk["wo"]
         y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
         if model.moe_experts:
             from ..parallel.ep import moe_mlp_inference
 
             m = moe_mlp_inference(
-                y.reshape(b, model.dim), blk["moe"],
+                y.reshape(b * kk, model.dim), blk["moe"],
                 n_experts=model.moe_experts, top_k=model.moe_top_k,
             )
-            x = x + m.reshape(b, 1, model.dim)
+            x = x + m.reshape(b, kk, model.dim)
         else:
             x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return (x @ params["head"])[:, 0, :].astype(jnp.float32), new_cache
+    return (x @ params["head"]).astype(jnp.float32), new_cache
 
 
 @functools.lru_cache(maxsize=64)
@@ -204,6 +214,123 @@ def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
         return jnp.concatenate([toks, last[None, :]], axis=0).T
 
     return run
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_spec_run(model: TransformerLM, draft: TransformerLM,
+                       s0: int, num_tokens: int, k: int, cache_dtype: str):
+    """Jitted greedy speculative loop for one (models, shapes) combo."""
+    cdt = jnp.dtype(cache_dtype)
+
+    @jax.jit
+    def run(params, draft_params, prompt):
+        tl, t_cache = prefill(model, params, prompt, cache_dtype=cdt)
+        dl, d_cache = prefill(draft, draft_params, prompt, cache_dtype=cdt)
+        del dl  # the draft's prompt logits are not used: the first
+        #         generated token is the TARGET's greedy pick
+        cur = jnp.argmax(tl, axis=-1).astype(jnp.int32)       # (1,)
+        out = jnp.zeros((1, num_tokens + k), jnp.int32)
+        out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
+
+        def draft_step(carry, _):
+            tok, pos, dc = carry
+            logits, dc = decode_step(draft, draft_params, tok, pos, dc)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, pos + 1, dc), nxt
+
+        def round_body(state):
+            pos, cur, t_cache, d_cache, out, n_out, rounds = state
+            # 1. Draft k sequential steps, INGESTING each fed token so
+            #    its cache stays aligned with the verified prefix; the
+            #    last proposal is never fed anywhere (d_k is unused).
+            (_, _, d_cache), ds = lax.scan(
+                draft_step, (cur, pos, d_cache), None, length=k
+            )                                     # ds: (k, 1) proposals
+            u = jnp.concatenate([cur[None, :], ds[: k - 1, :]],
+                                axis=0).T         # (1, k) verify inputs
+            # 2. One target block forward scores all k inputs.
+            tl, t_cache = decode_block(model, params, u, pos, t_cache)
+            y = jnp.argmax(tl, axis=-1).astype(jnp.int32)     # (1, k)
+            # 3. Longest accepted prefix: input i+1 must equal the
+            #    target's pick at row i. j in [1, k] tokens emit.
+            matches = u[0, 1:] == y[0, :-1]                   # (k-1,)
+            j = 1 + jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+            # 4. Emit: write all k picks at n_out; only advance by j —
+            #    rows beyond j are rewritten by the next round.
+            out = lax.dynamic_update_slice(out, y, (0, n_out))
+            cur = lax.dynamic_slice(y, (0, j - 1), (1, 1))[:, 0]
+            return (pos + j, cur, t_cache, d_cache, out, n_out + j,
+                    rounds + 1)
+
+        def cond(state):
+            return state[5] < num_tokens
+
+        state = (jnp.asarray(s0), cur, t_cache, d_cache, out,
+                 jnp.asarray(1), jnp.asarray(0))
+        pos, cur, _, _, out, n_out, rounds = lax.while_loop(
+            cond, round_body, state
+        )
+        return out[:, :num_tokens], n_out, rounds
+
+    return run
+
+
+def speculative_generate(
+    model: TransformerLM,
+    params,
+    draft_model: TransformerLM,
+    draft_params,
+    prompt: jnp.ndarray,          # (1, S0) int32 — latency path, B = 1
+    num_tokens: int,
+    *,
+    k: int = 4,
+    cache_dtype="float32",
+    return_stats: bool = False,
+):
+    """Greedy speculative decoding: a cheap draft proposes k-token
+    chains, the target verifies each chain with ONE cached block forward
+    (decode_block) and keeps the longest matching prefix — between 1 and
+    k target-quality tokens per target forward.
+
+    The output is EXACTLY the target's own greedy continuation — the
+    draft only changes the speed, never the tokens (the equality test
+    pins this against generate()). Both models must share the vocab;
+    the draft is typically shallower/narrower. B must be 1 (per-row
+    acceptance lengths diverge in a batch; speculation is the latency
+    lever, plain generate() the throughput one).
+
+    Returns tokens (1, num_tokens) int32 — or (tokens, stats) with
+    `return_stats=True`, where stats carries the verify-round count and
+    the mean accepted tokens per round (k = every chain fully accepted).
+    """
+    b, s0 = prompt.shape
+    if b != 1:
+        raise ValueError(f"speculative decoding is the B=1 latency path "
+                         f"(got batch {b}); use generate() for batches")
+    if num_tokens < 1:
+        raise ValueError("num_tokens must be >= 1")
+    if k < 2:
+        raise ValueError(f"k must be >= 2 (k={k} would draft nothing)")
+    if model.vocab != draft_model.vocab:
+        raise ValueError(
+            f"target vocab {model.vocab} != draft vocab {draft_model.vocab}"
+        )
+    if s0 + num_tokens + k > min(model.max_seq, draft_model.max_seq):
+        raise ValueError(
+            f"prompt {s0} + {num_tokens} tokens + k={k} speculative slack "
+            f"exceeds max_seq (target {model.max_seq}, draft "
+            f"{draft_model.max_seq}; BOTH caches hold every position)"
+        )
+    run = _compiled_spec_run(model, draft_model, s0, num_tokens, int(k),
+                             str(jnp.dtype(cache_dtype)))
+    toks, n_out, rounds = run(params, draft_params, prompt)
+    if return_stats:
+        # mean accepted tokens per verify round in [1, k]; k means every
+        # draft chain was fully accepted.
+        r = max(int(rounds), 1)
+        return toks, {"rounds": int(rounds),
+                      "mean_accepted": (int(n_out) - 1) / r}
+    return toks
 
 
 def generate(

@@ -1,0 +1,180 @@
+"""Speculative-decoding benchmark: plain greedy vs draft-verified.
+
+Decode at B=1 is latency-bound: every token pays a full sequential
+target forward. speculative_generate (models/generate.py) lets a cheap
+draft propose k-token chains the target verifies in ONE decode_block
+forward — tokens/s scales with the acceptance rate, and the output is
+bit-identical to plain greedy by construction (the equality test in
+tests/test_generate.py pins it; this bench asserts it again on the real
+run).
+
+Acceptance depends on how well the draft predicts the target, so the
+bench constructs the honest best case END TO END: both models train on
+the cyclic-successor corpus (the deterministic task the test suite's
+convergence tests use) until both predict it near-perfectly, then
+decode measures plain vs speculative at several k with the REAL
+acceptance the trained pair achieves — plus the random-draft worst case
+(acceptance ~1/vocab) so both ends of the curve are on record.
+
+One JSON line per row + a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_cnn_tpu.models.generate import generate, speculative_generate
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.train.lm import make_lm_state, make_lm_train_step
+from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+from mpi_cuda_cnn_tpu.utils.sync import hard_block, two_point
+
+
+def train_on_cycle(model, *, steps, batch, seq, lr=3e-3, seed=0):
+    """Fit `model` to token[t+1] = token[t] + 1 (mod vocab)."""
+    opt = make_optimizer(lr, opt="adamw", schedule="constant")
+    step_fn = make_lm_train_step(model, opt, attn_impl="oracle",
+                                 seq_len=seq)
+    state = make_lm_state(model, opt, seed)
+    rng = np.random.default_rng(seed)
+    loss = float("nan")
+    for _ in range(steps):
+        starts = rng.integers(0, model.vocab, size=(batch, 1))
+        w = (starts + np.arange(seq + 1)[None, :]) % model.vocab
+        toks = jnp.asarray(w, jnp.int32)
+        state, m = step_fn(state, toks[:, :-1], toks[:, 1:])
+        loss = m["loss"]
+    return state["params"], float(loss)
+
+
+def timed_tokens(fn, n):
+    """ms/token of a generate-style call via the shared two-point core:
+    fn(m) must produce m tokens and force completion."""
+
+    def run(m):
+        t0 = time.perf_counter()
+        hard_block(fn(m))
+        return time.perf_counter() - t0
+
+    run(n), run(2 * n)  # warm both program sizes
+    return two_point(run, n, warmup=0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--draft-dim", type=int, default=128)
+    ap.add_argument("--draft-depth", type=int, default=1)
+    ap.add_argument("--vocab", type=int, default=251)
+    ap.add_argument("--max-seq", type=int, default=2048)
+    ap.add_argument("--prompt", type=int, default=64)
+    ap.add_argument("--tokens", type=int, default=256)
+    ap.add_argument("--ks", default="2,4,8")
+    ap.add_argument("--train-steps", type=int, default=150)
+    ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    args = ap.parse_args()
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu" and jax.default_backend() != "tpu":
+        print("--device=tpu requested but the backend is "
+              f"{jax.default_backend()}", file=sys.stderr)
+        raise SystemExit(1)
+
+    target = TransformerLM(vocab=args.vocab, dim=args.dim,
+                           heads=args.heads, depth=args.depth,
+                           max_seq=args.max_seq)
+    draft = TransformerLM(vocab=args.vocab, dim=args.draft_dim,
+                          heads=2, depth=args.draft_depth,
+                          max_seq=args.max_seq)
+    t_params, t_loss = train_on_cycle(
+        target, steps=args.train_steps, batch=8, seq=128
+    )
+    d_params, d_loss = train_on_cycle(
+        draft, steps=4 * args.train_steps, batch=8, seq=128
+    )
+    prompt = jnp.asarray(
+        (np.arange(args.prompt)[None, :] % args.vocab), jnp.int32
+    )
+
+    t_plain = timed_tokens(
+        lambda m: generate(target, t_params, prompt, m), args.tokens
+    )
+    want = np.asarray(generate(target, t_params, prompt, args.tokens))
+    rows = [{
+        "bench": "speculative", "mode": "plain_greedy",
+        "ms_per_tok": round(t_plain * 1e3, 3),
+        "tokens_per_s": round(1.0 / t_plain),
+        "target_loss": round(t_loss, 4), "draft_loss": round(d_loss, 4),
+    }]
+    print(json.dumps(rows[0]), flush=True)
+
+    best = (rows[0]["tokens_per_s"], "plain")
+    for k in (int(x) for x in args.ks.split(",")):
+        got, stats = speculative_generate(
+            target, t_params, draft, d_params, prompt, args.tokens,
+            k=k, return_stats=True,
+        )
+        exact = bool(np.array_equal(np.asarray(got), want))
+        t_spec = timed_tokens(
+            lambda m: speculative_generate(
+                target, t_params, draft, d_params, prompt, m, k=k
+            ),
+            args.tokens,
+        )
+        row = {
+            "bench": "speculative", "mode": f"draft_k{k}",
+            "ms_per_tok": round(t_spec * 1e3, 3),
+            "tokens_per_s": round(1.0 / t_spec),
+            "mean_accepted": round(stats["mean_accepted"], 2),
+            "speedup_vs_plain": round(t_plain / t_spec, 2),
+            "greedy_exact": exact,
+        }
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+        if row["tokens_per_s"] > best[0] and exact:
+            best = (row["tokens_per_s"], f"k={k}")
+
+    # Worst case on record: an untrained draft accepts ~1/vocab.
+    rand = draft.init(jax.random.key(99))
+    _, rstats = speculative_generate(
+        target, t_params, draft, rand, prompt, args.tokens, k=4,
+        return_stats=True,
+    )
+    t_rand = timed_tokens(
+        lambda m: speculative_generate(
+            target, t_params, draft, rand, prompt, m, k=4
+        ),
+        args.tokens,
+    )
+    print(json.dumps({
+        "bench": "speculative", "mode": "random_draft_k4",
+        "ms_per_tok": round(t_rand * 1e3, 3),
+        "mean_accepted": round(rstats["mean_accepted"], 2),
+        "speedup_vs_plain": round(t_plain / t_rand, 2),
+    }), flush=True)
+
+    print(json.dumps({
+        "metric": "speculative_decode_tokens_per_s",
+        "value": best[0], "unit": "tokens/s", "config": best[1],
+        "plain_tokens_per_s": rows[0]["tokens_per_s"],
+        "model": f"d{args.dim}x{args.depth} draft d{args.draft_dim}x"
+                 f"{args.draft_depth} v{args.vocab} B=1",
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

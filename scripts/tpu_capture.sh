@@ -13,6 +13,8 @@
 #   7. bench_lm MoE row    (one measured MoE number; VERDICT #7)
 #   7b. bench_lm flagship  (head_dim-128 MFU config — 67.8% measured r4)
 #   8. bench_decode        (KV-cache tokens/s, GQA cache win; VERDICT #5)
+#   8b. bench_decode bf16 cache (the round-4 serving lever)
+#   8c. bench_speculative  (draft-verified greedy decode, bit-exact)
 #   9. profile_lm          (step-time attribution; VERDICT #3)
 #  10. make -C native test_tpu  (C driver on the chip)
 # Usage:  sh scripts/tpu_capture.sh   (from the repo root)
@@ -54,6 +56,9 @@ step bench_lm_moe 900 python scripts/bench_lm.py --quick --moe-experts 8 \
 step bench_lm_flagship 900 python scripts/bench_lm.py --quick --dim 4096 \
     --depth 3 --heads 32 --batch 2
 step bench_decode 900 python scripts/bench_decode.py
+step bench_decode_bf16 900 python scripts/bench_decode.py \
+    --cache-dtype bfloat16
+step bench_speculative 900 python scripts/bench_speculative.py
 step profile_lm 900 python scripts/profile_lm.py
 # make prints recipes/compiler lines on stdout — keep the JSONL clean by
 # sending this step's stdout to the log; its result is the note() line.

@@ -63,6 +63,70 @@ def test_bf16_cache_decode_close_and_really_bf16():
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < MODEL.vocab))
 
 
+def test_decode_block_matches_decode_steps():
+    """decode_block(k tokens) must equal k sequential decode_steps —
+    same logits, same cache — on MHA and on a GQA+RoPE model."""
+    from mpi_cuda_cnn_tpu.models.generate import decode_block
+
+    for m in (MODEL, TransformerLM(vocab=13, dim=32, heads=4, depth=2,
+                                   max_seq=48, kv_heads=2, pos="rope")):
+        params = m.init(jax.random.key(1))
+        toks = jnp.asarray(
+            np.random.default_rng(5).integers(0, 13, (2, 11)), jnp.int32
+        )
+        pre, blk = toks[:, :6], toks[:, 6:]
+
+        cache = init_cache(m, 2)
+        for i in range(6):
+            _, cache = decode_step(m, params, pre[:, i], i, cache)
+        want, want_cache = [], cache
+        for i in range(5):
+            l, want_cache = decode_step(m, params, blk[:, i], 6 + i,
+                                        want_cache)
+            want.append(l)
+        got, got_cache = decode_block(m, params, blk, 6, cache)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(jnp.stack(want, axis=1)),
+                                   rtol=2e-4, atol=2e-4)
+        for a, b in zip(jax.tree.leaves(got_cache),
+                        jax.tree.leaves(want_cache)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_speculative_equals_greedy():
+    """The gold property of greedy speculative decoding: the output is
+    EXACTLY the target's own greedy continuation, for ANY draft — an
+    untrained random draft (near-zero acceptance), the target itself
+    (full acceptance), and a differently-shaped draft, at several k."""
+    from mpi_cuda_cnn_tpu.models.generate import speculative_generate
+
+    params = MODEL.init(jax.random.key(0))
+    prompt = jnp.asarray([[1, 2, 3, 4, 5]], jnp.int32)
+    want = np.asarray(generate(MODEL, params, prompt, 10))
+
+    drafts = [
+        (MODEL, MODEL.init(jax.random.key(9))),        # random weights
+        (MODEL, params),                               # perfect draft
+        (TransformerLM(vocab=13, dim=16, heads=2, depth=1, max_seq=48),
+         None),                                        # shallower draft
+    ]
+    for k in (2, 4):
+        for dm, dp in drafts:
+            dp = dm.init(jax.random.key(3)) if dp is None else dp
+            got = speculative_generate(MODEL, params, dm, dp, prompt, 10,
+                                       k=k)
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+    with pytest.raises(ValueError, match="B=1"):
+        speculative_generate(MODEL, params, MODEL, params,
+                             jnp.asarray([[1], [2]], jnp.int32), 4)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = TransformerLM(vocab=7, dim=16, heads=2, depth=1, max_seq=48)
+        speculative_generate(MODEL, params, bad, bad.init(jax.random.key(0)),
+                             prompt, 4)
+
+
 def test_generate_shapes_and_budget():
     params = MODEL.init(jax.random.key(0))
     prompt = jnp.asarray([[1, 2, 3], [7, 8, 9]], jnp.int32)
