@@ -98,8 +98,6 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
     out-of-range pos raises here, a traced one cannot be checked).
     Returns (logits: (B, vocab), new_cache).
     """
-    if isinstance(pos, int) and pos >= model.max_seq:
-        raise ValueError(f"position {pos} out of range (max_seq {model.max_seq})")
     logits, new_cache = decode_block(model, params, tok[:, None], pos, cache)
     return logits[:, 0, :], new_cache
 
@@ -109,14 +107,22 @@ def decode_block(model: TransformerLM, params, toks, pos, cache):
     form of decode_step, for speculative verification — ONE forward
     scores k candidate tokens instead of k sequential decode steps.
 
-    toks: (B, k) int32; pos: start position (traced scalar OK). Writes
-    all k cache slots FIRST, then attends each row i over keys
-    <= pos+i — so within-block causality holds and any stale entries
-    beyond the accepted prefix from a previous speculative round are
-    either overwritten here or masked by the row bound.
+    toks: (B, k) int32; pos: start position (traced scalar OK; a
+    concrete out-of-range block raises here — dynamic_update_slice
+    would otherwise clamp the write start while positions/RoPE/mask use
+    the unclamped pos, silently corrupting the cache). Writes all k
+    cache slots FIRST, then attends each row i over keys <= pos+i — so
+    within-block causality holds and any stale entries beyond the
+    accepted prefix from a previous speculative round are either
+    overwritten here or masked by the row bound.
     Returns (logits: (B, k, vocab), new_cache).
     """
     b, kk = toks.shape
+    if isinstance(pos, int) and pos + kk > model.max_seq:
+        raise ValueError(
+            f"block [{pos}, {pos + kk}) out of range (max_seq "
+            f"{model.max_seq})"
+        )
     h, hd, hkv = model.heads, model.head_dim, model.n_kv
     x = params["tok_emb"][toks]                           # (B, k, dim)
     positions = pos + jnp.arange(kk)
@@ -174,9 +180,37 @@ def decode_block(model: TransformerLM, params, toks, pos, cache):
     return (x @ params["head"]).astype(jnp.float32), new_cache
 
 
+def filter_logits(logits, top_k: int = 0, top_p: float = 0.0):
+    """Top-k / nucleus (top-p) restriction: logits outside the kept set
+    go to NEG_INF. top_k keeps the k largest (ties at the boundary all
+    survive — the standard threshold form); top_p keeps the smallest
+    prefix of the probability-sorted vocabulary whose mass reaches p.
+    Both may combine; 0 disables either. Pure and shape-preserving, so
+    it composes with jax.random.categorical and jits inside the decode
+    scan."""
+    l = logits.astype(jnp.float32)
+    if top_k:
+        thr = jnp.sort(l, axis=-1)[..., -top_k, None]
+        l = jnp.where(l >= thr, l, NEG_INF)
+    if top_p:
+        sorted_l = jnp.sort(l, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        # Mass BEFORE each token: tokens whose preceding cumulative mass
+        # already reaches p are cut; the boundary token stays (the set
+        # must reach p, not stop short of it).
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        kept = cum_before < top_p
+        cutoff = jnp.min(
+            jnp.where(kept, sorted_l, jnp.inf), axis=-1, keepdims=True
+        )
+        l = jnp.where(l >= cutoff, l, NEG_INF)
+    return l
+
+
 @functools.lru_cache(maxsize=64)
 def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
-                  temperature: float, cache_dtype: str):
+                  temperature: float, cache_dtype: str,
+                  top_k: int, top_p: float):
     """One jitted prefill+scan program per (model, shape, sampling,
     cache dtype) combination — repeat generate() calls hit this cache
     instead of retracing."""
@@ -185,9 +219,13 @@ def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
     def sample(logits, k):
         if temperature <= 0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
+        # Temperature FIRST, then the nucleus: the kept set must be
+        # computed on the distribution actually sampled (top_p on the
+        # flattened T>1 distribution keeps more tokens — the standard
+        # semantics; top_k is temperature-invariant either way).
+        l = filter_logits(logits.astype(jnp.float32) / temperature,
+                          top_k, top_p)
+        return jax.random.categorical(k, l, axis=-1).astype(jnp.int32)
 
     def gen_body(params):
         def body(carry, i):
@@ -292,9 +330,15 @@ def speculative_generate(
     (decode_block) and keeps the longest matching prefix — between 1 and
     k target-quality tokens per target forward.
 
-    The output is EXACTLY the target's own greedy continuation — the
-    draft only changes the speed, never the tokens (the equality test
-    pins this against generate()). Both models must share the vocab;
+    The output is the target's own greedy continuation — the draft
+    changes the speed, not the tokens. Precision caveat, stated
+    exactly: decode_block's batched contractions may tile/reassociate
+    differently from the plain decode scan's, so the two paths agree to
+    float rounding (~1e-4 observed), not bitwise; an argmax whose top-2
+    logits tie within that drift could in principle differ. The
+    equality test (tests/test_generate.py) and the bench's in-run
+    assert have never observed a divergence. Both models must share the
+    vocab;
     the draft is typically shallower/narrower. B must be 1 (per-row
     acceptance lengths diverge in a batch; speculation is the latency
     lever, plain generate() the throughput one).
@@ -342,15 +386,19 @@ def generate(
     temperature: float = 0.0,
     key: jax.Array | None = None,
     cache_dtype="float32",
+    top_k: int = 0,
+    top_p: float = 0.0,
 ):
     """Prefill the prompt (one batched forward), then sample `num_tokens`
     continuations with the KV-cached decode scan.
 
     Returns (B, num_tokens) int32. Greedy argmax at temperature 0,
-    categorical sampling otherwise (key required). Prompt length +
-    num_tokens must fit max_seq. `cache_dtype` "bfloat16" halves the KV
-    cache bytes decode reads per token (attention scores/softmax stay
-    f32); f32 is the exactness default the parity tests pin.
+    categorical sampling otherwise (key required), optionally restricted
+    by `top_k` (k most likely) and/or `top_p` (nucleus: smallest set
+    reaching mass p) — see filter_logits. Prompt length + num_tokens
+    must fit max_seq. `cache_dtype` "bfloat16" halves the KV cache bytes
+    decode reads per token (attention scores/softmax stay f32); f32 is
+    the exactness default the parity tests pin.
     """
     b, s0 = prompt.shape
     if num_tokens < 1:
@@ -362,8 +410,18 @@ def generate(
         )
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if top_k < 0 or top_k > model.vocab:
+        raise ValueError(f"top_k {top_k} not in [0, vocab {model.vocab}]")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p {top_p} not in [0, 1]")
+    if (top_k or top_p) and temperature <= 0:
+        raise ValueError(
+            "top_k/top_p restrict SAMPLING — set temperature > 0 "
+            "(greedy argmax already takes the single most likely token)"
+        )
     if key is None:
         key = jax.random.key(0)  # unused at temperature 0
     run = _compiled_run(model, s0, num_tokens, float(temperature),
-                        str(jnp.dtype(cache_dtype)))
+                        str(jnp.dtype(cache_dtype)), int(top_k),
+                        float(top_p))
     return run(params, prompt, key)

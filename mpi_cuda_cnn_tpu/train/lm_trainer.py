@@ -114,6 +114,18 @@ class LMTrainer:
                 f"--decode-cache-dtype {cfg.decode_cache_dtype!r} must "
                 "be 'float32' or 'bfloat16'"
             )
+        if cfg.sample_top_k < 0 or not 0.0 <= cfg.sample_top_p <= 1.0:
+            raise ValueError(
+                f"--sample-top-k {cfg.sample_top_k} must be >= 0 and "
+                f"--sample-top-p {cfg.sample_top_p} in [0, 1]"
+            )
+        if (cfg.sample_top_k or cfg.sample_top_p) and \
+                cfg.sample_temperature <= 0:
+            raise ValueError(
+                "--sample-top-k/--sample-top-p restrict SAMPLING — set "
+                "--sample-temperature > 0 (greedy already takes the "
+                "single most likely token)"
+            )
 
         self.model = TransformerLM(
             vocab=vocab, dim=cfg.dim, heads=cfg.heads, depth=cfg.depth,
@@ -633,6 +645,7 @@ class LMTrainer:
             temperature=temperature,
             key=jax.random.key(seed) if temperature > 0 else None,
             cache_dtype=cfg.decode_cache_dtype,
+            top_k=cfg.sample_top_k, top_p=cfg.sample_top_p,
         )
         return np.asarray(prompt[0]), np.asarray(toks[0])
 

@@ -170,6 +170,10 @@ class LMConfig(_JsonConfig):
                                      # stream with the KV-cache decode
                                      # path and print the continuation
     sample_temperature: float = 0.0  # 0 = greedy argmax
+    sample_top_k: int = 0            # >0: sample among the k most likely
+    sample_top_p: float = 0.0        # >0: nucleus sampling (smallest
+                                     # set reaching mass p); both compose
+                                     # and need --sample-temperature > 0
     decode_cache_dtype: str = "float32"  # "bfloat16" halves the decode
                                      # KV-cache bytes (decode is cache-
                                      # read-bound: PERF.md decode table);

@@ -63,6 +63,42 @@ def test_bf16_cache_decode_close_and_really_bf16():
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < MODEL.vocab))
 
 
+def test_filter_logits_and_restricted_sampling():
+    """filter_logits: top_k keeps exactly the k largest (plus boundary
+    ties), top_p the smallest prefix reaching mass p; generate() with
+    top_k=1 at temperature > 0 equals greedy (the restriction leaves one
+    candidate), and sampled tokens stay inside the top_k set."""
+    from mpi_cuda_cnn_tpu.models.generate import filter_logits
+    from mpi_cuda_cnn_tpu.ops.attention import NEG_INF
+
+    l = jnp.asarray([[2.0, -1.0, 3.0, 0.5, -2.0]])
+    k2 = np.asarray(filter_logits(l, top_k=2))
+    assert (k2[0] > NEG_INF / 2).tolist() == [True, False, True, False, False]
+
+    # probs of l: softmax — top_p just over the largest prob keeps the
+    # top-2; a tiny top_p keeps exactly the argmax.
+    p = np.asarray(jax.nn.softmax(l, axis=-1))[0]
+    keep2 = np.asarray(filter_logits(l, top_p=float(p.max()) + 1e-3))
+    assert (keep2[0] > NEG_INF / 2).tolist() == [True, False, True, False, False]
+    keep1 = np.asarray(filter_logits(l, top_p=1e-6))
+    assert (keep1[0] > NEG_INF / 2).tolist() == [False, False, True, False, False]
+    # top_p=1 keeps everything.
+    assert (np.asarray(filter_logits(l, top_p=1.0))[0] > NEG_INF / 2).all()
+
+    params = MODEL.init(jax.random.key(0))
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    greedy = np.asarray(generate(MODEL, params, prompt, 6))
+    k1 = np.asarray(generate(MODEL, params, prompt, 6, temperature=1.0,
+                             key=jax.random.key(7), top_k=1))
+    np.testing.assert_array_equal(k1, greedy)
+
+    with pytest.raises(ValueError, match="temperature"):
+        generate(MODEL, params, prompt, 2, top_k=3)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(MODEL, params, prompt, 2, temperature=1.0, top_p=1.5,
+                 key=jax.random.key(0))
+
+
 def test_decode_block_matches_decode_steps():
     """decode_block(k tokens) must equal k sequential decode_steps —
     same logits, same cache — on MHA and on a GQA+RoPE model."""
