@@ -254,6 +254,20 @@ def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
     return run
 
 
+def _accept_and_emit(u, y, out, n_out):
+    """The speculative acceptance core, shared by the model-draft and
+    prompt-lookup runners so the two can never drift: u (1, k) verify
+    inputs, y (1, k) target picks. Accept the longest prefix where input
+    i+1 equals the target's pick at row i (j in [1, k] tokens), write
+    ALL k picks at n_out (rows beyond j are rewritten by the next
+    round's write), return (j, new cur token, out)."""
+    matches = u[0, 1:] == y[0, :-1]
+    j = 1 + jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+    out = lax.dynamic_update_slice(out, y, (0, n_out))
+    cur = lax.dynamic_slice(y, (0, j - 1), (1, 1))[:, 0]
+    return j, cur, out
+
+
 @functools.lru_cache(maxsize=16)
 def _compiled_spec_run(model: TransformerLM, draft: TransformerLM,
                        s0: int, num_tokens: int, k: int, cache_dtype: str):
@@ -289,14 +303,8 @@ def _compiled_spec_run(model: TransformerLM, draft: TransformerLM,
             # 2. One target block forward scores all k inputs.
             tl, t_cache = decode_block(model, params, u, pos, t_cache)
             y = jnp.argmax(tl, axis=-1).astype(jnp.int32)     # (1, k)
-            # 3. Longest accepted prefix: input i+1 must equal the
-            #    target's pick at row i. j in [1, k] tokens emit.
-            matches = u[0, 1:] == y[0, :-1]                   # (k-1,)
-            j = 1 + jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
-            # 4. Emit: write all k picks at n_out; only advance by j —
-            #    rows beyond j are rewritten by the next round.
-            out = lax.dynamic_update_slice(out, y, (0, n_out))
-            cur = lax.dynamic_slice(y, (0, j - 1), (1, 1))[:, 0]
+            # 3./4. Shared acceptance + buffered emit (_accept_and_emit).
+            j, cur, out = _accept_and_emit(u, y, out, n_out)
             return (pos + j, cur, t_cache, d_cache, out, n_out + j,
                     rounds + 1)
 
@@ -311,6 +319,118 @@ def _compiled_spec_run(model: TransformerLM, draft: TransformerLM,
         return out[:, :num_tokens], n_out, rounds
 
     return run
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_lookup_run(model: TransformerLM, s0: int, num_tokens: int,
+                         k: int, ngram: int, cache_dtype: str):
+    """Jitted prompt-lookup speculative loop (draft-free)."""
+    cdt = jnp.dtype(cache_dtype)
+    L = model.max_seq
+
+    @jax.jit
+    def run(params, prompt):
+        tl, t_cache = prefill(model, params, prompt, cache_dtype=cdt)
+        cur = jnp.argmax(tl, axis=-1).astype(jnp.int32)       # (1,)
+        ctx = jnp.zeros((1, L), jnp.int32)
+        ctx = lax.dynamic_update_slice(ctx, prompt, (0, 0))
+        ctx = lax.dynamic_update_slice(ctx, cur[:, None], (0, s0))
+        out = jnp.zeros((1, num_tokens + k), jnp.int32)
+        out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
+
+        def propose(ctx, pos, cur):
+            """The k-1 tokens that followed the MOST RECENT earlier
+            occurrence of the context's current ngram-token tail
+            (ctx[pos] == cur is already written). No match -> repeat
+            cur: acceptance just collapses to 1, never an error."""
+            idx = jnp.arange(L)
+            match = (idx >= ngram - 1) & (idx < pos)
+            row = ctx[0]
+            for d in range(ngram):
+                # row[j-d] vs row[pos-d]; jnp.roll wraps for j < d but
+                # those rows are outside the idx >= ngram-1 window.
+                match &= jnp.roll(row, d) == row[pos - d]
+            j = jnp.max(jnp.where(match, idx, -1))
+            start = jnp.clip(j + 1, 0, L - (k - 1))
+            props = lax.dynamic_slice(ctx, (0, start), (1, k - 1))[0]
+            return jnp.where(j >= 0, props,
+                             jnp.broadcast_to(cur, (k - 1,)))
+
+        def round_body(state):
+            pos, cur, t_cache, ctx, out, n_out, rounds = state
+            props = propose(ctx, pos, cur)
+            u = jnp.concatenate([cur, props])[None, :]        # (1, k)
+            tl, t_cache = decode_block(model, params, u, pos, t_cache)
+            y = jnp.argmax(tl, axis=-1).astype(jnp.int32)
+            j, cur, out = _accept_and_emit(u, y, out, n_out)
+            # Keep the context buffer current: the accepted picks land
+            # at pos+1.. (rows beyond j overwritten next round, same
+            # trick as `out`; ctx[pos+j] == new cur by construction).
+            ctx = lax.dynamic_update_slice(ctx, y, (0, pos + 1))
+            return (pos + j, cur, t_cache, ctx, out, n_out + j,
+                    rounds + 1)
+
+        def cond(state):
+            return state[5] < num_tokens
+
+        state = (jnp.asarray(s0), cur, t_cache, ctx, out,
+                 jnp.asarray(1), jnp.asarray(0))
+        pos, cur, _, _, out, n_out, rounds = lax.while_loop(
+            cond, round_body, state
+        )
+        return out[:, :num_tokens], n_out, rounds
+
+    return run
+
+
+def lookup_speculative_generate(
+    model: TransformerLM,
+    params,
+    prompt: jnp.ndarray,          # (1, S0) int32 — latency path, B = 1
+    num_tokens: int,
+    *,
+    k: int = 8,
+    ngram: int = 2,
+    cache_dtype="float32",
+    return_stats: bool = False,
+):
+    """Draft-FREE greedy speculative decoding (prompt lookup): propose
+    the k-1 tokens that followed the most recent earlier occurrence of
+    the current n-gram in the running context (prompt + generated), and
+    verify with the same one-block-forward machinery as
+    speculative_generate. No second model — this is the form the lm
+    CLI's --sample-speculative-k reaches — and it shines on repetitive
+    text (code, logs, structured data), where the continuation often
+    already appeared verbatim. Same greedy-exactness contract and
+    precision caveat as speculative_generate; same B=1 restriction.
+    """
+    b, s0 = prompt.shape
+    if b != 1:
+        raise ValueError(f"speculative decoding is the B=1 latency path "
+                         f"(got batch {b}); use generate() for batches")
+    if num_tokens < 1:
+        raise ValueError("num_tokens must be >= 1")
+    if k < 2:
+        raise ValueError(f"k must be >= 2 (k={k} would propose nothing)")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1 (got {ngram})")
+    if s0 < ngram:
+        raise ValueError(
+            f"prompt length {s0} shorter than the lookup ngram {ngram}"
+        )
+    if s0 + num_tokens + k > model.max_seq:
+        raise ValueError(
+            f"prompt {s0} + {num_tokens} tokens + k={k} speculative slack "
+            f"exceeds max_seq {model.max_seq}"
+        )
+    run = _compiled_lookup_run(model, s0, num_tokens, int(k), int(ngram),
+                               str(jnp.dtype(cache_dtype)))
+    toks, n_out, rounds = run(params, prompt)
+    if return_stats:
+        r = max(int(rounds), 1)
+        return toks, {"rounds": int(rounds),
+                      "mean_accepted": (int(n_out) - 1) / r}
+    return toks
 
 
 def speculative_generate(

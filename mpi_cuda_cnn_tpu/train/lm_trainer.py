@@ -126,6 +126,28 @@ class LMTrainer:
                 "--sample-temperature > 0 (greedy already takes the "
                 "single most likely token)"
             )
+        if cfg.sample_speculative_k:
+            if cfg.sample_speculative_k < 2:
+                raise ValueError(
+                    f"--sample-speculative-k {cfg.sample_speculative_k} "
+                    "must be >= 2 (the verify block needs proposals)"
+                )
+            if cfg.sample_temperature > 0:
+                raise ValueError(
+                    "--sample-speculative-k is greedy-only (acceptance "
+                    "compares argmax picks); drop --sample-temperature"
+                )
+            if cfg.sample_tokens and cfg.sample_tokens + \
+                    cfg.sample_speculative_k + 2 > cfg.seq_len:
+                # The same fail-NOW rationale as the checks above: the
+                # verify block needs k positions of cache slack beyond
+                # prompt (>= 2) + tokens, and sample() runs after the
+                # whole training run.
+                raise ValueError(
+                    f"--sample-tokens {cfg.sample_tokens} + speculative "
+                    f"slack k={cfg.sample_speculative_k} + a >= 2-token "
+                    f"prompt exceeds seq_len {cfg.seq_len}"
+                )
 
         self.model = TransformerLM(
             vocab=vocab, dim=cfg.dim, heads=cfg.heads, depth=cfg.depth,
@@ -615,11 +637,17 @@ class LMTrainer:
         from ..models.generate import generate
 
         cfg = self.cfg
-        max_prompt = cfg.seq_len - num_tokens
-        if max_prompt < 1:
+        # Speculative decoding needs k positions of cache slack beyond
+        # prompt + num_tokens (the verify block may overshoot); shrink
+        # the prompt, not k.
+        spec_k = cfg.sample_speculative_k
+        max_prompt = cfg.seq_len - num_tokens - spec_k
+        if max_prompt < (2 if spec_k else 1):
             raise ValueError(
-                f"--sample-tokens {num_tokens} leaves no room for a prompt "
-                f"within seq_len {cfg.seq_len}"
+                f"--sample-tokens {num_tokens}"
+                + (f" + speculative slack k={spec_k}" if spec_k else "")
+                + f" leaves no room for a prompt within seq_len "
+                f"{cfg.seq_len}"
             )
         p = min(prompt_len or max(cfg.seq_len // 2, 1), max_prompt)
         stream = (
@@ -640,13 +668,31 @@ class LMTrainer:
                 from ..parallel.tp import shard_lm_params
 
                 params = shard_lm_params(self.model, params, self.mesh)
-        toks = generate(
-            self.model, params, prompt, num_tokens,
-            temperature=temperature,
-            key=jax.random.key(seed) if temperature > 0 else None,
-            cache_dtype=cfg.decode_cache_dtype,
-            top_k=cfg.sample_top_k, top_p=cfg.sample_top_p,
-        )
+        if cfg.sample_speculative_k:
+            # Draft-free prompt-lookup speculation (greedy; validated at
+            # construction — and for programmatic callers here too: the
+            # CLI path can't reach this with temperature > 0, a direct
+            # sample(..., temperature=) call could).
+            if temperature > 0:
+                raise ValueError(
+                    "speculative sampling is greedy-only; call with "
+                    "temperature=0 or unset sample_speculative_k"
+                )
+            from ..models.generate import lookup_speculative_generate
+
+            toks = lookup_speculative_generate(
+                self.model, params, prompt, num_tokens,
+                k=cfg.sample_speculative_k,
+                cache_dtype=cfg.decode_cache_dtype,
+            )
+        else:
+            toks = generate(
+                self.model, params, prompt, num_tokens,
+                temperature=temperature,
+                key=jax.random.key(seed) if temperature > 0 else None,
+                cache_dtype=cfg.decode_cache_dtype,
+                top_k=cfg.sample_top_k, top_p=cfg.sample_top_p,
+            )
         return np.asarray(prompt[0]), np.asarray(toks[0])
 
     def evaluate(self) -> float:

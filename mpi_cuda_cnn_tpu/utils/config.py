@@ -174,6 +174,10 @@ class LMConfig(_JsonConfig):
     sample_top_p: float = 0.0        # >0: nucleus sampling (smallest
                                      # set reaching mass p); both compose
                                      # and need --sample-temperature > 0
+    sample_speculative_k: int = 0    # >=2: draft-free prompt-lookup
+                                     # speculative decoding with k-token
+                                     # verify blocks (greedy only —
+                                     # models/generate.py)
     decode_cache_dtype: str = "float32"  # "bfloat16" halves the decode
                                      # KV-cache bytes (decode is cache-
                                      # read-bound: PERF.md decode table);

@@ -57,9 +57,12 @@ def train_on_cycle(model, *, steps, batch, seq, lr=3e-3, seed=0):
     return state["params"], float(loss)
 
 
-def timed_tokens(fn, n):
-    """ms/token of a generate-style call via the shared two-point core:
-    fn(m) must produce m tokens and force completion."""
+def timed_tokens(fn, n, attempts=3):
+    """s/token of a generate-style call via the shared two-point core:
+    fn(m) must produce m tokens and force completion. A backend
+    transient can push even the median-of-3 slope negative (observed:
+    a banked -0.095 ms/tok row) — a physically impossible value is
+    re-measured, never emitted."""
 
     def run(m):
         t0 = time.perf_counter()
@@ -67,7 +70,14 @@ def timed_tokens(fn, n):
         return time.perf_counter() - t0
 
     run(n), run(2 * n)  # warm both program sizes
-    return two_point(run, n, warmup=0)
+    for _ in range(attempts):
+        t = two_point(run, n, warmup=0)
+        if t > 0:
+            return t
+    raise RuntimeError(
+        f"two-point slope stayed non-positive over {attempts} "
+        "median-of-3 attempts — backend too unstable to measure"
+    )
 
 
 def main():
@@ -146,6 +156,42 @@ def main():
         rows.append(row)
         if row["tokens_per_s"] > best[0] and exact:
             best = (row["tokens_per_s"], f"k={k}")
+
+    # Draft-FREE prompt-lookup speculation (the CLI-reachable form):
+    # needs the continuation's n-grams to have earlier occurrences, so
+    # its prompt spans > one full cycle of the corpus.
+    from mpi_cuda_cnn_tpu.models.generate import lookup_speculative_generate
+
+    lk_prompt = jnp.asarray(
+        (np.arange(args.vocab + 49)[None, :] % args.vocab), jnp.int32
+    )
+    lk_want = np.asarray(generate(target, t_params, lk_prompt, args.tokens))
+    lk_plain = timed_tokens(
+        lambda m: generate(target, t_params, lk_prompt, m), args.tokens
+    )
+    for k in (int(x) for x in args.ks.split(",")):
+        lk_toks, lstats = lookup_speculative_generate(
+            target, t_params, lk_prompt, args.tokens, k=k,
+            return_stats=True,
+        )
+        lk_got = np.asarray(lk_toks)
+        t_lk = timed_tokens(
+            lambda m: lookup_speculative_generate(
+                target, t_params, lk_prompt, m, k=k
+            ),
+            args.tokens,
+        )
+        row = {
+            "bench": "speculative", "mode": f"lookup_k{k}",
+            "ms_per_tok": round(t_lk * 1e3, 3),
+            "tokens_per_s": round(1.0 / t_lk),
+            "mean_accepted": round(lstats["mean_accepted"], 2),
+            "speedup_vs_plain": round(lk_plain / t_lk, 2),
+            "greedy_exact": bool(np.array_equal(lk_got, lk_want)),
+        }
+        print(json.dumps(row), flush=True)
+        if row["tokens_per_s"] > best[0] and row["greedy_exact"]:
+            best = (row["tokens_per_s"], f"lookup_k{k}")
 
     # Worst case on record: an untrained draft accepts ~1/vocab.
     rand = draft.init(jax.random.key(99))
