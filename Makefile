@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode profile_lm test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative profile_lm test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -30,7 +30,7 @@ test_native_tpu: native
 # 5-min bar WITHOUT xdist on a quiet box; multicore boxes divide
 # further. Every skipped subsystem keeps a fast representative
 # (or a dryrun_multichip path with a serial-parity assert); `make
-# test_all` is the full superset (338 tests, 32:00 measured serial).
+# test_all` is the full superset (343 tests, 32:00 measured serial).
 # pytest-xdist is optional: fan out when importable, serial otherwise.
 XDIST := $(shell $(PY) -c "import xdist" 2>/dev/null && echo "-n auto")
 
@@ -120,6 +120,12 @@ bench_lm:
 # MHA vs GQA vs MQA cache sizes (two-point timing; scripts/bench_decode.py).
 bench_decode:
 	$(PY) scripts/bench_decode.py
+
+# Speculative decoding benchmark: plain greedy vs model-draft vs draft-free
+# prompt-lookup, acceptance measured end to end on trained models; output
+# exactness asserted in-run (scripts/bench_speculative.py).
+bench_speculative:
+	$(PY) scripts/bench_speculative.py
 
 # Step-time attribution by ablation (full vs fwd-only vs identity-attn vs
 # no-head vs chunked-CE) — where the LM step's milliseconds go.
