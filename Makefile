@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative profile_lm test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative profile_lm profile_moe test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -71,14 +71,20 @@ test_ep8:
 
 # LM pipe x model x seq e2e smoke: Megatron blocks inside GPipe stages
 # with ring attention over the sequence shards. Three of the four axes
-# — 8 virtual devices can't also fit data:2 (16 needed); the step's
-# data-axis handling is the same pmean the 3-axis run already executes
-# over 'seq', and pipe x model x data IS covered (test_tp_pp_lm.py).
+# — 8 virtual devices can't also fit data:2; the FULL 2x2x2x2
+# composition runs on 16 virtual devices via `make test_4d16` (serial
+# parity asserted) and in dryrun path 15b.
 test_4d8:
 	$(CPU8) $(PY) -m mpi_cuda_cnn_tpu lm --device cpu --corpus self \
 	  --dim 64 --depth 4 --heads 8 --seq-len 128 --steps 20 \
 	  --batch-size 4 --mesh-shape pipe:2,model:2,seq:2 --grad-clip 1.0 \
 	  --ce-chunk 32 --log-every 10
+
+# The FULL 4D mesh — all four axes populated (pipe:2,model:2,seq:2,data:2
+# = 16 virtual devices): one train step, exact serial parity (loss +
+# updated params). The worker forces its own device count.
+test_4d16:
+	$(PY) scripts/fourd16_worker.py
 
 # LM training on the visible accelerator (bf16 + flash kernel on TPU).
 test_lm_tpu:
@@ -131,6 +137,12 @@ bench_speculative:
 # no-head vs chunked-CE) — where the LM step's milliseconds go.
 profile_lm:
 	$(PY) scripts/profile_lm.py
+
+# MoE component attribution (router/dispatch-einsum/expert-FFN/combine in
+# isolation + the moe_mlp body per dispatch_chunk + E x cf sweep) — the
+# single-chip quadratic-dispatch evidence (scripts/profile_moe.py).
+profile_moe:
+	$(PY) scripts/profile_moe.py --sweep
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

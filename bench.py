@@ -63,23 +63,26 @@ def _run() -> None:
     )
 
     trainer.run_epoch(0)  # warmup: stages the dataset + compiles the scan
-    # Best of 3 measured epochs: the TPU tunnel in this environment adds
-    # run-to-run dispatch jitter (~15%); the minimum is the steady state.
+    # Median of 5 measured epochs: the TPU tunnel in this environment
+    # adds run-to-run dispatch jitter (spreads up to ~36% observed), and
+    # every shipped measurement bug in this repo's history erred in the
+    # optimistic direction (utils/sync.py docstring) — the median is the
+    # honest steady state; the fastest epoch stays as a secondary field.
     times = []
-    for epoch in (1, 2, 3):
+    for epoch in (1, 2, 3, 4, 5):
         t0 = time.perf_counter()
         trainer.run_epoch(epoch)
         times.append(time.perf_counter() - t0)
-    epoch_s = min(times)
-    median_s = sorted(times)[len(times) // 2]
+    times.sort()
+    epoch_s = times[len(times) // 2]
 
     print(json.dumps({
         "metric": "mnist_epoch_wallclock",
         "value": round(epoch_s, 3),
         "unit": "s",
         "vs_baseline": round(REFERENCE_EPOCH_S / epoch_s, 2),
-        "median_s": round(median_s, 3),
-        "note": "value = best of 3 epochs; median_s = median of the same 3",
+        "best_s": round(times[0], 3),
+        "note": "value = median of 5 epochs; best_s = fastest of the same 5",
     }))
 
 

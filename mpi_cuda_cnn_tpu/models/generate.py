@@ -183,14 +183,15 @@ def decode_block(model: TransformerLM, params, toks, pos, cache):
 def filter_logits(logits, top_k: int = 0, top_p: float = 0.0):
     """Top-k / nucleus (top-p) restriction: logits outside the kept set
     go to NEG_INF. top_k keeps the k largest (ties at the boundary all
-    survive — the standard threshold form); top_p keeps the smallest
-    prefix of the probability-sorted vocabulary whose mass reaches p.
-    Both may combine; 0 disables either. Pure and shape-preserving, so
-    it composes with jax.random.categorical and jits inside the decode
-    scan."""
+    survive — the standard threshold form; values above the vocab size
+    clamp to it — keeping everything — instead of indexing out of
+    range); top_p keeps the smallest prefix of the probability-sorted
+    vocabulary whose mass reaches p. Both may combine; 0 disables
+    either. Pure and shape-preserving, so it composes with
+    jax.random.categorical and jits inside the decode scan."""
     l = logits.astype(jnp.float32)
     if top_k:
-        thr = jnp.sort(l, axis=-1)[..., -top_k, None]
+        thr = jnp.sort(l, axis=-1)[..., -min(top_k, l.shape[-1]), None]
         l = jnp.where(l >= thr, l, NEG_INF)
     if top_p:
         sorted_l = jnp.sort(l, axis=-1)[..., ::-1]
@@ -254,66 +255,149 @@ def _compiled_run(model: TransformerLM, s0: int, num_tokens: int,
     return run
 
 
-def _accept_and_emit(u, y, out, n_out):
-    """The speculative acceptance core, shared by the model-draft and
-    prompt-lookup runners so the two can never drift: u (1, k) verify
-    inputs, y (1, k) target picks. Accept the longest prefix where input
-    i+1 equals the target's pick at row i (j in [1, k] tokens), write
-    ALL k picks at n_out (rows beyond j are rewritten by the next
-    round's write), return (j, new cur token, out)."""
-    matches = u[0, 1:] == y[0, :-1]
-    j = 1 + jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+def _emit_rows(y, accept, out, n_out):
+    """Buffered emit shared by the greedy and sampling acceptance paths:
+    y (1, k) emit rows, accept (k-1,) bool prefix flags. j = 1 + the
+    accepted prefix length (row j-1 is the first-reject replacement or
+    the bonus row); ALL k rows are written at n_out — rows beyond j are
+    rewritten by the next round's write. Returns (j, new cur, out)."""
+    j = 1 + jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
     out = lax.dynamic_update_slice(out, y, (0, n_out))
     cur = lax.dynamic_slice(y, (0, j - 1), (1, 1))[:, 0]
     return j, cur, out
 
 
+def _accept_and_emit(u, y, out, n_out):
+    """The GREEDY speculative acceptance core, shared by the model-draft
+    and prompt-lookup runners so the two can never drift: u (1, k)
+    verify inputs, y (1, k) target argmax picks. Accept the longest
+    prefix where input i+1 equals the target's pick at row i (j in
+    [1, k] tokens emitted per round)."""
+    matches = u[0, 1:] == y[0, :-1]
+    return _emit_rows(y, matches, out, n_out)
+
+
+def _filtered_probs(logits, temperature, top_k, top_p):
+    """f32 probabilities of temperature-scaled, top-k/top-p-restricted
+    logits — the distribution `generate()` actually samples from; the
+    speculative sampling paths must target exactly this law."""
+    l = filter_logits(logits.astype(jnp.float32) / temperature, top_k, top_p)
+    return jax.nn.softmax(l, axis=-1)
+
+
+def _spec_sample_rows(tl, qs, u, key, temperature, top_k, top_p):
+    """Rejection-sampling acceptance for one verify block (B=1) — the
+    T>0 analog of _accept_and_emit's matching, implementing the standard
+    speculative sampling theorem (accept draft token x w.p.
+    min(1, p(x)/q(x)); replace a reject with a sample from the residual
+    norm(max(p-q, 0)); after a fully accepted chain, sample the bonus
+    row from p directly). The emitted token at every row is then
+    distributed EXACTLY as p for ANY proposal law q — the draft moves
+    the speed, never the law (tests/test_spec_sampling.py pins this
+    against analytic distributions).
+
+    tl: (1, k, V) target logits — row i is the target's distribution
+        for the token following verify input u[:, i];
+    qs: (k-1, V) f32 draft probabilities — row i is the law proposal
+        u[:, i+1] was drawn from (a one-hot delta for prompt-lookup);
+    u:  (1, k) int32 verify inputs (u[:, 0] is already emitted).
+    Returns (y: (1, k) int32 emit rows, accept: (k-1,) bool).
+    """
+    kk = tl.shape[1]
+    p = _filtered_probs(tl[0], temperature, top_k, top_p)      # (k, V)
+    props = u[0, 1:]                                           # (k-1,)
+    ku, kr, kb = jax.random.split(key, 3)
+    p_prop = jnp.take_along_axis(p[:-1], props[:, None], axis=-1)[:, 0]
+    q_prop = jnp.take_along_axis(qs, props[:, None], axis=-1)[:, 0]
+    # u*q < p  <=>  u < min(1, p/q) (u < 1 surely); q = 0 accepts iff
+    # p > 0 — a proposal the target filters out (p = 0) always rejects.
+    unif = jax.random.uniform(ku, (kk - 1,))
+    accept = unif * q_prop < p_prop
+    # Residual for each non-bonus row: norm(max(p - q, 0)). Rows where
+    # the residual is identically zero (p == q) can never be selected
+    # (acceptance there is 1), so their log(0) = -inf sample is unused.
+    res = jnp.maximum(p[:-1] - qs, 0.0)
+    res_tok = jax.random.categorical(kr, jnp.log(res), axis=-1)
+    bonus = jax.random.categorical(kb, jnp.log(p[-1]))
+    y_head = jnp.where(accept, props, res_tok.astype(jnp.int32))
+    y = jnp.concatenate([y_head, bonus[None].astype(jnp.int32)])
+    return y[None, :], accept
+
+
 @functools.lru_cache(maxsize=16)
 def _compiled_spec_run(model: TransformerLM, draft: TransformerLM,
-                       s0: int, num_tokens: int, k: int, cache_dtype: str):
-    """Jitted greedy speculative loop for one (models, shapes) combo."""
+                       s0: int, num_tokens: int, k: int, cache_dtype: str,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 0.0):
+    """Jitted speculative loop for one (models, shapes, sampling) combo:
+    greedy exact-match acceptance at temperature 0, rejection sampling
+    (draft samples its own filtered law; _spec_sample_rows targets the
+    filtered target law) at temperature > 0."""
     cdt = jnp.dtype(cache_dtype)
+    sampling = temperature > 0
 
     @jax.jit
-    def run(params, draft_params, prompt):
+    def run(params, draft_params, prompt, key):
         tl, t_cache = prefill(model, params, prompt, cache_dtype=cdt)
         dl, d_cache = prefill(draft, draft_params, prompt, cache_dtype=cdt)
         del dl  # the draft's prompt logits are not used: the first
-        #         generated token is the TARGET's greedy pick
-        cur = jnp.argmax(tl, axis=-1).astype(jnp.int32)       # (1,)
+        #         generated token is the TARGET's own pick/sample
+        if sampling:
+            key, k0 = jax.random.split(key)
+            cur = jax.random.categorical(
+                k0, jnp.log(_filtered_probs(tl, temperature, top_k, top_p))
+            ).astype(jnp.int32)                               # (1,)
+        else:
+            cur = jnp.argmax(tl, axis=-1).astype(jnp.int32)   # (1,)
         out = jnp.zeros((1, num_tokens + k), jnp.int32)
         out = lax.dynamic_update_slice(out, cur[:, None], (0, 0))
 
         def draft_step(carry, _):
-            tok, pos, dc = carry
+            tok, pos, dc, kd = carry
             logits, dc = decode_step(draft, draft_params, tok, pos, dc)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (nxt, pos + 1, dc), nxt
+            if sampling:
+                q = _filtered_probs(logits, temperature, top_k, top_p)
+                kd, ks = jax.random.split(kd)
+                nxt = jax.random.categorical(
+                    ks, jnp.log(q)
+                ).astype(jnp.int32)
+            else:
+                q = jnp.zeros_like(logits)        # unused in greedy mode
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, pos + 1, dc, kd), (nxt, q[0])
 
         def round_body(state):
-            pos, cur, t_cache, d_cache, out, n_out, rounds = state
+            pos, cur, t_cache, d_cache, out, n_out, rounds, key = state
             # 1. Draft k sequential steps, INGESTING each fed token so
             #    its cache stays aligned with the verified prefix; the
             #    last proposal is never fed anywhere (d_k is unused).
-            (_, _, d_cache), ds = lax.scan(
-                draft_step, (cur, pos, d_cache), None, length=k
-            )                                     # ds: (k, 1) proposals
+            key, kd, kv = jax.random.split(key, 3)
+            (_, _, d_cache, _), (ds, qs) = lax.scan(
+                draft_step, (cur, pos, d_cache, kd), None, length=k
+            )                     # ds: (k, 1) proposals; qs: (k, V) laws
             u = jnp.concatenate([cur[None, :], ds[: k - 1, :]],
                                 axis=0).T         # (1, k) verify inputs
             # 2. One target block forward scores all k inputs.
             tl, t_cache = decode_block(model, params, u, pos, t_cache)
-            y = jnp.argmax(tl, axis=-1).astype(jnp.int32)     # (1, k)
-            # 3./4. Shared acceptance + buffered emit (_accept_and_emit).
-            j, cur, out = _accept_and_emit(u, y, out, n_out)
+            # 3./4. Acceptance + buffered emit — exact-match (greedy) or
+            #    rejection-sampling (_spec_sample_rows), same emit core.
+            if sampling:
+                y, accept = _spec_sample_rows(
+                    tl, qs[: k - 1], u, kv, temperature, top_k, top_p
+                )
+                j, cur, out = _emit_rows(y, accept, out, n_out)
+            else:
+                y = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # (1, k)
+                j, cur, out = _accept_and_emit(u, y, out, n_out)
             return (pos + j, cur, t_cache, d_cache, out, n_out + j,
-                    rounds + 1)
+                    rounds + 1, key)
 
         def cond(state):
             return state[5] < num_tokens
 
         state = (jnp.asarray(s0), cur, t_cache, d_cache, out,
-                 jnp.asarray(1), jnp.asarray(0))
-        pos, cur, _, _, out, n_out, rounds = lax.while_loop(
+                 jnp.asarray(1), jnp.asarray(0), key)
+        pos, cur, _, _, out, n_out, rounds, _ = lax.while_loop(
             cond, round_body, state
         )
         return out[:, :num_tokens], n_out, rounds
@@ -323,15 +407,28 @@ def _compiled_spec_run(model: TransformerLM, draft: TransformerLM,
 
 @functools.lru_cache(maxsize=16)
 def _compiled_lookup_run(model: TransformerLM, s0: int, num_tokens: int,
-                         k: int, ngram: int, cache_dtype: str):
-    """Jitted prompt-lookup speculative loop (draft-free)."""
+                         k: int, ngram: int, cache_dtype: str,
+                         temperature: float = 0.0, top_k: int = 0,
+                         top_p: float = 0.0):
+    """Jitted prompt-lookup speculative loop (draft-free). At
+    temperature > 0 the deterministic proposal is a one-hot law, so
+    rejection sampling degenerates to: accept proposal x w.p. p(x),
+    resample from p-with-x-zeroed on reject — still exactly p."""
     cdt = jnp.dtype(cache_dtype)
     L = model.max_seq
+    V = model.vocab
+    sampling = temperature > 0
 
     @jax.jit
-    def run(params, prompt):
+    def run(params, prompt, key):
         tl, t_cache = prefill(model, params, prompt, cache_dtype=cdt)
-        cur = jnp.argmax(tl, axis=-1).astype(jnp.int32)       # (1,)
+        if sampling:
+            key, k0 = jax.random.split(key)
+            cur = jax.random.categorical(
+                k0, jnp.log(_filtered_probs(tl, temperature, top_k, top_p))
+            ).astype(jnp.int32)                               # (1,)
+        else:
+            cur = jnp.argmax(tl, axis=-1).astype(jnp.int32)   # (1,)
         ctx = jnp.zeros((1, L), jnp.int32)
         ctx = lax.dynamic_update_slice(ctx, prompt, (0, 0))
         ctx = lax.dynamic_update_slice(ctx, cur[:, None], (0, s0))
@@ -342,7 +439,11 @@ def _compiled_lookup_run(model: TransformerLM, s0: int, num_tokens: int,
             """The k-1 tokens that followed the MOST RECENT earlier
             occurrence of the context's current ngram-token tail
             (ctx[pos] == cur is already written). No match -> repeat
-            cur: acceptance just collapses to 1, never an error."""
+            cur: acceptance just collapses to 1, never an error. When
+            the match sits within k-1 of the buffer end, the window
+            start clamps to L-(k-1): the proposals then trail the
+            clamped window (not the match) — acceptance drops, the
+            contract (tokens come from ctx) holds."""
             idx = jnp.arange(L)
             match = (idx >= ngram - 1) & (idx < pos)
             row = ctx[0]
@@ -357,30 +458,64 @@ def _compiled_lookup_run(model: TransformerLM, s0: int, num_tokens: int,
                              jnp.broadcast_to(cur, (k - 1,)))
 
         def round_body(state):
-            pos, cur, t_cache, ctx, out, n_out, rounds = state
+            pos, cur, t_cache, ctx, out, n_out, rounds, key = state
             props = propose(ctx, pos, cur)
             u = jnp.concatenate([cur, props])[None, :]        # (1, k)
             tl, t_cache = decode_block(model, params, u, pos, t_cache)
-            y = jnp.argmax(tl, axis=-1).astype(jnp.int32)
-            j, cur, out = _accept_and_emit(u, y, out, n_out)
+            if sampling:
+                key, kv = jax.random.split(key)
+                qs = jax.nn.one_hot(props, V, dtype=jnp.float32)
+                y, accept = _spec_sample_rows(
+                    tl, qs, u, kv, temperature, top_k, top_p
+                )
+                j, cur, out = _emit_rows(y, accept, out, n_out)
+            else:
+                y = jnp.argmax(tl, axis=-1).astype(jnp.int32)
+                j, cur, out = _accept_and_emit(u, y, out, n_out)
             # Keep the context buffer current: the accepted picks land
             # at pos+1.. (rows beyond j overwritten next round, same
             # trick as `out`; ctx[pos+j] == new cur by construction).
             ctx = lax.dynamic_update_slice(ctx, y, (0, pos + 1))
             return (pos + j, cur, t_cache, ctx, out, n_out + j,
-                    rounds + 1)
+                    rounds + 1, key)
 
         def cond(state):
             return state[5] < num_tokens
 
         state = (jnp.asarray(s0), cur, t_cache, ctx, out,
-                 jnp.asarray(1), jnp.asarray(0))
-        pos, cur, _, _, out, n_out, rounds = lax.while_loop(
+                 jnp.asarray(1), jnp.asarray(0), key)
+        pos, cur, _, _, out, n_out, rounds, _ = lax.while_loop(
             cond, round_body, state
         )
         return out[:, :num_tokens], n_out, rounds
 
     return run
+
+
+def _validate_spec_sampling(temperature, key, top_k, top_p, vocab):
+    """Shared sampling-argument validation for the speculative paths —
+    the same contract generate() enforces."""
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if top_k < 0 or top_k > vocab:
+        raise ValueError(f"top_k {top_k} not in [0, vocab {vocab}]")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p {top_p} not in [0, 1]")
+    if (top_k or top_p) and temperature <= 0:
+        raise ValueError(
+            "top_k/top_p restrict SAMPLING — set temperature > 0 "
+            "(greedy argmax already takes the single most likely token)"
+        )
+
+
+def _spec_stats(n_out, rounds, num_tokens):
+    """Acceptance stats with the emitted count CAPPED at num_tokens: the
+    final round may overshoot the budget by up to k-1 accepted tokens
+    that never land in the returned buffer — counting them would inflate
+    the rate (round-4 advisor finding)."""
+    r = max(int(rounds), 1)
+    return {"rounds": int(rounds),
+            "mean_accepted": (min(int(n_out), num_tokens) - 1) / r}
 
 
 def lookup_speculative_generate(
@@ -392,17 +527,24 @@ def lookup_speculative_generate(
     k: int = 8,
     ngram: int = 2,
     cache_dtype="float32",
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 0.0,
     return_stats: bool = False,
 ):
-    """Draft-FREE greedy speculative decoding (prompt lookup): propose
-    the k-1 tokens that followed the most recent earlier occurrence of
-    the current n-gram in the running context (prompt + generated), and
+    """Draft-FREE speculative decoding (prompt lookup): propose the k-1
+    tokens that followed the most recent earlier occurrence of the
+    current n-gram in the running context (prompt + generated), and
     verify with the same one-block-forward machinery as
     speculative_generate. No second model — this is the form the lm
     CLI's --sample-speculative-k reaches — and it shines on repetitive
     text (code, logs, structured data), where the continuation often
-    already appeared verbatim. Same greedy-exactness contract and
-    precision caveat as speculative_generate; same B=1 restriction.
+    already appeared verbatim. Same B=1 restriction and exactness
+    contract as speculative_generate: bitwise greedy at temperature 0;
+    at temperature > 0, rejection sampling against the one-hot proposal
+    law (accept w.p. p(prop), resample the zeroed residual) — the
+    output law is exactly plain sampling's (tests/test_spec_sampling).
     """
     b, s0 = prompt.shape
     if b != 1:
@@ -423,13 +565,16 @@ def lookup_speculative_generate(
             f"prompt {s0} + {num_tokens} tokens + k={k} speculative slack "
             f"exceeds max_seq {model.max_seq}"
         )
+    _validate_spec_sampling(temperature, key, top_k, top_p, model.vocab)
     run = _compiled_lookup_run(model, s0, num_tokens, int(k), int(ngram),
-                               str(jnp.dtype(cache_dtype)))
-    toks, n_out, rounds = run(params, prompt)
+                               str(jnp.dtype(cache_dtype)),
+                               float(max(temperature, 0.0)), int(top_k),
+                               float(top_p))
+    if key is None:
+        key = jax.random.key(0)  # unused at temperature 0
+    toks, n_out, rounds = run(params, prompt, key)
     if return_stats:
-        r = max(int(rounds), 1)
-        return toks, {"rounds": int(rounds),
-                      "mean_accepted": (int(n_out) - 1) / r}
+        return toks, _spec_stats(n_out, rounds, num_tokens)
     return toks
 
 
@@ -443,29 +588,42 @@ def speculative_generate(
     *,
     k: int = 4,
     cache_dtype="float32",
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 0.0,
     return_stats: bool = False,
 ):
-    """Greedy speculative decoding: a cheap draft proposes k-token
-    chains, the target verifies each chain with ONE cached block forward
-    (decode_block) and keeps the longest matching prefix — between 1 and
-    k target-quality tokens per target forward.
+    """Speculative decoding: a cheap draft proposes k-token chains, the
+    target verifies each chain with ONE cached block forward
+    (decode_block) — between 1 and k target-quality tokens per target
+    forward.
 
-    The output is the target's own greedy continuation — the draft
-    changes the speed, not the tokens. Precision caveat, stated
-    exactly: decode_block's batched contractions may tile/reassociate
-    differently from the plain decode scan's, so the two paths agree to
-    float rounding (~1e-4 observed), not bitwise; an argmax whose top-2
-    logits tie within that drift could in principle differ. The
-    equality test (tests/test_generate.py) and the bench's in-run
-    assert have never observed a divergence. Both models must share the
-    vocab;
-    the draft is typically shallower/narrower. B must be 1 (per-row
-    acceptance lengths diverge in a batch; speculation is the latency
-    lever, plain generate() the throughput one).
+    At temperature 0 (default) acceptance is exact argmax matching and
+    the output is the target's own greedy continuation — the draft
+    changes the speed, not the tokens. At temperature > 0 (key
+    required; top_k/top_p as in generate()) acceptance is REJECTION
+    SAMPLING: the draft samples its own filtered law q, the target
+    accepts each proposal w.p. min(1, p/q) and replaces a reject with a
+    residual sample — the emitted law is exactly plain temperature
+    sampling's, for any draft (the speculative sampling theorem;
+    distribution-equality tests in tests/test_spec_sampling.py).
+
+    Precision caveat, stated exactly: decode_block's batched
+    contractions may tile/reassociate differently from the plain decode
+    scan's, so the two paths agree to float rounding (~1e-4 observed),
+    not bitwise; a greedy argmax whose top-2 logits tie within that
+    drift could in principle differ. The equality test
+    (tests/test_generate.py) and the bench's in-run assert have never
+    observed a divergence. Both models must share the vocab; the draft
+    is typically shallower/narrower. B must be 1 (per-row acceptance
+    lengths diverge in a batch; speculation is the latency lever, plain
+    generate() the throughput one).
 
     Returns tokens (1, num_tokens) int32 — or (tokens, stats) with
     `return_stats=True`, where stats carries the verify-round count and
-    the mean accepted tokens per round (k = every chain fully accepted).
+    the mean accepted tokens per round (capped at the returned budget —
+    the final round's overshoot never lands in the buffer).
     """
     b, s0 = prompt.shape
     if b != 1:
@@ -485,15 +643,16 @@ def speculative_generate(
             f"exceeds max_seq (target {model.max_seq}, draft "
             f"{draft_model.max_seq}; BOTH caches hold every position)"
         )
+    _validate_spec_sampling(temperature, key, top_k, top_p, model.vocab)
     run = _compiled_spec_run(model, draft_model, s0, num_tokens, int(k),
-                             str(jnp.dtype(cache_dtype)))
-    toks, n_out, rounds = run(params, draft_params, prompt)
+                             str(jnp.dtype(cache_dtype)),
+                             float(max(temperature, 0.0)), int(top_k),
+                             float(top_p))
+    if key is None:
+        key = jax.random.key(0)  # unused at temperature 0
+    toks, n_out, rounds = run(params, draft_params, prompt, key)
     if return_stats:
-        # mean accepted tokens per verify round in [1, k]; k means every
-        # draft chain was fully accepted.
-        r = max(int(rounds), 1)
-        return toks, {"rounds": int(rounds),
-                      "mean_accepted": (int(n_out) - 1) / r}
+        return toks, _spec_stats(n_out, rounds, num_tokens)
     return toks
 
 

@@ -151,6 +151,7 @@ class TransformerLM:
         compute_dtype=None,
         moe_axis: str | None = None,
         moe_inference: bool = False,
+        moe_dispatch_chunk: int = 0,
     ):
         """One pre-LN block: attention + MLP (or MoE) with residuals.
 
@@ -202,6 +203,7 @@ class TransformerLM:
                     y.reshape(b * s, self.dim), moe_p,
                     n_experts=self.moe_experts, axis=moe_axis,
                     top_k=self.moe_top_k,
+                    dispatch_chunk=moe_dispatch_chunk,
                 )
             return x + m.reshape(b, s, self.dim).astype(x.dtype), aux
         return (
@@ -223,6 +225,10 @@ class TransformerLM:
         moe_inference: bool = False,   # no-drop compute-all-experts MoE
                                        # (ep.moe_mlp_inference) — the
                                        # decode/prefill semantic
+        moe_dispatch_chunk: int = 0,   # single-chip chunked routing
+                                       # (ep.moe_mlp dispatch_chunk):
+                                       # kills the quadratic dispatch
+                                       # einsum term
         return_aux: bool = False,      # also return the MoE balance loss
         compute_dtype=None,            # e.g. jnp.bfloat16: run matmuls +
                                        # residual stream in this dtype
@@ -256,6 +262,7 @@ class TransformerLM:
             return self.apply_block(
                 blk, x, pos=pos, attn=attn, compute_dtype=cd,
                 moe_axis=moe_axis, moe_inference=moe_inference,
+                moe_dispatch_chunk=moe_dispatch_chunk,
             )
 
         if remat:

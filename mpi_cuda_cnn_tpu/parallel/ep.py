@@ -146,6 +146,7 @@ def moe_mlp(
     capacity_factor: float = 1.25,
     axis: str | None = EXPERT_AXIS,
     top_k: int = 1,
+    dispatch_chunk: int = 0,
 ):
     """MoE MLP for x: (T, D) local tokens. SPMD body when `axis` names a
     mesh axis — then params["w1"]/["w2"] hold only THIS device's E/P
@@ -153,8 +154,45 @@ def moe_mlp(
     or the exact single-device dense oracle when axis=None (full stacks).
     top_k=1 is Switch routing; top_k=2 the GShard form (capacity scales
     with k so per-expert slots track the k*T total assignments).
-    Returns (y: (T, D), aux_loss: scalar)."""
+    Returns (y: (T, D), aux_loss: scalar).
+
+    dispatch_chunk > 0 routes tokens in fixed-size chunks (a lax.scan
+    sharing the expert weights) — the single-chip MoE throughput lever.
+    The dense (T, E, C) dispatch/combine einsums cost 2*E*C*T*D with
+    C = ceil(T*k*cf/E), i.e. ~2*k*cf*T^2*D — QUADRATIC in local tokens;
+    at T = 16384 that term dwarfs the expert FFN's useful FLOPs 8x
+    (scripts/profile_moe.py banks the attribution). Chunking makes it
+    linear in T while staying pure MXU einsums. Capacity becomes
+    per-chunk (ceil(chunk*k*cf/E) slots per expert per chunk) — the
+    same estimator change every microbatched MoE trainer accepts, and
+    bitwise-identical to unchunked when nothing drops (tested). The aux
+    loss is the chunk mean. Under EP (`axis` set) chunking is rejected:
+    each shard already routes only its T/P local tokens, which is the
+    same quadratic-term reduction the mesh provides for free."""
     t, d = x.shape
+    if dispatch_chunk and dispatch_chunk < t:
+        if axis is not None:
+            raise ValueError(
+                "dispatch_chunk is the SINGLE-DEVICE quadratic-dispatch "
+                f"lever; under EP (axis={axis!r}) the mesh already "
+                "shards the routed tokens — drop one of the two"
+            )
+        if t % dispatch_chunk:
+            raise ValueError(
+                f"tokens {t} not divisible by dispatch_chunk "
+                f"{dispatch_chunk}"
+            )
+
+        def chunk_body(_, xc):
+            yc, auxc = moe_mlp(
+                xc, params, n_experts=n_experts,
+                capacity_factor=capacity_factor, axis=None, top_k=top_k,
+            )
+            return 0, (yc, auxc)
+
+        xs = x.reshape(t // dispatch_chunk, dispatch_chunk, d)
+        _, (ys, auxs) = lax.scan(chunk_body, 0, xs)
+        return ys.reshape(t, d), jnp.mean(auxs)
     capacity = max(1, -int(-t * top_k * capacity_factor // n_experts))  # ceil
     if top_k == 1:
         dispatch, combine, aux = top1_dispatch(

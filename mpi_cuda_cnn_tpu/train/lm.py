@@ -93,12 +93,15 @@ def lm_loss(
     moe_aux_weight: float = 0.01,
     ce_chunk: int = 0,
     moe_axis: str | None = None,
+    moe_dispatch_chunk: int = 0,
 ):
     """Mean next-token NLL (+ the Switch aux loss when the model is MoE).
     tokens/targets: (B, S) int32. The loss softmax always runs in f32.
     moe_axis names a mesh axis for expert-parallel dispatch inside a
     shard_map caller (parallel/ep.py make_ep_lm_train_step); None keeps
-    the local dense dispatch.
+    the local dense dispatch. moe_dispatch_chunk > 0 routes MoE tokens
+    in chunks (ep.moe_mlp dispatch_chunk — the single-chip lever for the
+    quadratic dispatch-einsum term; incompatible with moe_axis).
 
     ce_chunk > 0 fuses the head matmul into a chunked cross-entropy: the
     final-LN features go through the head in S-chunks of that size inside
@@ -116,6 +119,7 @@ def lm_loss(
             params, tokens, attn_fn=attn_fn, remat=remat,
             compute_dtype=compute_dtype, return_aux=True,
             return_features=True, moe_axis=moe_axis,
+            moe_dispatch_chunk=moe_dispatch_chunk,
         )
         nll = chunked_ce_mean(
             feats, params["head"], targets, ce_chunk, compute_dtype
@@ -124,6 +128,7 @@ def lm_loss(
     logits, aux = model.apply(
         params, tokens, attn_fn=attn_fn, remat=remat,
         compute_dtype=compute_dtype, return_aux=True, moe_axis=moe_axis,
+        moe_dispatch_chunk=moe_dispatch_chunk,
     )
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -142,6 +147,7 @@ def make_lm_train_step(
     moe_aux_weight: float = 0.01,
     ce_chunk: int = 0,
     grad_accum: int = 1,
+    moe_dispatch_chunk: int = 0,
 ):
     """step(state, tokens, targets) -> (state, {"loss": ...}), jitted.
 
@@ -168,6 +174,7 @@ def make_lm_train_step(
     loss = partial(
         lm_loss, model, attn_fn=attn_fn, compute_dtype=compute_dtype,
         remat=remat, moe_aux_weight=moe_aux_weight, ce_chunk=ce_chunk,
+        moe_dispatch_chunk=moe_dispatch_chunk,
     )
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())

@@ -132,11 +132,9 @@ class LMTrainer:
                     f"--sample-speculative-k {cfg.sample_speculative_k} "
                     "must be >= 2 (the verify block needs proposals)"
                 )
-            if cfg.sample_temperature > 0:
-                raise ValueError(
-                    "--sample-speculative-k is greedy-only (acceptance "
-                    "compares argmax picks); drop --sample-temperature"
-                )
+            # --sample-temperature > 0 composes since round 5: the
+            # speculative path rejection-samples, output law == plain
+            # temperature sampling's (models/generate.py).
             if cfg.sample_tokens and cfg.sample_tokens + \
                     cfg.sample_speculative_k + 2 > cfg.seq_len:
                 # The same fail-NOW rationale as the checks above: the
@@ -181,6 +179,19 @@ class LMTrainer:
             raise ValueError(
                 f"batch_size {cfg.batch_size} not divisible by "
                 f"data x expert shards ({self.n_data} x {self.n_expert})"
+            )
+        if cfg.moe_dispatch_chunk and (
+            self.n_expert > 1 or self.n_seq > 1 or self.n_model > 1
+            or self.n_pipe > 1
+        ):
+            raise ValueError(
+                "--moe-dispatch-chunk is the SINGLE-DEVICE (or pure-DP) "
+                "quadratic-dispatch lever; expert/seq/model/pipe meshes "
+                "already shard the routed tokens — drop one of the two"
+            )
+        if cfg.moe_dispatch_chunk and not cfg.moe_experts:
+            raise ValueError(
+                "--moe-dispatch-chunk needs an MoE model (--moe-experts)"
             )
         if self.n_model > 1 and self.n_seq > 1:
             # TP x SP (parallel/tp_sp.py): Megatron inside the ring
@@ -439,6 +450,7 @@ class LMTrainer:
                 seq_len=cfg.seq_len, compute_dtype=compute_dtype,
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
                 grad_accum=cfg.grad_accum,
+                moe_dispatch_chunk=cfg.moe_dispatch_chunk,
             )
         if self.n_pipe > 1 or self.n_seq > 1 and (self.n_model > 1
                                                   or cfg.fsdp):
@@ -669,14 +681,18 @@ class LMTrainer:
 
                 params = shard_lm_params(self.model, params, self.mesh)
         if cfg.sample_speculative_k:
-            # Draft-free prompt-lookup speculation (greedy; validated at
-            # construction — and for programmatic callers here too: the
-            # CLI path can't reach this with temperature > 0, a direct
-            # sample(..., temperature=) call could).
-            if temperature > 0:
+            # Draft-free prompt-lookup speculation. Greedy at
+            # temperature 0 (bitwise-exact contract); temperature > 0
+            # runs rejection sampling — output law == plain sampling's
+            # (models/generate.py _spec_sample_rows).
+            if p < 2:
+                # The lookup ngram (default 2) needs that much prompt;
+                # fail here with the config's vocabulary rather than
+                # deeper with the generator's (ADVICE round-4 finding).
                 raise ValueError(
-                    "speculative sampling is greedy-only; call with "
-                    "temperature=0 or unset sample_speculative_k"
+                    f"--sample-speculative-k needs a prompt of >= 2 "
+                    f"tokens (resolved prompt length {p}; raise "
+                    f"prompt_len or seq_len)"
                 )
             from ..models.generate import lookup_speculative_generate
 
@@ -684,6 +700,9 @@ class LMTrainer:
                 self.model, params, prompt, num_tokens,
                 k=cfg.sample_speculative_k,
                 cache_dtype=cfg.decode_cache_dtype,
+                temperature=temperature,
+                key=jax.random.key(seed) if temperature > 0 else None,
+                top_k=cfg.sample_top_k, top_p=cfg.sample_top_p,
             )
         else:
             toks = generate(

@@ -122,6 +122,12 @@ class LMConfig(_JsonConfig):
     moe_experts: int = 0          # >0: Switch-MoE MLP per block (EP over
                                   # the 'seq' axis when one exists)
     moe_top_k: int = 1            # experts per token (1=Switch, 2=GShard)
+    moe_dispatch_chunk: int = 0   # >0: route MoE tokens in chunks of
+                                  # this many (ep.moe_mlp) — the single-
+                                  # chip lever for the quadratic
+                                  # dispatch-einsum term; rejected on
+                                  # expert-sharded meshes (EP already
+                                  # divides the routed tokens)
     steps: int = 200
     batch_size: int = 8
     lr: float = 3e-4
@@ -176,8 +182,10 @@ class LMConfig(_JsonConfig):
                                      # and need --sample-temperature > 0
     sample_speculative_k: int = 0    # >=2: draft-free prompt-lookup
                                      # speculative decoding with k-token
-                                     # verify blocks (greedy only —
-                                     # models/generate.py)
+                                     # verify blocks (bitwise greedy at
+                                     # temperature 0; rejection sampling
+                                     # — exact output law — at
+                                     # temperature > 0: generate.py)
     decode_cache_dtype: str = "float32"  # "bfloat16" halves the decode
                                      # KV-cache bytes (decode is cache-
                                      # read-bound: PERF.md decode table);

@@ -43,11 +43,13 @@ PEAK_TFLOPS = {"tpu_v5e_bf16": 197.0, "tpu_v5e_f32": 49.0}
 
 
 def bench_config(model, *, batch, seq, compute_dtype, attn_impl,
-                 steps=20, warmup=3, seed=0, ce_chunk=0):
+                 steps=20, warmup=3, seed=0, ce_chunk=0,
+                 moe_dispatch_chunk=0, grad_accum=1, remat=False):
     opt = make_optimizer(3e-4, opt="adamw", schedule="constant")
     step_fn = make_lm_train_step(
         model, opt, attn_impl=attn_impl, seq_len=seq,
-        compute_dtype=compute_dtype, remat=False, ce_chunk=ce_chunk,
+        compute_dtype=compute_dtype, remat=remat, ce_chunk=ce_chunk,
+        moe_dispatch_chunk=moe_dispatch_chunk, grad_accum=grad_accum,
     )
     state = make_lm_state(model, opt, seed)
     rng = np.random.default_rng(seed)
@@ -115,6 +117,15 @@ def main():
     ap.add_argument("--ce-chunk", type=int, default=0,
                     help="chunked fused cross-entropy (train/lm.lm_loss): "
                          "S-chunk size, 0 = dense (B,S,V) logits")
+    ap.add_argument("--moe-dispatch-chunk", type=int, default=0,
+                    help="chunked MoE routing (ep.moe_mlp): token-chunk "
+                         "size, 0 = whole-batch dispatch. Single-chip "
+                         "lever for the quadratic dispatch einsum")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="micro-batch accumulation (must divide batch); "
+                         "amortizes the optimizer update's HBM traffic")
+    ap.add_argument("--remat", action="store_true",
+                    help="jax.checkpoint per block (recompute-in-bwd)")
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     args = ap.parse_args()
 
@@ -182,7 +193,8 @@ def main():
         dt, loss = bench_config(
             model, batch=args.batch, seq=args.seq,
             compute_dtype=cd, attn_impl=impl, steps=args.steps,
-            ce_chunk=ce,
+            ce_chunk=ce, moe_dispatch_chunk=args.moe_dispatch_chunk,
+            grad_accum=args.grad_accum, remat=args.remat,
         )
         tok_s = tokens_per_step / dt
         mfu = (
@@ -196,9 +208,16 @@ def main():
             "mfu": mfu,
             "loss": round(loss, 4),
         }
+        extras = {}
+        if args.moe_dispatch_chunk:
+            extras["moe_dispatch_chunk"] = args.moe_dispatch_chunk
+        if args.grad_accum > 1:
+            extras["grad_accum"] = args.grad_accum
+        if args.remat:
+            extras["remat"] = True
         print(json.dumps({
             "bench": "lm_pretrain", "dtype": dtype_name, "attn": impl,
-            "ce_chunk": ce, **results[key],
+            "ce_chunk": ce, **extras, **results[key],
         }))
 
     best = max(results.items(), key=lambda kv: kv[1]["tokens_per_s"])

@@ -76,12 +76,18 @@ def train_on_text(model, tokens, *, steps, batch, seq, lr=1e-3, seed=0):
     return state["params"], float(loss)
 
 
-def timed_tokens(fn, n, attempts=3):
+def timed_tokens(fn, n, attempts=3, floor=0.0):
     """s/token of a generate-style call via the shared two-point core:
     fn(m) must produce m tokens and force completion. A backend
-    transient can push even the median-of-3 slope negative (observed:
-    a banked -0.095 ms/tok row) — a physically impossible value is
-    re-measured, never emitted."""
+    transient can push even the median-of-3 slope NEGATIVE (observed: a
+    banked -0.095 ms/tok row) or impossibly FAST (observed round 5: a
+    lookup-k8 slope reading 85x speedup, ~7x above every healthy
+    window's measurement) — a value at or below `floor` is re-measured,
+    never emitted. Callers pass plain/(k*3) for speculative modes: the
+    per-round emit is <= k tokens, and the measured legitimate range
+    runs to ~1.8x k (block-forward + loop overheads amortize BETTER
+    than one plain step — banked lookup-k8 rows reach 12-14x), so the
+    3x-k margin rejects only transient-class values."""
 
     def run(m):
         t0 = time.perf_counter()
@@ -91,11 +97,12 @@ def timed_tokens(fn, n, attempts=3):
     run(n), run(2 * n)  # warm both program sizes
     for _ in range(attempts):
         t = two_point(run, n, warmup=0)
-        if t > 0:
+        if t > floor:
             return t
     raise RuntimeError(
-        f"two-point slope stayed non-positive over {attempts} "
-        "median-of-3 attempts — backend too unstable to measure"
+        f"two-point slope stayed at or below the plausibility floor "
+        f"({floor * 1e3:.4f} ms/tok) over {attempts} median-of-3 "
+        "attempts — backend too unstable to measure"
     )
 
 
@@ -166,7 +173,7 @@ def main():
             lambda m: speculative_generate(
                 target, t_params, draft, d_params, prompt, m, k=k
             ),
-            args.tokens,
+            args.tokens, floor=t_plain / (k * 3.0),
         )
         row = {
             "bench": "speculative", "mode": f"draft_k{k}",
@@ -203,7 +210,7 @@ def main():
             lambda m: lookup_speculative_generate(
                 target, t_params, lk_prompt, m, k=k
             ),
-            args.tokens,
+            args.tokens, floor=lk_plain / (k * 3.0),
         )
         row = {
             "bench": "speculative", "mode": f"lookup_k{k}",
@@ -216,6 +223,68 @@ def main():
         print(json.dumps(row), flush=True)
         if row["tokens_per_s"] > best[0] and row["greedy_exact"]:
             best = (row["tokens_per_s"], f"lookup_k{k}")
+
+    # Rejection-sampling speculation at temperature 0.8 (round 5): the
+    # same trained pair, now SAMPLING — acceptance is min(1, p/q) per
+    # proposal instead of argmax matching, output law == plain
+    # temperature sampling's (tests/test_spec_sampling.py pins the
+    # distribution equality; no bitwise assert is possible for sampling).
+    temp = 0.8
+    skey = jax.random.key(11)
+    t_plain_T = timed_tokens(
+        lambda m: generate(target, t_params, prompt, m, temperature=temp,
+                           key=skey),
+        args.tokens,
+    )
+    print(json.dumps({
+        "bench": "speculative", "mode": f"plain_sample_T{temp}",
+        "ms_per_tok": round(t_plain_T * 1e3, 3),
+        "tokens_per_s": round(1.0 / t_plain_T),
+    }), flush=True)
+    for k in (int(x) for x in args.ks.split(",")):
+        _, sst = speculative_generate(
+            target, t_params, draft, d_params, prompt, args.tokens,
+            k=k, temperature=temp, key=skey, return_stats=True,
+        )
+        t_sT = timed_tokens(
+            lambda m: speculative_generate(
+                target, t_params, draft, d_params, prompt, m, k=k,
+                temperature=temp, key=skey,
+            ),
+            args.tokens, floor=t_plain_T / (k * 3.0),
+        )
+        print(json.dumps({
+            "bench": "speculative", "mode": f"draft_k{k}_T{temp}",
+            "ms_per_tok": round(t_sT * 1e3, 3),
+            "tokens_per_s": round(1.0 / t_sT),
+            "mean_accepted": round(sst["mean_accepted"], 2),
+            "speedup_vs_plain": round(t_plain_T / t_sT, 2),
+        }), flush=True)
+    # Lookup sampling on the cycle-spanning prompt.
+    lk_plain_T = timed_tokens(
+        lambda m: generate(target, t_params, lk_prompt, m,
+                           temperature=temp, key=skey),
+        args.tokens,
+    )
+    for k in (int(x) for x in args.ks.split(",")):
+        _, lst = lookup_speculative_generate(
+            target, t_params, lk_prompt, args.tokens, k=k,
+            temperature=temp, key=skey, return_stats=True,
+        )
+        t_lkT = timed_tokens(
+            lambda m: lookup_speculative_generate(
+                target, t_params, lk_prompt, m, k=k, temperature=temp,
+                key=skey,
+            ),
+            args.tokens, floor=lk_plain_T / (k * 3.0),
+        )
+        print(json.dumps({
+            "bench": "speculative", "mode": f"lookup_k{k}_T{temp}",
+            "ms_per_tok": round(t_lkT * 1e3, 3),
+            "tokens_per_s": round(1.0 / t_lkT),
+            "mean_accepted": round(lst["mean_accepted"], 2),
+            "speedup_vs_plain": round(lk_plain_T / t_lkT, 2),
+        }), flush=True)
 
     # Lookup on REAL text: a fresh target trained briefly on the
     # framework's own sources (char-level — `--corpus self`), prompt =
@@ -241,7 +310,7 @@ def main():
         t_sp_lk = timed_tokens(
             lambda m: lookup_speculative_generate(st, st_params, sp, m,
                                                   k=8),
-            args.tokens,
+            args.tokens, floor=t_sp_plain / (8 * 3.0),
         )
         print(json.dumps({
             "bench": "speculative", "mode": "self_corpus_lookup_k8",
@@ -266,7 +335,7 @@ def main():
         lambda m: speculative_generate(
             target, t_params, draft, rand, prompt, m, k=4
         ),
-        args.tokens,
+        args.tokens, floor=t_plain / (4 * 3.0),
     )
     print(json.dumps({
         "bench": "speculative", "mode": "random_draft_k4",

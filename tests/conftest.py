@@ -90,7 +90,9 @@ SLOW_TESTS = {
     "test_pp_lm.py::test_lm_trainer_sp_pp_e2e",
     "test_pp_lm.py::test_sp_pp_lm_moe_trains",
     # The 4D mesh runs in the driver's dryrun path 15 (serial-parity
-    # asserted) every round besides these slow twins.
+    # asserted) every round besides these slow twins; the 16-device
+    # all-four-axes composition is a spawned worker (own jax process).
+    "test_4d_full.py::test_full_4d_mesh_16_devices_matches_serial",
     "test_tp_pp_lm.py::test_tp_pp_lm_4d_matches_serial",
     "test_tp_pp_lm.py::test_lm_trainer_4d_e2e",
     "test_tp_pp_lm.py::test_tp_pp_lm_checkpoint_resume",

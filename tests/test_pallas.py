@@ -165,3 +165,70 @@ def test_model_pallas_backend_trains():
                 metrics=MetricsLogger(echo=False))
     r = t.train()
     assert r.test_accuracy >= 0.9
+
+
+# ---------------------------------------------------------------------------
+# Implicit-GEMM conv (pallas_conv_gemm.py): the deep-shape formulation —
+# one (M, k*k*Cin) MXU contraction per tile instead of k*k half-filled
+# K=Cin dots. Parity vs the oracle on stride-1 shapes incl. bf16 + grads.
+# ---------------------------------------------------------------------------
+
+GEMM_CASES = [
+    # stride-1 only (the formulation's domain): a deep-ish shape, the
+    # odd-channel VGG head, and a k5 'same' case.
+    (2, 8, 8, 16, 3, 8, 1, 1),
+    (2, 6, 6, 2, 3, 3, 1, 0),
+    (2, 8, 8, 3, 5, 4, 1, 2),
+]
+
+
+@pytest.mark.parametrize("n,h,w,cin,k,cout,stride,pad", GEMM_CASES)
+def test_conv_gemm_forward_parity(n, h, w, cin, k, cout, stride, pad):
+    from mpi_cuda_cnn_tpu.ops.pallas_conv_gemm import conv2d_pallas_gemm
+
+    x = _rand(n, h, w, cin)
+    wk = _rand(k, k, cin, cout, seed=1)
+    got = conv2d_pallas_gemm(x, wk, stride, pad)
+    want = conv2d(x, wk, stride=stride, padding=pad)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_gemm_grad_parity():
+    from mpi_cuda_cnn_tpu.ops.pallas_conv_gemm import conv2d_pallas_gemm
+
+    n, h, w, cin, k, cout, stride, pad = GEMM_CASES[0]
+    x = _rand(n, h, w, cin)
+    wk = _rand(k, k, cin, cout, seed=1)
+
+    def loss_p(x, wk):
+        return jnp.sum(conv2d_pallas_gemm(x, wk, stride, pad) ** 2)
+
+    def loss_o(x, wk):
+        return jnp.sum(conv2d(x, wk, stride=stride, padding=pad) ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1))(x, wk)
+    go = jax.grad(loss_o, argnums=(0, 1))(x, wk)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(go[0]),
+                               rtol=1e-4, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(go[1]),
+                               rtol=1e-4, atol=5e-3)
+
+
+def test_conv_gemm_bf16_parity_and_stride_rejection():
+    from mpi_cuda_cnn_tpu.ops.pallas_conv_gemm import conv2d_pallas_gemm
+
+    n, h, w, cin, k, cout, stride, pad = GEMM_CASES[0]
+    x = _rand(n, h, w, cin).astype(jnp.bfloat16)
+    wk = (_rand(k, k, cin, cout, seed=1) * 0.1).astype(jnp.bfloat16)
+    got = conv2d_pallas_gemm(x, wk, stride, pad)
+    want = conv2d(x, wk, stride=stride, padding=pad)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+    with pytest.raises(ValueError, match="stride-1"):
+        conv2d_pallas_gemm(_rand(2, 8, 8, 4), _rand(3, 3, 4, 4, seed=1),
+                           2, 1)

@@ -1,0 +1,258 @@
+"""Rejection-sampling speculative decoding (temperature > 0).
+
+The T=0 speculative path's contract is bitwise: output == the target's
+greedy continuation (tests/test_generate.py). At temperature > 0 the
+contract is DISTRIBUTIONAL: accept draft token x with probability
+min(1, p(x)/q(x)), replace a rejected proposal with a sample from the
+residual norm(max(p - q, 0)) — the emitted token is then distributed
+exactly as p for ANY proposal distribution q (the standard speculative
+sampling theorem; the draft changes the speed, never the law).
+
+Reference analog: none — the reference (cnn.c) has no generation at
+all; this completes the beyond-parity serving axis the framework chose
+(VERDICT round 4, item 3).
+
+Two layers of evidence here:
+  1. the acceptance core `_spec_sample_rows` against ANALYTIC
+     distributions (sharp: TV < 0.05 at N=4096 on an 8-token vocab);
+  2. the end-to-end generators' per-position marginals against the
+     target model's own analytic distribution, with an adversarial
+     (random-weight) draft so the residual path carries real mass.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.models.generate import (
+    _spec_sample_rows,
+    generate,
+    lookup_speculative_generate,
+    prefill,
+    speculative_generate,
+)
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+
+SMALL = TransformerLM(vocab=8, dim=16, heads=2, depth=1, max_seq=32)
+
+
+def _tv(p, q):
+    return 0.5 * float(np.abs(np.asarray(p) - np.asarray(q)).sum())
+
+
+def _hist(tokens, vocab):
+    return np.bincount(np.asarray(tokens).ravel(), minlength=vocab) / len(tokens)
+
+
+# ---------------------------------------------------------------------------
+# 1. The acceptance core, against analytic distributions
+
+
+def test_reject_core_emits_exactly_target_distribution():
+    """prop ~ q, then accept/residual via _spec_sample_rows: the emitted
+    row-0 token must be distributed exactly as the (temperature-scaled)
+    target row — the speculative sampling theorem, verified empirically
+    at TV < 0.05 where sampling noise alone is ~0.015."""
+    rng = np.random.default_rng(0)
+    v, temp = 8, 0.8
+    tl = jnp.asarray(rng.normal(size=(1, 2, v)) * 1.5, jnp.float32)
+    q = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.normal(size=v) * 2.0)))
+    p_want = np.asarray(jax.nn.softmax(tl[0, 0] / temp))
+
+    def one(key):
+        kp, kc = jax.random.split(key)
+        prop = jax.random.categorical(kp, jnp.log(q)).astype(jnp.int32)
+        u = jnp.stack([jnp.int32(0), prop])[None, :]
+        y, accept = _spec_sample_rows(tl, q[None, :], u, kc, temp, 0, 0.0)
+        return y[0, 0], accept[0]
+
+    n = 4096
+    toks, accepts = jax.vmap(one)(jax.random.split(jax.random.key(42), n))
+    assert _tv(_hist(toks, v), p_want) < 0.05
+    # The draft is far from the target here — both branches must carry
+    # real mass or the test proves nothing about the residual path.
+    acc_rate = float(jnp.mean(accepts.astype(jnp.float32)))
+    assert 0.05 < acc_rate < 0.95
+
+
+def test_reject_core_respects_target_filters():
+    """With top_k on the TARGET, emitted tokens must follow the
+    filtered-renormalized target distribution — including proposals the
+    filter forbids (p=0 ⇒ always rejected, never emitted)."""
+    rng = np.random.default_rng(1)
+    v, temp, top_k = 8, 1.0, 3
+    tl = jnp.asarray(rng.normal(size=(1, 2, v)) * 1.5, jnp.float32)
+    q = jnp.full((v,), 1.0 / v)  # uniform draft: proposes forbidden tokens
+    scaled = np.asarray(tl[0, 0] / temp)
+    keep = scaled >= np.sort(scaled)[-top_k]
+    p_want = np.exp(scaled) * keep
+    p_want /= p_want.sum()
+
+    def one(key):
+        kp, kc = jax.random.split(key)
+        prop = jax.random.categorical(kp, jnp.log(q)).astype(jnp.int32)
+        u = jnp.stack([jnp.int32(0), prop])[None, :]
+        y, _ = _spec_sample_rows(tl, q[None, :], u, kc, temp, top_k, 0.0)
+        return y[0, 0]
+
+    toks = jax.vmap(one)(jax.random.split(jax.random.key(7), 4096))
+    got = _hist(toks, v)
+    assert _tv(got, p_want) < 0.05
+    assert got[~keep].sum() == 0.0  # filtered tokens never emitted
+
+
+def test_reject_core_delta_proposal_is_lookup_semantics():
+    """A one-hot q (the prompt-lookup case): accept w.p. p(prop), and the
+    residual is p with the proposal zeroed — still exactly p overall."""
+    rng = np.random.default_rng(2)
+    v, temp, prop_tok = 8, 0.7, 3
+    tl = jnp.asarray(rng.normal(size=(1, 2, v)), jnp.float32)
+    q = jax.nn.one_hot(prop_tok, v)
+    p_want = np.asarray(jax.nn.softmax(tl[0, 0] / temp))
+
+    def one(key):
+        u = jnp.asarray([[0, prop_tok]], jnp.int32)
+        y, accept = _spec_sample_rows(tl, q[None, :], u, key, temp, 0, 0.0)
+        return y[0, 0], accept[0]
+
+    toks, accepts = jax.vmap(one)(jax.random.split(jax.random.key(3), 4096))
+    assert _tv(_hist(toks, v), p_want) < 0.05
+    # Acceptance of a delta proposal IS p(prop): check it directly.
+    assert abs(float(jnp.mean(accepts.astype(jnp.float32)))
+               - p_want[prop_tok]) < 0.04
+
+
+# ---------------------------------------------------------------------------
+# 2. End-to-end generators: per-position marginals vs the analytic law
+
+
+def _analytic_marginals(model, params, prompt, temperature):
+    """Exact p(token0) and p(token1) of plain temperature sampling: the
+    first from the prefill logits, the second by enumerating token0."""
+    logits, _ = prefill(model, params, prompt)
+    p0 = np.asarray(jax.nn.softmax(logits[0] / temperature))
+    p1 = np.zeros(model.vocab)
+    for a in range(model.vocab):
+        ext = jnp.concatenate(
+            [prompt, jnp.asarray([[a]], jnp.int32)], axis=1
+        )
+        la = model.apply(params, ext)[0, -1].astype(jnp.float32)
+        p1 += p0[a] * np.asarray(jax.nn.softmax(la / temperature))
+    return p0, p1
+
+
+@pytest.mark.parametrize("path", ["draft", "lookup"])
+def test_spec_sampling_marginals_match_plain(path):
+    """speculative sampling at T=0.8 with an ADVERSARIAL draft (random
+    weights / no useful lookup matches → heavy residual traffic): the
+    marginal distribution of each emitted position must match plain
+    temperature sampling's analytic law. N=400 seeds on an 8-vocab ⇒
+    sampling noise TV ≈ 0.056; bound 0.15 catches any systematic skew
+    toward the draft (an always-accept bug reads TV > 0.4 here)."""
+    params = SMALL.init(jax.random.key(0))
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    temp, n = 0.8, 400
+    p0_want, p1_want = _analytic_marginals(SMALL, params, prompt, temp)
+
+    draft = TransformerLM(vocab=8, dim=16, heads=2, depth=1, max_seq=32)
+    draft_params = draft.init(jax.random.key(99))
+
+    t0, t1 = [], []
+    for seed in range(n):
+        key = jax.random.key(seed)
+        if path == "draft":
+            toks = speculative_generate(
+                SMALL, params, draft, draft_params, prompt, 3, k=3,
+                temperature=temp, key=key,
+            )
+        else:
+            toks = lookup_speculative_generate(
+                SMALL, params, prompt, 3, k=3, ngram=2,
+                temperature=temp, key=key,
+            )
+        t0.append(int(toks[0, 0]))
+        t1.append(int(toks[0, 1]))
+    assert _tv(_hist(jnp.asarray(t0), 8), p0_want) < 0.15
+    assert _tv(_hist(jnp.asarray(t1), 8), p1_want) < 0.15
+
+
+def test_spec_sampling_t0_exactness_preserved():
+    """temperature=0 through the NEW argument surface still produces the
+    bitwise greedy continuation (key present but ignored)."""
+    params = SMALL.init(jax.random.key(0))
+    draft_params = SMALL.init(jax.random.key(9))
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    want = np.asarray(generate(SMALL, params, prompt, 8))
+    got = speculative_generate(
+        SMALL, params, SMALL, draft_params, prompt, 8, k=3,
+        temperature=0.0, key=jax.random.key(5),
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+    got = lookup_speculative_generate(
+        SMALL, params, prompt, 8, k=3, temperature=0.0,
+        key=jax.random.key(5),
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_spec_sampling_deterministic_per_key_and_validation():
+    params = SMALL.init(jax.random.key(0))
+    draft_params = SMALL.init(jax.random.key(9))
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+
+    a = speculative_generate(SMALL, params, SMALL, draft_params, prompt,
+                             6, k=2, temperature=1.0,
+                             key=jax.random.key(1))
+    b = speculative_generate(SMALL, params, SMALL, draft_params, prompt,
+                             6, k=2, temperature=1.0,
+                             key=jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="PRNG"):
+        speculative_generate(SMALL, params, SMALL, draft_params, prompt,
+                             4, temperature=0.5)
+    with pytest.raises(ValueError, match="PRNG"):
+        lookup_speculative_generate(SMALL, params, prompt, 4,
+                                    temperature=0.5)
+    with pytest.raises(ValueError, match="temperature"):
+        speculative_generate(SMALL, params, SMALL, draft_params, prompt,
+                             4, top_k=3)
+
+
+def test_spec_sampling_stats_capped_at_num_tokens():
+    """mean_accepted must count only tokens that land in the returned
+    buffer: a perfect draft at k > num_tokens cannot report more
+    accepted tokens than were emitted (ADVICE round-4 finding 1)."""
+    params = SMALL.init(jax.random.key(0))
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    _, stats = speculative_generate(
+        SMALL, params, SMALL, params, prompt, 3, k=6, return_stats=True
+    )
+    assert stats["mean_accepted"] <= 3.0
+
+
+def test_trainer_speculative_sampling_reachable():
+    """The product surface: LMTrainer.sample with --sample-speculative-k
+    AND --sample-temperature > 0 (+ top-k) runs the rejection-sampling
+    lookup path and returns valid tokens; a too-short prompt fails with
+    the trainer's vocabulary (ADVICE round-4 finding 2)."""
+    import pytest
+
+    from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+    from mpi_cuda_cnn_tpu.utils.config import LMConfig
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    cfg = LMConfig(corpus="synthetic", dim=32, depth=1, heads=2,
+                   seq_len=64, steps=2, batch_size=8, log_every=0,
+                   lr_schedule="constant", warmup_steps=0,
+                   sample_speculative_k=4, sample_temperature=0.8,
+                   sample_top_k=6)
+    t = LMTrainer(cfg, metrics=MetricsLogger(echo=False))
+    t.train()
+    # The CLI passes temperature=cfg.sample_temperature (cli.py).
+    _, cont = t.sample(8, temperature=cfg.sample_temperature, seed=3)
+    assert len(cont) == 8
+    assert all(0 <= int(c) < t.model.vocab for c in cont)
+    with pytest.raises(ValueError, match="prompt"):
+        t.sample(8, prompt_len=1, temperature=cfg.sample_temperature)
