@@ -43,12 +43,45 @@ def init_cache(model: TransformerLM, batch: int,
     bfloat16 halves the cache again: decode is cache-READ-bound (PERF.md
     decode table — tokens/s tracks cache bytes almost linearly), so the
     storage dtype is a bandwidth lever independent of GQA; scores and
-    softmax stay f32 either way (decode_block accumulates in f32)."""
+    softmax stay f32 either way (decode_block accumulates in f32).
+
+    `dtype` int8 is the next factor-2: k/v quantize per (position, head)
+    — absmax/127 scales stored alongside as f32 (B, S, Hkv, 1): +4
+    bytes per 512-byte f32 row at head_dim 128 (0.8% of the f32 cache's
+    bytes; ~3% of the int8 cache's). The scales never enter the MXU
+    contractions: a k-row's scale is constant along the contracted
+    head_dim, so it multiplies the LOGITS after the QK dot, and a
+    v-row's scale folds into the probabilities before the PV dot. The
+    STORED cache is pure int8 (the bandwidth lever); decode_block's
+    einsums consume it through an int8->f32 convert, whose cost shows
+    at the MHA shape (PERF.md round-5 decode table: int8 wins +27-32%
+    at GQA/MQA, loses ~9% at MHA where the convert spans 8x the
+    bytes)."""
     shape = (batch, model.max_seq, model.n_kv, model.head_dim)
+    if jnp.dtype(dtype) == jnp.int8:
+        sshape = shape[:-1] + (1,)
+        return [
+            {"k": jnp.zeros(shape, jnp.int8),
+             "ks": jnp.zeros(sshape, jnp.float32),
+             "v": jnp.zeros(shape, jnp.int8),
+             "vs": jnp.zeros(sshape, jnp.float32)}
+            for _ in range(model.depth)
+        ]
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(model.depth)
     ]
+
+
+def _quant_kv(x):
+    """Per-(batch, position, head) absmax int8 quantization of a
+    (B, T, Hkv, head_dim) k/v tensor: returns (int8 values, f32 scales
+    (B, T, Hkv, 1)) with x ≈ values * scales."""
+    xf = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-10)
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s
 
 
 def prefill(model: TransformerLM, params, prompt: jnp.ndarray,
@@ -64,19 +97,39 @@ def prefill(model: TransformerLM, params, prompt: jnp.ndarray,
     if s0 > model.max_seq:
         raise ValueError(f"prompt length {s0} exceeds max_seq {model.max_seq}")
     full = (b, model.max_seq, model.n_kv, model.head_dim)
+    sfull = full[:-1] + (1,)
+    int8 = jnp.dtype(cache_dtype) == jnp.int8
     cache: list[dict] = []
 
     def capture_attn(q, k, v):
-        cache.append({
-            "k": lax.dynamic_update_slice(
-                jnp.zeros(full, cache_dtype), k.astype(cache_dtype),
-                (0, 0, 0, 0),
-            ),
-            "v": lax.dynamic_update_slice(
-                jnp.zeros(full, cache_dtype), v.astype(cache_dtype),
-                (0, 0, 0, 0),
-            ),
-        })
+        if int8:
+            qk, sk = _quant_kv(k)
+            qv, sv = _quant_kv(v)
+            cache.append({
+                "k": lax.dynamic_update_slice(
+                    jnp.zeros(full, jnp.int8), qk, (0, 0, 0, 0)
+                ),
+                "ks": lax.dynamic_update_slice(
+                    jnp.zeros(sfull, jnp.float32), sk, (0, 0, 0, 0)
+                ),
+                "v": lax.dynamic_update_slice(
+                    jnp.zeros(full, jnp.int8), qv, (0, 0, 0, 0)
+                ),
+                "vs": lax.dynamic_update_slice(
+                    jnp.zeros(sfull, jnp.float32), sv, (0, 0, 0, 0)
+                ),
+            })
+        else:
+            cache.append({
+                "k": lax.dynamic_update_slice(
+                    jnp.zeros(full, cache_dtype), k.astype(cache_dtype),
+                    (0, 0, 0, 0),
+                ),
+                "v": lax.dynamic_update_slice(
+                    jnp.zeros(full, cache_dtype), v.astype(cache_dtype),
+                    (0, 0, 0, 0),
+                ),
+            })
         return attention(q, k, v, causal=True)
 
     logits = model.apply(
@@ -143,27 +196,53 @@ def decode_block(model: TransformerLM, params, toks, pos, cache):
         if model.pos == "rope":
             q = rope(q, positions)
             k = rope(k, positions)
-        ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
-                                      (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
-                                      (0, pos, 0, 0))
-        new_cache.append({"k": ck, "v": cv})
+        int8 = c["k"].dtype == jnp.int8
+        if int8:
+            qk8, sk8 = _quant_kv(k)
+            qv8, sv8 = _quant_kv(v)
+            ck = lax.dynamic_update_slice(c["k"], qk8, (0, pos, 0, 0))
+            cks = lax.dynamic_update_slice(c["ks"], sk8, (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(c["v"], qv8, (0, pos, 0, 0))
+            cvs = lax.dynamic_update_slice(c["vs"], sv8, (0, pos, 0, 0))
+            new_cache.append({"k": ck, "ks": cks, "v": cv, "vs": cvs})
+        else:
+            ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
+                                          (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
+                                          (0, pos, 0, 0))
+            new_cache.append({"k": ck, "v": cv})
         # Rows attend over the cached prefix + the block's causal part:
         # row i sees keys at positions <= pos+i.
         g = h // hkv
         qg = q.reshape(b, kk, hkv, g, hd)
         scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
         logits = jnp.einsum(
-            "bqhgd,bkhd->bhgqk", qg, ck, preferred_element_type=jnp.float32
+            "bqhgd,bkhd->bhgqk", qg,
+            ck.astype(jnp.float32) if int8 else ck,
+            preferred_element_type=jnp.float32,
         ) * scale                                 # (B, Hkv, g, k, max_seq)
+        if int8:
+            # A key row's scale is constant along the contracted
+            # head_dim, so it factors out of the dot: apply to logits.
+            logits = logits * jnp.transpose(cks, (0, 2, 3, 1))[:, :, None, :, :]
         valid = (jnp.arange(ck.shape[1])[None, :]
                  <= positions[:, None])           # (k, max_seq)
         logits = jnp.where(valid[None, None, None, :, :], logits, NEG_INF)
         probs = jax.nn.softmax(logits, axis=-1)
-        o = jnp.einsum(
-            "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,
-            preferred_element_type=jnp.float32,
-        ).reshape(b, kk, h * hd).astype(x.dtype)
+        if int8:
+            # A value row's scale multiplies its whole head_dim row in
+            # the weighted sum — fold it into the probabilities, keep
+            # the PV contraction reading pure int8.
+            pv = probs * jnp.transpose(cvs, (0, 2, 3, 1))[:, :, None, :, :]
+            o = jnp.einsum(
+                "bhgqk,bkhd->bqhgd", pv, cv.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            ).reshape(b, kk, h * hd).astype(x.dtype)
+        else:
+            o = jnp.einsum(
+                "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,
+                preferred_element_type=jnp.float32,
+            ).reshape(b, kk, h * hd).astype(x.dtype)
         x = x + o @ blk["wo"]
         y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
         if model.moe_experts:

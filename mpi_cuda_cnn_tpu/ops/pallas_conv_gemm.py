@@ -24,7 +24,8 @@ direct path (pallas_ops._conv_forward). Backward reuses pallas_ops'
 existing kernels (dx transposed-conv, dw accumulator) unchanged.
 
 Measured verdict lives in PERF.md ("Pallas conv/dense kernels" section);
-`scripts/bench_conv_shapes.py --gemm` produces the comparison rows.
+`scripts/bench_conv_shapes.py` emits the three-way comparison rows
+(XLA / direct / gemm) unconditionally.
 """
 
 from __future__ import annotations
@@ -60,12 +61,24 @@ def _conv1_gemm_kernel(x_ref, w_ref, o_ref, *, kh, kw, oh, ow):
     bn = x_ref.shape[0]
     cin = x_ref.shape[3]
     m = bn * oh * ow
-    cols = [
-        _flatten_pixels(x_ref[:, ky : ky + oh, kx : kx + ow, :], m, cin)
+    slices = [
+        x_ref[:, ky : ky + oh, kx : kx + ow, :]
         for ky in range(kh)
         for kx in range(kw)
     ]
-    p = jnp.concatenate(cols, axis=-1)                  # (M, kh*kw*Cin)
+    if x_ref.dtype == jnp.float32:
+        # Concatenate the window slices as 4-D values FIRST, then one
+        # pixel flatten — measurably faster (this ordering is what puts
+        # the deep f32 shapes AT or past XLA, PERF.md round-5 table).
+        p4 = jnp.concatenate(slices, axis=-1)  # (BN, OH, OW, kh*kw*Cin)
+        p = p4.reshape(m, kh * kw * cin)
+    else:
+        # Packed dtypes: Mosaic rejects the 4-D lane concat ("offset
+        # mismatch on non-concat dimension"), so flatten each slice
+        # (f32 round-trip) and concat in 2-D.
+        p = jnp.concatenate(
+            [_flatten_pixels(s, m, cin) for s in slices], axis=-1
+        )                                               # (M, kh*kw*Cin)
     o_ref[:] = (
         jnp.dot(p, w_ref[:], preferred_element_type=jnp.float32)
         .reshape(o_ref.shape)

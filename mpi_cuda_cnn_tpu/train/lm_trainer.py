@@ -106,13 +106,13 @@ class LMTrainer:
                 f"[0, seq_len {cfg.seq_len}) — the prompt needs >= 1 "
                 f"position of the decode budget"
             )
-        if cfg.decode_cache_dtype not in ("float32", "bfloat16"):
+        if cfg.decode_cache_dtype not in ("float32", "bfloat16", "int8"):
             # Same rationale: the auto-generated flag parser is type=str,
             # so a typo ('bf16') would otherwise surface only at
             # sampling time, after the whole run.
             raise ValueError(
                 f"--decode-cache-dtype {cfg.decode_cache_dtype!r} must "
-                "be 'float32' or 'bfloat16'"
+                "be 'float32', 'bfloat16', or 'int8'"
             )
         if cfg.sample_top_k < 0 or not 0.0 <= cfg.sample_top_p <= 1.0:
             raise ValueError(

@@ -189,6 +189,9 @@ class LMConfig(_JsonConfig):
     decode_cache_dtype: str = "float32"  # "bfloat16" halves the decode
                                      # KV-cache bytes (decode is cache-
                                      # read-bound: PERF.md decode table);
+                                     # "int8" quarters them (absmax per
+                                     # position x head, scales applied
+                                     # outside the dots — generate.py);
                                      # f32 = exactness default
 
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_cuda_cnn_tpu.ops.conv import conv2d
+from mpi_cuda_cnn_tpu.ops.pallas_conv_gemm import conv2d_pallas_gemm
 from mpi_cuda_cnn_tpu.ops.pallas_ops import conv2d_pallas
 from mpi_cuda_cnn_tpu.utils.sync import scan_two_point
 
@@ -61,10 +62,19 @@ def main():
                              args.iters)
             t_pl = dev_time(partial(conv2d_pallas, stride=s, padding=p), x,
                             wt, args.iters)
+            # Implicit-GEMM formulation (stride-1 only): the round-5
+            # answer to "was the direct kernel's deep-shape loss
+            # structural or a formulation gap?"
+            t_gemm = (
+                dev_time(partial(conv2d_pallas_gemm, stride=s, padding=p),
+                         x, wt, args.iters)
+                if s == 1 else float("nan")
+            )
             print(
                 f"{dt_name} {n}x{h}x{w}x{ci} k{k} -> {co} s{s}: "
                 f"xla {t_xla:7.3f} ms  pallas {t_pl:7.3f} ms  "
-                f"ratio {t_pl / t_xla:5.2f}"
+                f"gemm {t_gemm:7.3f} ms  "
+                f"ratio {t_pl / t_xla:5.2f}/{t_gemm / t_xla:5.2f}"
             )
 
 

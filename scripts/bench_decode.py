@@ -104,9 +104,11 @@ def main():
                          "prompt + 2N must fit --max-seq")
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--cache-dtype", default="float32",
-                    choices=["float32", "bfloat16"],
+                    choices=["float32", "bfloat16", "int8"],
                     help="KV-cache storage dtype; bfloat16 halves the "
-                         "bytes decode reads per token")
+                         "bytes decode reads per token, int8 quarters "
+                         "them (+4 f32 scale bytes per (position, head) "
+                         "row — 0.8%% of the f32 cache at head_dim 128)")
     ap.add_argument("--weights-dtype", default="float32",
                     choices=["float32", "bfloat16"],
                     help="serving weights dtype; decode reads every "
@@ -151,6 +153,11 @@ def main():
             args.batch * args.max_seq * hkv * model.head_dim * itemsize * 2
             * args.depth / 1e6
         )
+        if args.cache_dtype == "int8":
+            # + the per-(position, head) f32 absmax scales.
+            cache_mb += (
+                args.batch * args.max_seq * hkv * 4 * 2 * args.depth / 1e6
+            )
         label = f"kv{hkv}" + ("(MHA)" if hkv == args.heads else "")
         if args.cache_dtype != "float32":
             label += f"+{args.cache_dtype}"

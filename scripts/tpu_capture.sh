@@ -11,11 +11,16 @@
 #   5. bench_lm d=1024 config (MXU saturation lever; VERDICT #3)
 #   6. bench_lm d=1024 + fused chunked CE (the two levers together)
 #   7. bench_lm MoE row    (one measured MoE number; VERDICT #7)
-#   7b. bench_lm flagship  (head_dim-128 MFU config — 67.8% measured r4)
+#   7b. bench_lm MoE + dispatch-chunk 512 (round-5 2x single-chip lever)
+#   7c. bench_lm flagship  (head_dim-128 MFU config — 67.8% measured r4)
+#   7d. bench_lm flagship + grad-accum 4 (round-5 update-amortization)
 #   8. bench_decode        (KV-cache tokens/s, GQA cache win; VERDICT #5)
 #   8b. bench_decode bf16 cache (the round-4 serving lever)
-#   8c. bench_speculative  (draft-verified greedy decode, bit-exact)
+#   8c. bench_decode int8 cache (round-5: quarter bytes + absmax scales)
+#   8d. bench_configs      (five-config rows, two-point — round-5 form)
+#   8e. bench_speculative  (draft/lookup speculation incl. T=0.8 rows)
 #   9. profile_lm          (step-time attribution; VERDICT #3)
+#   9b. profile_moe        (MoE component attribution + chunk sweep)
 #  10. make -C native test_tpu  (C driver on the chip)
 # Usage:  sh scripts/tpu_capture.sh   (from the repo root)
 
@@ -53,11 +58,25 @@ step bench_lm_d1024_ce 900 python scripts/bench_lm.py --quick --dim 1024 \
     --depth 8 --heads 16 --batch 4 --ce-chunk 512
 step bench_lm_moe 900 python scripts/bench_lm.py --quick --moe-experts 8 \
     --moe-top-k 2
+# Round-5 lever: chunked dispatch kills the quadratic routing terms
+# (PERF.md "MoE single-chip attribution"; 512 = measured optimum).
+step bench_lm_moe_chunked 900 python scripts/bench_lm.py --quick \
+    --moe-experts 8 --moe-top-k 2 --moe-dispatch-chunk 512
 step bench_lm_flagship 900 python scripts/bench_lm.py --quick --dim 4096 \
     --depth 3 --heads 32 --batch 2
+# Round-5 lever: grad-accum amortizes the AdamW update's HBM traffic
+# (77.4% MFU at accum 16; the accum-4 point is the cheap re-check).
+step bench_lm_flagship_ga4 1200 python scripts/bench_lm.py --quick \
+    --dim 4096 --depth 3 --heads 32 --batch 8 --grad-accum 4
 step bench_decode 900 python scripts/bench_decode.py
 step bench_decode_bf16 900 python scripts/bench_decode.py \
     --cache-dtype bfloat16
+# Round-5: int8 KV cache (quarter bytes; absmax scales outside the dots).
+step bench_decode_int8 900 python scripts/bench_decode.py \
+    --cache-dtype int8
+# Round-5: stabilized five-config rows (two-point; tunnel-independent).
+step bench_configs 1200 python scripts/bench_configs.py
+step profile_moe 900 python scripts/profile_moe.py
 step bench_speculative 900 python scripts/bench_speculative.py
 step profile_lm 900 python scripts/profile_lm.py
 # make prints recipes/compiler lines on stdout — keep the JSONL clean by
