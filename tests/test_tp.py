@@ -305,3 +305,39 @@ def test_tp_sharded_decode_matches_single_device(eight_devices):
 
     got = generate(model, tp_params, prompt, 8)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tp_sharded_decode_int8_cache(eight_devices):
+    """The round-5 int8 KV cache composes with sharded serving: the
+    quantized cache + scale buffers are created INSIDE the jitted decode
+    program, so GSPMD partitions them from the Megatron placement like
+    any other decode intermediate. Tokens must match the single-device
+    int8-cache run exactly (same quantization, same math, different
+    layout)."""
+    from mpi_cuda_cnn_tpu.models.generate import generate
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.parallel.tp import shard_lm_params
+
+    from mpi_cuda_cnn_tpu.models.generate import prefill
+
+    model = TransformerLM(vocab=32, dim=32, heads=4, depth=2, max_seq=32)
+    params = model.init(jax.random.key(3))
+    rng = np.random.default_rng(4)
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 8)), jnp.int32)
+
+    want = generate(model, params, prompt, 8, cache_dtype="int8")
+
+    mesh = make_mesh({MODEL_AXIS: 4}, devices=jax.devices()[:4])
+    tp_params = shard_lm_params(model, params, mesh)
+    # Same reduction-order guard as the sibling f32 test: token equality
+    # is only meaningful while the top-2 logit gap dwarfs the TP
+    # row-parallel float noise (int8 adds a second tie hazard — a k/v
+    # value at a .5 quantization boundary could round differently).
+    lw, _ = prefill(model, params, prompt)
+    lg, _ = prefill(model, tp_params, prompt)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(lw),
+                               rtol=1e-5, atol=1e-5)
+    top2 = np.sort(np.asarray(lw), axis=-1)[:, -2:]
+    assert (top2[:, 1] - top2[:, 0]).min() > 1e-3
+    got = generate(model, tp_params, prompt, 8, cache_dtype="int8")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
