@@ -382,3 +382,16 @@ def test_int8_cache_decode_close_and_really_int8():
     out = lookup_speculative_generate(MODEL, params, prompt, 4, k=2,
                                       cache_dtype="int8")
     assert out.shape == (1, 4)
+
+
+def test_filter_logits_top_k_clamps_to_vocab():
+    """Direct filter_logits callers with top_k > vocab get the whole
+    vocabulary kept (clamp), not an opaque negative-index sort error
+    (ADVICE round-4 finding 4)."""
+    from mpi_cuda_cnn_tpu.models.generate import filter_logits
+    from mpi_cuda_cnn_tpu.ops.attention import NEG_INF
+
+    l = jnp.asarray([[2.0, -1.0, 3.0]])
+    out = np.asarray(filter_logits(l, top_k=10))
+    assert (out > NEG_INF / 2).all()
+    np.testing.assert_allclose(out, np.asarray(l))
