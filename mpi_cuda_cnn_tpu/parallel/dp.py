@@ -55,16 +55,36 @@ def dp_shard_perm(perm, mesh, axis: str = DATA_AXIS):
     return jax.device_put(perm, NamedSharding(mesh, spec))
 
 
-def _local_grads(loss_fn: Callable, params, x, y, grad_accum: int):
+def _local_grads(loss_fn: Callable, params, x, y, grad_accum: int,
+                 accum_dtype=None):
     """(loss, aux, grads) on the local shard, optionally accumulated over
     `grad_accum` sequential micro-batches (lax.scan keeps ONE micro-batch
     of activations live — the memory half of the reference's 32-sample
-    accumulator semantics, cnn.c:467-469, generalized)."""
+    accumulator semantics, cnn.c:467-469, generalized).
+
+    accum_dtype (e.g. jnp.bfloat16) stores the gradient ACCUMULATOR in
+    that dtype — half the grad-tree bytes per scan iteration IF the
+    carry is a real HBM pass. Measured on the v5e flagship it is NOT:
+    XLA fuses the accumulate into the backward's epilogue, so bf16
+    carry ties f32 (876 vs 871 ms at accum 8 — PERF.md flagship
+    section records the non-win so nobody re-derives it). The flag
+    stays for backends/shapes where that fusion doesn't hold; default
+    None keeps exact f32 accumulation. The mean is cast back to the
+    param dtype before the optimizer. Accuracy when on: summing N bf16
+    micro-grads loses ~sqrt(N)*2^-8 relative (~1-2% at N=16-32) — the
+    same error class as bf16 gradient all-reduce, bounded by test.
+    Loss/aux always accumulate f32 (scalars — free)."""
+
+    if grad_accum <= 1:
+        accum_dtype = None  # no accumulator, no traffic to save — and a
+        #                     cast round-trip would only lose precision
 
     def compute(px, py):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, px, py
         )
+        if accum_dtype is not None:
+            grads = jax.tree.map(lambda g: g.astype(accum_dtype), grads)
         return loss, aux, grads
 
     if grad_accum <= 1:
@@ -89,17 +109,21 @@ def _local_grads(loss_fn: Callable, params, x, y, grad_accum: int):
         zeros,
         (xs, ys),
     )
-    return jax.tree.map(lambda t: t / a, totals)
+    loss, aux, grads = jax.tree.map(lambda t: t / a, totals)
+    if accum_dtype is not None:
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+    return loss, aux, grads
 
 
-def local_grads_no_aux(loss_fn, params, x, y, grad_accum: int):
+def local_grads_no_aux(loss_fn, params, x, y, grad_accum: int,
+                       accum_dtype=None):
     """(loss, grads) for an aux-free scalar loss_fn(params, x, y) —
     the one shim over _local_grads the LM steps share (train/lm.py,
     parallel/sp.py, parallel/ep.py) instead of each faking an aux."""
 
     loss, _, grads = _local_grads(
         lambda p, a, b: (loss_fn(p, a, b), jnp.float32(0)),
-        params, x, y, grad_accum,
+        params, x, y, grad_accum, accum_dtype=accum_dtype,
     )
     return loss, grads
 

@@ -148,8 +148,15 @@ def make_lm_train_step(
     ce_chunk: int = 0,
     grad_accum: int = 1,
     moe_dispatch_chunk: int = 0,
+    accum_dtype=None,
 ):
     """step(state, tokens, targets) -> (state, {"loss": ...}), jitted.
+
+    accum_dtype (jnp.bfloat16 or the string "bfloat16") stores the
+    grad-accumulation carry in that dtype — halves the per-microbatch
+    grad-tree HBM traffic that bounds the grad-accum MFU ladder
+    (dp._local_grads for the accuracy band; only meaningful with
+    grad_accum > 1, ignored otherwise).
 
     state = {"params", "opt_state", "step"} — the same pytree-of-arrays
     state scheme as every other train step (checkpointable by
@@ -169,6 +176,8 @@ def make_lm_train_step(
     """
     import optax
 
+    if accum_dtype is not None:
+        accum_dtype = jnp.dtype(accum_dtype)
     impl = pick_attn_impl(attn_impl, seq_len or model.max_seq, compute_dtype)
     attn_fn = get_attn_fn(impl)
     loss = partial(
@@ -192,7 +201,8 @@ def make_lm_train_step(
         from ..parallel.dp import local_grads_no_aux
 
         l, grads = local_grads_no_aux(
-            loss, state["params"], tokens, targets, grad_accum
+            loss, state["params"], tokens, targets, grad_accum,
+            accum_dtype=accum_dtype,
         )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]

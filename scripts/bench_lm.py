@@ -44,12 +44,14 @@ PEAK_TFLOPS = {"tpu_v5e_bf16": 197.0, "tpu_v5e_f32": 49.0}
 
 def bench_config(model, *, batch, seq, compute_dtype, attn_impl,
                  steps=20, warmup=3, seed=0, ce_chunk=0,
-                 moe_dispatch_chunk=0, grad_accum=1, remat=False):
+                 moe_dispatch_chunk=0, grad_accum=1, remat=False,
+                 accum_dtype=None):
     opt = make_optimizer(3e-4, opt="adamw", schedule="constant")
     step_fn = make_lm_train_step(
         model, opt, attn_impl=attn_impl, seq_len=seq,
         compute_dtype=compute_dtype, remat=remat, ce_chunk=ce_chunk,
         moe_dispatch_chunk=moe_dispatch_chunk, grad_accum=grad_accum,
+        accum_dtype=accum_dtype,
     )
     state = make_lm_state(model, opt, seed)
     rng = np.random.default_rng(seed)
@@ -124,6 +126,12 @@ def main():
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="micro-batch accumulation (must divide batch); "
                          "amortizes the optimizer update's HBM traffic")
+    ap.add_argument("--accum-dtype", default=None,
+                    choices=[None, "bfloat16", "float32"],
+                    help="grad-accumulation carry dtype; measured a TIE "
+                         "on v5e (XLA fuses the accumulate into the bwd "
+                         "epilogue — PERF.md) but kept for backends "
+                         "where it isn't (~1-2%% grad error band)")
     ap.add_argument("--remat", action="store_true",
                     help="jax.checkpoint per block (recompute-in-bwd)")
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
@@ -195,6 +203,7 @@ def main():
             compute_dtype=cd, attn_impl=impl, steps=args.steps,
             ce_chunk=ce, moe_dispatch_chunk=args.moe_dispatch_chunk,
             grad_accum=args.grad_accum, remat=args.remat,
+            accum_dtype=args.accum_dtype,
         )
         tok_s = tokens_per_step / dt
         mfu = (
@@ -213,6 +222,8 @@ def main():
             extras["moe_dispatch_chunk"] = args.moe_dispatch_chunk
         if args.grad_accum > 1:
             extras["grad_accum"] = args.grad_accum
+        if args.accum_dtype:
+            extras["accum_dtype"] = args.accum_dtype
         if args.remat:
             extras["remat"] = True
         print(json.dumps({
