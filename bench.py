@@ -76,13 +76,32 @@ def _run() -> None:
     times.sort()
     epoch_s = times[len(times) // 2]
 
+    # Informational: the epoch's DEVICE time (Trainer.device_epoch_seconds
+    # — the one shared two-point implementation). The primary metric
+    # stays the wall-clock the baseline was measured in; this field
+    # documents how much of it is the remote-tunnel dispatch (~80% for
+    # this model). Cost/safety guards: the pass runs ~19 extra epochs,
+    # so skip it when that would approach the parent's attempt timeout
+    # (a jittery-tunnel day must not discard the already-measured
+    # headline), and only on a TPU backend (on CPU the wall-clock is
+    # already honest).
+    import jax
+
+    device_s = None
+    if jax.default_backend() == "tpu" and 19 * epoch_s < 30.0:
+        est = trainer.device_epoch_seconds()
+        device_s = round(est, 4) if est is not None else None
+
     print(json.dumps({
         "metric": "mnist_epoch_wallclock",
         "value": round(epoch_s, 3),
         "unit": "s",
         "vs_baseline": round(REFERENCE_EPOCH_S / epoch_s, 2),
         "best_s": round(times[0], 3),
-        "note": "value = median of 5 epochs; best_s = fastest of the same 5",
+        "device_epoch_s": device_s,
+        "note": "value = median of 5 wall-clock epochs (one tunnel "
+                "dispatch each); device_epoch_s = two-point on-device "
+                "epoch time (dispatch window cancelled)",
     }))
 
 

@@ -487,6 +487,50 @@ class Trainer:
                 grad_accum=self.cfg.grad_accum,
             )
 
+    def device_epoch_seconds(self, *, reps: int = 3, k: int = 2,
+                             min_signal_s: float = 0.015) -> float | None:
+        """On-device steady-state epoch seconds via the shared two-point
+        recipe (utils/sync.two_point): k scanned epochs dispatched
+        back-to-back with ONE hard sync, so (T(2k)-T(k))/k cancels any
+        fixed per-window cost — under this environment's remote-TPU
+        tunnel that is the ~100-300 ms dispatch round-trip dominating a
+        single epoch's wall-clock. The ONE implementation behind
+        bench.py's `device_epoch_s` field and bench_configs' primary
+        column (the recipe must not drift per caller — that per-script
+        drift caused every shipped measurement bug, utils/sync.py).
+
+        Runs ~reps*(3k)+1 extra epochs, advancing self.state (harmless
+        for a timing run). Returns None when the scanned path isn't
+        staged (streaming fallback) or the slope is non-positive (a
+        backend transient — callers fall back to wall-clock)."""
+        from ..utils.sync import two_point
+
+        if not self._use_scan() or self._scan_epoch_fn is None:
+            return None
+        b = self.cfg.batch_size
+        nsteps = self.steps_per_epoch
+        perm = (self._epoch_order(0)[: nsteps * b]
+                .reshape(nsteps, b).astype(np.int32))
+        rows = dp_shard_perm(perm, self.mesh)
+
+        def run(m):
+            t0 = time.perf_counter()
+            sums = None
+            for _ in range(m):
+                # Thread self.state so donated buffers stay valid.
+                self.state, sums = self._scan_epoch_fn(
+                    self.state, self._dev_images, self._dev_labels, rows
+                )
+            hard_block(sums)
+            return time.perf_counter() - t0
+
+        est = two_point(run, k, warmup=1, reps=reps)
+        if 0 < est < min_signal_s:
+            # Sub-15 ms epochs leave the window diff inside tunnel
+            # jitter; re-measure with ~100 ms of signal per window.
+            est = two_point(run, 16, warmup=0, reps=reps)
+        return est if est > 0 else None
+
     def _run_epoch_scanned(self, epoch: int, *, skip_steps: int = 0) -> dict:
         """Scanned epoch: one device dispatch per `log_every` steps (one per
         epoch when logging is off). The host sends only the int32 batch

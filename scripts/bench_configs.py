@@ -45,67 +45,23 @@ SYNTHETIC_FALLBACK = {
 
 
 def steady_epoch_seconds(trainer) -> float | None:
-    """Tunnel-stable steady-state epoch seconds via the shared two-point
-    recipe (utils/sync.two_point): k scanned epochs dispatched
-    back-to-back with ONE hard sync at the end, so (T(2k)-T(k))/k
-    cancels the tunnel's fixed per-window round-trip — the round-4 rows
-    measured single wall-clocks and "tracked tunnel conditions, not
-    kernels" (PERF.md five-config caveat). Requires the scanned path
-    (returns None on the oversized-dataset streaming fallback). The
-    extra epochs keep training the state — harmless for a timing run."""
-    import time
-
-    import numpy as np
-
-    from mpi_cuda_cnn_tpu.parallel.dp import dp_shard_perm
-    from mpi_cuda_cnn_tpu.utils.sync import hard_block, two_point
-
+    """Tunnel-stable steady-state epoch seconds — the shared
+    implementation is Trainer.device_epoch_seconds (two-point over
+    pipelined scanned epochs; the round-4 rows measured single
+    wall-clocks and "tracked tunnel conditions, not kernels" — PERF.md
+    five-config caveat). reps=5: median-of-3 still let one-window
+    transients through on ~10% of rows across four banked round-5 runs
+    (a dp4 9.4 ms against three ~7.1 ms runs; a vgg 109 ms against
+    three ~90 ms); five windows cost ~2 s more and pin the median.
+    TPU-gated: on CPU the wall-clock is already honest and the ~30
+    extra epochs would dominate the run. None -> wall-clock fallback
+    (also on a non-positive slope — the same guard as bench_decode's
+    `ok = per_tok > 0`)."""
     import jax
 
     if jax.default_backend() != "tpu":
-        # The recipe exists to cancel the TPU tunnel's dispatch window;
-        # on CPU the wall-clock is already honest and the extra ~30
-        # epochs (reps=5 x two windows) would dominate the run.
         return None
-    if getattr(trainer, "_scan_epoch_fn", None) is None:
-        return None
-    b = trainer.cfg.batch_size
-    nsteps = trainer.steps_per_epoch
-    perm = (
-        trainer._epoch_order(0)[: nsteps * b]
-        .reshape(nsteps, b)
-        .astype(np.int32)
-    )
-    rows = dp_shard_perm(perm, trainer.mesh)
-
-    def run(m):
-        t0 = time.perf_counter()
-        sums = None
-        for _ in range(m):
-            # Thread trainer.state so donated buffers stay valid.
-            trainer.state, sums = trainer._scan_epoch_fn(
-                trainer.state, trainer._dev_images, trainer._dev_labels,
-                rows,
-            )
-        hard_block(sums)
-        return time.perf_counter() - t0
-
-    # reps=5: the default median-of-3 still let one-window transients
-    # through on ~10% of rows across four banked round-5 runs (a dp4
-    # 9.4 ms against three ~7.1 ms runs; a vgg 109 ms against three
-    # ~90 ms); five windows cost ~2 s more and pin the median.
-    est = two_point(run, 2, warmup=1, reps=5)
-    if est < 0.015:
-        # Sub-15 ms epochs (lenet5 at 8k samples is ~6 ms of device
-        # time): k=2 leaves the window diff inside tunnel jitter — the
-        # run-to-run spread the recipe exists to kill. Re-measure with
-        # enough epochs per window for ~100 ms of signal.
-        est = two_point(run, 16, warmup=0, reps=5)
-    # A backend transient can push even a median-of-5 slope non-positive
-    # (two_point's own docstring records a 15x one-window pathology);
-    # report the wall-clock fallback rather than a nonsense primary
-    # value — the same guard as bench_decode's `ok = per_tok > 0`.
-    return est if est > 0 else None
+    return trainer.device_epoch_seconds(reps=5)
 
 
 def main() -> None:

@@ -80,11 +80,22 @@ def check_config(*, b, h, hkv, s, d, dtype, bwd, rng):
     # cannot resolve these sub-10 ms kernels through the tunnel's jitter
     # — observed negative columns at n=3 AND n=25); the fwd+bwd target
     # is the shared grad_stacked wrapper.
+    def timed(fn, n, *args):
+        t = scan_two_point(fn, n, *args)
+        if t * n < 0.05:
+            # The s=2048 kernels are ~0.1 ms: n=25 gives ~2.5 ms of
+            # window signal, below the tunnel's jitter — the source of
+            # the round-4/5 captures' occasional negative columns.
+            # Re-measure with enough iterations for ~100 ms of signal.
+            n2 = min(max(50, int(0.1 / max(t, 2e-6))), 2000)
+            t = scan_two_point(fn, n2, *args)
+        return t
+
     fwd_fn = lambda q, k, v: flash_attention(q, k, v, True)
-    t_fwd = scan_two_point(fwd_fn, 25, q, k, v)
+    t_fwd = timed(fwd_fn, 25, q, k, v)
     t_bwd = None
     if bwd:
-        t_bwd = scan_two_point(grad_stacked(fwd_fn), 10, q, k, v)
+        t_bwd = timed(grad_stacked(fwd_fn), 10, q, k, v)
     return {
         "s": s, "kv_heads": hkv, "dtype": str(jnp.dtype(dtype)),
         "parity_rel_err": round(rel, 6), "parity_ok": ok,
