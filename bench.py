@@ -80,15 +80,12 @@ def _run() -> None:
     # — the one shared two-point implementation). The primary metric
     # stays the wall-clock the baseline was measured in; this field
     # documents how much of it is the remote-tunnel dispatch (~80% for
-    # this model). Cost/safety guards: the pass runs ~19 extra epochs,
-    # so skip it when that would approach the parent's attempt timeout
-    # (a jittery-tunnel day must not discard the already-measured
-    # headline), and only on a TPU backend (on CPU the wall-clock is
-    # already honest).
-    import jax
-
+    # this model). Cost guard: the pass runs ~19 extra epochs, so skip
+    # it when that would approach the parent's attempt timeout (a
+    # jittery-tunnel day must not discard the already-measured
+    # headline). The non-TPU gate lives inside the shared method.
     device_s = None
-    if jax.default_backend() == "tpu" and 19 * epoch_s < 30.0:
+    if 19 * epoch_s < 30.0:
         est = trainer.device_epoch_seconds()
         device_s = round(est, 4) if est is not None else None
 

@@ -500,11 +500,16 @@ class Trainer:
         drift caused every shipped measurement bug, utils/sync.py).
 
         Runs ~reps*(3k)+1 extra epochs, advancing self.state (harmless
-        for a timing run). Returns None when the scanned path isn't
-        staged (streaming fallback) or the slope is non-positive (a
-        backend transient — callers fall back to wall-clock)."""
+        for a timing run). Returns None on a non-TPU backend (the
+        recipe exists to cancel the TPU tunnel's dispatch window; on
+        CPU the wall-clock is already honest and the extra epochs would
+        dominate the caller's run), when the scanned path isn't staged
+        (streaming fallback), or when the slope stays non-positive (a
+        backend transient) — callers fall back to wall-clock."""
         from ..utils.sync import two_point
 
+        if jax.default_backend() != "tpu":
+            return None
         if not self._use_scan() or self._scan_epoch_fn is None:
             return None
         b = self.cfg.batch_size
@@ -525,9 +530,11 @@ class Trainer:
             return time.perf_counter() - t0
 
         est = two_point(run, k, warmup=1, reps=reps)
-        if 0 < est < min_signal_s:
+        if est < min_signal_s:
             # Sub-15 ms epochs leave the window diff inside tunnel
-            # jitter; re-measure with ~100 ms of signal per window.
+            # jitter; re-measure with ~100 ms of signal per window. A
+            # NEGATIVE first slope is the same artifact class and gets
+            # the same retry (not an early None).
             est = two_point(run, 16, warmup=0, reps=reps)
         return est if est > 0 else None
 

@@ -53,14 +53,9 @@ def steady_epoch_seconds(trainer) -> float | None:
     transients through on ~10% of rows across four banked round-5 runs
     (a dp4 9.4 ms against three ~7.1 ms runs; a vgg 109 ms against
     three ~90 ms); five windows cost ~2 s more and pin the median.
-    TPU-gated: on CPU the wall-clock is already honest and the ~30
-    extra epochs would dominate the run. None -> wall-clock fallback
-    (also on a non-positive slope — the same guard as bench_decode's
-    `ok = per_tok > 0`)."""
-    import jax
-
-    if jax.default_backend() != "tpu":
-        return None
+    None -> wall-clock fallback (non-TPU backend — the gate lives in
+    the shared method — or a persistently non-positive slope, the same
+    guard as bench_decode's `ok = per_tok > 0`)."""
     return trainer.device_epoch_seconds(reps=5)
 
 

@@ -77,17 +77,22 @@ def train_on_text(model, tokens, *, steps, batch, seq, lr=1e-3, seed=0):
 
 
 def timed_tokens(fn, n, attempts=3, floor=0.0):
-    """s/token of a generate-style call via the shared two-point core:
-    fn(m) must produce m tokens and force completion. A backend
-    transient can push even the median-of-3 slope NEGATIVE (observed: a
-    banked -0.095 ms/tok row) or impossibly FAST (observed round 5: a
-    lookup-k8 slope reading 85x speedup, ~7x above every healthy
-    window's measurement) — a value at or below `floor` is re-measured,
-    never emitted. Callers pass plain/(k*3) for speculative modes: the
-    per-round emit is <= k tokens, and the measured legitimate range
-    runs to ~1.8x k (block-forward + loop overheads amortize BETTER
-    than one plain step — banked lookup-k8 rows reach 12-14x), so the
-    3x-k margin rejects only transient-class values."""
+    """(s/token, suspect) of a generate-style call via the shared
+    two-point core: fn(m) must produce m tokens and force completion.
+    A backend transient can push even the median-of-3 slope NEGATIVE
+    (observed: a banked -0.095 ms/tok row) or impossibly FAST (observed
+    round 5: a lookup-k8 slope reading 85x speedup, ~7x above every
+    healthy window's measurement) — a value at or below `floor` is
+    re-measured up to `attempts` times. Callers pass plain/(k*4) for
+    speculative modes (per-round emit <= k tokens; banked legitimate
+    rows reach ~2x k because the verify block + while_loop amortize far
+    better than one plain step per round, and a first 3x-k margin was
+    itself outrun by a healthy window). If every attempt stays at or
+    below the floor the LAST positive sample is returned with
+    suspect=True — the row is emitted flagged, never silently dropped
+    and never allowed to kill the remaining bench rows (a raise here
+    cost one banked capture its speculative section; non-positive
+    slopes with no positive sample at all still raise)."""
 
     def run(m):
         t0 = time.perf_counter()
@@ -95,15 +100,32 @@ def timed_tokens(fn, n, attempts=3, floor=0.0):
         return time.perf_counter() - t0
 
     run(n), run(2 * n)  # warm both program sizes
+    last_positive = None
     for _ in range(attempts):
         t = two_point(run, n, warmup=0)
         if t > floor:
-            return t
+            return t, False
+        if t > 0:
+            last_positive = t
+    if last_positive is not None:
+        return last_positive, True
     raise RuntimeError(
-        f"two-point slope stayed at or below the plausibility floor "
-        f"({floor * 1e3:.4f} ms/tok) over {attempts} median-of-3 "
-        "attempts — backend too unstable to measure"
+        f"two-point slope stayed non-positive over {attempts} "
+        "median-of-3 attempts — backend too unstable to measure"
     )
+
+
+def try_timed(fn, n, floor):
+    """timed_tokens for the SPECULATIVE rows: an unmeasurable mode
+    (persistently non-positive slope) returns (None, True) so the
+    caller emits a skipped row and the bench CONTINUES — one jittery
+    mode must not cost the capture every later row (it did once:
+    banked bench_speculative_final_r5 rc=1). The plain baselines keep
+    the raise — without them the speedup columns mean nothing."""
+    try:
+        return timed_tokens(fn, n, floor=floor)
+    except RuntimeError:
+        return None, True
 
 
 def main():
@@ -150,7 +172,7 @@ def main():
         (np.arange(args.prompt)[None, :] % args.vocab), jnp.int32
     )
 
-    t_plain = timed_tokens(
+    t_plain, _ = timed_tokens(
         lambda m: generate(target, t_params, prompt, m), args.tokens
     )
     want = np.asarray(generate(target, t_params, prompt, args.tokens))
@@ -169,12 +191,17 @@ def main():
             k=k, return_stats=True,
         )
         exact = bool(np.array_equal(np.asarray(got), want))
-        t_spec = timed_tokens(
+        t_spec, sus = try_timed(
             lambda m: speculative_generate(
                 target, t_params, draft, d_params, prompt, m, k=k
             ),
-            args.tokens, floor=t_plain / (k * 3.0),
+            args.tokens, t_plain / (k * 4.0),
         )
+        if t_spec is None:
+            print(json.dumps({"bench": "speculative",
+                              "mode": f"draft_k{k}",
+                              "skipped": "unmeasurable"}), flush=True)
+            continue
         row = {
             "bench": "speculative", "mode": f"draft_k{k}",
             "ms_per_tok": round(t_spec * 1e3, 3),
@@ -182,10 +209,11 @@ def main():
             "mean_accepted": round(stats["mean_accepted"], 2),
             "speedup_vs_plain": round(t_plain / t_spec, 2),
             "greedy_exact": exact,
+            **({"suspect_fast": True} if sus else {}),
         }
         print(json.dumps(row), flush=True)
         rows.append(row)
-        if row["tokens_per_s"] > best[0] and exact:
+        if row["tokens_per_s"] > best[0] and exact and not sus:
             best = (row["tokens_per_s"], f"k={k}")
 
     # Draft-FREE prompt-lookup speculation (the CLI-reachable form):
@@ -197,7 +225,7 @@ def main():
         (np.arange(args.vocab + 49)[None, :] % args.vocab), jnp.int32
     )
     lk_want = np.asarray(generate(target, t_params, lk_prompt, args.tokens))
-    lk_plain = timed_tokens(
+    lk_plain, _ = timed_tokens(
         lambda m: generate(target, t_params, lk_prompt, m), args.tokens
     )
     for k in (int(x) for x in args.ks.split(",")):
@@ -206,12 +234,17 @@ def main():
             return_stats=True,
         )
         lk_got = np.asarray(lk_toks)
-        t_lk = timed_tokens(
+        t_lk, sus = try_timed(
             lambda m: lookup_speculative_generate(
                 target, t_params, lk_prompt, m, k=k
             ),
-            args.tokens, floor=lk_plain / (k * 3.0),
+            args.tokens, lk_plain / (k * 4.0),
         )
+        if t_lk is None:
+            print(json.dumps({"bench": "speculative",
+                              "mode": f"lookup_k{k}",
+                              "skipped": "unmeasurable"}), flush=True)
+            continue
         row = {
             "bench": "speculative", "mode": f"lookup_k{k}",
             "ms_per_tok": round(t_lk * 1e3, 3),
@@ -219,9 +252,11 @@ def main():
             "mean_accepted": round(lstats["mean_accepted"], 2),
             "speedup_vs_plain": round(lk_plain / t_lk, 2),
             "greedy_exact": bool(np.array_equal(lk_got, lk_want)),
+            **({"suspect_fast": True} if sus else {}),
         }
         print(json.dumps(row), flush=True)
-        if row["tokens_per_s"] > best[0] and row["greedy_exact"]:
+        if row["tokens_per_s"] > best[0] and row["greedy_exact"] \
+                and not sus:
             best = (row["tokens_per_s"], f"lookup_k{k}")
 
     # Rejection-sampling speculation at temperature 0.8 (round 5): the
@@ -231,7 +266,7 @@ def main():
     # distribution equality; no bitwise assert is possible for sampling).
     temp = 0.8
     skey = jax.random.key(11)
-    t_plain_T = timed_tokens(
+    t_plain_T, _ = timed_tokens(
         lambda m: generate(target, t_params, prompt, m, temperature=temp,
                            key=skey),
         args.tokens,
@@ -246,22 +281,28 @@ def main():
             target, t_params, draft, d_params, prompt, args.tokens,
             k=k, temperature=temp, key=skey, return_stats=True,
         )
-        t_sT = timed_tokens(
+        t_sT, susT = try_timed(
             lambda m: speculative_generate(
                 target, t_params, draft, d_params, prompt, m, k=k,
                 temperature=temp, key=skey,
             ),
-            args.tokens, floor=t_plain_T / (k * 3.0),
+            args.tokens, t_plain_T / (k * 4.0),
         )
+        if t_sT is None:
+            print(json.dumps({"bench": "speculative",
+                              "mode": f"draft_k{k}_T{temp}",
+                              "skipped": "unmeasurable"}), flush=True)
+            continue
         print(json.dumps({
             "bench": "speculative", "mode": f"draft_k{k}_T{temp}",
             "ms_per_tok": round(t_sT * 1e3, 3),
             "tokens_per_s": round(1.0 / t_sT),
             "mean_accepted": round(sst["mean_accepted"], 2),
             "speedup_vs_plain": round(t_plain_T / t_sT, 2),
+            **({"suspect_fast": True} if susT else {}),
         }), flush=True)
     # Lookup sampling on the cycle-spanning prompt.
-    lk_plain_T = timed_tokens(
+    lk_plain_T, _ = timed_tokens(
         lambda m: generate(target, t_params, lk_prompt, m,
                            temperature=temp, key=skey),
         args.tokens,
@@ -271,19 +312,25 @@ def main():
             target, t_params, lk_prompt, args.tokens, k=k,
             temperature=temp, key=skey, return_stats=True,
         )
-        t_lkT = timed_tokens(
+        t_lkT, susLT = try_timed(
             lambda m: lookup_speculative_generate(
                 target, t_params, lk_prompt, m, k=k, temperature=temp,
                 key=skey,
             ),
-            args.tokens, floor=lk_plain_T / (k * 3.0),
+            args.tokens, lk_plain_T / (k * 4.0),
         )
+        if t_lkT is None:
+            print(json.dumps({"bench": "speculative",
+                              "mode": f"lookup_k{k}_T{temp}",
+                              "skipped": "unmeasurable"}), flush=True)
+            continue
         print(json.dumps({
             "bench": "speculative", "mode": f"lookup_k{k}_T{temp}",
             "ms_per_tok": round(t_lkT * 1e3, 3),
             "tokens_per_s": round(1.0 / t_lkT),
             "mean_accepted": round(lst["mean_accepted"], 2),
             "speedup_vs_plain": round(lk_plain_T / t_lkT, 2),
+            **({"suspect_fast": True} if susLT else {}),
         }), flush=True)
 
     # Lookup on REAL text: a fresh target trained briefly on the
@@ -301,29 +348,36 @@ def main():
         )
         sp = jnp.asarray(np.asarray(text[:512])[None, :], jnp.int32)
         sp_want = np.asarray(generate(st, st_params, sp, args.tokens))
-        t_sp_plain = timed_tokens(
+        t_sp_plain, _ = timed_tokens(
             lambda m: generate(st, st_params, sp, m), args.tokens
         )
         got, sstats = lookup_speculative_generate(
             st, st_params, sp, args.tokens, k=8, return_stats=True
         )
-        t_sp_lk = timed_tokens(
+        t_sp_lk, sus_sp = try_timed(
             lambda m: lookup_speculative_generate(st, st_params, sp, m,
                                                   k=8),
-            args.tokens, floor=t_sp_plain / (8 * 3.0),
+            args.tokens, t_sp_plain / (8 * 4.0),
         )
-        print(json.dumps({
-            "bench": "speculative", "mode": "self_corpus_lookup_k8",
-            "train_steps": args.self_corpus_steps,
-            "train_loss": round(st_loss, 3),
-            "plain_ms_per_tok": round(t_sp_plain * 1e3, 3),
-            "ms_per_tok": round(t_sp_lk * 1e3, 3),
-            "mean_accepted": round(sstats["mean_accepted"], 2),
-            "speedup_vs_plain": round(t_sp_plain / t_sp_lk, 2),
-            "greedy_exact": bool(
-                np.array_equal(np.asarray(got), sp_want)
-            ),
-        }), flush=True)
+        if t_sp_lk is None:
+            print(json.dumps({"bench": "speculative",
+                              "mode": "self_corpus_lookup_k8",
+                              "skipped": "unmeasurable"}), flush=True)
+            t_sp_lk = None
+        if t_sp_lk is not None:
+            print(json.dumps({
+                "bench": "speculative", "mode": "self_corpus_lookup_k8",
+                "train_steps": args.self_corpus_steps,
+                "train_loss": round(st_loss, 3),
+                "plain_ms_per_tok": round(t_sp_plain * 1e3, 3),
+                "ms_per_tok": round(t_sp_lk * 1e3, 3),
+                "mean_accepted": round(sstats["mean_accepted"], 2),
+                "speedup_vs_plain": round(t_sp_plain / t_sp_lk, 2),
+                "greedy_exact": bool(
+                    np.array_equal(np.asarray(got), sp_want)
+                ),
+                **({"suspect_fast": True} if sus_sp else {}),
+            }), flush=True)
 
     # Worst case on record: an untrained draft accepts ~1/vocab.
     rand = draft.init(jax.random.key(99))
@@ -331,18 +385,24 @@ def main():
         target, t_params, draft, rand, prompt, args.tokens, k=4,
         return_stats=True,
     )
-    t_rand = timed_tokens(
+    t_rand, sus_r = try_timed(
         lambda m: speculative_generate(
             target, t_params, draft, rand, prompt, m, k=4
         ),
-        args.tokens, floor=t_plain / (4 * 3.0),
+        args.tokens, t_plain / (4 * 4.0),
     )
-    print(json.dumps({
-        "bench": "speculative", "mode": "random_draft_k4",
-        "ms_per_tok": round(t_rand * 1e3, 3),
-        "mean_accepted": round(rstats["mean_accepted"], 2),
-        "speedup_vs_plain": round(t_plain / t_rand, 2),
-    }), flush=True)
+    if t_rand is None:
+        print(json.dumps({"bench": "speculative",
+                          "mode": "random_draft_k4",
+                          "skipped": "unmeasurable"}), flush=True)
+    else:
+        print(json.dumps({
+            "bench": "speculative", "mode": "random_draft_k4",
+            "ms_per_tok": round(t_rand * 1e3, 3),
+            "mean_accepted": round(rstats["mean_accepted"], 2),
+            "speedup_vs_plain": round(t_plain / t_rand, 2),
+            **({"suspect_fast": True} if sus_r else {}),
+        }), flush=True)
 
     print(json.dumps({
         "metric": "speculative_decode_tokens_per_s",

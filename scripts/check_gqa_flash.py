@@ -87,7 +87,11 @@ def check_config(*, b, h, hkv, s, d, dtype, bwd, rng):
             # window signal, below the tunnel's jitter — the source of
             # the round-4/5 captures' occasional negative columns.
             # Re-measure with enough iterations for ~100 ms of signal.
-            n2 = min(max(50, int(0.1 / max(t, 2e-6))), 2000)
+            # A non-positive first read says nothing about the kernel's
+            # real cost, so grow boundedly (10x) rather than jumping to
+            # the iteration cap — at a ~5 ms kernel the cap would mean
+            # ~90 s for one cell and blow the capture step's timeout.
+            n2 = 10 * n if t <= 0 else min(max(50, int(0.1 / t)), 2000)
             t = scan_two_point(fn, n2, *args)
         return t
 
