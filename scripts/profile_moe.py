@@ -154,14 +154,66 @@ def main():
             y, aux = moe_mlp(xx, {"gate": gate, "w1": w1, "w2": w2}, **kw)
             return y + aux
 
-        ms_body = scan_two_point(body, args.iters, x, params["w1"],
-                                 params["w2"]) * 1e3
+        # Expert stacks in the COMPUTE dtype, like the micro rows and
+        # the scatter prototype — one dtype across every compared row.
+        ms_body = scan_two_point(body, args.iters, x, w1c, w2c) * 1e3
         emit({
             "bench": "moe_profile_body", "dispatch_chunk": chunk,
             "T": t, "E": e, "top_k": k, "cf": args.cf,
             "moe_mlp_ms": round(ms_body, 3),
             "backend": jax.default_backend(),
         })
+
+    # --- scatter-dispatch prototype (round-5 experiment) --------------
+    # The dense formulation's quadratic terms come from the (T, E, C)
+    # routing tensors; a scatter/gather formulation has none: tokens
+    # scatter-add into their (expert, slot) rows (one trash row absorbs
+    # drops), experts run the same batched GEMMs, outputs gather back.
+    # O(T*D) data movement — but XLA lowers scatter on TPU via sort
+    # machinery, so whether it BEATS the chunked einsums is an
+    # empirical question this row answers.
+    def scatter_body(xx, w1, w2, g=params["gate"], e=e, cap=cap, k=k):
+        t_, d_ = xx.shape
+        probs = jax.nn.softmax((xx @ g).astype(jnp.float32), axis=-1)
+        vals, idx = jax.lax.top_k(probs, k)
+        gates = vals if k == 1 else vals / jnp.sum(vals, -1, keepdims=True)
+        used = jnp.zeros((e,), jnp.float32)
+        slots, gsel = [], []
+        for j in range(k):
+            onehot = jax.nn.one_hot(idx[:, j], e, dtype=jnp.float32)
+            pos = jnp.cumsum(onehot, 0) - 1.0 + used[None, :]
+            pos_j = jnp.take_along_axis(
+                pos, idx[:, j : j + 1], 1
+            )[:, 0].astype(jnp.int32)
+            keep = pos_j < cap
+            slots.append(jnp.where(keep, idx[:, j] * cap + pos_j,
+                                   e * cap))
+            gsel.append(jnp.where(keep, gates[:, j], 0.0))
+            used = used + jnp.sum(onehot * (pos < cap), axis=0)
+        expert_in = jnp.zeros((e * cap + 1, d_), xx.dtype)
+        for slot in slots:
+            expert_in = expert_in.at[slot].add(xx)
+        out = _expert_ffn(
+            expert_in[: e * cap].reshape(e, cap, d_), w1, w2
+        ).reshape(e * cap, d_)
+        out = jnp.concatenate(
+            [out, jnp.zeros((1, d_), out.dtype)], axis=0
+        )
+        y = sum(
+            gs[:, None].astype(out.dtype) * out[slot]
+            for gs, slot in zip(gsel, slots)
+        )
+        return y
+
+    ms_scatter = scan_two_point(
+        scatter_body, args.iters, x, params["w1"].astype(dt),
+        params["w2"].astype(dt),
+    ) * 1e3
+    emit({
+        "bench": "moe_profile_scatter", "T": t, "E": e, "top_k": k,
+        "cf": args.cf, "moe_scatter_ms": round(ms_scatter, 3),
+        "backend": jax.default_backend(),
+    })
 
     # --- E x cf sweep (fixed total params: E experts of hidden H) -----
     if args.sweep:
@@ -174,8 +226,10 @@ def main():
                                      axis=None, top_k=k)
                     return y + aux
 
-                ms_body = scan_two_point(body, args.iters, x, p_e["w1"],
-                                         p_e["w2"]) * 1e3
+                ms_body = scan_two_point(
+                    body, args.iters, x, p_e["w1"].astype(dt),
+                    p_e["w2"].astype(dt),
+                ) * 1e3
                 emit({
                     "bench": "moe_profile_sweep", "E": ee, "cf": cf,
                     "top_k": k, "T": t,

@@ -256,3 +256,33 @@ def test_trainer_speculative_sampling_reachable():
     assert all(0 <= int(c) < t.model.vocab for c in cont)
     with pytest.raises(ValueError, match="prompt"):
         t.sample(8, prompt_len=1, temperature=cfg.sample_temperature)
+
+
+def test_reject_core_respects_target_top_p():
+    """Nucleus (top_p) on the TARGET: emitted tokens follow the
+    smallest-prefix-reaching-mass-p renormalized law — the top_p twin
+    of the top_k filter test (the two restrict differently: mass vs
+    count)."""
+    rng = np.random.default_rng(5)
+    v, temp, top_p = 8, 1.0, 0.6
+    tl = jnp.asarray(rng.normal(size=(1, 2, v)) * 1.5, jnp.float32)
+    q = jnp.full((v,), 1.0 / v)  # uniform draft proposes cut tokens too
+    p_full = np.asarray(jax.nn.softmax(tl[0, 0] / temp))
+    order = np.argsort(-p_full)
+    cum_before = np.cumsum(p_full[order]) - p_full[order]
+    keep = np.zeros(v, bool)
+    keep[order[cum_before < top_p]] = True  # boundary token stays
+    p_want = p_full * keep
+    p_want /= p_want.sum()
+
+    def one(key):
+        kp, kc = jax.random.split(key)
+        prop = jax.random.categorical(kp, jnp.log(q)).astype(jnp.int32)
+        u = jnp.stack([jnp.int32(0), prop])[None, :]
+        y, _ = _spec_sample_rows(tl, q[None, :], u, kc, temp, 0, top_p)
+        return y[0, 0]
+
+    toks = jax.vmap(one)(jax.random.split(jax.random.key(11), 4096))
+    got = _hist(toks, v)
+    assert _tv(got, p_want) < 0.05
+    assert got[~keep].sum() == 0.0  # cut tokens never emitted
