@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative profile_lm profile_moe test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative profile_lm profile_moe report test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -144,6 +144,11 @@ profile_lm:
 # single-chip quadratic-dispatch evidence (scripts/profile_moe.py).
 profile_moe:
 	$(PY) scripts/profile_moe.py --sweep
+
+# Summarize a metrics JSONL run (--metrics-jsonl sink) as markdown tables:
+#   make report RUN=run.jsonl
+report:
+	$(PY) scripts/obs_report.py $(RUN)
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

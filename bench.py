@@ -44,9 +44,12 @@ def _run() -> None:
         jax.config.update("jax_platforms", dev)
     from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
     from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.obs.schema import make_record
     from mpi_cuda_cnn_tpu.train.trainer import Trainer
     from mpi_cuda_cnn_tpu.utils.config import Config
     from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    _t0 = time.perf_counter()
 
     ds = synthetic_stripes(num_train=60_000, num_test=32)
     cfg = Config(
@@ -89,17 +92,46 @@ def _run() -> None:
         est = trainer.device_epoch_seconds()
         device_s = round(est, 4) if est is not None else None
 
-    print(json.dumps({
-        "metric": "mnist_epoch_wallclock",
-        "value": round(epoch_s, 3),
-        "unit": "s",
-        "vs_baseline": round(REFERENCE_EPOCH_S / epoch_s, 2),
-        "best_s": round(times[0], 3),
-        "device_epoch_s": device_s,
-        "note": "value = median of 5 wall-clock epochs (one tunnel "
-                "dispatch each); device_epoch_s = two-point on-device "
-                "epoch time (dispatch window cancelled)",
-    }))
+    # Compiled-program accounting (obs/cost.py): FLOPs/collectives of
+    # the scanned-epoch program actually benchmarked — derived, never
+    # hand-typed. XLA counts the scan BODY once (static HLO), so the
+    # number is ~one step's FLOPs; the epoch estimate multiplies by the
+    # step count. Telemetry must not sink the benchmark: any failure
+    # degrades to nulls.
+    step_flops = epoch_flops_est = collectives = None
+    try:
+        from mpi_cuda_cnn_tpu.obs import cost as obs_cost
+        from mpi_cuda_cnn_tpu.parallel.dp import dp_shard_perm
+
+        nsteps = trainer.steps_per_epoch
+        perm = (trainer._epoch_order(0)[: nsteps * cfg.batch_size]
+                .reshape(nsteps, cfg.batch_size).astype("int32"))
+        costs = obs_cost.try_analyze(
+            trainer._scan_epoch_fn, trainer.state, trainer._dev_images,
+            trainer._dev_labels, dp_shard_perm(perm, trainer.mesh),
+        )
+        if costs is not None:
+            step_flops = costs.flops
+            epoch_flops_est = costs.flops * nsteps if costs.flops else None
+            collectives = costs.collectives
+    except Exception:
+        pass
+
+    print(json.dumps(make_record(
+        "bench", time.perf_counter() - _t0,
+        metric="mnist_epoch_wallclock",
+        value=round(epoch_s, 3),
+        unit="s",
+        vs_baseline=round(REFERENCE_EPOCH_S / epoch_s, 2),
+        best_s=round(times[0], 3),
+        device_epoch_s=device_s,
+        step_flops=step_flops,
+        epoch_flops_est=epoch_flops_est,
+        collectives=collectives,
+        note="value = median of 5 wall-clock epochs (one tunnel "
+             "dispatch each); device_epoch_s = two-point on-device "
+             "epoch time (dispatch window cancelled)",
+    )))
 
 
 def main() -> None:
@@ -132,7 +164,12 @@ def main() -> None:
         tail = (proc.stderr or "").strip().splitlines()[-3:]
         errors.append(f"attempt {attempt}: rc={proc.returncode} " + " | ".join(tail))
         time.sleep(2.0)
+    # Literal schema stamp (obs.schema shape) — the parent must never
+    # import jax, which importing the package would do.
     print(json.dumps({
+        "schema": 1,
+        "event": "bench",
+        "t": 0.0,
         "metric": "mnist_epoch_wallclock",
         "value": None,
         "unit": "s",

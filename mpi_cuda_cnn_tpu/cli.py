@@ -8,6 +8,8 @@ driver (native/) offers the same surface for the north star's
 
     python -m mpi_cuda_cnn_tpu train-images train-labels t10k-images t10k-labels
     python -m mpi_cuda_cnn_tpu --dataset synthetic --model lenet5_relu --epochs 3
+    python -m mpi_cuda_cnn_tpu --metrics-jsonl run.jsonl ...   # telemetry sink
+    python -m mpi_cuda_cnn_tpu report run.jsonl                # summary tables
 """
 
 from __future__ import annotations
@@ -71,8 +73,11 @@ def run(cfg: Config) -> int:
         log.error("%s", e)
         return 2
     log.info("model=%s dataset=%s input=%s", model.name, ds.name, ds.input_shape)
-    trainer = Trainer(model, ds, cfg, metrics=MetricsLogger())
-    result = trainer.train()
+    # The context manager closes the JSONL sink even when the trainer
+    # raises mid-run — the records written so far must survive.
+    with MetricsLogger(path=cfg.metrics_jsonl) as metrics:
+        trainer = Trainer(model, ds, cfg, metrics=metrics)
+        result = trainer.train()
     log.info(
         "done: epochs=%d acc=%.4f mean_step=%.3fms",
         result.epochs_run,
@@ -93,30 +98,31 @@ def run_lm(argv: list[str]) -> int:
     if not _select_device(cfg, log):
         return 2
     initialize_distributed()
-    try:
-        trainer = LMTrainer(cfg, metrics=MetricsLogger())
-    except (OSError, ValueError) as e:
-        log.error("lm setup failed: %s", e)
-        return 2
-    log.info(
-        "lm model=d%dx%d h%d seq=%d vocab=%d moe=%d mesh=%s attn=%s",
-        cfg.dim, cfg.depth, cfg.heads, cfg.seq_len, trainer.model.vocab,
-        cfg.moe_experts, dict(trainer.mesh.shape), trainer.attn_impl,
-    )
-    result = trainer.train()
-    log.info(
-        "done: steps=%d eval_ppl=%.3f tokens/s=%.0f",
-        result.steps_run, result.eval_ppl, result.tokens_per_s,
-    )
-    if cfg.sample_tokens:
-        _, cont = trainer.sample(
-            cfg.sample_tokens, temperature=cfg.sample_temperature,
-            seed=cfg.seed,
+    with MetricsLogger(path=cfg.metrics_jsonl) as metrics:
+        try:
+            trainer = LMTrainer(cfg, metrics=metrics)
+        except (OSError, ValueError) as e:
+            log.error("lm setup failed: %s", e)
+            return 2
+        log.info(
+            "lm model=d%dx%d h%d seq=%d vocab=%d moe=%d mesh=%s attn=%s",
+            cfg.dim, cfg.depth, cfg.heads, cfg.seq_len, trainer.model.vocab,
+            cfg.moe_experts, dict(trainer.mesh.shape), trainer.attn_impl,
         )
-        # Char-level corpora (self / file / synthetic-mod-251) decode as
-        # bytes; anything out of byte range prints as escapes.
-        text = bytes(int(t) & 0xFF for t in cont)
-        log.info("sample (%d tokens): %r", cfg.sample_tokens, text)
+        result = trainer.train()
+        log.info(
+            "done: steps=%d eval_ppl=%.3f tokens/s=%.0f",
+            result.steps_run, result.eval_ppl, result.tokens_per_s,
+        )
+        if cfg.sample_tokens:
+            _, cont = trainer.sample(
+                cfg.sample_tokens, temperature=cfg.sample_temperature,
+                seed=cfg.seed,
+            )
+            # Char-level corpora (self / file / synthetic-mod-251) decode as
+            # bytes; anything out of byte range prints as escapes.
+            text = bytes(int(t) & 0xFF for t in cont)
+            log.info("sample (%d tokens): %r", cfg.sample_tokens, text)
     return 0
 
 
@@ -124,6 +130,12 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "lm":
         return run_lm(argv[1:])
+    if argv and argv[0] == "report":
+        # Offline: summarize a metrics JSONL run (obs.report) — no jax
+        # device init, safe on any machine.
+        from .obs.report import report_main
+
+        return report_main(argv[1:])
     cfg = parse_args(argv)
     return run(cfg)
 

@@ -1,0 +1,44 @@
+"""obs — the telemetry subsystem.
+
+Four pillars, one record schema:
+
+- `trace`:  spans/annotations with ONE naming scheme across XProf device
+            traces and the JSONL metrics stream.
+- `cost`:   FLOPs/bytes/collectives of the COMPILED step via XLA cost
+            analysis and HLO/jaxpr walks — MFU as a computed property,
+            not a hand-typed constant.
+- `device`: HBM occupancy/peaks from device.memory_stats(), degrading
+            to None on backends without allocator stats.
+- `schema`: the versioned JSONL record shape shared by MetricsLogger,
+            bench.py, and `mctpu report`; `report` renders any run file
+            into the markdown tables PERF.md used to assemble by hand.
+"""
+
+from .cost import (  # noqa: F401
+    COLLECTIVE_PRIMS,
+    PEAK_TFLOPS,
+    ProgramCosts,
+    analyze,
+    hlo_collective_counts,
+    jaxpr_collective_counts,
+    mfu,
+    peak_flops,
+    try_analyze,
+)
+from .device import (  # noqa: F401
+    device_memory_stats,
+    hbm_peak_bytes,
+    memory_snapshot,
+)
+from .report import render_markdown, report_main, summarize  # noqa: F401
+from .schema import (  # noqa: F401
+    RUN_MARKER,
+    SCHEMA_VERSION,
+    dump_records,
+    iter_records,
+    iter_runs,
+    load_records,
+    make_record,
+    validate_record,
+)
+from .trace import annotate, current_path, span  # noqa: F401

@@ -1,0 +1,200 @@
+"""Compiled-program accounting: FLOPs/bytes/collectives from the program
+XLA actually runs.
+
+Before this module, MFU numerators were analytic formulas
+(train/lm.lm_flops_per_token) or hand-derived constants inside bench
+scripts, and collective behavior was asserted from reading the source.
+Here both are computed properties of the compiled step:
+
+- `analyze(jitted_fn, *args)` lowers + compiles the function for the
+  given arguments and reads `cost_analysis()` — FLOPs and bytes of the
+  real post-fusion program, the same numbers XProf's roofline uses.
+- Collectives are counted two ways, because they appear at two levels:
+  `jaxpr_collective_counts` walks the jaxpr (explicit collectives the
+  program writes itself — shard_map psum/ppermute/all_to_all), and
+  `hlo_collective_counts` scans the compiled HLO (which ALSO includes
+  whatever GSPMD inserted). The HLO count is the ground truth for "what
+  crosses the interconnect per step"; the jaxpr count is the structural
+  check tests pin.
+
+Caveats, so numbers are read honestly: `cost_analysis` reports the
+per-module optimized-HLO estimate (per-core on multi-device backends),
+and it counts STATIC HLO — a `lax.scan`/`while` body is counted ONCE,
+not per trip (measured: a 10-iteration scan of a matmul reports the
+same FLOPs as 1 iteration). For a scanned-epoch program the reported
+FLOPs are therefore ~one step's, not the dispatch's; producers record
+that with `counting="static-body"` and `steps_per_dispatch=1` so
+downstream per-step math stays correct. The same staticness applies to
+collective counts (a psum inside the scan body counts 1, executes N
+times). Finally, `lower().compile()` does not share jit's executable
+cache in all JAX versions, so `analyze` can cost one extra compile —
+callers on hot paths do it once per program shape and keep it out of
+their timing envelopes (StepTimer.exclude).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import jax
+
+# Peak dense-matmul throughput per (backend, dtype) — the MFU
+# denominator. The ONE table (scripts/bench_lm.py imports it); extend as
+# chips appear. CPU has no meaningful MXU peak: peak_flops returns None
+# there and MFU reports null rather than a number against a fake peak.
+PEAK_TFLOPS: dict[str, float] = {
+    "tpu_v5e_bf16": 197.0,
+    "tpu_v5e_f32": 49.0,
+}
+
+# Jaxpr primitive names that are cross-device collectives.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter",
+})
+
+# HLO instruction names that are collectives (async forms appear as
+# NAME-start/NAME-done pairs — counting '-start' or the bare name, and
+# never '-done', counts each collective once).
+_HLO_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|all-to-all|collective-permute"
+    r"|reduce-scatter)(-start)?\("
+)
+
+
+@dataclasses.dataclass
+class ProgramCosts:
+    """Accounting for ONE compiled program (which may run many train
+    steps per dispatch — scanned epochs; `flops` is per dispatch)."""
+
+    flops: float | None
+    bytes_accessed: float | None
+    collectives: dict[str, int]
+
+    def to_fields(self) -> dict:
+        """The record fields a "program" event carries (obs.schema)."""
+        return {
+            "flops": self.flops,
+            "bytes": self.bytes_accessed,
+            "collectives": self.collectives,
+        }
+
+
+def peak_flops(dtype: str = "bfloat16", *, backend: str | None = None,
+               override_tflops: float | None = None) -> float | None:
+    """Peak FLOP/s for the MFU denominator, or None when the backend has
+    no registered peak. An override names the chip's bf16 peak; the f32
+    peak scales by the same ratio as v5e (the MXU's f32 path)."""
+    if override_tflops is not None:
+        bf16 = override_tflops
+    elif (backend or jax.default_backend()) == "tpu":
+        bf16 = PEAK_TFLOPS["tpu_v5e_bf16"]
+    else:
+        return None
+    if dtype in ("bfloat16", "bf16"):
+        return bf16 * 1e12
+    return bf16 * 1e12 * PEAK_TFLOPS["tpu_v5e_f32"] / PEAK_TFLOPS["tpu_v5e_bf16"]
+
+
+def mfu(flops: float | None, seconds: float, peak: float | None) -> float | None:
+    """Model FLOPs utilization; None whenever a factor is unknown."""
+    if not flops or not peak or seconds <= 0:
+        return None
+    return flops / seconds / peak
+
+
+def _normalize_cost_analysis(ca) -> dict:
+    """cost_analysis() returns a dict on some backends/versions and a
+    one-element list of dicts on others; normalize to one dict."""
+    if ca is None:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca)
+
+
+def hlo_collective_counts(hlo_text: str) -> dict[str, int]:
+    """Count collective instructions in compiled HLO text."""
+    counts: dict[str, int] = {}
+    for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
+        name = m.group(1)
+        counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def _walk_jaxpr(jaxpr, counts: dict[str, int]) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            counts[name] = counts.get(name, 0) + 1
+        for v in eqn.params.values():
+            # Recurse into sub-jaxprs (jit/scan/while/cond/shard_map
+            # bodies) wherever they appear in the params tree.
+            for sub in jax.tree_util.tree_leaves(
+                v, is_leaf=lambda x: hasattr(x, "jaxpr") or hasattr(x, "eqns")
+            ):
+                inner = getattr(sub, "jaxpr", sub)
+                if hasattr(inner, "eqns"):
+                    _walk_jaxpr(inner, counts)
+
+
+def jaxpr_collective_counts(fn, *args, **kwargs) -> dict[str, int]:
+    """Count explicit collective primitives in fn's jaxpr (static count:
+    a ppermute inside a scan body counts once, not per iteration)."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    counts: dict[str, int] = {}
+    _walk_jaxpr(closed.jaxpr, counts)
+    return counts
+
+
+def analyze(fn, *args, **kwargs) -> ProgramCosts:
+    """Lower + compile `fn` for these args and read the XLA accounting.
+
+    `fn` must be jit-wrapped (anything with .lower — jax.jit output).
+    Raises whatever lowering/compilation raises; use `try_analyze` on
+    paths that must never fail for telemetry's sake.
+    """
+    compiled = fn.lower(*args, **kwargs).compile()
+    costs = _normalize_cost_analysis(compiled.cost_analysis())
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        hlo = ""
+    return ProgramCosts(
+        flops=costs.get("flops"),
+        bytes_accessed=costs.get("bytes accessed"),
+        collectives=hlo_collective_counts(hlo),
+    )
+
+
+def try_analyze(fn, *args, **kwargs) -> ProgramCosts | None:
+    """analyze(), or None if anything about this backend/function resists
+    AOT lowering — telemetry must degrade, not break the train loop."""
+    try:
+        return analyze(fn, *args, **kwargs)
+    except Exception:
+        return None
+
+
+def log_program(metrics, label: str, fn, *args,
+                steps_per_dispatch: int = 1,
+                counting: str = "program",
+                compute_dtype: str = "float32") -> bool:
+    """Analyze `fn(*args)` and emit ONE "program" record to `metrics`
+    (a utils.logging.MetricsLogger). Returns False when analysis failed
+    — the ONE emit path both trainers share, so the record shape cannot
+    drift between them.
+
+    counting="static-body" marks a scanned program whose body XLA counts
+    once (see module docstring): such producers pass
+    steps_per_dispatch=1 so flops stay ~per-step."""
+    costs = try_analyze(fn, *args)
+    if costs is None:
+        return False
+    metrics.log(
+        "program", label=label, steps_per_dispatch=steps_per_dispatch,
+        counting=counting, backend=jax.default_backend(),
+        compute_dtype=compute_dtype, **costs.to_fields(),
+    )
+    return True

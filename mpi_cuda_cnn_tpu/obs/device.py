@@ -1,0 +1,69 @@
+"""Device telemetry: HBM occupancy/peaks via `device.memory_stats()`.
+
+The numbers PERF.md's memory claims were previously read off profiler
+screenshots or inferred from OOMs. `memory_stats()` is the allocator's
+own accounting (bytes_in_use, peak_bytes_in_use, ...); TPU and GPU
+backends expose it, CPU returns None — every function here degrades to
+None/empty rather than raising, so telemetry can be threaded through
+trainers unconditionally.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# The allocator keys we record (when present). peak_bytes_in_use is the
+# one that answers "does this config fit"; bytes_in_use the steady state.
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+         "largest_alloc_size")
+
+
+def device_memory_stats(device) -> dict | None:
+    """This device's allocator stats, or None when the backend has none."""
+    stats_fn = getattr(device, "memory_stats", None)
+    if stats_fn is None:
+        return None
+    try:
+        stats = stats_fn()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: int(stats[k]) for k in _KEYS if k in stats}
+
+
+def memory_snapshot(devices=None) -> list[dict]:
+    """One entry per device: {"id", "platform", "stats": {...} | null}.
+    The "memory" event's `devices` field (obs.schema)."""
+    out = []
+    for d in devices or jax.devices():
+        out.append({
+            "id": d.id,
+            "platform": d.platform,
+            "stats": device_memory_stats(d),
+        })
+    return out
+
+
+def emit_step_telemetry(metrics, timer, steps: int, *, devices=None,
+                        **fields) -> None:
+    """Emit the per-interval telemetry record pair — "step_phases" (the
+    timer's per-step phase attribution) and "memory" (a device
+    snapshot) — to `metrics` when its JSONL sink is open. The ONE emit
+    path both trainers share, so the record shapes cannot drift."""
+    if metrics is None or not metrics.jsonl_enabled or steps <= 0:
+        return
+    metrics.log("step_phases", steps=steps, phases_ms=timer.phases_ms(),
+                **fields)
+    metrics.log("memory", devices=memory_snapshot(devices), **fields)
+
+
+def hbm_peak_bytes(devices=None) -> int | None:
+    """Max peak_bytes_in_use across devices; None when no device
+    exposes stats (CPU) — callers emit null, tests skip cleanly."""
+    peaks = [
+        e["stats"]["peak_bytes_in_use"]
+        for e in memory_snapshot(devices)
+        if e["stats"] and "peak_bytes_in_use" in e["stats"]
+    ]
+    return max(peaks) if peaks else None

@@ -1,0 +1,253 @@
+"""Aggregate a metrics JSONL run into the tables PERF.md used to get by
+hand.
+
+`mctpu report run.jsonl` (or `python scripts/obs_report.py run.jsonl`)
+reads any file of schema records (obs.schema — pre-schema lines pass
+through, '#' comments skip) and renders per-event summary tables:
+training trajectory, epoch wall-clocks, step-phase attribution,
+compiled-program accounting (FLOPs, bytes, collectives, MFU when a peak
+is known), device-memory peaks, and host spans. JSON output (--format
+json) feeds scripts; markdown is for pasting into PERF.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Iterable
+
+from .cost import mfu, peak_flops
+from .schema import iter_runs
+
+
+def _by_event(records: Iterable[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for r in records:
+        if isinstance(r, dict) and "event" in r:
+            out.setdefault(r["event"], []).append(r)
+    return out
+
+
+def summarize(records: Iterable[dict], *,
+              peak_tflops: float | None = None) -> dict:
+    """Aggregate records into one summary dict (the JSON output form)."""
+    ev = _by_event(records)
+    summary: dict = {
+        "events": {k: len(v) for k, v in sorted(ev.items())},
+        "duration_s": max((r.get("t", 0.0) for v in ev.values() for r in v),
+                          default=0.0),
+    }
+
+    trains = ev.get("train", [])
+    if trains:
+        losses = [r["loss"] for r in trains if r.get("loss") is not None]
+        summary["train"] = {
+            "records": len(trains),
+            "first_loss": losses[0] if losses else None,
+            "last_loss": losses[-1] if losses else None,
+            "min_loss": min(losses) if losses else None,
+            "last_step": trains[-1].get("step"),
+        }
+
+    epochs = ev.get("epoch", [])
+    if epochs:
+        secs = [r["seconds"] for r in epochs]
+        summary["epochs"] = {
+            "count": len(epochs),
+            "mean_s": statistics.fmean(secs),
+            "median_s": statistics.median(secs),
+            "best_s": min(secs),
+        }
+
+    evals = ev.get("eval", [])
+    if evals:
+        summary["eval"] = {k: v for k, v in evals[-1].items()
+                           if k not in ("schema", "event", "t")}
+
+    phases = ev.get("step_phases", [])
+    if phases:
+        steps = sum(r["steps"] for r in phases)
+        totals: dict[str, float] = {}
+        for r in phases:
+            for name, ms in r["phases_ms"].items():
+                totals[name] = totals.get(name, 0.0) + ms * r["steps"]
+        summary["step_phases"] = {
+            "steps": steps,
+            "per_step_ms": {k: v / max(steps, 1) for k, v in totals.items()},
+        }
+
+    programs = ev.get("program", [])
+    if programs:
+        progs = []
+        for r in programs:
+            p = {
+                "label": r.get("label", "step"),
+                "flops": r.get("flops"),
+                "bytes": r.get("bytes"),
+                "steps_per_dispatch": r.get("steps_per_dispatch", 1),
+                "collectives": r.get("collectives", {}),
+                "backend": r.get("backend"),
+            }
+            flops, n = p["flops"], p["steps_per_dispatch"] or 1
+            p["flops_per_step"] = flops / n if flops else None
+            peak = peak_flops(
+                r.get("compute_dtype", "bfloat16"),
+                backend=p["backend"], override_tflops=peak_tflops,
+            ) if (p["backend"] == "tpu" or peak_tflops) else None
+            sp = summary.get("step_phases", {}).get("per_step_ms", {})
+            step_s = sum(sp.values()) / 1e3 if sp else None
+            p["mfu"] = (mfu(p["flops_per_step"], step_s, peak)
+                        if step_s else None)
+            progs.append(p)
+        summary["programs"] = progs
+
+    memories = ev.get("memory", [])
+    if memories:
+        peaks = [
+            d["stats"]["peak_bytes_in_use"]
+            for r in memories for d in r["devices"]
+            if d.get("stats") and "peak_bytes_in_use" in d["stats"]
+        ]
+        summary["memory"] = {
+            "records": len(memories),
+            "hbm_peak_bytes": max(peaks) if peaks else None,
+        }
+
+    spans = ev.get("span", [])
+    if spans:
+        agg: dict[str, list[float]] = {}
+        for r in spans:
+            agg.setdefault(r["name"], []).append(r["ms"])
+        summary["spans"] = {
+            name: {"count": len(ms), "total_ms": sum(ms),
+                   "mean_ms": statistics.fmean(ms)}
+            for name, ms in sorted(agg.items())
+        }
+    return summary
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    if isinstance(v, dict):
+        return ", ".join(f"{k}:{n}" for k, n in sorted(v.items())) or "—"
+    return str(v)
+
+
+def render_markdown(summary: dict, title: str = "Run report") -> str:
+    """The summary as markdown tables — what PERF.md sections are made
+    of, generated instead of hand-assembled."""
+    lines = [f"## {title}", ""]
+    lines += [
+        f"Records: "
+        + ", ".join(f"{k}={v}" for k, v in summary["events"].items())
+        + f"; duration {summary['duration_s']:.4g} s",
+        "",
+    ]
+    if "train" in summary:
+        t = summary["train"]
+        lines += [
+            "| training | records | first loss | last loss | min loss | last step |",
+            "|---|---|---|---|---|---|",
+            f"| | {t['records']} | {_fmt(t['first_loss'])} "
+            f"| {_fmt(t['last_loss'])} | {_fmt(t['min_loss'])} "
+            f"| {_fmt(t['last_step'])} |",
+            "",
+        ]
+    if "epochs" in summary:
+        e = summary["epochs"]
+        lines += [
+            "| epochs | mean s | median s | best s |",
+            "|---|---|---|---|",
+            f"| {e['count']} | {e['mean_s']:.4g} | {e['median_s']:.4g} "
+            f"| {e['best_s']:.4g} |",
+            "",
+        ]
+    if "eval" in summary:
+        kv = summary["eval"]
+        lines += ["| eval | " + " | ".join(kv) + " |",
+                  "|---|" + "---|" * len(kv),
+                  "| last | " + " | ".join(_fmt(v) for v in kv.values()) + " |",
+                  ""]
+    if "step_phases" in summary:
+        sp = summary["step_phases"]
+        names = sorted(sp["per_step_ms"])
+        lines += [
+            "| step phases (ms/step) | " + " | ".join(names)
+            + " | total | steps |",
+            "|---|" + "---|" * (len(names) + 2),
+            "| | "
+            + " | ".join(f"{sp['per_step_ms'][n]:.4g}" for n in names)
+            + f" | {sum(sp['per_step_ms'].values()):.4g} | {sp['steps']} |",
+            "",
+        ]
+    if "programs" in summary:
+        lines += [
+            "| program | flops/dispatch | bytes | steps/dispatch "
+            "| flops/step | collectives | MFU |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for p in summary["programs"]:
+            mfu_s = f"{p['mfu'] * 100:.1f}%" if p.get("mfu") else "—"
+            lines.append(
+                f"| {p['label']} | {_fmt(p['flops'])} | {_fmt(p['bytes'])} "
+                f"| {p['steps_per_dispatch']} | {_fmt(p['flops_per_step'])} "
+                f"| {_fmt(p['collectives'])} | {mfu_s} |"
+            )
+        lines.append("")
+    if "memory" in summary:
+        m = summary["memory"]
+        peak = m["hbm_peak_bytes"]
+        peak_s = f"{peak / 2**20:.1f} MiB" if peak else "—"
+        lines += [f"Device memory: peak {peak_s} "
+                  f"({m['records']} snapshots)", ""]
+    if "spans" in summary:
+        lines += ["| span | count | total ms | mean ms |",
+                  "|---|---|---|---|"]
+        for name, s in summary["spans"].items():
+            lines.append(
+                f"| {name} | {s['count']} | {s['total_ms']:.4g} "
+                f"| {s['mean_ms']:.4g} |"
+            )
+        lines.append("")
+    return "\n".join(lines)
+
+
+def report_main(argv: list[str] | None = None) -> int:
+    """The `mctpu report` subcommand (also scripts/obs_report.py)."""
+    ap = argparse.ArgumentParser(
+        prog="mctpu report",
+        description="Summarize a metrics JSONL run as markdown tables "
+                    "(or JSON with --format json).",
+    )
+    ap.add_argument("paths", nargs="+", help="metrics JSONL file(s)")
+    ap.add_argument("--format", choices=("md", "json"), default="md")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="chip bf16 peak for the MFU column (defaults to "
+                         "v5e when records say backend=tpu)")
+    args = ap.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        try:
+            # Per-run segments ('# run' markers from MetricsLogger's
+            # append mode): aggregating across unrelated runs would pair
+            # one run's FLOPs with another's step times.
+            runs = [r for r in iter_runs(path) if r]
+        except (OSError, ValueError) as e:
+            print(f"error: {path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        for i, records in enumerate(runs, 1):
+            summary = summarize(records, peak_tflops=args.peak_tflops)
+            label = path if len(runs) == 1 else f"{path} (run {i}/{len(runs)})"
+            if args.format == "json":
+                print(json.dumps(
+                    {"path": path, "run": i, "runs": len(runs), **summary}
+                ))
+            else:
+                print(render_markdown(summary, title=f"Run report — {label}"))
+    return rc

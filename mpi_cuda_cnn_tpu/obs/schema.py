@@ -1,0 +1,154 @@
+"""The one JSONL record shape every metrics producer shares.
+
+Before this module, each emitter invented its own dialect: MetricsLogger
+wrote {"event", "t", ...}, bench.py printed a one-off benchmark object,
+scripts/profile_*.py printed ad-hoc rows, and PERF_capture.jsonl mixed
+all three plus `# comment` lines. PERF.md tables were then assembled by
+hand from the union. One schema ends that: every record carries a
+version stamp and an event name, event families declare their required
+keys, and `iter_records`/`validate_record` are the single read/check
+path used by the `mctpu report` aggregator, the tests, and any future
+consumer.
+
+Records are one JSON object per line. Lines starting with '#' are
+comments (PERF_capture.jsonl's capture markers) and are skipped by the
+reader, so existing capture files stay parseable.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Iterator
+
+SCHEMA_VERSION = 1
+
+# Keys every record must carry. "t" is seconds since the producer
+# started (relative, so records from different processes don't need
+# clock agreement); "event" names the record family.
+REQUIRED_KEYS = ("schema", "event", "t")
+
+# Per-family required keys (beyond REQUIRED_KEYS). Families not listed
+# here are free-form — the schema constrains what the report aggregator
+# depends on, not what producers may add.
+EVENT_KEYS: dict[str, tuple[str, ...]] = {
+    # Training progress (per log interval). "step" is the in-run step.
+    "train": ("step", "loss"),
+    # Epoch wall-clock (CNN trainer).
+    "epoch": ("epoch", "seconds"),
+    # Eval sweep result.
+    "eval": (),
+    # Step-phase wall-clock attribution: milliseconds per step spent in
+    # host-side data prep, async dispatch, device compute wait, and
+    # checkpointing, over `steps` steps.
+    "step_phases": ("steps", "phases_ms"),
+    # Compiled-program accounting from XLA cost analysis: FLOPs and
+    # bytes per dispatched program, plus HLO collective counts.
+    "program": ("flops", "collectives"),
+    # Device memory telemetry (per-device bytes; absent stats -> null).
+    "memory": ("devices",),
+    # Host-side span (obs.trace.span): nested name and duration.
+    "span": ("name", "ms"),
+}
+
+
+def make_record(event: str, t: float, **fields) -> dict:
+    """Assemble a schema-stamped record (does not validate — producers
+    that want the check call validate_record on the result)."""
+    return {"schema": SCHEMA_VERSION, "event": event, "t": round(t, 4),
+            **fields}
+
+
+def validate_record(rec: dict) -> dict:
+    """Check one record against the schema; returns it unchanged.
+
+    Raises ValueError naming every missing key — the error message is
+    the schema documentation a producer sees first.
+    """
+    if not isinstance(rec, dict):
+        raise ValueError(f"record must be an object, got {type(rec).__name__}")
+    missing = [k for k in REQUIRED_KEYS if k not in rec]
+    if missing:
+        raise ValueError(f"record missing required keys {missing}: {rec}")
+    if not isinstance(rec["schema"], int):
+        raise ValueError(f"record schema must be an int: {rec['schema']!r}")
+    if rec["schema"] > SCHEMA_VERSION:
+        raise ValueError(
+            f"record schema v{rec['schema']} is newer than this reader "
+            f"(v{SCHEMA_VERSION})"
+        )
+    extra = EVENT_KEYS.get(rec["event"], ())
+    missing = [k for k in extra if k not in rec]
+    if missing:
+        raise ValueError(
+            f"{rec['event']!r} record missing keys {missing}: {rec}"
+        )
+    return rec
+
+
+# Comment prefix MetricsLogger writes on each open — the run boundary
+# in an append-mode file (iter_runs splits on it; iter_records skips it
+# like any other comment).
+RUN_MARKER = "# run"
+
+
+def iter_records(path: str | Path, *, strict: bool = False) -> Iterator[dict]:
+    """Yield records from a JSONL file, skipping blank and '#' lines.
+
+    Pre-schema records (no "schema" key) are passed through unvalidated
+    unless strict=True — report must keep reading old PERF_capture.jsonl
+    files.
+    """
+    for _, rec in _iter_lines(path, strict=strict):
+        if rec is not None:
+            yield rec
+
+
+def iter_runs(path: str | Path, *, strict: bool = False) -> Iterator[list[dict]]:
+    """Yield one record list per run, split at RUN_MARKER comment lines
+    (append-mode files accumulate runs; aggregating across them would
+    blend unrelated numbers). A file with no markers is one run."""
+    current: list[dict] = []
+    seen_any = False
+    for is_marker, rec in _iter_lines(path, strict=strict):
+        if is_marker:
+            if current or seen_any:
+                yield current
+                current = []
+            seen_any = True
+        elif rec is not None:
+            current.append(rec)
+    if current or not seen_any:
+        yield current
+
+
+def _iter_lines(path: str | Path, *, strict: bool):
+    """(is_run_marker, record | None) per line, shared by the readers."""
+    with Path(path).open() as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if line.startswith(RUN_MARKER):
+                yield True, None
+                continue
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                if strict:
+                    raise ValueError(f"{path}:{lineno}: bad JSON: {e}") from e
+                continue
+            if strict or (isinstance(rec, dict) and "schema" in rec):
+                validate_record(rec)
+            yield False, rec
+
+
+def load_records(path: str | Path, *, strict: bool = False) -> list[dict]:
+    return list(iter_records(path, strict=strict))
+
+
+def dump_records(records: Iterable[dict], path: str | Path) -> None:
+    """Write records as JSONL (the round-trip twin of load_records)."""
+    with Path(path).open("w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")

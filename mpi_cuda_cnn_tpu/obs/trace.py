@@ -1,0 +1,68 @@
+"""Tracing spans: one name, visible in BOTH XProf and the JSONL stream.
+
+Two tools, one naming scheme:
+
+- `annotate(name)` — for code under `jax.jit`/`shard_map` tracing: a
+  `jax.named_scope` so the region's HLO ops carry the name into XProf /
+  TensorBoard device traces. Zero runtime cost (it is metadata on the
+  traced ops).
+- `span(name, metrics=...)` — for HOST-side regions (epoch loops, eval
+  sweeps, checkpoint saves): nests via a stack, wraps
+  `jax.profiler.TraceAnnotation` so the host track of an XProf capture
+  shows the same name, and on exit emits a {"event": "span"} record to
+  the metrics stream. XProf traces and the JSONL therefore agree on
+  names — the point of pillar (1) in the obs design.
+
+Span names compose with '/' as they nest: span("epoch") containing
+span("eval") emits "epoch/eval". Host spans measure wall-clock only;
+they do NOT force device completion (a span around an async dispatch
+measures the dispatch, which is exactly the async split StepTimer
+accounts for).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+import jax
+
+_state = threading.local()
+
+
+def _stack() -> list[str]:
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+def current_path() -> str:
+    """The '/'-joined path of open host spans on this thread ('' at top)."""
+    return "/".join(_stack())
+
+
+def annotate(name: str):
+    """Named scope for traced code — the in-jit half of the span API."""
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def span(name: str, metrics=None, **fields):
+    """Host-side named span. Emits one "span" record on exit when a
+    metrics logger (utils.logging.MetricsLogger) is passed; always
+    annotates the profiler's host track so an XProf capture taken over
+    the region shows the same name."""
+    stack = _stack()
+    stack.append(name)
+    path = "/".join(stack)
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(path):
+            yield path
+    finally:
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        popped = stack.pop()
+        assert popped == name
+        if metrics is not None:
+            metrics.log("span", name=path, ms=round(dt_ms, 3), **fields)

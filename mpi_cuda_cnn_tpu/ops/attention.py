@@ -21,6 +21,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..obs.trace import annotate
+
 NEG_INF = -1e30  # large-but-finite: keeps fully-masked rows NaN-free
 
 
@@ -36,21 +38,22 @@ def attention(q, k, v, *, causal: bool = False):
     if h % hkv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
     g = h // hkv
-    qg = q.reshape(b, sq, hkv, g, d)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    logits = jnp.einsum(
-        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        qi = jnp.arange(sq)[:, None]
-        ki = jnp.arange(k.shape[1])[None, :]
-        logits = jnp.where(ki <= qi, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum(
-        "bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
-    return out.reshape(b, sq, h, d).astype(q.dtype)
+    with annotate("ops.attention"):
+        qg = q.reshape(b, sq, hkv, g, d)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        logits = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qi = jnp.arange(sq)[:, None]
+            ki = jnp.arange(k.shape[1])[None, :]
+            logits = jnp.where(ki <= qi, logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(b, sq, h, d).astype(q.dtype)
 
 
 def repeat_kv(kv, n_heads: int):

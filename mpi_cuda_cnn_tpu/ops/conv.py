@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.trace import annotate
+
 DIMSPEC = ("NHWC", "HWIO", "NHWC")
 
 
@@ -37,14 +39,15 @@ def conv2d(
     """x: (N,H,W,Cin) f32/bf16; w: (kh,kw,Cin,Cout). Returns (N,Ho,Wo,Cout)."""
     sh, sw = (stride, stride) if isinstance(stride, int) else stride
     ph, pw = (padding, padding) if isinstance(padding, int) else padding
-    return lax.conv_general_dilated(
-        x,
-        w,
-        window_strides=(sh, sw),
-        padding=((ph, ph), (pw, pw)),
-        dimension_numbers=DIMSPEC,
-        precision=precision,
-    )
+    with annotate("ops.conv2d"):
+        return lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=DIMSPEC,
+            precision=precision,
+        )
 
 
 @partial(jax.jit, static_argnames=("stride", "padding", "input_hw"))

@@ -12,11 +12,14 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..obs.trace import annotate
+
 
 def dense(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray | None = None,
           precision=None) -> jnp.ndarray:
     """x: (N, d_in); w: (d_in, d_out); b: (d_out,)."""
-    y = jnp.dot(x, w, precision=precision)
-    if b is not None:
-        y = y + b
-    return y
+    with annotate("ops.dense"):
+        y = jnp.dot(x, w, precision=precision)
+        if b is not None:
+            y = y + b
+        return y

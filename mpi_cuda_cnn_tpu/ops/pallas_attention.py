@@ -40,10 +40,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs.trace import annotate
 from .attention import NEG_INF
 
 # Tuned on v5e (s=8192, d=64): large blocks amortize per-grid-step
@@ -504,7 +504,8 @@ def flash_attention(q, k, v, causal: bool = False):
     served zero-copy via the kernel's block index maps). S a multiple of
     128. Exact (online softmax), causal optional. Both the forward and
     backward are fused Pallas kernels with O(block) memory."""
-    return _flash_forward(q, k, v, causal)
+    with annotate("ops.flash_attention"):
+        return _flash_forward(q, k, v, causal)
 
 
 def _fwd(q, k, v, causal):

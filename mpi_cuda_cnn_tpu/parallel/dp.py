@@ -29,6 +29,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..data.pipeline import PIXEL_SCALE
+from ..obs.trace import annotate
 from .mesh import DATA_AXIS
 
 TrainState = dict[str, Any]  # {"params": pytree, "opt_state": pytree, "step": i32}
@@ -148,23 +149,27 @@ def _make_step_body(
 
     def step(state: TrainState, x, y):
         if augment is not None:
-            key = jax.random.fold_in(jax.random.key(aug_seed), state["step"])
-            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
-            x = augment(key, x)
-        loss, aux, grads = _local_grads(
-            loss_fn, state["params"], x, y, grad_accum
-        )
+            with annotate("dp.augment"):
+                key = jax.random.fold_in(jax.random.key(aug_seed), state["step"])
+                key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+                x = augment(key, x)
+        with annotate("dp.local_grads"):
+            loss, aux, grads = _local_grads(
+                loss_fn, state["params"], x, y, grad_accum
+            )
         # ONE fused gradient all-reduce per step — the explicit SPMD twin
         # of the reference's intent, replacing its per-sample-per-layer
         # allreduce storm (cnnmpi.c:490). XLA fuses the pytree of pmeans
         # into a single ICI collective.
-        grads = jax.lax.pmean(grads, axis)
-        loss = jax.lax.pmean(loss, axis)
-        aux = jax.lax.pmean(aux, axis)
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["params"]
-        )
-        params = optax.apply_updates(state["params"], updates)
+        with annotate("dp.grad_allreduce"):
+            grads = jax.lax.pmean(grads, axis)
+            loss = jax.lax.pmean(loss, axis)
+            aux = jax.lax.pmean(aux, axis)
+        with annotate("dp.update"):
+            updates, opt_state = optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
         new_state = {"params": params, "opt_state": opt_state,
                      "step": state["step"] + 1}
         return new_state, {"loss": loss, **aux}

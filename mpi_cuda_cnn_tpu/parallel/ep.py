@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..obs.trace import annotate
+
 EXPERT_AXIS = "expert"
 
 
@@ -207,10 +209,12 @@ def moe_mlp(
     # gate weights round like every other bf16 operand).
     dispatch = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)    # (E, C, D)
+    with annotate("ep.dispatch_einsum"):
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x)    # (E, C, D)
 
     if axis is None:
-        expert_out = _expert_ffn(expert_in, params["w1"], params["w2"])
+        with annotate("ep.expert_ffn"):
+            expert_out = _expert_ffn(expert_in, params["w1"], params["w2"])
     else:
         p = lax.axis_size(axis)
         if n_experts % p:
@@ -240,16 +244,20 @@ def moe_mlp(
             )
         # (E, C, D) -> (E/P, P*C, D): every device receives the slots
         # destined for ITS experts from every device.
-        expert_in = lax.all_to_all(
-            expert_in, axis, split_axis=0, concat_axis=1, tiled=True
-        )
-        expert_out = _expert_ffn(expert_in, w1, w2)
+        with annotate("ep.all_to_all_dispatch"):
+            expert_in = lax.all_to_all(
+                expert_in, axis, split_axis=0, concat_axis=1, tiled=True
+            )
+        with annotate("ep.expert_ffn"):
+            expert_out = _expert_ffn(expert_in, w1, w2)
         # Inverse: (E/P, P*C, D) -> (E, C, D), back on the tokens' owner.
-        expert_out = lax.all_to_all(
-            expert_out, axis, split_axis=1, concat_axis=0, tiled=True
-        )
+        with annotate("ep.all_to_all_combine"):
+            expert_out = lax.all_to_all(
+                expert_out, axis, split_axis=1, concat_axis=0, tiled=True
+            )
 
-    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    with annotate("ep.combine_einsum"):
+        y = jnp.einsum("tec,ecd->td", combine, expert_out)
     return y.astype(x.dtype), aux
 
 

@@ -49,6 +49,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..obs.trace import annotate
 from ..ops.activations import stable_softmax
 from ..ops.losses import softmax_cross_entropy, squared_error_total
 from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
@@ -412,7 +413,8 @@ def _make_local_loss(plan: PipelinePlan):
             # never reach the last stage inside the scan, so they carry no
             # loss and no gradient); later stages read the shifted buffer.
             inp = jnp.where(s_idx == 0, feed[jnp.minimum(t, M - 1)], buf)
-            y = jax.lax.switch(s_idx, fns, fp, inp)
+            with annotate("pp.stage_body"):
+                y = jax.lax.switch(s_idx, fns, fp, inp)
             out_t = t - (S - 1)
             w = jnp.where(
                 (s_idx == S - 1) & (out_t >= 0) & (out_t < M), 1.0, 0.0
@@ -425,8 +427,9 @@ def _make_local_loss(plan: PipelinePlan):
             acc_sum = acc_sum + w * jnp.mean(
                 (jnp.argmax(logits, -1) == jnp.argmax(yt, -1)).astype(jnp.float32)
             )
-            return (jax.lax.ppermute(y, PIPE_AXIS, fwd_perm),
-                    loss_sum, etot_sum, acc_sum), None
+            with annotate("pp.ppermute_activations"):
+                y = jax.lax.ppermute(y, PIPE_AXIS, fwd_perm)
+            return (y, loss_sum, etot_sum, acc_sum), None
 
         carry0 = (jnp.zeros((mb, plan.a_max), jnp.float32),
                   jnp.float32(0), jnp.float32(0), jnp.float32(0))

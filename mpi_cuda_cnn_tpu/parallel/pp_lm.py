@@ -33,7 +33,6 @@ pipelining nor a transformer (SURVEY.md §2 "PP: absent"; §5.7).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax

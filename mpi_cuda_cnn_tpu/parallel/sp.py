@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..obs.trace import annotate
 from ..ops.attention import (
     NEG_INF,
     finalize_online,
@@ -85,9 +86,11 @@ def ring_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
 
     def hop(h, carry):
         o_m_l, kh, vh = carry
-        o_m_l = fold(o_m_l, kh, vh, h)
-        kh = lax.ppermute(kh, axis, perm)
-        vh = lax.ppermute(vh, axis, perm)
+        with annotate("sp.ring.fold"):
+            o_m_l = fold(o_m_l, kh, vh, h)
+        with annotate("sp.ring.ppermute"):
+            kh = lax.ppermute(kh, axis, perm)
+            vh = lax.ppermute(vh, axis, perm)
         return o_m_l, kh, vh
 
     # p-1 fold+rotate hops, then fold the final resident block WITHOUT
@@ -171,9 +174,11 @@ def _ring_flash_fwd_impl(q, k, v, axis, causal):
 
     def hop(hcnt, carry):
         o, lse, kh, vh = carry
-        o, lse = fold(o, lse, kh, vh, hcnt)
-        kh = lax.ppermute(kh, axis, perm)
-        vh = lax.ppermute(vh, axis, perm)
+        with annotate("sp.ring_flash.fold"):
+            o, lse = fold(o, lse, kh, vh, hcnt)
+        with annotate("sp.ring_flash.ppermute"):
+            kh = lax.ppermute(kh, axis, perm)
+            vh = lax.ppermute(vh, axis, perm)
         return o, lse, kh, vh
 
     o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
@@ -292,8 +297,12 @@ def ulysses_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
     def to_seq(x):
         return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
-    out = attention(to_heads(q), to_heads(k), to_heads(v), causal=causal)
-    return to_seq(out)
+    with annotate("sp.ulysses.all_to_all_heads"):
+        qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    with annotate("sp.ulysses.attention"):
+        out = attention(qh, kh, vh, causal=causal)
+    with annotate("sp.ulysses.all_to_all_seq"):
+        return to_seq(out)
 
 
 def _wrap(body, mesh, axis):

@@ -38,7 +38,6 @@ divisible by the 'model' axis, dims divisible for w1/w2.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax
@@ -49,7 +48,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.transformer import TransformerLM, _layernorm
 from ..ops.attention import rope
-from .mesh import DATA_AXIS, MODEL_AXIS
+from .mesh import MODEL_AXIS
 from .sp import (
     SEQ_AXIS,
     ring_attention,

@@ -22,10 +22,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import TransformerLM
+from ..obs import cost as obs_cost
+from ..obs.device import emit_step_telemetry
+from ..obs.trace import span
 from ..parallel.dp import replicate
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, make_mesh
 from ..parallel.sp import SEQ_AXIS, make_sp_lm_train_step
 from ..utils.logging import MetricsLogger, get_logger
+from ..utils.profiling import StepTimer
 from ..utils.sync import hard_block
 from .checkpoint import (
     AsyncCheckpointer,
@@ -596,21 +600,42 @@ class LMTrainer:
         t0 = time.perf_counter()
         loss = float("nan")
         m = None
+        timer = StepTimer()
+        timer.start()
         try:
             for step in range(start_step, cfg.steps):
-                tokens, targets = self._sample_batch(step)
-                self.state, m = self.train_step(
-                    self.state, self._place(tokens), self._place(targets)
-                )
+                with timer.phase("data"):
+                    tokens, targets = self._sample_batch(step)
+                    tokens, targets = self._place(tokens), self._place(targets)
+                if step == start_step and self.metrics.jsonl_enabled:
+                    # exclude(): the analysis costs an AOT compile that
+                    # must not land in the step-phase attribution.
+                    with timer.exclude():
+                        if not obs_cost.log_program(
+                            self.metrics, "lm_train_step", self.train_step,
+                            self.state, tokens, targets,
+                            compute_dtype=cfg.compute_dtype,
+                        ):
+                            self.log.warning(
+                                "obs: cost analysis unavailable for "
+                                "lm_train_step"
+                            )
+                with timer.phase("dispatch"):
+                    self.state, m = self.train_step(self.state, tokens, targets)
                 if cfg.log_every and (step + 1) % cfg.log_every == 0:
-                    loss = float(m["loss"])
+                    with timer.phase("device"):
+                        loss = float(m["loss"])
                     self.metrics.log("train", step=step + 1, loss=loss)
                 if cfg.checkpoint_dir and cfg.checkpoint_every and (
                     (step + 1) % cfg.checkpoint_every == 0
                 ):
-                    self._ckpt.save(self.state, step + 1)
-            hard_block(self.state)
-            dt = time.perf_counter() - t0
+                    with timer.phase("checkpoint"):
+                        self._ckpt.save(self.state, step + 1)
+            with timer.phase("device"):
+                hard_block(self.state)
+            # Exclude the obs AOT compile from the headline tokens/s —
+            # telemetry must not sink the number it reports.
+            dt = time.perf_counter() - t0 - timer.excluded_s
             if cfg.checkpoint_dir:
                 self._ckpt.save(self.state, cfg.steps)
         finally:
@@ -620,8 +645,12 @@ class LMTrainer:
                 self._ckpt.close()
         steps_run = cfg.steps - start_step
         loss = float(m["loss"]) if m is not None else loss
+        timer.stop(max(steps_run, 1))
+        emit_step_telemetry(self.metrics, timer, steps_run,
+                            devices=list(self.mesh.devices.flat))
 
-        eval_loss = self.evaluate()
+        with span("eval", metrics=self.metrics.sink_or_none()):
+            eval_loss = self.evaluate()
         tok_s = steps_run * cfg.batch_size * cfg.seq_len / max(dt, 1e-9)
         self.log.info(
             "lm done: steps=%d loss=%.4f eval_loss=%.4f ppl=%.2f tok/s=%.0f",

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +46,9 @@ from ..parallel.tp import (
     make_tp_state,
     make_tp_train_step,
 )
+from ..obs import cost as obs_cost
+from ..obs.device import emit_step_telemetry
+from ..obs.trace import span
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer, profile_trace
 from ..utils.sync import hard_block
@@ -286,6 +288,12 @@ class Trainer:
                 f"{self.num_train}: no full batches"
             )
 
+        # Telemetry: compiled-program accounting is emitted once per
+        # program label (obs.cost — an extra AOT compile, so only when a
+        # JSONL sink wants it); per-epoch phase/memory records ride the
+        # same gate.
+        self._programs_logged: set[str] = set()
+
         # One checkpointer for every save site; async by default (the
         # step loop pays only the host snapshot, the npz write overlaps
         # the next steps; train() drains it before returning).
@@ -313,6 +321,32 @@ class Trainer:
             return
         if global_step and global_step % cfg.checkpoint_every_steps == 0:
             self._ckpt.save(self.state, global_step)
+
+    def _maybe_log_program(self, label: str, fn, *args,
+                           steps_per_dispatch: int = 1,
+                           counting: str = "program") -> None:
+        """Emit ONE "program" record per program label: FLOPs/bytes from
+        XLA cost analysis of the step actually dispatched, collectives
+        from its HLO (obs.cost). Costs an extra AOT compile, so gated on
+        the JSONL sink; failures degrade to a warning."""
+        if self.metrics is None or not self.metrics.jsonl_enabled:
+            return
+        if label in self._programs_logged:
+            return
+        self._programs_logged.add(label)
+        if not obs_cost.log_program(
+            self.metrics, label, fn, *args,
+            steps_per_dispatch=steps_per_dispatch, counting=counting,
+            compute_dtype=self.cfg.compute_dtype,
+        ):
+            self.log.warning("obs: cost analysis unavailable for %r", label)
+
+    def _emit_epoch_obs(self, epoch: int, timer: StepTimer,
+                        nsteps: int) -> None:
+        """Per-epoch telemetry (the shared obs.device emit path)."""
+        emit_step_telemetry(self.metrics, timer, nsteps,
+                            devices=list(self.mesh.devices.flat),
+                            epoch=epoch)
 
     @staticmethod
     def _pick_eval_batch(ntest: int, granularity: int, target: int = 2048) -> int:
@@ -402,6 +436,8 @@ class Trainer:
         nsteps = 0
         order = self._epoch_order(epoch)
         b = cfg.batch_size
+        timer = StepTimer()
+        timer.start()
         # Oversized datasets normalize PER BATCH: the cached train_x/train_y
         # copies are a 4x float32 blow-up of the whole set — the exact host
         # materialization this path exists to avoid (see _use_scan).
@@ -409,19 +445,28 @@ class Trainer:
         labels = np.asarray(self.ds.train_labels) if stream else None
         for start in range(skip_steps * b, self.num_train - self.num_train % b, b):
             idx = order[start : start + b]
-            if stream:
-                bx = normalize_images(self.ds.train_images[idx])
-                by = one_hot(labels[idx], self.ds.num_classes)
-            else:
-                bx, by = self.train_x[idx], self.train_y[idx]
-            batch = self._place_batch(bx, by)
-            self.state, m = self.train_step(self.state, *batch)
+            with timer.phase("data"):
+                if stream:
+                    bx = normalize_images(self.ds.train_images[idx])
+                    by = one_hot(labels[idx], self.ds.num_classes)
+                else:
+                    bx, by = self.train_x[idx], self.train_y[idx]
+                batch = self._place_batch(bx, by)
+            if nsteps == 0:
+                # exclude(): the analysis costs an AOT compile that must
+                # not land in the step-phase attribution it feeds.
+                with timer.exclude():
+                    self._maybe_log_program("train_step", self.train_step,
+                                            self.state, *batch)
+            with timer.phase("dispatch"):
+                self.state, m = self.train_step(self.state, *batch)
             running = m if running is None else jax.tree.map(jnp.add, running, m)
             nsteps += 1
             # step is the ABSOLUTE in-epoch position (skip included) so a
             # resumed run's metric stream lines up with the scanned path's.
             if cfg.log_every > 0 and (skip_steps + nsteps) % cfg.log_every == 0:
-                jax.block_until_ready(running)
+                with timer.phase("device"):
+                    jax.block_until_ready(running)
                 self.metrics.log(
                     "train",
                     epoch=epoch,
@@ -430,14 +475,20 @@ class Trainer:
                     etotal=float(running["etotal"]) / nsteps,
                     acc=float(running["acc"]) / nsteps,
                 )
-            self._maybe_step_checkpoint(
-                epoch * self.steps_per_epoch + skip_steps + nsteps
-            )
+            with timer.phase("checkpoint"):
+                self._maybe_step_checkpoint(
+                    epoch * self.steps_per_epoch + skip_steps + nsteps
+                )
         # hard_block, not block_until_ready: the epoch wall-clock must
         # cover the COMPUTE, and under this env's remote-TPU tunnel
         # block_until_ready returns at enqueue (utils/sync.py).
-        hard_block(self.state)
-        seconds = time.perf_counter() - t0
+        with timer.phase("device"):
+            hard_block(self.state)
+        # Subtract the obs AOT-compile time the timer excluded, so the
+        # epoch record and step_phases record cannot disagree.
+        seconds = time.perf_counter() - t0 - timer.excluded_s
+        timer.stop(max(nsteps, 1))
+        self._emit_epoch_obs(epoch, timer, nsteps)
         if nsteps == 0:
             raise ValueError(
                 f"no full batches: train set of {self.num_train} yields "
@@ -547,12 +598,15 @@ class Trainer:
         exact step counts."""
         cfg = self.cfg
         t0 = time.perf_counter()
-        if self._scan_epoch_fn is None:
-            self._stage_dataset()
-        b = cfg.batch_size
-        nsteps = self.steps_per_epoch
-        order = self._epoch_order(epoch)[: nsteps * b]
-        perm = order.reshape(nsteps, b).astype(np.int32)
+        timer = StepTimer()
+        timer.start()
+        with timer.phase("data"):
+            if self._scan_epoch_fn is None:
+                self._stage_dataset()
+            b = cfg.batch_size
+            nsteps = self.steps_per_epoch
+            order = self._epoch_order(epoch)[: nsteps * b]
+            perm = order.reshape(nsteps, b).astype(np.int32)
 
         # log_every <= 0 means logging off -> the whole epoch is one scan.
         # A shorter tail chunk costs one extra (cached thereafter) compile.
@@ -573,16 +627,28 @@ class Trainer:
                     cfg.checkpoint_every_steps - gstep % cfg.checkpoint_every_steps
                 )
                 end = min(end, nxt - epoch * nsteps)
-            rows = dp_shard_perm(perm[done:end], self.mesh)
-            self.state, sums = self._scan_epoch_fn(
-                self.state, self._dev_images, self._dev_labels, rows
-            )
+            with timer.phase("data"):
+                rows = dp_shard_perm(perm[done:end], self.mesh)
+            with timer.exclude():  # AOT compile out of the attribution
+                # counting="static-body": XLA counts the scan body ONCE
+                # (obs/cost.py docstring), so the record's flops are ~one
+                # step's — steps_per_dispatch=1 keeps per-step math right.
+                self._maybe_log_program(
+                    "scan_epoch", self._scan_epoch_fn, self.state,
+                    self._dev_images, self._dev_labels, rows,
+                    steps_per_dispatch=1, counting="static-body",
+                )
+            with timer.phase("dispatch"):
+                self.state, sums = self._scan_epoch_fn(
+                    self.state, self._dev_images, self._dev_labels, rows
+                )
             totals = sums if totals is None else jax.tree.map(jnp.add, totals, sums)
             done = end
             # Parity with the loop path: log only at exact multiples of
             # log_every (a short tail chunk trains but does not log).
             if log_chunks and done % cfg.log_every == 0:
-                jax.block_until_ready(totals)
+                with timer.phase("device"):
+                    jax.block_until_ready(totals)
                 run = done - skip_steps
                 self.metrics.log(
                     "train",
@@ -592,10 +658,14 @@ class Trainer:
                     etotal=float(totals["etotal"]) / run,
                     acc=float(totals["acc"]) / run,
                 )
-            self._maybe_step_checkpoint(epoch * nsteps + done)
-        hard_block(self.state)  # see run_epoch: must wait for compute
-        seconds = time.perf_counter() - t0
+            with timer.phase("checkpoint"):
+                self._maybe_step_checkpoint(epoch * nsteps + done)
+        with timer.phase("device"):
+            hard_block(self.state)  # see run_epoch: must wait for compute
+        seconds = time.perf_counter() - t0 - timer.excluded_s  # see run_epoch
         run = nsteps - skip_steps
+        timer.stop(max(run, 1))
+        self._emit_epoch_obs(epoch, timer, run)
         return {
             "epoch": epoch,
             "steps": run,
@@ -631,16 +701,20 @@ class Trainer:
         try:
             with profile_trace(cfg.profile_dir):
                 for epoch in range(start_epoch, cfg.epochs):
-                    timer.start()
                     em = self.run_epoch(epoch, skip_steps=skip_steps)
                     skip_steps = 0  # only the resumed epoch is partial
-                    timer.stop(em["steps"])
+                    # Fold in the epoch's own measurement (which already
+                    # excludes the obs AOT compile) instead of re-timing
+                    # around it — mean_step_ms must agree with the
+                    # epoch/step_phases records of the same run.
+                    timer.add(em["seconds"], em["steps"])
                     epoch_seconds.append(em["seconds"])
                     self.metrics.log("epoch", epoch=epoch,
                                      seconds=em["seconds"])
 
                     if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                        ntests, ncorrect = self.evaluate()
+                        with span("eval", metrics=self.metrics.sink_or_none()):
+                            ntests, ncorrect = self.evaluate()
                         result_acc = ncorrect / ntests
                         self.metrics.log("eval", epoch=epoch, ntests=ntests,
                                          ncorrect=ncorrect,
@@ -648,10 +722,12 @@ class Trainer:
                     if cfg.checkpoint_dir and cfg.checkpoint_every and (
                         (epoch + 1) % cfg.checkpoint_every == 0
                     ):
-                        self._ckpt.save(self.state, self._global_step())
+                        with span("checkpoint", metrics=self.metrics.sink_or_none()):
+                            self._ckpt.save(self.state, self._global_step())
 
             if cfg.checkpoint_dir:
-                self._ckpt.save(self.state, self._global_step())
+                with span("checkpoint", metrics=self.metrics.sink_or_none()):
+                    self._ckpt.save(self.state, self._global_step())
         finally:
             # Drains the in-flight write even on an exceptional exit, so
             # its failure re-raises (chained) instead of dying with the

@@ -1,0 +1,50 @@
+"""JAX version compatibility.
+
+The framework targets the current stable API (`jax.shard_map` with
+`check_vma`, `lax.axis_size`); on older installs (<= 0.4.x) shard_map
+still lives at `jax.experimental.shard_map.shard_map` with a
+`check_rep` kwarg, and `lax.axis_size` does not exist (`lax.psum(1,
+axis)` is its classic static-int equivalent). Importing this module
+(the package __init__ does) installs translating aliases for whichever
+are missing, so every call site keeps the one modern spelling. On a
+modern JAX this module is a no-op.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map_alias() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # nothing to alias; calls will fail loudly
+        return
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma: bool | None = None, **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size_alias() -> None:
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name) -> int:
+        # psum of a Python literal over a named axis folds statically.
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+
+
+_install_shard_map_alias()
+_install_axis_size_alias()

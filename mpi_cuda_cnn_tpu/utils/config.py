@@ -14,7 +14,6 @@ import argparse
 import dataclasses
 import json
 import sys
-from pathlib import Path
 
 
 class _JsonConfig:
@@ -102,6 +101,13 @@ class Config(_JsonConfig):
     resume: bool = False
     log_every: int = 100          # steps; reference prints every 1000 samples
     profile_dir: str | None = None
+    metrics_jsonl: str | None = None  # write schema-stamped JSONL metrics
+                                  # (obs.schema) here: train/epoch/eval
+                                  # records plus telemetry — step-phase
+                                  # timings, compiled-step FLOPs and
+                                  # collective counts, device-memory
+                                  # snapshots; `mctpu report FILE`
+                                  # renders the summary tables
     eval_every: int = 1           # epochs
 
 
@@ -171,6 +177,8 @@ class LMConfig(_JsonConfig):
                                      # Config.async_checkpoint)
     resume: bool = False
     log_every: int = 20
+    metrics_jsonl: str | None = None  # JSONL metrics + telemetry sink
+                                     # (see Config.metrics_jsonl)
     sample_tokens: int = 0           # >0: after training, generate this
                                      # many tokens from the held-out
                                      # stream with the KV-cache decode

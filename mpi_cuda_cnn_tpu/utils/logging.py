@@ -4,7 +4,8 @@ The reference's entire observability surface is fprintf(stderr, ...): a
 running squared-error every 1000 steps (cnn.c:470-473) and one final
 "ntests=%d, ncorrect=%d" line (cnn.c:518). We keep those human-readable
 lines (so e2e output is comparable) and add structured JSONL metrics with
-wall-clock timing — the subsystem SURVEY.md §5.5 notes the reference lacks.
+wall-clock timing — records in the obs.schema shape, so `mctpu report`
+aggregates any run file into the tables PERF.md used to get by hand.
 """
 
 from __future__ import annotations
@@ -14,6 +15,8 @@ import logging
 import sys
 import time
 from pathlib import Path
+
+from ..obs.schema import RUN_MARKER, make_record
 
 _LOGGER_NAME = "mpi_cuda_cnn_tpu"
 
@@ -32,7 +35,14 @@ def get_logger() -> logging.Logger:
 
 
 class MetricsLogger:
-    """Structured metrics: JSONL file sink + human-readable stderr echo."""
+    """Structured metrics: JSONL file sink + human-readable stderr echo.
+
+    Records carry the obs.schema shape ({"schema", "event", "t", ...}).
+    A context manager, so trainers hold the file handle exception-safely:
+
+        with MetricsLogger(path) as metrics:
+            Trainer(..., metrics=metrics).train()
+    """
 
     def __init__(self, path: str | Path | None = None, echo: bool = True,
                  capture: bool = False):
@@ -41,6 +51,14 @@ class MetricsLogger:
             p = Path(path)
             p.parent.mkdir(parents=True, exist_ok=True)
             self._file = p.open("a")
+            # Run-boundary marker: append mode means re-running with the
+            # same path accumulates runs in one file — the comment line
+            # (obs.schema.RUN_MARKER) is where iter_runs/`mctpu report`
+            # split, so aggregates never blend unrelated runs.
+            self._file.write(time.strftime(
+                RUN_MARKER + " %Y-%m-%dT%H:%M:%SZ\n", time.gmtime()
+            ))
+            self._file.flush()
         self._echo = echo
         self._log = get_logger()
         self._t0 = time.perf_counter()
@@ -48,8 +66,21 @@ class MetricsLogger:
         # should leave it off and use the JSONL sink).
         self.rows: list[dict] | None = [] if capture else None
 
+    @property
+    def jsonl_enabled(self) -> bool:
+        """True while a JSONL sink is open — the trainers' gate for
+        telemetry that costs something to produce (program cost
+        analysis, per-epoch memory snapshots)."""
+        return self._file is not None
+
+    def sink_or_none(self) -> "MetricsLogger | None":
+        """self when the JSONL sink is open, else None — the form
+        obs.trace.span's `metrics=` argument wants (emit span records
+        only when a run file is collecting them)."""
+        return self if self.jsonl_enabled else None
+
     def log(self, event: str, **fields) -> None:
-        record = {"event": event, "t": round(time.perf_counter() - self._t0, 4), **fields}
+        record = make_record(event, time.perf_counter() - self._t0, **fields)
         if self.rows is not None:
             self.rows.append(record)
         if self._file:
@@ -63,6 +94,14 @@ class MetricsLogger:
         if self._file:
             self._file.close()
             self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # Close the sink even when the trainer raised mid-run — the
+        # records written so far must survive the exception.
+        self.close()
 
 
 def _fmt(v):

@@ -9,9 +9,12 @@ the chip's peak.
 
 Runs the matrix {f32, bf16} x {oracle, flash} by default (--quick runs
 bf16+flash only) and prints one JSON line per config plus a summary
-line. MFU = analytic fwd+bwd FLOPs (lm_flops_per_token) / wall-clock /
-peak; peak defaults to v5e bf16 (197 TFLOP/s) and can be overridden
-with --peak-tflops.
+line. Two FLOPs accountings per row, both computed (obs/cost.py — no
+hand-typed constants): `mfu` uses the analytic model FLOPs
+(lm_flops_per_token — the standard MFU numerator: remat must not
+inflate utilization), `mfu_xla` uses XLA cost analysis of the compiled
+step (the FLOPs actually executed). Peaks come from the one registry
+(obs.cost.PEAK_TFLOPS); --peak-tflops overrides for other chips.
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.obs import cost as obs_cost
 from mpi_cuda_cnn_tpu.train.lm import (
     count_params,
     lm_flops_per_token,
@@ -37,9 +41,6 @@ from mpi_cuda_cnn_tpu.train.lm import (
 )
 from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
 from mpi_cuda_cnn_tpu.utils.sync import two_point
-
-# Peak dense matmul throughput used as the MFU denominator.
-PEAK_TFLOPS = {"tpu_v5e_bf16": 197.0, "tpu_v5e_f32": 49.0}
 
 
 def bench_config(model, *, batch, seq, compute_dtype, attn_impl,
@@ -89,7 +90,10 @@ def bench_config(model, *, batch, seq, compute_dtype, attn_impl,
         return dt
 
     dt = two_point(timed, steps, warmup=0)
-    return dt, box["loss"]
+    # Compiled-step accounting (obs/cost.py): the FLOPs XLA actually
+    # executes for THIS program — the mfu_xla numerator.
+    costs = obs_cost.try_analyze(step_fn, box["state"], tokens, targets)
+    return dt, box["loss"], costs
 
 
 def main():
@@ -154,14 +158,14 @@ def main():
     )
 
     def peak_for(dtype_name):
-        """MFU denominator per compute dtype — f32 matmuls have their own
-        (4x lower) peak on the MXU; comparing them to the bf16 peak would
-        understate f32 utilization. A --peak-tflops override names the
-        chip's bf16 peak and scales for f32 by the same ratio as v5e."""
-        bf16 = args.peak_tflops or PEAK_TFLOPS["tpu_v5e_bf16"]
-        if dtype_name == "bfloat16":
-            return bf16
-        return bf16 * PEAK_TFLOPS["tpu_v5e_f32"] / PEAK_TFLOPS["tpu_v5e_bf16"]
+        """MFU denominator (TFLOP/s) per compute dtype — the ONE peak
+        formula, obs.cost.peak_flops: f32 matmuls have their own (4x
+        lower) MXU peak, a --peak-tflops override names the chip's bf16
+        peak and f32 scales by the same ratio as v5e."""
+        peak = obs_cost.peak_flops(
+            dtype_name, override_tflops=args.peak_tflops
+        )
+        return peak / 1e12 if peak else None
 
     tokens_per_step = args.batch * args.seq
     flops_per_step = lm_flops_per_token(model, args.seq) * tokens_per_step
@@ -198,7 +202,7 @@ def main():
     nparams = count_params(model.init(jax.random.key(0)))
     for dtype_name, impl, ce in configs:
         cd = jnp.bfloat16 if dtype_name == "bfloat16" else None
-        dt, loss = bench_config(
+        dt, loss, costs = bench_config(
             model, batch=args.batch, seq=args.seq,
             compute_dtype=cd, attn_impl=impl, steps=args.steps,
             ce_chunk=ce, moe_dispatch_chunk=args.moe_dispatch_chunk,
@@ -210,11 +214,19 @@ def main():
             round(flops_per_step / dt / (peak_for(dtype_name) * 1e12), 4)
             if mfu_valid else None
         )
+        xla_flops = costs.flops if costs else None
+        mfu_xla = (
+            round(xla_flops / dt / (peak_for(dtype_name) * 1e12), 4)
+            if mfu_valid and xla_flops else None
+        )
         key = f"{dtype_name}+{impl}" + (f"+ce{ce}" if ce else "")
         results[key] = {
             "step_ms": round(dt * 1e3, 2),
             "tokens_per_s": round(tok_s),
             "mfu": mfu,
+            "mfu_xla": mfu_xla,
+            "xla_flops_per_step": xla_flops,
+            "collectives": costs.collectives if costs else None,
             "loss": round(loss, 4),
         }
         extras = {}

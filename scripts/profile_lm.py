@@ -36,9 +36,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.obs import cost as obs_cost
 from mpi_cuda_cnn_tpu.train.lm import (
     get_attn_fn,
-    lm_flops_per_token,
     lm_loss,
     make_lm_state,
     make_lm_train_step,
@@ -175,14 +175,19 @@ def main():
         ),
     }
     tokens_per_step = args.batch * args.seq
-    flops = lm_flops_per_token(model, args.seq) * tokens_per_step
+    # FLOPs of the COMPILED full step (obs/cost.py XLA cost analysis),
+    # not an analytic formula — the number matches the program the rows
+    # above timed, byte-accounting included.
+    costs = obs_cost.try_analyze(step, state, tokens, targets)
     print(json.dumps({
         "bench": "lm_profile",
         "model": f"d{args.dim}x{args.depth} h{args.heads} s{args.seq} "
                  f"v{args.vocab} b{args.batch} {args.dtype}+{args.attn}",
         **ms, **derived,
         "tokens_per_s": round(tokens_per_step / rows["full_step"]),
-        "flops_per_step": flops,
+        "flops_per_step": costs.flops if costs else None,
+        "bytes_per_step": costs.bytes_accessed if costs else None,
+        "collectives": costs.collectives if costs else None,
         "backend": jax.default_backend(),
     }))
 

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mpi_cuda_cnn_tpu.data.augment import SPECS, make_augment
+from mpi_cuda_cnn_tpu.data.augment import make_augment
 
 
 def _batch(n=4, h=8, w=8, c=1, seed=0):

@@ -2,7 +2,6 @@
 (cnn.c:361-363) + rejection of the truncation its other variants silently
 trained on (SURVEY.md 2.8)."""
 
-import gzip
 import struct
 
 import numpy as np
