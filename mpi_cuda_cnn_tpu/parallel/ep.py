@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..obs.trace import annotate
+from ..utils.donation import donate_jit
 
 EXPERT_AXIS = "expert"
 
@@ -53,8 +54,76 @@ def init_moe_params(key, dim: int, hidden: int, n_experts: int) -> dict:
     }
 
 
+def router_dispatch(x, gate_w, n_experts: int, capacity: int, k: int = 1,
+                    dtype=None):
+    """THE routing core — top-k choice + capacity slot assignment, fused.
+
+    Builds the ONE (T, E, C) dispatch tensor the MoE einsums consume,
+    DIRECTLY in `dtype` (default x.dtype): the (T, E, C) writes are the
+    dominant routing cost (2.7 GB/layer at the profiled T=16k config,
+    PERF.md "MoE single-chip attribution"), and the old f32-build +
+    cast + separate combine tensor paid that cost four ways — f32 build,
+    cast read+write, second (combine) build per choice, second cast.
+    The gate weighting now travels as a (T, E) map instead of a second
+    (T, E, C) tensor: each token's chosen experts are DISTINCT (lax.top_k),
+    so at most one choice lands on any (t, e) pair and
+    combine == dispatch * gate_te[:, :, None] exactly.
+
+    All queue math (cumsum positions, capacity masks) stays f32 — exact
+    small-integer arithmetic, which bf16 loses past 256 tokens; only the
+    (T, E, C) outer products take `dtype`.
+
+    k=1 is Switch routing (raw top prob as the gate); k>1 renormalizes
+    over the chosen k (GShard). Capacity is allocated by CHOICE
+    PRIORITY: all tokens' 1st choices claim slots before any 2nd choice
+    does, so adding k > 1 never evicts a would-be top-1 assignment. Per
+    choice, slots go in token order.
+
+    Returns (dispatch, gate_te, aux_loss):
+      dispatch: (T, E, C) in {0, 1}, `dtype` — token t occupies slot c
+                of expert e;
+      gate_te:  (T, E) f32 — the token's (renormalized) gate for each
+                chosen-and-kept expert, 0 elsewhere;
+      aux_loss: scalar f32 load-balancing loss (Switch form over FIRST
+                choices: the signal that spreads primary assignments).
+    """
+    t = x.shape[0]
+    dtype = jnp.dtype(dtype) if dtype is not None else x.dtype
+    logits = x @ gate_w                                   # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(probs, k)                   # (T, k), distinct
+    gates = vals if k == 1 else vals / jnp.sum(vals, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((t, n_experts, capacity), dtype)
+    gate_te = jnp.zeros((t, n_experts), jnp.float32)
+    used = jnp.zeros((n_experts,), jnp.float32)  # kept slots per expert
+    # Python loop over choices: unrolled at trace time, so the compiled
+    # program grows linearly in k. Fine for the MoE regimes this routing
+    # targets (k is 1 or 2 in every shipped config; even 4 is cheap).
+    for j in range(k):
+        onehot = jax.nn.one_hot(idx[:, j], n_experts, dtype=jnp.float32)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + used[None, :]) * onehot
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
+            dtype=dtype,
+        )
+        dispatch = dispatch + keep.astype(dtype)[:, :, None] * slot[:, None, :]
+        gate_te = gate_te + keep * gates[:, j, None]
+        used = used + jnp.sum(keep, axis=0)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(idx[:, 0], n_experts, dtype=jnp.float32), axis=0
+    )
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = jnp.sum(frac_tokens * frac_probs) * n_experts
+    return dispatch, gate_te, aux_loss
+
+
 def top1_dispatch(x, gate_w, n_experts: int, capacity: int):
-    """Switch top-1 routing for tokens x: (T, D).
+    """Switch top-1 routing for tokens x: (T, D) — the dense-tensor view
+    of router_dispatch (kept for callers/tests that want the classic
+    (dispatch, combine) pair; the hot path consumes router_dispatch's
+    fused form and never builds `combine`).
 
     Returns (dispatch, combine, aux_loss):
       dispatch: (T, E, C) f32 in {0, 1} — token t occupies slot c of
@@ -63,76 +132,20 @@ def top1_dispatch(x, gate_w, n_experts: int, capacity: int):
       aux_loss: scalar load-balancing loss (mean_prob · mean_assignment
                 · E, the Switch auxiliary), to be added by the caller.
     """
-    t = x.shape[0]
-    logits = x @ gate_w                                   # (T, E)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                   # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)  # (T, E)
-    # Position of each token within its expert's queue (first come first
-    # served in token order); tokens past capacity are dropped.
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot    # (T, E), 0-based
-    keep = (pos < capacity).astype(jnp.float32) * onehot
-    slot = jax.nn.one_hot(
-        jnp.sum(pos, axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32
-    )                                                     # (T, C)
-    dispatch = keep[:, :, None] * slot[:, None, :]        # (T, E, C)
-    combine = dispatch * gate[:, None, None]
-    # Switch aux loss: fraction of tokens per expert x mean router prob.
-    frac_tokens = jnp.mean(onehot, axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
-    aux_loss = jnp.sum(frac_tokens * frac_probs) * n_experts
-    return dispatch, combine, aux_loss
+    return topk_dispatch(x, gate_w, n_experts, capacity, k=1)
 
 
 def topk_dispatch(x, gate_w, n_experts: int, capacity: int, k: int = 2):
-    """Top-k routing (GShard-style) for tokens x: (T, D).
-
-    Each token is routed to its k highest-probability experts with the
-    combined gate renormalized over the chosen k (the standard top-k
-    normalization). Capacity is allocated by CHOICE PRIORITY: all tokens'
-    1st choices claim slots before any 2nd choice does, so adding k > 1
-    never evicts a would-be top-1 assignment. Per choice, slots go in
-    token order (same policy as top1_dispatch).
-
-    Returns (dispatch, combine, aux_loss) with the same shapes/semantics
-    as top1_dispatch — (T, E, C) tensors, einsum-ready; k=1 reproduces
+    """Top-k routing (GShard-style) for tokens x: (T, D) — dense-tensor
+    view of router_dispatch; see top1_dispatch. k=1 reproduces
     top1_dispatch exactly (tested)."""
-    t = x.shape[0]
-    logits = x @ gate_w                                   # (T, E)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    vals, idx = jax.lax.top_k(probs, k)                   # (T, k), distinct
-    # k=1 keeps the RAW top prob (Switch semantics — degenerates to
-    # top1_dispatch exactly); k>1 renormalizes over the chosen k (GShard).
-    gates = vals if k == 1 else vals / jnp.sum(vals, axis=-1, keepdims=True)
-
-    dispatch = jnp.zeros((t, n_experts, capacity), jnp.float32)
-    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
-    used = jnp.zeros((n_experts,), jnp.float32)  # kept slots per expert
-    # Python loop over choices: unrolled at trace time, so the compiled
-    # program grows linearly in k. Fine for the MoE regimes this routing
-    # targets (k is 1 or 2 in every shipped config; even 4 is cheap); a
-    # lax.scan would only help at far larger k than any router uses.
-    for j in range(k):
-        onehot = jax.nn.one_hot(idx[:, j], n_experts, dtype=jnp.float32)
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + used[None, :]) * onehot
-        keep = (pos < capacity).astype(jnp.float32) * onehot
-        slot = jax.nn.one_hot(
-            jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
-            dtype=jnp.float32,
-        )
-        d_j = keep[:, :, None] * slot[:, None, :]
-        dispatch = dispatch + d_j
-        combine = combine + d_j * gates[:, j, None, None]
-        used = used + jnp.sum(keep, axis=0)
-    # Load-balance aux (Switch form over FIRST choices: the signal that
-    # spreads primary assignments; renormalized 2nd choices would dilute it).
-    frac_tokens = jnp.mean(
-        jax.nn.one_hot(idx[:, 0], n_experts, dtype=jnp.float32), axis=0
+    dispatch, gate_te, aux = router_dispatch(
+        x, gate_w, n_experts, capacity, k=k, dtype=jnp.float32
     )
-    frac_probs = jnp.mean(probs, axis=0)
-    aux_loss = jnp.sum(frac_tokens * frac_probs) * n_experts
-    return dispatch, combine, aux_loss
+    # combine == dispatch * gate_te exactly: the chosen experts per token
+    # are distinct, so each (t, e) pair carries at most one choice's gate.
+    combine = dispatch * gate_te[:, :, None]
+    return dispatch, combine, aux
 
 
 def _expert_ffn(h, w1, w2):
@@ -149,6 +162,7 @@ def moe_mlp(
     axis: str | None = EXPERT_AXIS,
     top_k: int = 1,
     dispatch_chunk: int = 0,
+    dispatch_dtype=None,
 ):
     """MoE MLP for x: (T, D) local tokens. SPMD body when `axis` names a
     mesh axis — then params["w1"]/["w2"] hold only THIS device's E/P
@@ -164,13 +178,22 @@ def moe_mlp(
     C = ceil(T*k*cf/E), i.e. ~2*k*cf*T^2*D — QUADRATIC in local tokens;
     at T = 16384 that term dwarfs the expert FFN's useful FLOPs 8x
     (scripts/profile_moe.py banks the attribution). Chunking makes it
-    linear in T while staying pure MXU einsums. Capacity becomes
-    per-chunk (ceil(chunk*k*cf/E) slots per expert per chunk) — the
-    same estimator change every microbatched MoE trainer accepts, and
+    linear in T while staying pure MXU einsums — the router + dispatch
+    build runs INSIDE the scan body, so the (chunk, E, C) tensor is
+    built, consumed, and freed per iteration and nothing routing-sized
+    ever exists at batch extent. Capacity becomes per-chunk
+    (ceil(chunk*k*cf/E) slots per expert per chunk) — the same
+    estimator change every microbatched MoE trainer accepts, and
     bitwise-identical to unchunked when nothing drops (tested). The aux
     loss is the chunk mean. Under EP (`axis` set) chunking is rejected:
     each shard already routes only its T/P local tokens, which is the
-    same quadratic-term reduction the mesh provides for free."""
+    same quadratic-term reduction the mesh provides for free.
+
+    dispatch_dtype overrides the dispatch tensor's dtype (default:
+    x.dtype — bf16 under a bf16 compute path). jnp.bfloat16 under an
+    f32 path halves the routing-tensor build/read bytes at a bounded
+    cost: dispatch entries are exact {0, 1} in any float dtype, so only
+    the einsum accumulation dtype changes."""
     t, d = x.shape
     if dispatch_chunk and dispatch_chunk < t:
         if axis is not None:
@@ -189,6 +212,7 @@ def moe_mlp(
             yc, auxc = moe_mlp(
                 xc, params, n_experts=n_experts,
                 capacity_factor=capacity_factor, axis=None, top_k=top_k,
+                dispatch_dtype=dispatch_dtype,
             )
             return 0, (yc, auxc)
 
@@ -196,19 +220,14 @@ def moe_mlp(
         _, (ys, auxs) = lax.scan(chunk_body, 0, xs)
         return ys.reshape(t, d), jnp.mean(auxs)
     capacity = max(1, -int(-t * top_k * capacity_factor // n_experts))  # ceil
-    if top_k == 1:
-        dispatch, combine, aux = top1_dispatch(
-            x, params["gate"], n_experts, capacity
+    # Fused router (router_dispatch): ONE (T, E, C) tensor built directly
+    # in the einsum dtype + a (T, E) gate map — never an f32 build/cast
+    # round-trip, never a second (T, E, C) combine tensor.
+    with annotate("ep.router_build"):
+        dispatch, gate_te, aux = router_dispatch(
+            x, params["gate"], n_experts, capacity, k=top_k,
+            dtype=dispatch_dtype or x.dtype,
         )
-    else:
-        dispatch, combine, aux = topk_dispatch(
-            x, params["gate"], n_experts, capacity, top_k
-        )
-    # Dispatch/combine follow x's dtype so a bf16 compute path stays bf16
-    # end to end (dispatch is exact {0,1} in any float dtype; combine's
-    # gate weights round like every other bf16 operand).
-    dispatch = dispatch.astype(x.dtype)
-    combine = combine.astype(x.dtype)
     with annotate("ep.dispatch_einsum"):
         expert_in = jnp.einsum("tec,td->ecd", dispatch, x)    # (E, C, D)
 
@@ -257,7 +276,26 @@ def moe_mlp(
             )
 
     with annotate("ep.combine_einsum"):
-        y = jnp.einsum("tec,ecd->td", combine, expert_out)
+        if top_k == 1:
+            # Switch routing: each token occupies at most ONE (e, c)
+            # slot, so the gate is a per-token SCALAR — contract the
+            # SAME dispatch tensor the forward path already built and
+            # scale the (T, D) result. No (T, E, C) combine tensor
+            # exists at all: the routing-tensor traffic drops from
+            # 2 writes + 2 reads to 1 write + 2 reads. Exact: the one
+            # nonzero product per row makes the reassociation bitwise.
+            gate_t = jnp.sum(gate_te, axis=-1)            # (T,)
+            y = jnp.einsum("tec,ecd->td", dispatch, expert_out)
+            y = y * gate_t.astype(y.dtype)[:, None]
+        else:
+            # Top-k: the combine weights are ONE broadcast multiply of
+            # the dispatch tensor by the (T, E) gate map — never a
+            # second routed build (the old form assembled combine from
+            # k more one-hot products in f32 and cast it). Exact:
+            # dispatch entries are {0, 1} and each (t, e) pair carries
+            # at most one choice's gate.
+            combine = dispatch * gate_te.astype(dispatch.dtype)[:, :, None]
+            y = jnp.einsum("tec,ecd->td", combine, expert_out)
     return y.astype(x.dtype), aux
 
 
@@ -417,4 +455,4 @@ def make_ep_lm_train_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)

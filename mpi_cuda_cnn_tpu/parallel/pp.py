@@ -53,6 +53,7 @@ from ..obs.trace import annotate
 from ..ops.activations import stable_softmax
 from ..ops.losses import softmax_cross_entropy, squared_error_total
 from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from ..utils.donation import donate_jit
 
 TrainState = dict[str, Any]
 
@@ -656,7 +657,7 @@ def make_pp_train_step(
         out_specs=(specs, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)
 
 
 def make_pp_scan_epoch(
@@ -707,7 +708,7 @@ def make_pp_scan_epoch(
         out_specs=(specs, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)
 
 
 def make_pp_forward(plan: PipelinePlan, mesh):

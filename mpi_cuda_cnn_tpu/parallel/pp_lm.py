@@ -49,6 +49,7 @@ from .mesh import DATA_AXIS, PIPE_AXIS
 from .pp import _batch_spec
 from .pp import microbatch as pp_lm_microbatch  # noqa: F401
 from .pp import pp_shard_batch as pp_lm_shard_batch  # noqa: F401
+from ..utils.donation import donate_jit
 
 TrainState = dict[str, Any]
 
@@ -327,7 +328,7 @@ def _jit_pp_step(optimizer, local_loss, state, mesh, *, reduce_axes,
         out_specs=(specs, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)
 
 
 def make_sp_pp_lm_train_step(

@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..obs.trace import annotate
+from ..utils.donation import donate_jit
 from ..ops.attention import (
     NEG_INF,
     finalize_online,
@@ -563,4 +564,4 @@ def make_sp_lm_train_step(
         out_specs=(sspec, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)

@@ -34,6 +34,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, MODEL_AXIS
+from ..utils.donation import donate_jit
 
 TrainState = dict[str, Any]
 
@@ -143,7 +144,7 @@ def make_tp_train_step(
     callers place state via make_tp_state and batches via shard_batch_2d.
     """
     step = _step_body(loss_fn, optimizer, augment, aug_seed, grad_accum)
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return donate_jit(step, donate=donate)
 
 
 def make_tp_scan_epoch(
@@ -172,7 +173,7 @@ def make_tp_scan_epoch(
         state, metrics = jax.lax.scan(body, state, perm)
         return state, jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
 
-    return jax.jit(epoch, donate_argnums=(0,) if donate else ())
+    return donate_jit(epoch, donate=donate)
 
 
 def lm_tp_specs(model, mesh, axis: str = MODEL_AXIS) -> dict:

@@ -50,6 +50,7 @@ from .pp_lm import (
     stack_blocks,
     unstack_blocks,
 )
+from ..utils.donation import donate_jit
 from .tp_sp import (
     MOE_SPEC_TAILS,
     TP_SPEC_TAILS,
@@ -304,4 +305,4 @@ def make_tp_pp_lm_train_step(
         out_specs=(specs, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)

@@ -49,6 +49,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.transformer import TransformerLM, _layernorm
 from ..ops.attention import rope
 from .mesh import MODEL_AXIS
+from ..utils.donation import donate_jit
 from .sp import (
     SEQ_AXIS,
     ring_attention,
@@ -500,4 +501,4 @@ def make_tp_sp_lm_train_step(
         out_specs=(state_specs, P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    return donate_jit(sharded, donate=donate)

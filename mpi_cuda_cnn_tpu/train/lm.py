@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import TransformerLM
+from ..utils.donation import donate_jit
 
 
 # Measured f32 oracle/flash crossover (scripts/bench_crossover.py on one
@@ -94,6 +95,7 @@ def lm_loss(
     ce_chunk: int = 0,
     moe_axis: str | None = None,
     moe_dispatch_chunk: int = 0,
+    moe_dispatch_dtype=None,
 ):
     """Mean next-token NLL (+ the Switch aux loss when the model is MoE).
     tokens/targets: (B, S) int32. The loss softmax always runs in f32.
@@ -120,6 +122,7 @@ def lm_loss(
             compute_dtype=compute_dtype, return_aux=True,
             return_features=True, moe_axis=moe_axis,
             moe_dispatch_chunk=moe_dispatch_chunk,
+            moe_dispatch_dtype=moe_dispatch_dtype,
         )
         nll = chunked_ce_mean(
             feats, params["head"], targets, ce_chunk, compute_dtype
@@ -129,6 +132,7 @@ def lm_loss(
         params, tokens, attn_fn=attn_fn, remat=remat,
         compute_dtype=compute_dtype, return_aux=True, moe_axis=moe_axis,
         moe_dispatch_chunk=moe_dispatch_chunk,
+        moe_dispatch_dtype=moe_dispatch_dtype,
     )
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -148,6 +152,7 @@ def make_lm_train_step(
     ce_chunk: int = 0,
     grad_accum: int = 1,
     moe_dispatch_chunk: int = 0,
+    moe_dispatch_dtype=None,
     accum_dtype=None,
 ):
     """step(state, tokens, targets) -> (state, {"loss": ...}), jitted.
@@ -184,9 +189,10 @@ def make_lm_train_step(
         lm_loss, model, attn_fn=attn_fn, compute_dtype=compute_dtype,
         remat=remat, moe_aux_weight=moe_aux_weight, ce_chunk=ce_chunk,
         moe_dispatch_chunk=moe_dispatch_chunk,
+        moe_dispatch_dtype=moe_dispatch_dtype,
     )
 
-    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    @partial(donate_jit, donate=donate)
     def step(state, tokens, targets):
         if grad_accum > 1 and tokens.shape[0] % grad_accum:
             raise ValueError(

@@ -197,6 +197,32 @@ class LMTrainer:
             raise ValueError(
                 "--moe-dispatch-chunk needs an MoE model (--moe-experts)"
             )
+        if cfg.moe_dispatch_dtype:
+            if not cfg.moe_experts:
+                raise ValueError(
+                    "--moe-dispatch-dtype needs an MoE model "
+                    "(--moe-experts)"
+                )
+            if cfg.moe_dispatch_dtype not in ("bfloat16", "float32"):
+                raise ValueError(
+                    f"--moe-dispatch-dtype {cfg.moe_dispatch_dtype!r} "
+                    "must be 'bfloat16' or 'float32'"
+                )
+            if self.n_expert > 1 or self.n_seq > 1 or self.n_pipe > 1:
+                # Only the plain jitted step (data/model/FSDP GSPMD
+                # meshes) threads the override; silently dropping it on
+                # the shard_map paths would let a run believe bf16
+                # dispatch was active while building f32 tensors —
+                # reject, same policy as --moe-dispatch-chunk. (Under a
+                # bf16 compute path those meshes already build bf16
+                # dispatch: it follows x.dtype.)
+                raise ValueError(
+                    "--moe-dispatch-dtype rides the plain jitted step "
+                    "(data/model/FSDP meshes); the expert/seq/pipe "
+                    "shard_map steps don't thread it — drop one of the "
+                    "two (bf16 compute already gives bf16 dispatch "
+                    "there)"
+                )
         if self.n_model > 1 and self.n_seq > 1:
             # TP x SP (parallel/tp_sp.py): Megatron inside the ring
             # shard_map. Structural checks (MoE, divisibility) fire at
@@ -338,7 +364,7 @@ class LMTrainer:
                         self.model, self.optimizer, self.mesh, self.state,
                         compute_dtype=compute_dtype, remat=cfg.remat,
                         grad_clip=cfg.grad_clip, attn_impl=impl,
-                        ce_chunk=cfg.ce_chunk,
+                        ce_chunk=cfg.ce_chunk, donate=cfg.donate,
                     )
                 else:
                     from ..parallel.pp_lm import make_sp_pp_lm_train_step
@@ -350,7 +376,7 @@ class LMTrainer:
                         self.model, self.optimizer, self.mesh, self.state,
                         compute_dtype=compute_dtype, remat=cfg.remat,
                         grad_clip=cfg.grad_clip, impl=impl,
-                        ce_chunk=cfg.ce_chunk,
+                        ce_chunk=cfg.ce_chunk, donate=cfg.donate,
                     )
             else:
                 # Each stage sees the full sequence, so the plain
@@ -376,7 +402,7 @@ class LMTrainer:
                     self.model, self.optimizer, self.mesh, self.state,
                     compute_dtype=compute_dtype, remat=cfg.remat,
                     grad_clip=cfg.grad_clip, attn_impl=self.attn_impl,
-                    ce_chunk=cfg.ce_chunk,
+                    ce_chunk=cfg.ce_chunk, donate=cfg.donate,
                 )
         elif self.n_seq > 1 and self.n_model > 1:
             from ..parallel.tp_sp import (
@@ -401,7 +427,7 @@ class LMTrainer:
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
                 compute_dtype=compute_dtype, remat=cfg.remat,
                 ce_chunk=cfg.ce_chunk, impl=self.attn_impl,
-                grad_clip=cfg.grad_clip,
+                grad_clip=cfg.grad_clip, donate=cfg.donate,
             )
         elif self.n_expert > 1:
             # EP x DP: batch sharded over (data, expert) jointly; the
@@ -416,7 +442,7 @@ class LMTrainer:
                 data_axis=DATA_AXIS if self.n_data > 1 else None,
                 attn_impl=self.attn_impl, remat=cfg.remat,
                 compute_dtype=compute_dtype, ce_chunk=cfg.ce_chunk,
-                grad_accum=cfg.grad_accum,
+                grad_accum=cfg.grad_accum, donate=cfg.donate,
             )
         elif self.n_seq > 1:
             impl = cfg.attn_impl
@@ -443,7 +469,7 @@ class LMTrainer:
                 remat=cfg.remat, compute_dtype=compute_dtype,
                 ce_chunk=cfg.ce_chunk, state_specs=sp_specs,
                 grad_clip=cfg.grad_clip if cfg.fsdp else 0.0,
-                grad_accum=cfg.grad_accum,
+                grad_accum=cfg.grad_accum, donate=cfg.donate,
             )
         else:
             self.attn_impl = pick_attn_impl(
@@ -455,6 +481,11 @@ class LMTrainer:
                 remat=cfg.remat, ce_chunk=cfg.ce_chunk,
                 grad_accum=cfg.grad_accum,
                 moe_dispatch_chunk=cfg.moe_dispatch_chunk,
+                moe_dispatch_dtype=(
+                    jnp.dtype(cfg.moe_dispatch_dtype)
+                    if cfg.moe_dispatch_dtype else None
+                ),
+                donate=cfg.donate,
             )
         if self.n_pipe > 1 or self.n_seq > 1 and (self.n_model > 1
                                                   or cfg.fsdp):

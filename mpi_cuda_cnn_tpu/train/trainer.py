@@ -539,7 +539,8 @@ class Trainer:
             )
 
     def device_epoch_seconds(self, *, reps: int = 3, k: int = 2,
-                             min_signal_s: float = 0.015) -> float | None:
+                             min_signal_s: float = 0.015,
+                             budget_s: float | None = None) -> float | None:
         """On-device steady-state epoch seconds via the shared two-point
         recipe (utils/sync.two_point): k scanned epochs dispatched
         back-to-back with ONE hard sync, so (T(2k)-T(k))/k cancels any
@@ -551,12 +552,18 @@ class Trainer:
         drift caused every shipped measurement bug, utils/sync.py).
 
         Runs ~reps*(3k)+1 extra epochs, advancing self.state (harmless
-        for a timing run). Returns None on a non-TPU backend (the
-        recipe exists to cancel the TPU tunnel's dispatch window; on
-        CPU the wall-clock is already honest and the extra epochs would
-        dominate the caller's run), when the scanned path isn't staged
-        (streaming fallback), or when the slope stays non-positive (a
-        backend transient) — callers fall back to wall-clock."""
+        for a timing run) — and up to reps*48 MORE when the sub-15 ms
+        retry re-measures at k=16. budget_s caps the TOTAL wall-clock:
+        the retry is skipped (returning None) when its projected cost
+        would overrun it, so a caller's attempt timeout can't be eaten
+        by the re-measure path (bench.py's guard used to size only the
+        first pass — ADVICE round 5). Returns None on a non-TPU backend
+        (the recipe exists to cancel the TPU tunnel's dispatch window;
+        on CPU the wall-clock is already honest and the extra epochs
+        would dominate the caller's run), when the scanned path isn't
+        staged (streaming fallback), or when the slope stays
+        non-positive (a backend transient) — callers fall back to
+        wall-clock."""
         from ..utils.sync import two_point
 
         if jax.default_backend() != "tpu":
@@ -580,12 +587,21 @@ class Trainer:
             hard_block(sums)
             return time.perf_counter() - t0
 
+        t0 = time.perf_counter()
         est = two_point(run, k, warmup=1, reps=reps)
         if est < min_signal_s:
             # Sub-15 ms epochs leave the window diff inside tunnel
             # jitter; re-measure with ~100 ms of signal per window. A
             # NEGATIVE first slope is the same artifact class and gets
             # the same retry (not an early None).
+            if budget_s is not None:
+                # The retry runs reps*3*16 epochs vs the first pass's
+                # 1 + reps*3*k — project its cost from what the first
+                # pass actually took and skip when it would overrun.
+                elapsed = time.perf_counter() - t0
+                projected = elapsed * (reps * 48) / (1 + reps * 3 * k)
+                if elapsed + projected > budget_s:
+                    return None
             est = two_point(run, 16, warmup=0, reps=reps)
         return est if est > 0 else None
 

@@ -134,6 +134,12 @@ class LMConfig(_JsonConfig):
                                   # dispatch-einsum term; rejected on
                                   # expert-sharded meshes (EP already
                                   # divides the routed tokens)
+    moe_dispatch_dtype: str | None = None  # routing-tensor dtype override
+                                  # (ep.moe_mlp dispatch_dtype):
+                                  # "bfloat16" halves the (T,E,C)
+                                  # dispatch build/read bytes under an
+                                  # f32 compute path; default follows
+                                  # the compute dtype
     steps: int = 200
     batch_size: int = 8
     lr: float = 3e-4
@@ -150,6 +156,12 @@ class LMConfig(_JsonConfig):
                                   # shard_map paths reject it ('pipe'
                                   # already microbatches)
     seed: int = 0
+    donate: bool = True           # donate the state pytree to every jitted
+                                  # step (utils/donation.donate_jit): XLA
+                                  # aliases params/opt-state/accumulator
+                                  # buffers in place — halves live state
+                                  # at the update. Off only for debugging
+                                  # (keeping a pre-step state readable)
 
     compute_dtype: str = "float32"   # bfloat16 = MXU-native matmuls
     attn_impl: str = "auto"          # auto | flash | oracle (seq-sharded

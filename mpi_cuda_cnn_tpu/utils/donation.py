@@ -1,0 +1,42 @@
+"""The ONE buffer-donation policy for jitted train/update steps.
+
+Every train step in the framework carries its full state — params,
+optimizer state, step counter, and (under grad accumulation) the
+accumulator carry — as argument 0 and returns the updated state as its
+first output. Donating that argument lets XLA alias the input buffers to
+the output buffers: the AdamW update rewrites p/m/v IN PLACE instead of
+allocating a fresh ~3x-params set per step, which halves live state at
+the update and is the precondition for larger accumulation batches
+(PERF.md "hot-step memory traffic"). The flagship LM step moves ~19 GB
+of optimizer state per update; without donation every byte of it needs a
+second resident copy at the update's peak.
+
+Before this module each step builder wrote its own
+`donate_argnums=(0,) if donate else ()` — ten sites that could (and,
+with fresh builders, would) drift. `donate_jit` is the single spelling,
+and `obs.cost.donation_report` / `assert_donation` are the compile-time
+proof that the aliasing actually happened (the HLO's
+`input_output_alias` table + XLA memory analysis — donation silently
+degrades to a copy when an output shape/layout mismatches, so "we passed
+the flag" is not evidence).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["donate_jit"]
+
+
+def donate_jit(fn, *, donate: bool = True, argnums: tuple[int, ...] = (0,),
+               **jit_kwargs):
+    """jax.jit with the repo's donation convention applied uniformly.
+
+    argnums names the donated positional arguments — (0,), the state
+    pytree, everywhere today. donate=False (parity tests, callers that
+    reuse a state across calls) compiles the same program without
+    aliasing. Extra jit kwargs pass through.
+    """
+    return jax.jit(
+        fn, donate_argnums=argnums if donate else (), **jit_kwargs
+    )

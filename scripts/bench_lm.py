@@ -131,8 +131,9 @@ def main():
                     help="micro-batch accumulation (must divide batch); "
                          "amortizes the optimizer update's HBM traffic")
     ap.add_argument("--accum-dtype", default=None,
-                    choices=[None, "bfloat16", "float32"],
-                    help="grad-accumulation carry dtype; measured a TIE "
+                    choices=["bfloat16", "float32"],
+                    help="grad-accumulation carry dtype (default: the "
+                         "param dtype, f32 — exact); measured a TIE "
                          "on v5e (XLA fuses the accumulate into the bwd "
                          "epilogue — PERF.md) but kept for backends "
                          "where it isn't (~1-2%% grad error band)")
@@ -140,6 +141,12 @@ def main():
                     help="jax.checkpoint per block (recompute-in-bwd)")
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     args = ap.parse_args()
+
+    # "float32" == the default exact carry: normalize to None so the
+    # accumulation path never does a silent f32->f32 cast round-trip
+    # (ADVICE.md — the old choices list also made None unreachable).
+    if args.accum_dtype == "float32":
+        args.accum_dtype = None
 
     if args.device == "cpu":
         # In-process selection, like the CLI: the JAX_PLATFORMS env var can

@@ -80,6 +80,104 @@ def _timed_fwd(loss_fn, params, *args):
     return run
 
 
+def _accum_ablation(model, opt, state, tokens, targets, *, accum, cd,
+                    attn_impl, attn_fn, steps):
+    """Attribute the per-microbatch grad-accumulation overhead (the
+    fitted ~8 ms/microbatch at the flagship, PERF.md) by ABLATION, the
+    same differences-of-measurements method as the main rows:
+
+      accum_full          the real accum step (scan + tree carry + AdamW)
+      accum_no_update     same accumulation, optimizer update removed
+                          -> update share = full - no_update
+      accum_scalar_carry  scan runs every fwd+bwd but the carry holds
+                          per-leaf SCALAR sums (backward cannot be
+                          DCE'd; no grad-tree-extent add/read/write)
+                          -> tree-carry share/microbatch =
+                             (no_update - scalar_carry) / accum
+      plain_no_update     one full-batch fwd+bwd, no scan, no update
+                          -> scan/microbatching share/microbatch =
+                             (scalar_carry - plain_no_update) / accum
+
+    Coarse where XLA fuses across the seams (the carry add can ride the
+    backward epilogue — then the tree-carry share reads ~0 and the floor
+    is proven fused), but honest: every row is a measured program.
+    """
+    from mpi_cuda_cnn_tpu.parallel.dp import local_grads_no_aux
+    from mpi_cuda_cnn_tpu.train.lm import lm_loss as _lm_loss
+
+    def loss_fn(p, t, y):
+        return _lm_loss(model, p, t, y, attn_fn=attn_fn, compute_dtype=cd)
+
+    def split(t):
+        a = accum
+        return t.reshape(t.shape[0] // a, a, *t.shape[1:]).swapaxes(0, 1)
+
+    @jax.jit
+    def accum_no_update(state, tokens, targets):
+        l, grads = local_grads_no_aux(
+            loss_fn, state["params"], tokens, targets, accum
+        )
+        # Consume the grads at scalar extent so the accumulation isn't
+        # dead code; the optimizer update is the only thing removed.
+        return state, {"loss": l + 0.0 * sum(
+            jnp.sum(g) for g in jax.tree.leaves(grads)
+        )}
+
+    @jax.jit
+    def accum_scalar_carry(state, tokens, targets):
+        xs, ys = split(tokens), split(targets)
+
+        def body(c, xy):
+            l, grads = jax.value_and_grad(loss_fn)(state["params"], *xy)
+            s = sum(jnp.sum(g) for g in jax.tree.leaves(grads))
+            return (c[0] + l, c[1] + s), None
+
+        (l, s), _ = jax.lax.scan(
+            body, (jnp.float32(0), jnp.float32(0)), (xs, ys)
+        )
+        return state, {"loss": l / accum + 0.0 * s}
+
+    @jax.jit
+    def plain_no_update(state, tokens, targets):
+        l, grads = jax.value_and_grad(loss_fn)(
+            state["params"], tokens, targets
+        )
+        return state, {"loss": l + 0.0 * sum(
+            jnp.sum(g) for g in jax.tree.leaves(grads)
+        )}
+
+    from mpi_cuda_cnn_tpu.train.lm import make_lm_train_step
+
+    accum_full = make_lm_train_step(
+        model, opt, attn_impl=attn_impl, seq_len=tokens.shape[1],
+        compute_dtype=cd, donate=False, grad_accum=accum,
+    )
+
+    rows = {}
+    for name, fn in (
+        ("accum_full", accum_full),
+        ("accum_no_update", accum_no_update),
+        ("accum_scalar_carry", accum_scalar_carry),
+        ("plain_no_update", plain_no_update),
+    ):
+        rows[name] = _two_point(
+            _timed_loop(fn, state, tokens, targets), steps
+        )
+    ms = {k: round(v * 1e3, 2) for k, v in rows.items()}
+    a = accum
+    derived = {
+        "update_ms": round(ms["accum_full"] - ms["accum_no_update"], 2),
+        "tree_carry_ms_per_microbatch": round(
+            (ms["accum_no_update"] - ms["accum_scalar_carry"]) / a, 3
+        ),
+        "scan_overhead_ms_per_microbatch": round(
+            (ms["accum_scalar_carry"] - ms["plain_no_update"]) / a, 3
+        ),
+    }
+    costs = obs_cost.try_analyze(accum_full, state, tokens, targets)
+    return ms, derived, costs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dim", type=int, default=512)
@@ -93,6 +191,11 @@ def main():
                     choices=["bfloat16", "float32"])
     ap.add_argument("--attn", default="flash", choices=["flash", "oracle"])
     ap.add_argument("--ce-chunk", type=int, default=512)
+    ap.add_argument("--grad-accum", type=int, default=0,
+                    help="> 1: run the grad-accumulation overhead "
+                         "ablation instead of the step-component rows "
+                         "(attributes the per-microbatch cost to tree "
+                         "carry vs scan machinery vs update)")
     ap.add_argument("--profile-dir", default=None,
                     help="also capture a jax.profiler trace of one step")
     ap.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
@@ -116,6 +219,30 @@ def main():
     )
     tokens, targets = toks[:, :-1], toks[:, 1:]
     attn_fn = get_attn_fn(args.attn)
+
+    if args.grad_accum > 1:
+        if args.batch % args.grad_accum:
+            raise SystemExit(
+                f"--batch {args.batch} not divisible by --grad-accum "
+                f"{args.grad_accum}"
+            )
+        ms, derived, costs = _accum_ablation(
+            model, opt, state, tokens, targets, accum=args.grad_accum,
+            cd=cd, attn_impl=args.attn, attn_fn=attn_fn, steps=args.steps,
+        )
+        print(json.dumps({
+            "bench": "lm_accum_profile",
+            "model": f"d{args.dim}x{args.depth} h{args.heads} "
+                     f"s{args.seq} v{args.vocab} b{args.batch} "
+                     f"{args.dtype}+{args.attn} accum{args.grad_accum}",
+            **ms, **derived,
+            "flops_per_step": costs.flops if costs else None,
+            "bytes_per_step": costs.bytes_accessed if costs else None,
+            "aliased_outputs": costs.aliased_outputs if costs else None,
+            "alias_bytes": costs.alias_bytes if costs else None,
+            "backend": jax.default_backend(),
+        }))
+        return
 
     rows = {}
 

@@ -68,6 +68,20 @@ step bench_lm_flagship 900 python scripts/bench_lm.py --quick --dim 4096 \
 # (77.4% MFU at accum 16; the accum-4 point is the cheap re-check).
 step bench_lm_flagship_ga4 1200 python scripts/bench_lm.py --quick \
     --dim 4096 --depth 3 --heads 32 --batch 8 --grad-accum 4
+# PR-2 re-verification: flagship at accum 32 with whole-state donation —
+# the >= 80% MFU target (pre-PR banked 78.2%; donation halves live state
+# at the update, the headroom the asymptote model leaves).
+step bench_lm_flagship_ga32 1800 python scripts/bench_lm.py --quick \
+    --dim 4096 --depth 3 --heads 32 --batch 64 --grad-accum 32
+# PR-2: grad-accum overhead attribution (tree carry vs scan machinery vs
+# update, the fitted ~8 ms/microbatch term) at the flagship shape.
+step profile_lm_accum 1200 python scripts/profile_lm.py --dim 4096 \
+    --depth 3 --heads 32 --batch 16 --grad-accum 8 --steps 5
+# PR-2: MoE with the router-fused dispatch (one routing tensor built in
+# the einsum dtype, gate as a (T,E)/scalar map) — the >= 28% MFU target
+# at the d512x8 bench config (pre-PR banked 23.0% at chunk 512).
+step bench_lm_moe_fused 900 python scripts/bench_lm.py --quick \
+    --moe-experts 8 --moe-top-k 2 --moe-dispatch-chunk 512 --grad-accum 4
 step bench_decode 900 python scripts/bench_decode.py
 step bench_decode_bf16 900 python scripts/bench_decode.py \
     --cache-dtype bfloat16

@@ -286,3 +286,36 @@ def test_reject_core_respects_target_top_p():
     got = _hist(toks, v)
     assert _tv(got, p_want) < 0.05
     assert got[~keep].sum() == 0.0  # cut tokens never emitted
+
+
+def test_reject_core_degenerate_residual_falls_back_to_p():
+    """When rounding zeroes the whole residual row (sum(max(p-q,0)) == 0)
+    while a rejection is still possible (p < q at the proposal), the
+    guard must sample from p instead of a categorical over all -inf —
+    which would deterministically emit token 0 even when p[0] == 0
+    (ADVICE round 5)."""
+    # p ~= [1e-30, .5, .5, 1e-30]; q doubles the proposal token's mass
+    # (2e-30) and matches everywhere else — the f32 row cannot represent
+    # p's compensating excess, so sum(max(p - q, 0)) == 0 exactly while
+    # the accept rule (u * q < p at token 0) still rejects with
+    # probability 1/2. A rejection then samples the residual row.
+    logits = jnp.log(jnp.asarray([1e-30, 0.5, 0.5, 1e-30], jnp.float32))
+    tl = jnp.stack([logits, logits])[None, :, :]          # (1, 2, v)
+    p_row = jax.nn.softmax(logits)
+    q = jnp.asarray(p_row).at[0].mul(2.0)[None, :]        # (1, v)
+    assert float(jnp.sum(jnp.maximum(p_row - q[0], 0.0))) == 0.0
+    u = jnp.asarray([[1, 0]], jnp.int32)                  # propose token 0
+    rejected = 0
+    for seed in range(16):
+        y, accept = _spec_sample_rows(
+            tl, q, u, jax.random.key(seed), 1.0, 0, 0.0
+        )
+        if bool(accept[0]):
+            continue
+        rejected += 1
+        emitted = int(y[0, 0])
+        assert float(p_row[emitted]) > 1e-6, (
+            f"degenerate residual emitted a zero-probability token "
+            f"{emitted}"
+        )
+    assert rejected > 0, "construction never rejected; test is vacuous"
