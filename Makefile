@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative profile_lm profile_moe report test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve profile_lm profile_moe report test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -133,6 +133,12 @@ bench_decode:
 # exactness asserted in-run (scripts/bench_speculative.py).
 bench_speculative:
 	$(PY) scripts/bench_speculative.py
+
+# Serving benchmark: paged-KV continuous batching vs static batching
+# under Poisson arrivals — throughput, TTFT, p50/p99 per-token latency
+# (scripts/bench_serve.py == `mctpu serve-bench`).
+bench_serve:
+	$(PY) scripts/bench_serve.py
 
 # Step-time attribution by ablation (full vs fwd-only vs identity-attn vs
 # no-head vs chunked-CE) — where the LM step's milliseconds go.

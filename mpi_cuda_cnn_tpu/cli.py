@@ -10,6 +10,7 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu --dataset synthetic --model lenet5_relu --epochs 3
     python -m mpi_cuda_cnn_tpu --metrics-jsonl run.jsonl ...   # telemetry sink
     python -m mpi_cuda_cnn_tpu report run.jsonl                # summary tables
+    python -m mpi_cuda_cnn_tpu serve-bench --requests 32       # serving bench
 """
 
 from __future__ import annotations
@@ -136,6 +137,12 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.report import report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "serve-bench":
+        # Serving bench: paged-KV continuous batching vs static
+        # batching under Poisson arrivals (serve/bench.py).
+        from .serve.bench import serve_bench_main
+
+        return serve_bench_main(argv[1:])
     cfg = parse_args(argv)
     return run(cfg)
 

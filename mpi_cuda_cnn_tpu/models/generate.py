@@ -21,6 +21,14 @@ rows' routing into a request's logits).
 
 Sampling: greedy (temperature=0) or temperature-scaled categorical with a
 jax.random key.
+
+Two cache LAYOUTS share one decode implementation: token_forward is the
+skeleton (embedding/QKV/MoE/head — QKV via transformer.project_qkv, the
+same code the training forward runs) and attend_kv the masked attention
+read; the contiguous max_seq buffers here and serve/paged_cache.py's
+page-pool layout differ only in how cache rows are materialized.
+decode_step/decode_block accept either (pass a serve.PagedKVCache with
+per-slot positions for the continuous-batching form).
 """
 
 from __future__ import annotations
@@ -31,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import NEG_INF, attention, rope
+from ..ops.attention import NEG_INF, attention
 from .transformer import TransformerLM, _layernorm
 
 
@@ -155,6 +163,124 @@ def decode_step(model: TransformerLM, params, tok, pos, cache):
     return logits[:, 0, :], new_cache
 
 
+def token_forward(model: TransformerLM, params, toks, positions, attend):
+    """THE cached-decode forward skeleton: k tokens per row at explicit
+    absolute positions, with the attention/cache behavior injected per
+    layer. Everything around attention — embedding, layernorms, QKV
+    projections + rotary (transformer.project_qkv, shared with the
+    training forward), MoE/dense MLP, final head — has exactly one
+    implementation; the contiguous decode_block and serve/'s paged
+    continuous-batching path differ ONLY in their `attend`.
+
+    toks: (B, k) int32; positions: (k,) shared across rows, or (B, k)
+    PER-ROW absolute positions (the serving form — each slot sits at
+    its own depth). attend(i, q, k, v) -> (B, k, H*hd) f32 performs
+    layer i's cache update + masked attention read (closing over its
+    cache; layers are traced in order, so append-style capture works —
+    the same idiom as prefill's attn_fn).
+    Returns (B, k, vocab) f32 logits.
+    """
+    b, kk = toks.shape
+    x = params["tok_emb"][toks]                           # (B, k, dim)
+    if model.pos == "learned":
+        # (k, dim) broadcasts over rows; (B, k, dim) indexes per row.
+        x = x + params["pos_emb"][positions]
+    for i, blk in enumerate(params["blocks"]):
+        y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+        q, k, v = model.project_qkv(blk, y, positions=positions)
+        o = attend(i, q, k, v)
+        x = x + o.astype(x.dtype) @ blk["wo"]
+        y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+        if model.moe_experts:
+            from ..parallel.ep import moe_mlp_inference
+
+            m = moe_mlp_inference(
+                y.reshape(b * kk, model.dim), blk["moe"],
+                n_experts=model.moe_experts, top_k=model.moe_top_k,
+            )
+            x = x + m.reshape(b, kk, model.dim)
+        else:
+            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+    return (x @ params["head"]).astype(jnp.float32)
+
+
+def attend_kv(q, ck, cv, mask, cks=None, cvs=None):
+    """THE masked GQA attention read over materialized cache rows — the
+    one implementation both cache layouts consume (the contiguous
+    max_seq buffers here, the paged gather in serve/paged_cache.py; the
+    paged-vs-contiguous bitwise parity rests on this being shared).
+
+    q: (B, k, H, hd); ck/cv: (B, L, Hkv, hd) cache rows (any storage
+    dtype; int8 rows come with cks/cvs absmax scales (B, L, Hkv, 1),
+    applied OUTSIDE the dots — a key row's scale is constant along the
+    contracted head_dim so it factors onto the logits, a value row's
+    folds into the probabilities before the PV contraction). mask:
+    (k, L) or (B, k, L) bool, True = attend; scores/softmax are f32.
+    Returns (B, k, H*hd) f32.
+    """
+    b, kk, h, hd = q.shape
+    hkv = ck.shape[2]
+    int8 = ck.dtype == jnp.int8
+    g = h // hkv
+    qg = q.reshape(b, kk, hkv, g, hd)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg,
+        ck.astype(jnp.float32) if int8 else ck,
+        preferred_element_type=jnp.float32,
+    ) * scale                                 # (B, Hkv, g, k, L)
+    if int8:
+        logits = logits * jnp.transpose(cks, (0, 2, 3, 1))[:, :, None, :, :]
+    if mask.ndim == 2:
+        mask = mask[None]                     # shared across rows
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if int8:
+        pv = probs * jnp.transpose(cvs, (0, 2, 3, 1))[:, :, None, :, :]
+        o = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", pv, cv.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        o = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,
+            preferred_element_type=jnp.float32,
+        )
+    return o.reshape(b, kk, h * hd)
+
+
+def attend_contiguous(c, q, k, v, pos, positions):
+    """Contiguous-cache attend: write k/v at [pos, pos+k) of the static
+    (B, max_seq, Hkv, hd) buffers, then attend each row i over keys at
+    positions <= positions[i] (attend_kv does the masked read).
+    Returns (o: (B, k, H*hd) f32, new_c)."""
+    int8 = c["k"].dtype == jnp.int8
+    if int8:
+        qk8, sk8 = _quant_kv(k)
+        qv8, sv8 = _quant_kv(v)
+        new_c = {
+            "k": lax.dynamic_update_slice(c["k"], qk8, (0, pos, 0, 0)),
+            "ks": lax.dynamic_update_slice(c["ks"], sk8, (0, pos, 0, 0)),
+            "v": lax.dynamic_update_slice(c["v"], qv8, (0, pos, 0, 0)),
+            "vs": lax.dynamic_update_slice(c["vs"], sv8, (0, pos, 0, 0)),
+        }
+    else:
+        new_c = {
+            "k": lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
+                                          (0, pos, 0, 0)),
+            "v": lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
+                                          (0, pos, 0, 0)),
+        }
+    # Rows attend over the cached prefix + the block's causal part:
+    # row i sees keys at positions <= pos+i.
+    mask = (jnp.arange(new_c["k"].shape[1])[None, :]
+            <= positions[:, None])            # (k, max_seq)
+    o = attend_kv(q, new_c["k"], new_c["v"], mask,
+                  cks=new_c.get("ks"), cvs=new_c.get("vs"))
+    return o, new_c
+
+
 def decode_block(model: TransformerLM, params, toks, pos, cache):
     """k tokens through the model at positions [pos, pos+k): the block
     form of decode_step, for speculative verification — ONE forward
@@ -168,95 +294,34 @@ def decode_block(model: TransformerLM, params, toks, pos, cache):
     within-block causality holds and any stale entries beyond the
     accepted prefix from a previous speculative round are either
     overwritten here or masked by the row bound.
+
+    `cache` may also be a serve.paged_cache.PagedKVCache (pos then may
+    be a (B,) per-slot vector) — the decode surface accepts either
+    cache layout. Detection is by the block_table attribute, so the
+    serve package only loads when a paged cache is actually passed
+    (models/ must not depend on serve/ — serve/ imports THIS module).
     Returns (logits: (B, k, vocab), new_cache).
     """
+    if hasattr(cache, "block_table"):
+        from ..serve.paged_cache import paged_decode_block
+
+        return paged_decode_block(model, params, toks, pos, cache)
     b, kk = toks.shape
     if isinstance(pos, int) and pos + kk > model.max_seq:
         raise ValueError(
             f"block [{pos}, {pos + kk}) out of range (max_seq "
             f"{model.max_seq})"
         )
-    h, hd, hkv = model.heads, model.head_dim, model.n_kv
-    x = params["tok_emb"][toks]                           # (B, k, dim)
     positions = pos + jnp.arange(kk)
-    if model.pos == "learned":
-        x = x + params["pos_emb"][positions]
     new_cache = []
-    for blk, c in zip(params["blocks"], cache):
-        y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-        if hkv == h:
-            qkv = y @ blk["wqkv"]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-        else:
-            q = y @ blk["wq"]
-            k, v = jnp.split(y @ blk["wkv"], 2, axis=-1)
-        q = q.reshape(b, kk, h, hd)
-        k = k.reshape(b, kk, hkv, hd)
-        v = v.reshape(b, kk, hkv, hd)
-        if model.pos == "rope":
-            q = rope(q, positions)
-            k = rope(k, positions)
-        int8 = c["k"].dtype == jnp.int8
-        if int8:
-            qk8, sk8 = _quant_kv(k)
-            qv8, sv8 = _quant_kv(v)
-            ck = lax.dynamic_update_slice(c["k"], qk8, (0, pos, 0, 0))
-            cks = lax.dynamic_update_slice(c["ks"], sk8, (0, pos, 0, 0))
-            cv = lax.dynamic_update_slice(c["v"], qv8, (0, pos, 0, 0))
-            cvs = lax.dynamic_update_slice(c["vs"], sv8, (0, pos, 0, 0))
-            new_cache.append({"k": ck, "ks": cks, "v": cv, "vs": cvs})
-        else:
-            ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
-                                          (0, pos, 0, 0))
-            cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
-                                          (0, pos, 0, 0))
-            new_cache.append({"k": ck, "v": cv})
-        # Rows attend over the cached prefix + the block's causal part:
-        # row i sees keys at positions <= pos+i.
-        g = h // hkv
-        qg = q.reshape(b, kk, hkv, g, hd)
-        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-        logits = jnp.einsum(
-            "bqhgd,bkhd->bhgqk", qg,
-            ck.astype(jnp.float32) if int8 else ck,
-            preferred_element_type=jnp.float32,
-        ) * scale                                 # (B, Hkv, g, k, max_seq)
-        if int8:
-            # A key row's scale is constant along the contracted
-            # head_dim, so it factors out of the dot: apply to logits.
-            logits = logits * jnp.transpose(cks, (0, 2, 3, 1))[:, :, None, :, :]
-        valid = (jnp.arange(ck.shape[1])[None, :]
-                 <= positions[:, None])           # (k, max_seq)
-        logits = jnp.where(valid[None, None, None, :, :], logits, NEG_INF)
-        probs = jax.nn.softmax(logits, axis=-1)
-        if int8:
-            # A value row's scale multiplies its whole head_dim row in
-            # the weighted sum — fold it into the probabilities, keep
-            # the PV contraction reading pure int8.
-            pv = probs * jnp.transpose(cvs, (0, 2, 3, 1))[:, :, None, :, :]
-            o = jnp.einsum(
-                "bhgqk,bkhd->bqhgd", pv, cv.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            ).reshape(b, kk, h * hd).astype(x.dtype)
-        else:
-            o = jnp.einsum(
-                "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,
-                preferred_element_type=jnp.float32,
-            ).reshape(b, kk, h * hd).astype(x.dtype)
-        x = x + o @ blk["wo"]
-        y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
-        if model.moe_experts:
-            from ..parallel.ep import moe_mlp_inference
 
-            m = moe_mlp_inference(
-                y.reshape(b * kk, model.dim), blk["moe"],
-                n_experts=model.moe_experts, top_k=model.moe_top_k,
-            )
-            x = x + m.reshape(b, kk, model.dim)
-        else:
-            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
-    x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return (x @ params["head"]).astype(jnp.float32), new_cache
+    def attend(i, q, k, v):
+        o, new_c = attend_contiguous(cache[i], q, k, v, pos, positions)
+        new_cache.append(new_c)
+        return o
+
+    logits = token_forward(model, params, toks, positions, attend)
+    return logits, new_cache
 
 
 def filter_logits(logits, top_k: int = 0, top_p: float = 0.0):

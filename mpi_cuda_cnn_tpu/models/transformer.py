@@ -141,6 +141,41 @@ class TransformerLM:
             params["blocks"].append(blk)
         return params
 
+    def project_qkv(
+        self,
+        blk: dict,
+        y: jnp.ndarray,                # (B, S, dim) normed activations
+        *,
+        positions: jnp.ndarray,        # (S,) or (B, S) absolute positions
+        compute_dtype=None,
+    ):
+        """QKV projections + head reshape + rotary — THE one
+        implementation, shared by the training forward (apply_block) and
+        the cached decode core (models/generate.token_forward, which the
+        contiguous decode_block AND serve/'s paged path both ride).
+        Before the serve/ refactor the decode path re-implemented these
+        lines and only a parity test bound the two; now they cannot
+        drift. Per-row (B, S) positions are the continuous-batching
+        decode form — each serving slot sits at its own depth.
+        Returns q: (B, S, H, hd); k, v: (B, S, Hkv, hd)."""
+        b, s, _ = y.shape
+        h, hd, hkv = self.heads, self.head_dim, self.n_kv
+        w = (lambda t: t.astype(compute_dtype)) if compute_dtype else (lambda t: t)
+        if hkv == h:
+            qkv = y @ w(blk["wqkv"])                # (B, S, 3*dim)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = y @ w(blk["wq"])                    # (B, S, dim)
+            kv = y @ w(blk["wkv"])                  # (B, S, 2*hkv*hd)
+            k, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(b, s, h, hd)
+        k = k.reshape(b, s, hkv, hd)
+        v = v.reshape(b, s, hkv, hd)
+        if self.pos == "rope":
+            q = rope(q, positions)
+            k = rope(k, positions)
+        return q, k, v
+
     def apply_block(
         self,
         blk: dict,
@@ -162,24 +197,12 @@ class TransformerLM:
         Returns (x, aux) with aux the MoE balance loss (0 for dense).
         """
         b, s, _ = x.shape
-        h, hd, hkv = self.heads, self.head_dim, self.n_kv
+        h, hd = self.heads, self.head_dim
         cd = compute_dtype
         w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
 
         y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
-        if hkv == h:
-            qkv = y @ w(blk["wqkv"])                # (B, S, 3*dim)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-        else:
-            q = y @ w(blk["wq"])                    # (B, S, dim)
-            kv = y @ w(blk["wkv"])                  # (B, S, 2*hkv*hd)
-            k, v = jnp.split(kv, 2, axis=-1)
-        q = q.reshape(b, s, h, hd)
-        k = k.reshape(b, s, hkv, hd)
-        v = v.reshape(b, s, hkv, hd)
-        if self.pos == "rope":
-            q = rope(q, pos)
-            k = rope(k, pos)
+        q, k, v = self.project_qkv(blk, y, positions=pos, compute_dtype=cd)
         o = attn(q, k, v).reshape(b, s, h * hd)
         x = x + (o.astype(x.dtype) @ w(blk["wo"]))
         y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])

@@ -120,6 +120,43 @@ def summarize(records: Iterable[dict], *,
             "hbm_peak_bytes": max(peaks) if peaks else None,
         }
 
+    requests = ev.get("request", [])
+    if requests:
+        by_mode: dict[str, list[dict]] = {}
+        for r in requests:
+            by_mode.setdefault(r.get("mode", "?"), []).append(r)
+        rows = []
+        for mode, rs in sorted(by_mode.items()):
+            ttft = [r["ttft_ms"] for r in rs]
+            # Per-output-token latency after the first token (TPOT).
+            tpot = [
+                (r["latency_ms"] - r["ttft_ms"])
+                / max(r["output_tokens"] - 1, 1)
+                for r in rs
+            ]
+            rows.append({
+                "mode": mode,
+                "requests": len(rs),
+                "prompt_tokens": sum(r["prompt_tokens"] for r in rs),
+                "output_tokens": sum(r["output_tokens"] for r in rs),
+                "preemptions": sum(r.get("preemptions", 0) for r in rs),
+                "ttft_p50_ms": _pct(ttft, 50),
+                "ttft_p99_ms": _pct(ttft, 99),
+                "tpot_p50_ms": _pct(tpot, 50),
+                "tpot_p99_ms": _pct(tpot, 99),
+            })
+        summary["requests"] = rows
+
+    serves = ev.get("serve", [])
+    if serves:
+        summary["serve"] = [
+            {k: r.get(k) for k in
+             ("mode", "requests", "output_tokens", "decode_ticks",
+              "prefill_chunks", "preemptions", "tokens_per_s",
+              "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms")}
+            for r in serves
+        ]
+
     spans = ev.get("span", [])
     if spans:
         agg: dict[str, list[float]] = {}
@@ -131,6 +168,22 @@ def summarize(records: Iterable[dict], *,
             for name, ms in sorted(agg.items())
         }
     return summary
+
+
+def pct_nearest(vals: list[float], q: float) -> float | None:
+    """Nearest-rank percentile (no interpolation): conservative at the
+    tail on small request counts. THE serving percentile convention —
+    serve/engine.ServeResult.summary() uses this same function, so the
+    per-request table here and the engine's own `serve` summary agree
+    on identical data."""
+    s = sorted(vals)
+    if not s:
+        return None
+    i = min(len(s) - 1, max(0, -(-int(q) * len(s) // 100) - 1))
+    return round(s[i], 3)
+
+
+_pct = pct_nearest
 
 
 def _fmt(v) -> str:
@@ -213,6 +266,34 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 f"| {alias_s} | {_fmt(p.get('temp_bytes'))} "
                 f"| {p['steps_per_dispatch']} | {_fmt(p['flops_per_step'])} "
                 f"| {_fmt(p['collectives'])} | {mfu_s} |"
+            )
+        lines.append("")
+    if "requests" in summary:
+        lines += [
+            "| serving (per-request) | requests | out tokens | preempt "
+            "| TTFT p50 ms | TTFT p99 ms | tok p50 ms | tok p99 ms |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for r in summary["requests"]:
+            lines.append(
+                f"| {r['mode']} | {r['requests']} | {r['output_tokens']} "
+                f"| {r['preemptions']} | {_fmt(r['ttft_p50_ms'])} "
+                f"| {_fmt(r['ttft_p99_ms'])} | {_fmt(r['tpot_p50_ms'])} "
+                f"| {_fmt(r['tpot_p99_ms'])} |"
+            )
+        lines.append("")
+    if "serve" in summary:
+        lines += [
+            "| serve run | requests | tokens/s | decode ticks "
+            "| prefill chunks | preempt | TTFT p99 ms | tok p99 ms |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for s in summary["serve"]:
+            lines.append(
+                f"| {s['mode']} | {_fmt(s['requests'])} "
+                f"| {_fmt(s['tokens_per_s'])} | {_fmt(s['decode_ticks'])} "
+                f"| {_fmt(s['prefill_chunks'])} | {_fmt(s['preemptions'])} "
+                f"| {_fmt(s['ttft_p99_ms'])} | {_fmt(s['tpot_p99_ms'])} |"
             )
         lines.append("")
     if "memory" in summary:

@@ -49,6 +49,12 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     "memory": ("devices",),
     # Host-side span (obs.trace.span): nested name and duration.
     "span": ("name", "ms"),
+    # One served request (serve/engine.py): latency from arrival to
+    # first token (ttft_ms) and to completion (latency_ms).
+    "request": ("id", "mode", "prompt_tokens", "output_tokens",
+                "ttft_ms", "latency_ms"),
+    # One serving-bench run summary per scheduler mode (serve/bench.py).
+    "serve": ("mode", "requests", "tokens_per_s"),
 }
 
 

@@ -74,18 +74,23 @@ def rope(x, positions, *, base: float = 10000.0):
 
     positions: (S,) absolute token positions — explicit, so sequence
     shards under SP pass their true global positions (pos_offset +
-    arange, exactly like the learned table). Angles are computed in f32
-    regardless of x.dtype (bf16 loses position precision past ~256);
-    output returns in x.dtype. D must be even.
+    arange, exactly like the learned table) — or (B, S) PER-ROW
+    positions, the continuous-batching decode form (each serving slot
+    sits at its own depth, so one batched forward spans many absolute
+    positions; serve/engine.py). Angles are computed in f32 regardless
+    of x.dtype (bf16 loses position precision past ~256); output
+    returns in x.dtype. D must be even.
     """
     d = x.shape[-1]
     if d % 2:
         raise ValueError(f"rope needs an even head dim, got {d}")
     half = d // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)  # (half,)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]       # (1, S, 1, half)
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    # (S, 1, half) broadcasts over batch AND heads; (B, S, 1, half)
+    # broadcasts over heads only — one expand serves both rank forms.
+    cos = jnp.expand_dims(jnp.cos(angles), -2)
+    sin = jnp.expand_dims(jnp.sin(angles), -2)
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
     out = jnp.concatenate(

@@ -55,7 +55,7 @@ def init_moe_params(key, dim: int, hidden: int, n_experts: int) -> dict:
 
 
 def router_dispatch(x, gate_w, n_experts: int, capacity: int, k: int = 1,
-                    dtype=None):
+                    dtype=None, return_stats: bool = False):
     """THE routing core — top-k choice + capacity slot assignment, fused.
 
     Builds the ONE (T, E, C) dispatch tensor the MoE einsums consume,
@@ -86,6 +86,13 @@ def router_dispatch(x, gate_w, n_experts: int, capacity: int, k: int = 1,
                 chosen-and-kept expert, 0 elsewhere;
       aux_loss: scalar f32 load-balancing loss (Switch form over FIRST
                 choices: the signal that spreads primary assignments).
+
+    return_stats=True swaps aux_loss for its PER-EXPERT SUFFICIENT
+    STATISTICS (first_choice_count (E,), prob_sum (E,)) — additive
+    across token chunks, so moe_mlp's chunked scan can accumulate them
+    in the carry and form the balance loss ONCE GLOBALLY (a mean of
+    per-chunk losses is a different, biased objective: the product of
+    per-chunk means is not the mean of the product).
     """
     t = x.shape[0]
     dtype = jnp.dtype(dtype) if dtype is not None else x.dtype
@@ -111,11 +118,13 @@ def router_dispatch(x, gate_w, n_experts: int, capacity: int, k: int = 1,
         dispatch = dispatch + keep.astype(dtype)[:, :, None] * slot[:, None, :]
         gate_te = gate_te + keep * gates[:, j, None]
         used = used + jnp.sum(keep, axis=0)
-    frac_tokens = jnp.mean(
-        jax.nn.one_hot(idx[:, 0], n_experts, dtype=jnp.float32), axis=0
-    )
-    frac_probs = jnp.mean(probs, axis=0)
-    aux_loss = jnp.sum(frac_tokens * frac_probs) * n_experts
+    onehot1 = jax.nn.one_hot(idx[:, 0], n_experts, dtype=jnp.float32)
+    if return_stats:
+        return dispatch, gate_te, (jnp.sum(onehot1, axis=0),
+                                   jnp.sum(probs, axis=0))
+    aux_loss = jnp.sum(
+        jnp.mean(onehot1, axis=0) * jnp.mean(probs, axis=0)
+    ) * n_experts
     return dispatch, gate_te, aux_loss
 
 
@@ -163,6 +172,7 @@ def moe_mlp(
     top_k: int = 1,
     dispatch_chunk: int = 0,
     dispatch_dtype=None,
+    _aux_stats: bool = False,
 ):
     """MoE MLP for x: (T, D) local tokens. SPMD body when `axis` names a
     mesh axis — then params["w1"]/["w2"] hold only THIS device's E/P
@@ -185,7 +195,13 @@ def moe_mlp(
     (ceil(chunk*k*cf/E) slots per expert per chunk) — the same
     estimator change every microbatched MoE trainer accepts, and
     bitwise-identical to unchunked when nothing drops (tested). The aux
-    loss is the chunk mean. Under EP (`axis` set) chunking is rejected:
+    loss is formed ONCE GLOBALLY from per-expert count/prob sums
+    accumulated in the scan carry — NOT a mean of per-chunk losses
+    (that was a biased estimator: the product of per-chunk means is not
+    the mean of the product, so toggling dispatch_chunk used to change
+    the training objective; round-5 advisor finding). Chunked and
+    unchunked aux now agree to float rounding (tested near-exact).
+    Under EP (`axis` set) chunking is rejected:
     each shard already routes only its T/P local tokens, which is the
     same quadratic-term reduction the mesh provides for free.
 
@@ -193,7 +209,11 @@ def moe_mlp(
     x.dtype — bf16 under a bf16 compute path). jnp.bfloat16 under an
     f32 path halves the routing-tensor build/read bytes at a bounded
     cost: dispatch entries are exact {0, 1} in any float dtype, so only
-    the einsum accumulation dtype changes."""
+    the einsum accumulation dtype changes.
+
+    _aux_stats is the chunked scan's internal hook: the second return
+    becomes router_dispatch's additive per-expert (count, prob-sum)
+    stats instead of the scalar loss."""
     t, d = x.shape
     if dispatch_chunk and dispatch_chunk < t:
         if axis is not None:
@@ -208,17 +228,25 @@ def moe_mlp(
                 f"{dispatch_chunk}"
             )
 
-        def chunk_body(_, xc):
-            yc, auxc = moe_mlp(
+        def chunk_body(carry, xc):
+            count_sum, prob_sum = carry
+            yc, (f, p) = moe_mlp(
                 xc, params, n_experts=n_experts,
                 capacity_factor=capacity_factor, axis=None, top_k=top_k,
-                dispatch_dtype=dispatch_dtype,
+                dispatch_dtype=dispatch_dtype, _aux_stats=True,
             )
-            return 0, (yc, auxc)
+            return (count_sum + f, prob_sum + p), yc
 
         xs = x.reshape(t // dispatch_chunk, dispatch_chunk, d)
-        _, (ys, auxs) = lax.scan(chunk_body, 0, xs)
-        return ys.reshape(t, d), jnp.mean(auxs)
+        zero = jnp.zeros((n_experts,), jnp.float32)
+        (count_sum, prob_sum), ys = lax.scan(chunk_body, (zero, zero), xs)
+        # The GLOBAL Switch balance loss from the accumulated sufficient
+        # statistics: identical objective to unchunked routing (only the
+        # summation order differs — near-exact, tested).
+        aux = jnp.sum(
+            (count_sum / t) * (prob_sum / t)
+        ) * n_experts
+        return ys.reshape(t, d), aux
     capacity = max(1, -int(-t * top_k * capacity_factor // n_experts))  # ceil
     # Fused router (router_dispatch): ONE (T, E, C) tensor built directly
     # in the einsum dtype + a (T, E) gate map — never an f32 build/cast
@@ -226,7 +254,7 @@ def moe_mlp(
     with annotate("ep.router_build"):
         dispatch, gate_te, aux = router_dispatch(
             x, params["gate"], n_experts, capacity, k=top_k,
-            dtype=dispatch_dtype or x.dtype,
+            dtype=dispatch_dtype or x.dtype, return_stats=_aux_stats,
         )
     with annotate("ep.dispatch_einsum"):
         expert_in = jnp.einsum("tec,td->ecd", dispatch, x)    # (E, C, D)

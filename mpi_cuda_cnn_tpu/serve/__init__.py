@@ -1,0 +1,28 @@
+"""Serving engine: paged KV cache + iteration-level continuous batching.
+
+The decode-side counterpart of the scanned-epoch training design — see
+paged_cache.py (the memory layout), scheduler.py (the admission /
+preemption policy), engine.py (the jitted ticks), bench.py (the
+`mctpu serve-bench` harness).
+"""
+
+from .engine import PagedEngine, ServeResult
+from .paged_cache import PagedKVCache, PagePool, init_paged_cache
+from .scheduler import (
+    ContinuousScheduler,
+    Request,
+    StaticScheduler,
+    pages_for,
+)
+
+__all__ = [
+    "ContinuousScheduler",
+    "PagedEngine",
+    "PagedKVCache",
+    "PagePool",
+    "Request",
+    "ServeResult",
+    "StaticScheduler",
+    "init_paged_cache",
+    "pages_for",
+]

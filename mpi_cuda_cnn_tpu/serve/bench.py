@@ -1,0 +1,170 @@
+"""`mctpu serve-bench` — static vs continuous batching on one chip.
+
+Drives the PagedEngine with a Poisson-arrival workload of mixed
+prompt/output lengths (the serving regime the schedulers differ on:
+identical lengths make static batching look fine) and reports, per
+mode: throughput, TTFT p50/p99, per-output-token latency p50/p99,
+decode-tick and preemption counts. Per-request records go through the
+obs JSONL schema (`request` events + one `serve` summary event per
+mode) so `mctpu report` renders the serving tables.
+
+The workload is seeded and regenerated identically per mode — the two
+schedulers see the same requests, arrivals, and (greedy) token budget;
+only the schedule differs. Weights are randomly initialized: scheduling
+costs do not depend on what the tokens say.
+
+    python -m mpi_cuda_cnn_tpu serve-bench --requests 32 --rate 50
+    python scripts/bench_serve.py --mode continuous --cache-dtype int8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
+                  out_min: int, out_max: int, rate: float, seed: int):
+    """n seeded requests: uniform prompt/output lengths in the given
+    ranges, Poisson arrivals at `rate` req/s (exponential gaps; rate 0
+    = everything arrives at t=0). Regenerating with the same seed gives
+    an identical workload — the cross-mode comparison contract."""
+    from .scheduler import Request
+
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    reqs = []
+    for i in range(n):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        plen = int(rng.integers(prompt_min, prompt_max + 1))
+        olen = int(rng.integers(out_min, out_max + 1))
+        prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
+        reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=olen,
+                            arrival=t))
+    return reqs
+
+
+def serve_bench_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mctpu serve-bench",
+        description="Serving bench: paged-KV continuous batching vs "
+                    "static batching under Poisson arrivals "
+                    "(throughput, TTFT, p50/p99 per-token latency).",
+    )
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="0 = MHA; fewer = GQA/MQA (smaller pages)")
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--max-seq", type=int, default=512)
+    ap.add_argument("--slots", type=int, default=4,
+                    help="decode batch rows (in-flight sequences)")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="tokens per KV page")
+    ap.add_argument("--pages", type=int, default=0,
+                    help="global page-pool size incl. the scratch page "
+                         "(0 = size for slots full-length sequences — "
+                         "ample; shrink it to exercise preemption)")
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--cache-dtype", default="float32",
+                    choices=["float32", "bfloat16", "int8"])
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--prompt-min", type=int, default=8)
+    ap.add_argument("--prompt-max", type=int, default=96)
+    ap.add_argument("--out-min", type=int, default=8)
+    ap.add_argument("--out-max", type=int, default=96)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="Poisson arrival rate, requests/s (0 = all at "
+                         "t=0: the pure-throughput comparison)")
+    ap.add_argument("--mode", default="both",
+                    choices=["both", "static", "continuous"])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append per-request obs records here")
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "tpu", "cpu"])
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu" and jax.default_backend() != "tpu":
+        print("--device=tpu requested but the backend is "
+              f"{jax.default_backend()}", file=sys.stderr)
+        return 1
+
+    from ..models.transformer import TransformerLM
+    from ..utils.logging import MetricsLogger
+    from .engine import PagedEngine
+    from .paged_cache import pages_for
+
+    if args.prompt_max + args.out_max > args.max_seq:
+        print(f"prompt {args.prompt_max} + out {args.out_max} exceeds "
+              f"--max-seq {args.max_seq}", file=sys.stderr)
+        return 1
+    model = TransformerLM(
+        vocab=args.vocab, dim=args.dim, heads=args.heads, depth=args.depth,
+        max_seq=args.max_seq, kv_heads=args.kv_heads,
+    )
+    params = model.init(jax.random.key(args.seed))
+    max_len = args.prompt_max + args.out_max
+    pages = args.pages or args.slots * pages_for(max_len, args.page_size) + 1
+    engine = PagedEngine(
+        model, params, slots=args.slots, num_pages=pages,
+        page_size=args.page_size, prefill_chunk=args.prefill_chunk,
+        cache_dtype=args.cache_dtype, max_len=max_len,
+    )
+    modes = (["static", "continuous"] if args.mode == "both"
+             else [args.mode])
+    workload_kw = dict(
+        n=args.requests, vocab=args.vocab, prompt_min=args.prompt_min,
+        prompt_max=args.prompt_max, out_min=args.out_min,
+        out_max=args.out_max, rate=args.rate, seed=args.seed,
+    )
+    summaries = {}
+    with MetricsLogger(path=args.metrics_jsonl, echo=False) as metrics:
+        # Warm both compiled programs (engine-level: the same two serve
+        # every mode) on one throwaway request, so no mode pays
+        # compilation inside its latencies.
+        engine.run(make_workload(**{**workload_kw, "n": 1, "rate": 0.0}),
+                   mode=modes[0])
+        for mode in modes:
+            result = engine.run(make_workload(**workload_kw), mode=mode)
+            s = result.summary()
+            summaries[mode] = s
+            for rec in result.request_records():
+                metrics.log("request", **rec)
+            metrics.log("serve", **{
+                "bench": "serve", "backend": jax.default_backend(),
+                "cache_dtype": args.cache_dtype, "rate": args.rate,
+                "slots": args.slots, "page_size": args.page_size,
+                "pages": pages, **s,
+            })
+            print(json.dumps({"bench": "serve", "backend":
+                              jax.default_backend(),
+                              "cache_dtype": args.cache_dtype, **s}))
+    if len(summaries) == 2:
+        st, ct = summaries["static"], summaries["continuous"]
+        print(json.dumps({
+            "metric": "serve_tokens_per_s",
+            "value": ct["tokens_per_s"],
+            "unit": "tokens/s",
+            "static_tokens_per_s": st["tokens_per_s"],
+            "speedup": round(ct["tokens_per_s"] / max(st["tokens_per_s"],
+                                                      1e-9), 3),
+            "decode_ticks": {"static": st["decode_ticks"],
+                             "continuous": ct["decode_ticks"]},
+            "ttft_p99_ms": {"static": st["ttft_p99_ms"],
+                            "continuous": ct["ttft_p99_ms"]},
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(serve_bench_main())

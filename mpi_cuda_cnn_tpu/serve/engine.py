@@ -1,0 +1,301 @@
+"""The serving engine: jitted paged ticks driven by a scheduler.
+
+Exactly TWO compiled programs serve every request mix, so continuous
+batching never retraces as the batch composition churns:
+
+- `decode tick` — all engine slots advance one token in one forward
+  (B = slots, k = 1, per-slot positions); dead/padded slots ride along
+  with valid=False, their writes routed to the scratch page and their
+  sampled tokens ignored by the host.
+- `prefill chunk` — one slot advances `prefill_chunk` prompt tokens
+  (B = 1, k = chunk, padded to the static chunk width). The LAST chunk
+  of a prompt also yields the request's first generated token (argmax
+  of the final valid position's logits) — TTFT is paid at prefill
+  completion, not at the next decode tick.
+
+Both donate the page pools, so the cache updates in place across ticks
+(utils/donation discipline; the pool is the engine's dominant buffer).
+Sampling is greedy — the serving benches measure schedule/memory
+effects, and greedy keeps static-vs-continuous token streams bitwise
+comparable per request.
+
+The host loop (`run`) is one scheduler iteration per pass: admit ->
+at most one prefill chunk -> one decode tick over every decoding slot.
+Interleaving the single chunk between ticks bounds how long a long
+prompt can stall token emission for in-flight sequences (the Orca
+iteration-level property); `decode_ticks`/`prefill_chunks` counts are
+the deterministic cost model the CPU tests compare schedulers on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerLM
+from .paged_cache import (
+    PagedKVCache,
+    PagePool,
+    init_paged_cache,
+    paged_forward,
+)
+from .scheduler import ContinuousScheduler, Request, StaticScheduler
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One engine run: the finished requests (with their timestamps
+    filled in) plus the aggregate counters the bench reports."""
+
+    mode: str
+    requests: list[Request]
+    decode_ticks: int
+    prefill_chunks: int
+    preemptions: int
+    duration_s: float
+
+    @property
+    def output_tokens(self) -> int:
+        return sum(len(r.out) for r in self.requests)
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.output_tokens / max(self.duration_s, 1e-9)
+
+    def ttft_ms(self) -> list[float]:
+        return [1e3 * (r.first_token_at - r.arrival)
+                for r in self.requests]
+
+    def tpot_ms(self) -> list[float]:
+        """Per-output-token latency (time-per-output-token) after the
+        first token, per request; requests with one token report 0."""
+        return [
+            1e3 * (r.finished_at - r.first_token_at) / max(len(r.out) - 1, 1)
+            for r in self.requests
+        ]
+
+    def request_records(self) -> list[dict]:
+        """Per-request field dicts in the obs `request` event shape
+        (the caller stamps them through MetricsLogger/make_record)."""
+        return [
+            {
+                "id": r.rid,
+                "mode": self.mode,
+                "prompt_tokens": int(r.prompt.size),
+                "output_tokens": len(r.out),
+                "ttft_ms": round(1e3 * (r.first_token_at - r.arrival), 3),
+                "latency_ms": round(1e3 * (r.finished_at - r.arrival), 3),
+                "preemptions": r.preemptions,
+            }
+            for r in sorted(self.requests, key=lambda r: r.rid)
+        ]
+
+    def summary(self) -> dict:
+        # Nearest-rank percentiles (obs.report.pct_nearest) — the ONE
+        # serving convention, so `mctpu report`'s per-request table and
+        # this summary can never disagree on the same run.
+        from ..obs.report import pct_nearest
+
+        ttft, tpot = self.ttft_ms(), self.tpot_ms()
+        return {
+            "mode": self.mode,
+            "requests": len(self.requests),
+            "output_tokens": self.output_tokens,
+            "decode_ticks": self.decode_ticks,
+            "prefill_chunks": self.prefill_chunks,
+            "preemptions": self.preemptions,
+            "duration_s": round(self.duration_s, 4),
+            "tokens_per_s": round(self.tokens_per_s, 2),
+            "ttft_p50_ms": pct_nearest(ttft, 50),
+            "ttft_p99_ms": pct_nearest(ttft, 99),
+            "tpot_p50_ms": pct_nearest(tpot, 50),
+            "tpot_p99_ms": pct_nearest(tpot, 99),
+        }
+
+
+class PagedEngine:
+    """Greedy serving engine over a paged KV cache.
+
+    slots bounds the decode batch; num_pages * page_size tokens is the
+    TOTAL cache budget shared by all in-flight sequences (page 0 is
+    scratch); max_len bounds any one sequence (prompt + new tokens) and
+    sizes the block table. cache_dtype composes with the shipped
+    --decode-cache-dtype forms (float32 / bfloat16 / int8).
+    """
+
+    def __init__(self, model: TransformerLM, params, *, slots: int = 4,
+                 num_pages: int = 64, page_size: int = 16,
+                 prefill_chunk: int = 32, cache_dtype="float32",
+                 max_len: int | None = None):
+        self.model = model
+        self.params = params
+        self.slots = slots
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.prefill_chunk = prefill_chunk
+        self.cache_dtype = jnp.dtype(cache_dtype)
+        self.max_len = min(max_len or model.max_seq, model.max_seq)
+        tmpl = init_paged_cache(model, slots=slots, num_pages=num_pages,
+                                page_size=page_size, dtype=self.cache_dtype,
+                                max_len=self.max_len)
+        self._pages = tmpl.pages
+        self._table_width = tmpl.block_table.shape[1]
+
+        def tick(cache: PagedKVCache, params, toks, pos, live):
+            logits, cache = paged_forward(
+                model, params, toks[:, None], pos[:, None], live[:, None],
+                cache,
+            )
+            return cache, jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+
+        chunk = prefill_chunk
+
+        def prefill(cache: PagedKVCache, params, toks, pos0, n_valid):
+            positions = pos0 + jnp.arange(chunk)[None, :]
+            valid = (jnp.arange(chunk) < n_valid)[None, :]
+            logits, cache = paged_forward(
+                model, params, toks, positions, valid, cache
+            )
+            nxt = jnp.argmax(logits[0, jnp.maximum(n_valid - 1, 0)])
+            return cache, nxt.astype(jnp.int32)
+
+        # Donate the cache: the page pools update in place tick-to-tick
+        # (the engine always adopts the returned cache) instead of
+        # allocating a second pool-sized buffer per dispatch.
+        self._tick = jax.jit(tick, donate_argnums=(0,))
+        self._prefill = jax.jit(prefill, donate_argnums=(0,))
+
+    # -- host-side helpers ------------------------------------------------
+
+    def _cache_view(self, table: np.ndarray) -> PagedKVCache:
+        return PagedKVCache(pages=self._pages,
+                            block_table=jnp.asarray(table),
+                            page_size=self.page_size)
+
+    def _slot_table(self, slot) -> np.ndarray:
+        row = np.zeros((1, self._table_width), np.int32)
+        row[0, : len(slot.pages)] = slot.pages
+        return row
+
+    def _emit(self, slot, tok: int, now: float) -> None:
+        req = slot.req
+        req.out.append(tok)
+        if req.first_token_at is None:
+            req.first_token_at = now
+
+    def run(self, requests: list[Request], *, mode: str = "continuous",
+            time_fn=time.perf_counter) -> ServeResult:
+        """Serve `requests` to completion and return the ServeResult.
+
+        Requests are mutated in place (out/timestamps); arrivals are
+        seconds relative to run start — the loop idles (sleeps) until
+        the next arrival when there is nothing admitted to work on.
+        """
+        if mode == "continuous":
+            sched = ContinuousScheduler(
+                slots=self.slots, pool=PagePool(self.num_pages),
+                page_size=self.page_size, max_len=self.max_len,
+            )
+        elif mode == "static":
+            sched = StaticScheduler(
+                slots=self.slots, pool=PagePool(self.num_pages),
+                page_size=self.page_size, max_len=self.max_len,
+            )
+        else:
+            raise ValueError(f"mode {mode!r}: want 'continuous' or 'static'")
+        sched.submit(requests)
+        n_reqs = sched.unfinished
+        decode_ticks = prefill_chunks = 0
+        t0 = time_fn()
+        while sched.unfinished:
+            now = time_fn() - t0
+            sched.admit(now)
+            progressed = False
+
+            # At most ONE prefill chunk per iteration: long prompts
+            # advance without starving in-flight decodes.
+            slot = sched.prefill_slot()
+            if slot is not None:
+                ctx = np.concatenate(
+                    [slot.req.prompt, np.asarray(slot.req.out, np.int32)]
+                )
+                n = min(self.prefill_chunk, slot.target - slot.cached)
+                toks = np.zeros((1, self.prefill_chunk), np.int32)
+                toks[0, :n] = ctx[slot.cached : slot.cached + n]
+                cache, nxt = self._prefill(
+                    self._cache_view(self._slot_table(slot)), self.params,
+                    jnp.asarray(toks), jnp.int32(slot.cached), jnp.int32(n),
+                )
+                self._pages = cache.pages
+                slot.cached += n
+                prefill_chunks += 1
+                progressed = True
+                if slot.cached >= slot.target:
+                    # Prefill complete: the chunk's last valid logits
+                    # give the first generated token right now. A
+                    # request done at its first token releases its slot
+                    # only under continuous batching — static holds
+                    # every reservation until the batch drains (the
+                    # occupancy discipline the comparison measures).
+                    self._emit(slot, int(nxt), time_fn() - t0)
+                    if slot.req.done and isinstance(sched,
+                                                    ContinuousScheduler):
+                        sched.finish(slot, time_fn() - t0)
+
+            dslots = sched.grow_for_decode()
+            if dslots:
+                toks = np.zeros((self.slots,), np.int32)
+                pos = np.zeros((self.slots,), np.int32)
+                live = np.zeros((self.slots,), bool)
+                table = np.zeros((self.slots, self._table_width), np.int32)
+                for s in dslots:
+                    toks[s.idx] = s.req.out[-1]
+                    pos[s.idx] = s.cached
+                    live[s.idx] = True
+                    table[s.idx, : len(s.pages)] = s.pages
+                cache, nxt = self._tick(
+                    self._cache_view(table), self.params, jnp.asarray(toks),
+                    jnp.asarray(pos), jnp.asarray(live),
+                )
+                self._pages = cache.pages
+                decode_ticks += 1
+                nxt = np.asarray(nxt)
+                now = time_fn() - t0
+                for s in dslots:
+                    s.cached += 1
+                    self._emit(s, int(nxt[s.idx]), now)
+                    if s.req.done and isinstance(sched, ContinuousScheduler):
+                        sched.finish(s, now)
+                progressed = True
+
+            if isinstance(sched, StaticScheduler) and sched.batch_done():
+                sched.drain(time_fn() - t0)
+                progressed = True
+
+            if not progressed:
+                nxt_arrival = sched.next_arrival()
+                if nxt_arrival is None:
+                    raise RuntimeError("scheduler stalled with no queue")
+                if nxt_arrival <= now:
+                    raise RuntimeError(
+                        f"request {sched.queue[0].rid} cannot be admitted "
+                        f"into an idle engine — page pool ({self.num_pages}"
+                        f" pages of {self.page_size}) too small"
+                    )
+                time.sleep(min(nxt_arrival - now, 0.05))
+            sched.pool.check()
+
+        if len(sched.finished) != n_reqs:
+            raise RuntimeError(
+                f"run lost requests: {len(sched.finished)} of {n_reqs}"
+            )
+        assert sched.pool.free_pages == sched.pool.usable, "pages leaked"
+        return ServeResult(
+            mode=mode, requests=sched.finished, decode_ticks=decode_ticks,
+            prefill_chunks=prefill_chunks, preemptions=sched.preemptions,
+            duration_s=time_fn() - t0,
+        )

@@ -1,0 +1,301 @@
+"""Paged KV cache — virtual memory for decode (PagedAttention, Kwon et
+al., SOSP '23; layout per the TPU paged-attention kernel notes).
+
+The contiguous cache (models/generate.init_cache) sizes every sequence
+at max_seq: a batch of B requests pins B * max_seq * Hkv * hd * 2 cache
+bytes per layer no matter how short each request actually is, finished
+sequences hold their extent until the whole batch drains, and a new
+request cannot be admitted mid-flight because the buffers are indexed by
+batch row. PERF.md's decode table shows tokens/s tracks cache bytes
+almost linearly — so idle cache extent is directly lost throughput.
+
+This module replaces the per-sequence extent with FIXED-SIZE TOKEN PAGES
+in one global pool:
+
+- per layer, `k`/`v` pools of shape (num_pages, page_size, Hkv, hd)
+  (+ f32 absmax scales (num_pages, page_size, Hkv, 1) for the int8
+  form — the same quantization contract as the contiguous cache);
+- a per-slot BLOCK TABLE (slots, pages_per_slot) of page indices maps a
+  sequence's logical positions to physical pages — position p lives in
+  page block_table[s, p // page_size] at offset p % page_size;
+- PAGE 0 IS RESERVED as a scratch page: host-side invariants route every
+  write from a dead slot or a padding token there, so a freed page can
+  be re-issued to another sequence without a stale writer corrupting it.
+
+The device-side ops are pure functions of (pages, block_table): the
+scatter write + gathered read (`paged_update_attend`) and the
+generate-compatible forward (`paged_decode_block` — models/generate's
+decode_step/decode_block accept a PagedKVCache and land here). The
+attention read itself is models/generate.attend_kv, shared with the
+contiguous path — the parity tests rest on the two layouts differing
+only in how cache rows are materialized, never in the attention math.
+Host-side page accounting (alloc/free/ownership) is `PagePool`; policy
+(who gets pages when) lives in scheduler.py.
+
+TPU note: the gather materializes (B, L, Hkv, hd) rows per layer — the
+XLA formulation of the paged read. The fused form (per-page async DMA
+into VMEM, double-buffered — the Pallas paged-attention kernel) is a
+drop-in replacement behind attend_kv when decode batch sizes outgrow
+the gather; the layout above matches that kernel's contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import _quant_kv, attend_kv, token_forward
+from ..models.transformer import TransformerLM
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """Device-side paged cache state: per-layer page pools + the block
+    table mapping each slot's logical positions to physical pages.
+    `page_size` is static metadata (it shapes the compiled program)."""
+
+    pages: list[dict]
+    block_table: jnp.ndarray      # (slots, pages_per_slot) int32
+    page_size: int
+
+    @property
+    def num_pages(self) -> int:
+        return self.pages[0]["k"].shape[0]
+
+    @property
+    def slots(self) -> int:
+        return self.block_table.shape[0]
+
+
+jax.tree_util.register_dataclass(
+    PagedKVCache, data_fields=["pages", "block_table"],
+    meta_fields=["page_size"],
+)
+
+
+def init_paged_cache(model: TransformerLM, *, slots: int, num_pages: int,
+                     page_size: int, dtype=jnp.float32,
+                     max_len: int | None = None) -> PagedKVCache:
+    """Empty page pools + an all-scratch block table.
+
+    num_pages INCLUDES the reserved scratch page 0, so num_pages - 1
+    pages are allocatable; max_len (default model.max_seq) bounds any
+    one sequence and fixes the block-table width. Total cache bytes are
+    num_pages * page_size tokens per layer — the pool is sized to the
+    MEMORY BUDGET, not to slots * max_seq (the contiguous cache's
+    forced extent; the whole point of paging).
+    """
+    if num_pages < 2:
+        raise ValueError(f"num_pages {num_pages} < 2 (page 0 is scratch)")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    max_len = max_len or model.max_seq
+    shape = (num_pages, page_size, model.n_kv, model.head_dim)
+    int8 = jnp.dtype(dtype) == jnp.int8
+    sshape = shape[:-1] + (1,)
+    pages = []
+    for _ in range(model.depth):
+        if int8:
+            pages.append({
+                "k": jnp.zeros(shape, jnp.int8),
+                "ks": jnp.zeros(sshape, jnp.float32),
+                "v": jnp.zeros(shape, jnp.int8),
+                "vs": jnp.zeros(sshape, jnp.float32),
+            })
+        else:
+            pages.append({"k": jnp.zeros(shape, dtype),
+                          "v": jnp.zeros(shape, dtype)})
+    table = jnp.zeros((slots, pages_for(max_len, page_size)), jnp.int32)
+    return PagedKVCache(pages=pages, block_table=table, page_size=page_size)
+
+
+def paged_update_attend(c: dict, q, k, v, positions, valid, block_table,
+                        page_size: int):
+    """One layer's paged write + gathered attention read.
+
+    q: (B, kk, H, hd); k/v: (B, kk, Hkv, hd); positions: (B, kk)
+    absolute positions; valid: (B, kk) bool — invalid tokens (padding
+    beyond a prompt's length, dead slots) write to scratch page 0 at
+    offset 0 instead, so they can never touch a page owned by a live
+    sequence. Writes land FIRST (in-chunk causality: row i then reads
+    rows <= i through the gather), then the block table gathers each
+    slot's pages into (B, L, Hkv, hd) rows for the shared attend_kv
+    read, masked to key positions <= the row's own position. Positions
+    beyond a slot's written extent read whatever the gathered (possibly
+    scratch/stale) rows hold — the mask keeps them out of the softmax.
+    Returns (o: (B, kk, H*hd) f32, new_c).
+    """
+    b, kk = positions.shape
+    hkv, hd = k.shape[2], k.shape[3]
+    page_idx = jnp.take_along_axis(block_table, positions // page_size,
+                                   axis=1)                  # (B, kk)
+    off = positions % page_size
+    page_idx = jnp.where(valid, page_idx, 0)
+    off = jnp.where(valid, off, 0)
+    pi, of = page_idx.reshape(-1), off.reshape(-1)
+    int8 = c["k"].dtype == jnp.int8
+    if int8:
+        qk8, sk8 = _quant_kv(k)
+        qv8, sv8 = _quant_kv(v)
+        new_c = {
+            "k": c["k"].at[pi, of].set(qk8.reshape(b * kk, hkv, hd)),
+            "ks": c["ks"].at[pi, of].set(sk8.reshape(b * kk, hkv, 1)),
+            "v": c["v"].at[pi, of].set(qv8.reshape(b * kk, hkv, hd)),
+            "vs": c["vs"].at[pi, of].set(sv8.reshape(b * kk, hkv, 1)),
+        }
+    else:
+        cdt = c["k"].dtype
+        new_c = {
+            "k": c["k"].at[pi, of].set(
+                k.astype(cdt).reshape(b * kk, hkv, hd)),
+            "v": c["v"].at[pi, of].set(
+                v.astype(cdt).reshape(b * kk, hkv, hd)),
+        }
+    # Gather this slot's pages into contiguous logical rows. L =
+    # pages_per_slot * page_size — the engine sizes the table to the
+    # serving max_len, not to the pool (reads scale with the SEQUENCE
+    # bound; pool size only bounds total residency).
+    npages = block_table.shape[1]
+    gathered = {
+        name: new_c[name][block_table].reshape(
+            b, npages * page_size, *new_c[name].shape[2:]
+        )
+        for name in new_c
+    }
+    mask = (jnp.arange(npages * page_size)[None, None, :]
+            <= positions[:, :, None])         # (B, kk, L)
+    o = attend_kv(q, gathered["k"], gathered["v"], mask,
+                  cks=gathered.get("ks"), cvs=gathered.get("vs"))
+    return o, new_c
+
+
+def paged_forward(model: TransformerLM, params, toks, positions, valid,
+                  cache: PagedKVCache):
+    """toks (B, kk) through the model against the paged cache — the
+    paged twin of decode_block's contiguous path, same token_forward
+    skeleton, attend swapped. positions/valid: (B, kk).
+    Returns (logits (B, kk, vocab) f32, new PagedKVCache)."""
+    new_pages: list[dict] = []
+
+    def attend(i, q, k, v):
+        o, new_c = paged_update_attend(
+            cache.pages[i], q, k, v, positions, valid,
+            cache.block_table, cache.page_size,
+        )
+        new_pages.append(new_c)
+        return o
+
+    logits = token_forward(model, params, toks, positions, attend)
+    return logits, dataclasses.replace(cache, pages=new_pages)
+
+
+def paged_decode_block(model: TransformerLM, params, toks, pos,
+                       cache: PagedKVCache):
+    """The generate-surface adapter: decode_step/decode_block semantics
+    over a PagedKVCache. pos may be a scalar start (all rows at the same
+    depth, the static-batch form) or a (B,) per-slot vector (the
+    continuous-batching form). All tokens are valid writes — padding /
+    dead-slot routing is the engine's concern (paged_forward + explicit
+    `valid`). Concrete out-of-range positions raise, mirroring the
+    contiguous path's guard — past the block-table extent the gathered
+    page index would CLAMP to the last column and silently scatter over
+    the sequence's final legitimate cache rows (traced positions cannot
+    be checked, exactly as in contiguous decode_block).
+    Returns (logits (B, k, vocab), new cache)."""
+    b, kk = toks.shape
+    limit = cache.block_table.shape[1] * cache.page_size
+    if not isinstance(pos, jax.core.Tracer):
+        hi = int(np.max(np.asarray(pos))) + kk
+        if hi > limit:
+            raise ValueError(
+                f"block reaching position {hi} out of range (block table "
+                f"covers {limit} = {cache.block_table.shape[1]} pages x "
+                f"{cache.page_size})"
+            )
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        positions = jnp.broadcast_to(pos + jnp.arange(kk), (b, kk))
+    else:
+        positions = pos[:, None] + jnp.arange(kk)[None, :]
+    logits, cache = paged_forward(
+        model, params, toks, positions, jnp.ones((b, kk), bool), cache
+    )
+    return logits, cache
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold `tokens` cache entries (ceil)."""
+    return -(-tokens // page_size)
+
+
+class PagePool:
+    """Host-side page accounting: which physical page belongs to which
+    owner. Page 0 is the reserved scratch page and is never issued.
+
+    The pool is the safety layer under the scheduler: alloc hands out
+    each page exactly once, free verifies ownership (a double free or a
+    free of someone else's page raises instead of silently corrupting a
+    neighbor sequence), and `check()` asserts the global invariant
+    free + allocated == usable after any admit/finish/preempt sequence
+    (tests/test_serve.py drives it through all three).
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages {num_pages} < 2 (page 0 is scratch)")
+        self.num_pages = num_pages
+        # Pop from the end -> pages issue in ascending order
+        # (deterministic layouts for tests and debugging).
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._owner: dict[int, object] = {}
+
+    @property
+    def usable(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def owned_by(self, owner) -> list[int]:
+        return [p for p, o in self._owner.items() if o == owner]
+
+    def try_alloc(self, n: int, owner) -> list[int] | None:
+        """n pages for `owner`, or None (and no change) if the pool
+        cannot cover the request — admission control's primitive."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        return pages
+
+    def free(self, pages: list[int], owner) -> None:
+        for p in pages:
+            got = self._owner.get(p)
+            if got is None:
+                raise RuntimeError(f"double free of page {p} (owner {owner})")
+            if got != owner:
+                raise RuntimeError(
+                    f"page {p} is owned by {got}, not {owner} — refusing "
+                    "to free another sequence's page"
+                )
+        for p in pages:
+            del self._owner[p]
+            self._free.append(p)
+
+    def check(self) -> None:
+        """The no-leak / no-double-book invariant."""
+        assert len(self._free) + len(self._owner) == self.usable, (
+            f"page leak: {len(self._free)} free + {len(self._owner)} "
+            f"owned != {self.usable} usable"
+        )
+        assert not (set(self._free) & set(self._owner)), "page double-booked"
+        assert 0 not in self._owner and 0 not in self._free, (
+            "scratch page 0 entered circulation"
+        )

@@ -1,0 +1,285 @@
+"""Iteration-level serving schedulers (Orca, Yu et al., OSDI '22).
+
+Static batching admits a batch, runs it to full drain, then admits the
+next: every request pays the longest request's residency, and vacated
+slots do no work until the batch ends. Continuous batching reconsiders
+the batch EVERY iteration: a finished sequence frees its pages and its
+slot immediately, a queued request is admitted into the vacated slot
+between ticks, and long prompts prefill in fixed-size chunks interleaved
+with decode ticks so token emission never stalls behind an admission.
+
+This module is the POLICY layer and is deliberately jax-free: it moves
+Requests between a queue, fixed engine slots, and the PagePool, and the
+engine (engine.py) executes whatever the policy exposes each iteration
+(`prefill_slot()`, `decode_slots()`). Determinism is part of the
+contract — FCFS admission, lowest-admission-order prefill first,
+preempt-latest — so the tick-count comparisons in tests/test_serve.py
+and the bench are exactly reproducible.
+
+Preemption: when a decoding sequence needs its next page and the pool is
+dry, the LATEST-admitted occupied slot is evicted — its pages are freed,
+its request (prompt + tokens generated so far) returns to the queue
+head, and readmission recomputes the grown context via the normal
+chunked prefill (recompute-style preemption: pages-over-wire swapping
+has nowhere to go on one chip). Emitted tokens stay emitted; TTFT is
+unaffected; only tail latency pays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Iterable
+
+import numpy as np
+
+from .paged_cache import PagePool, pages_for
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request plus its runtime bookkeeping. `prompt` is a
+    1-D int32 array; `out` accumulates emitted tokens (they survive
+    preemption — recompute re-prefills prompt + out)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    arrival: float = 0.0
+    out: list[int] = dataclasses.field(default_factory=list)
+    admitted_at: float | None = None
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    preemptions: int = 0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid}: max_new_tokens < 1")
+
+    @property
+    def context_len(self) -> int:
+        return self.prompt.size + len(self.out)
+
+    @property
+    def done(self) -> bool:
+        return len(self.out) >= self.max_new_tokens
+
+
+@dataclasses.dataclass
+class Slot:
+    """One fixed batch row of the engine. `cached` counts cache rows
+    written; while cached < target the slot is prefilling (target =
+    the request's context length at admission), after that it decodes —
+    the current token (last emitted, not yet cached) goes in at row
+    `cached` on the next tick."""
+
+    idx: int
+    req: Request | None = None
+    pages: list[int] = dataclasses.field(default_factory=list)
+    cached: int = 0
+    target: int = 0
+    admit_seq: int = -1
+
+    @property
+    def free(self) -> bool:
+        return self.req is None
+
+    @property
+    def prefilling(self) -> bool:
+        return self.req is not None and self.cached < self.target
+
+    @property
+    def decoding(self) -> bool:
+        return self.req is not None and self.cached >= self.target
+
+
+class _SchedulerBase:
+    def __init__(self, *, slots: int, pool: PagePool, page_size: int,
+                 max_len: int):
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        self.slots = [Slot(i) for i in range(slots)]
+        self.pool = pool
+        self.page_size = page_size
+        self.max_len = max_len
+        self.queue: deque[Request] = deque()
+        self.finished: list[Request] = []
+        self.preemptions = 0
+        self._admit_seq = 0
+
+    def submit(self, requests: Iterable[Request]) -> None:
+        reqs = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        for r in reqs:
+            total = r.prompt.size + r.max_new_tokens
+            if total > self.max_len:
+                raise ValueError(
+                    f"request {r.rid}: prompt {r.prompt.size} + "
+                    f"{r.max_new_tokens} new exceeds max_len {self.max_len}"
+                )
+            self.queue.append(r)
+
+    @property
+    def unfinished(self) -> int:
+        return len(self.queue) + sum(not s.free for s in self.slots)
+
+    def next_arrival(self) -> float | None:
+        return min((r.arrival for r in self.queue), default=None)
+
+    def prefill_slot(self) -> Slot | None:
+        """The earliest-admitted slot still prefilling (FCFS: one
+        sequence's prompt finishes before the next's starts, so TTFT
+        ordering follows admission ordering)."""
+        cands = [s for s in self.slots if s.prefilling]
+        return min(cands, key=lambda s: s.admit_seq, default=None)
+
+    def decode_slots(self) -> list[Slot]:
+        return [s for s in self.slots if s.decoding]
+
+    def _bind(self, slot: Slot, req: Request, pages: list[int],
+              now: float) -> None:
+        slot.req = req
+        slot.pages = pages
+        slot.cached = 0
+        slot.target = req.context_len
+        slot.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        if req.admitted_at is None:
+            req.admitted_at = now
+
+    def _release(self, slot: Slot) -> None:
+        if slot.pages:
+            self.pool.free(slot.pages, slot.req.rid)
+        slot.req = None
+        slot.pages = []
+        slot.cached = 0
+        slot.target = 0
+        slot.admit_seq = -1
+
+    def finish(self, slot: Slot, now: float) -> None:
+        slot.req.finished_at = now
+        self.finished.append(slot.req)
+        self._release(slot)
+
+
+class ContinuousScheduler(_SchedulerBase):
+    """FCFS iteration-level scheduling with recompute preemption."""
+
+    def admit(self, now: float) -> list[Slot]:
+        """Move arrived queue-head requests into free slots, bounded by
+        free pages: a request is admitted only when the pool covers its
+        whole prefill extent AND its first decode row (so an admission
+        can never preempt an existing sequence on its very first decode
+        token). Head-of-line FCFS: if the head doesn't fit, nothing
+        behind it jumps ahead."""
+        bound = []
+        for slot in self.slots:
+            if not slot.free or not self.queue:
+                continue
+            req = self.queue[0]
+            if req.arrival > now:
+                break
+            if pages_for(req.context_len + 1,
+                         self.page_size) > self.pool.free_pages:
+                break
+            pages = self.pool.try_alloc(
+                pages_for(req.context_len, self.page_size), req.rid
+            )
+            assert pages is not None
+            self.queue.popleft()
+            self._bind(slot, req, pages, now)
+            bound.append(slot)
+        return bound
+
+    def preempt(self, slot: Slot) -> None:
+        """Evict `slot`: free its pages, requeue its request at the
+        HEAD (it keeps FCFS priority and its emitted tokens; the grown
+        context recomputes via chunked prefill on readmission)."""
+        req = slot.req
+        req.preemptions += 1
+        self.preemptions += 1
+        self.queue.appendleft(req)
+        self._release(slot)
+
+    def grow_for_decode(self) -> list[Slot]:
+        """Give every decoding slot the page its next cache row needs,
+        preempting latest-admitted sequences while the pool is dry.
+        Returns the decoding slots that survived, oldest-first (the
+        engine's tick order)."""
+        survivors = []
+        for slot in sorted(self.decode_slots(), key=lambda s: s.admit_seq):
+            if slot.free or not slot.decoding:
+                continue  # preempted by an earlier iteration below
+            while slot.pages and len(slot.pages) * self.page_size <= slot.cached:
+                got = self.pool.try_alloc(1, slot.req.rid)
+                if got is not None:
+                    slot.pages.extend(got)
+                    continue
+                victims = [s for s in self.slots if not s.free]
+                victim = max(victims, key=lambda s: s.admit_seq)
+                if victim is slot and len(victims) == 1:
+                    raise RuntimeError(
+                        f"page pool ({self.pool.num_pages} pages of "
+                        f"{self.page_size}) cannot hold request "
+                        f"{slot.req.rid} alone — size the pool for at "
+                        "least one max_len sequence"
+                    )
+                self.preempt(victim)
+            if not slot.free and slot.decoding:
+                survivors.append(slot)
+        return survivors
+
+
+class StaticScheduler(_SchedulerBase):
+    """Classic static batching over the same paged storage: admit a
+    batch only when ALL slots are free, reserve each request's
+    worst-case page extent up front (the contiguous cache's reservation
+    discipline, expressed in pages — what makes the tick/latency
+    comparison against ContinuousScheduler apples-to-apples), never
+    preempt, and hold every slot until the whole batch drains."""
+
+    def admit(self, now: float) -> list[Slot]:
+        if any(not s.free for s in self.slots):
+            return []
+        bound = []
+        for slot in self.slots:
+            if not self.queue or self.queue[0].arrival > now:
+                break
+            req = self.queue[0]
+            # Worst-case rows: full context less the final emitted
+            # token (which is never written back).
+            need = pages_for(req.context_len + req.max_new_tokens - 1,
+                             self.page_size)
+            pages = self.pool.try_alloc(need, req.rid)
+            if pages is None:
+                if not bound:
+                    raise RuntimeError(
+                        f"page pool ({self.pool.num_pages} pages) cannot "
+                        f"hold request {req.rid}'s worst case — static "
+                        "batching reserves max extent up front"
+                    )
+                break
+            self.queue.popleft()
+            self._bind(slot, req, pages, now)
+            bound.append(slot)
+        return bound
+
+    def grow_for_decode(self) -> list[Slot]:
+        """No growth, no preemption — pages were reserved at admission.
+        Decoding slots whose request is already done still HOLD their
+        slot and pages (the batch drains as one); the engine keeps
+        them out of the tick's valid mask."""
+        return [s for s in self.decode_slots() if not s.req.done]
+
+    def batch_done(self) -> bool:
+        occupied = [s for s in self.slots if not s.free]
+        return bool(occupied) and all(
+            s.req.done and s.decoding for s in occupied
+        )
+
+    def drain(self, now: float) -> None:
+        for slot in self.slots:
+            if not slot.free:
+                self.finish(slot, now)

@@ -1,0 +1,14 @@
+"""Thin wrapper for the serving bench (mpi_cuda_cnn_tpu.serve.bench) —
+`python scripts/bench_serve.py ...` == `mctpu serve-bench ...`: static
+vs continuous batching under Poisson arrivals on a paged KV cache,
+reporting throughput, TTFT, and p50/p99 per-token latency."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_cuda_cnn_tpu.serve.bench import serve_bench_main
+
+if __name__ == "__main__":
+    sys.exit(serve_bench_main())

@@ -19,6 +19,7 @@
 #   8c. bench_decode int8 cache (round-5: quarter bytes + absmax scales)
 #   8d. bench_configs      (five-config rows, two-point — round-5 form)
 #   8e. bench_speculative  (draft/lookup speculation incl. T=0.8 rows)
+#   8f. bench_serve        (paged-KV continuous vs static batching; PR-3)
 #   9. profile_lm          (step-time attribution; VERDICT #3)
 #   9b. profile_moe        (MoE component attribution + chunk sweep)
 #  10. make -C native test_tpu  (C driver on the chip)
@@ -92,6 +93,14 @@ step bench_decode_int8 900 python scripts/bench_decode.py \
 step bench_configs 1200 python scripts/bench_configs.py
 step profile_moe 900 python scripts/profile_moe.py
 step bench_speculative 900 python scripts/bench_speculative.py
+# PR-3: serving — paged-KV continuous vs static batching (Poisson
+# arrivals, mixed lengths): banks chip TTFT/p99-per-token/tokens-per-s
+# for the PERF.md "Serving" table (CPU rows measured; schedule effects
+# are chip-independent, bandwidth effects are not).
+step bench_serve 900 python scripts/bench_serve.py --requests 32 \
+    --rate 200
+step bench_serve_gqa_int8 900 python scripts/bench_serve.py \
+    --requests 32 --rate 200 --kv-heads 1 --cache-dtype int8
 step profile_lm 900 python scripts/profile_lm.py
 # make prints recipes/compiler lines on stdout — keep the JSONL clean by
 # sending this step's stdout to the log; its result is the note() line.

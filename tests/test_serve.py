@@ -1,0 +1,313 @@
+"""Serving engine (mpi_cuda_cnn_tpu/serve/): paged-cache parity with the
+contiguous decode path, page-pool accounting invariants, and the
+continuous-vs-static scheduler comparison — all deterministic on CPU."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.models.generate import decode_step, generate, init_cache
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+from mpi_cuda_cnn_tpu.serve.paged_cache import (
+    PagePool,
+    init_paged_cache,
+    pages_for,
+)
+from mpi_cuda_cnn_tpu.serve.scheduler import ContinuousScheduler, Request
+
+MODEL = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
+GQA = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48,
+                    kv_heads=2, pos="rope")
+
+
+def _identity_paged_cache(model, batch, page_size, dtype=jnp.float32):
+    """A paged cache whose block tables cover max_seq per row with
+    ascending page indices — the layout the layer-level parity loops
+    drive through decode_step's PagedKVCache dispatch."""
+    per = pages_for(model.max_seq, page_size)
+    cache = init_paged_cache(model, slots=batch,
+                             num_pages=batch * per + 1,
+                             page_size=page_size, dtype=dtype)
+    table = 1 + np.arange(batch * per, dtype=np.int32).reshape(batch, per)
+    return dataclasses.replace(cache, block_table=jnp.asarray(table))
+
+
+@pytest.mark.parametrize("model", [MODEL, GQA], ids=["mha", "gqa_rope"])
+def test_paged_decode_step_matches_contiguous_f32(model):
+    """decode_step over a PagedKVCache (per-slot positions) must equal
+    the contiguous cache BITWISE in f32: the two layouts share the
+    attention read (generate.attend_kv) and differ only in how cache
+    rows are materialized, so any drift is a layout bug, not rounding.
+    Page size 8 does not divide 20 steps evenly — writes cross page
+    boundaries mid-sequence."""
+    params = model.init(jax.random.key(0))
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(0, 13, (3, 20)), jnp.int32
+    )
+    cc = init_cache(model, 3)
+    pc = _identity_paged_cache(model, 3, page_size=8)
+    for i in range(20):
+        want, cc = decode_step(model, params, toks[:, i], i, cc)
+        got, pc = decode_step(model, params, toks[:, i],
+                              jnp.full((3,), i, jnp.int32), pc)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"step {i}")
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_paged_decode_step_matches_contiguous_quantized(dtype):
+    """bf16/int8 paged caches quantize EXACTLY like the contiguous ones
+    (same per-(position, head) absmax contract), so the two layouts stay
+    within tight float tolerance of each other — far inside the
+    cache-dtype error bands the contiguous tests pin vs f32."""
+    params = MODEL.init(jax.random.key(0))
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(0, 13, (2, 16)), jnp.int32
+    )
+    cc = init_cache(MODEL, 2, jnp.dtype(dtype))
+    pc = _identity_paged_cache(MODEL, 2, page_size=8, dtype=jnp.dtype(dtype))
+    assert pc.pages[0]["k"].dtype == jnp.dtype(dtype)
+    for i in range(16):
+        want, cc = decode_step(MODEL, params, toks[:, i], i, cc)
+        got, pc = decode_step(MODEL, params, toks[:, i],
+                              jnp.full((2,), i, jnp.int32), pc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5, err_msg=f"step {i}")
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_engine_greedy_generations_match_generate(dtype):
+    """End-to-end: the engine's chunked-prefill + paged-decode greedy
+    continuations equal models/generate.generate's contiguous ones for
+    every request — across cache dtypes, prompt lengths that don't
+    divide the prefill chunk, and both scheduler modes."""
+    params = MODEL.init(jax.random.key(0))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 13, (n,)).astype(np.int32)
+               for n in (3, 7, 11, 5)]
+    new = [9, 4, 12, 7]
+    want = [
+        np.asarray(generate(MODEL, params, jnp.asarray(p[None, :]), n,
+                            cache_dtype=dtype))[0]
+        for p, n in zip(prompts, new)
+    ]
+    engine = PagedEngine(MODEL, params, slots=2, num_pages=4 * 6 + 1,
+                         page_size=8, prefill_chunk=4, cache_dtype=dtype)
+    for mode in ("continuous", "static"):
+        reqs = [Request(rid=i, prompt=p, max_new_tokens=n)
+                for i, (p, n) in enumerate(zip(prompts, new))]
+        res = engine.run(reqs, mode=mode)
+        assert sorted(r.rid for r in res.requests) == [0, 1, 2, 3]
+        for r in res.requests:
+            np.testing.assert_array_equal(
+                np.asarray(r.out), want[r.rid],
+                err_msg=f"{mode} request {r.rid} ({dtype})"
+            )
+
+
+def test_static_holds_slot_when_request_finishes_at_prefill():
+    """A max_new_tokens=1 request finishes AT prefill completion (its
+    only token comes from the last chunk's logits). Under static
+    batching that slot must stay reserved until the batch drains —
+    finishing it early would release pages mid-batch, breaking the
+    reserve-until-drain discipline the comparison measures — and both
+    requests must still complete in both modes."""
+    params = MODEL.init(jax.random.key(0))
+    engine = PagedEngine(MODEL, params, slots=2, num_pages=15, page_size=8)
+    for mode in ("static", "continuous"):
+        reqs = [Request(rid=0, prompt=np.arange(5) % 13, max_new_tokens=1),
+                Request(rid=1, prompt=np.arange(7) % 13, max_new_tokens=10)]
+        res = engine.run(reqs, mode=mode)
+        assert sorted(r.rid for r in res.requests) == [0, 1]
+        assert [len(r.out) for r in
+                sorted(res.requests, key=lambda r: r.rid)] == [1, 10]
+
+
+def test_page_pool_accounting():
+    pool = PagePool(8)  # 7 usable, page 0 scratch
+    a = pool.try_alloc(3, "a")
+    b = pool.try_alloc(2, "b")
+    assert a == [1, 2, 3] and b == [4, 5]  # deterministic ascending issue
+    assert pool.free_pages == 2
+    assert pool.try_alloc(3, "c") is None  # over-ask: no change
+    assert pool.free_pages == 2
+    pool.check()
+    with pytest.raises(RuntimeError, match="owned by"):
+        pool.free([4], "a")                # foreign free refused
+    pool.free(a, "a")
+    with pytest.raises(RuntimeError, match="double free"):
+        pool.free(a, "a")
+    pool.free(b, "b")
+    pool.check()
+    assert pool.free_pages == pool.usable
+
+
+def test_scheduler_admit_finish_preempt_keep_pool_consistent():
+    """Drive the continuous scheduler through admit -> decode growth ->
+    forced preemption -> finish and assert the pool invariant after
+    every transition: no leak, no double-book, scratch page never
+    circulates."""
+    pool = PagePool(7)  # 6 usable pages of 4 tokens
+    sched = ContinuousScheduler(slots=2, pool=pool, page_size=4, max_len=24)
+    rng = np.random.default_rng(0)
+    reqs = [Request(rid=i, prompt=rng.integers(0, 13, (8,)), arrival=0.0,
+                    max_new_tokens=12) for i in range(3)]
+    sched.submit(reqs)
+    bound = sched.admit(0.0)
+    # 8-token prompts need 2 pages each +1 headroom: both slots admit.
+    assert [s.req.rid for s in bound] == [0, 1]
+    pool.check()
+    assert pool.free_pages == 2
+    for s in bound:                       # prefill completes, decode grows
+        s.cached = s.target
+        s.req.out.append(1)
+    assert len(sched.grow_for_decode()) == 2
+    pool.check()
+    # Burn the remaining pages: advance both slots until the pool runs
+    # dry and the LATEST-admitted sequence gets preempted.
+    while sched.preemptions == 0:
+        for s in list(sched.decode_slots()):
+            s.cached += 1
+            s.req.out.append(1)
+        sched.grow_for_decode()
+        pool.check()
+    assert sched.slots[1].free            # victim = latest admitted
+    assert reqs[1].preemptions == 1
+    assert sched.queue[0].rid == 1        # requeued at the head
+    sched.finish(sched.slots[0], now=1.0)
+    pool.check()
+    assert reqs[0].finished_at == 1.0
+    # Everything freed once the survivor finished.
+    assert pool.free_pages == pool.usable - 0 - len(sched.slots[1].pages)
+
+
+def test_engine_preemption_recovers_and_completes():
+    """A pool far smaller than the workload's worst case forces
+    preemptions; recompute must still finish every request with its
+    full greedy budget, and the engine's end-of-run invariants (no lost
+    requests, zero leaked pages) must hold."""
+    params = MODEL.init(jax.random.key(1))
+    rng = np.random.default_rng(5)
+    engine = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                         prefill_chunk=8, max_len=40)
+    reqs = [Request(rid=i, prompt=rng.integers(0, 13, (6,)),
+                    max_new_tokens=18) for i in range(5)]
+    res = engine.run(reqs, mode="continuous")
+    assert res.preemptions > 0
+    assert sorted(r.rid for r in res.requests) == list(range(5))
+    assert all(len(r.out) == 18 for r in res.requests)
+
+
+def test_continuous_batching_beats_static_on_mixed_lengths():
+    """THE tentpole property, deterministically on CPU: with mixed
+    output lengths, iteration-level continuous batching finishes the
+    workload in FEWER decode ticks than static batching (vacated slots
+    readmit mid-flight instead of idling until the batch drains) — and
+    greedy token streams are identical per request across modes."""
+    params = MODEL.init(jax.random.key(0))
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 13, (4,)).astype(np.int32) for _ in range(8)]
+    lens = [3, 24, 3, 24, 3, 24, 3, 24]   # short/long mix: static pays
+    #                                       the long tail in every batch
+    engine = PagedEngine(MODEL, params, slots=2, num_pages=33, page_size=4,
+                         prefill_chunk=8, max_len=32)
+
+    def workload():
+        return [Request(rid=i, prompt=p, max_new_tokens=n)
+                for i, (p, n) in enumerate(zip(prompts, lens))]
+
+    static = engine.run(workload(), mode="static")
+    cont = engine.run(workload(), mode="continuous")
+    assert static.output_tokens == cont.output_tokens == sum(lens)
+    assert cont.decode_ticks < static.decode_ticks
+    by_rid = {r.rid: r.out for r in static.requests}
+    for r in cont.requests:
+        assert r.out == by_rid[r.rid], f"request {r.rid} diverged"
+
+
+def test_request_records_schema_validate_and_report():
+    """Per-request engine records round-trip the obs schema (strict
+    validation) and surface in `mctpu report`'s serving tables."""
+    from mpi_cuda_cnn_tpu.obs.report import summarize
+    from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
+
+    params = MODEL.init(jax.random.key(0))
+    engine = PagedEngine(MODEL, params, slots=2, num_pages=13, page_size=8)
+    reqs = [Request(rid=i, prompt=np.arange(4) % 13, max_new_tokens=5)
+            for i in range(3)]
+    res = engine.run(reqs, mode="continuous")
+    records = [validate_record(make_record("request", 0.1, **rec))
+               for rec in res.request_records()]
+    records.append(validate_record(
+        make_record("serve", 0.2, **res.summary())
+    ))
+    s = summarize(records)
+    assert s["requests"][0]["mode"] == "continuous"
+    assert s["requests"][0]["requests"] == 3
+    assert s["requests"][0]["output_tokens"] == 15
+    assert s["serve"][0]["decode_ticks"] == res.decode_ticks
+    assert s["serve"][0]["tokens_per_s"] > 0
+
+
+def test_serve_bench_cli_runs_and_emits_valid_jsonl(tmp_path):
+    """The `mctpu serve-bench` surface end-to-end: both modes run, the
+    comparison line prints, and the JSONL sink strict-validates."""
+    import json
+
+    from mpi_cuda_cnn_tpu.serve.bench import serve_bench_main
+    from mpi_cuda_cnn_tpu.obs.schema import load_records
+
+    sink = tmp_path / "serve.jsonl"
+    rc = serve_bench_main([
+        "--requests", "6", "--dim", "32", "--depth", "1", "--heads", "2",
+        "--vocab", "64", "--max-seq", "128", "--prompt-min", "4",
+        "--prompt-max", "12", "--out-min", "4", "--out-max", "12",
+        "--slots", "2", "--page-size", "8", "--prefill-chunk", "8",
+        "--metrics-jsonl", str(sink),
+    ])
+    assert rc == 0
+    recs = load_records(sink, strict=True)
+    assert sum(r["event"] == "request" for r in recs) == 12  # 6 x 2 modes
+    assert sum(r["event"] == "serve" for r in recs) == 2
+    modes = {json.dumps(sorted(r["mode"] for r in recs
+                               if r["event"] == "serve"))}
+    assert modes == {json.dumps(["continuous", "static"])}
+
+
+def test_paged_decode_block_rejects_out_of_range_positions():
+    """Concrete positions past the block-table extent must raise like
+    the contiguous path — past the table the gathered page index would
+    clamp to the last column and silently scatter over the sequence's
+    final legitimate cache rows."""
+    from mpi_cuda_cnn_tpu.models.generate import decode_block
+
+    params = MODEL.init(jax.random.key(0))
+    pc = _identity_paged_cache(MODEL, 1, page_size=8)  # covers max_seq=48
+    toks = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    with pytest.raises(ValueError, match="out of range"):
+        decode_block(MODEL, params, toks, MODEL.max_seq - 2, pc)
+    with pytest.raises(ValueError, match="out of range"):
+        decode_block(MODEL, params, toks,
+                     np.asarray([MODEL.max_seq - 1]), pc)
+
+
+def test_scheduler_and_engine_rejections():
+    params = MODEL.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="max_len"):
+        sched = ContinuousScheduler(slots=1, pool=PagePool(4), page_size=4,
+                                    max_len=16)
+        sched.submit([Request(rid=0, prompt=np.zeros(10, np.int32),
+                              max_new_tokens=10)])
+    with pytest.raises(ValueError, match="empty prompt"):
+        Request(rid=0, prompt=np.zeros(0, np.int32), max_new_tokens=1)
+    with pytest.raises(ValueError, match="num_pages"):
+        PagePool(1)
+    engine = PagedEngine(MODEL, params, slots=1, num_pages=2, page_size=4,
+                         max_len=16)
+    with pytest.raises(RuntimeError, match="too small"):
+        engine.run([Request(rid=0, prompt=np.zeros(8, np.int32),
+                            max_new_tokens=4)], mode="continuous")
