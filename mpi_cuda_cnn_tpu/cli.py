@@ -15,10 +15,12 @@ driver (native/) offers the same surface for the north star's
 
 from __future__ import annotations
 
+import dataclasses
 import sys
 
 from .data.datasets import get_dataset, load_idx_dataset
 from .data.idx import IdxError
+from .faults import FaultInjector, supervise
 from .models.presets import get_model
 from .parallel.distributed import initialize_distributed
 from .train.trainer import Trainer
@@ -41,6 +43,46 @@ def _select_device(cfg: Config, log) -> bool:
         log.error("unknown --device %r (want auto|tpu|cpu)", cfg.device)
         return False
     return True
+
+
+def _fault_setup(cfg, log):
+    """Validate the supervisor/fault flags up front. Returns
+    (rc, injector): rc != 0 is a config error (nothing was run);
+    injector is the ONE FaultInjector for the whole supervised run —
+    faults fired in a crashed attempt stay fired, so a restart proves
+    recovery instead of re-tripping the same crash."""
+    if cfg.max_restarts > 0 and not cfg.checkpoint_dir:
+        log.error("--max-restarts needs --checkpoint-dir: a restarted "
+                  "attempt resumes from the latest valid checkpoint")
+        return 2, None
+    try:
+        return 0, FaultInjector(cfg.fault_plan) if cfg.fault_plan else None
+    except ValueError as e:
+        log.error("bad --fault-plan: %s", e)
+        return 2, None
+
+
+def _supervised(cfg, log, metrics, first_trainer, make_trainer):
+    """Run training under the crash-safe supervisor.
+
+    `first_trainer` was built by the caller OUTSIDE this call (so a
+    construction/config error surfaces once, with the caller's own
+    error handling, and is never mistaken for a mid-training crash);
+    each restarted attempt rebuilds with resume forced — the
+    supervisor's whole contract is continue-from-checkpoint. Returns
+    (result, last_trainer); training exceptions propagate once restarts
+    are exhausted."""
+    trainer = first_trainer
+
+    def attempt(n: int):
+        nonlocal trainer
+        if n > 0:
+            trainer = make_trainer(dataclasses.replace(cfg, resume=True))
+        return trainer.train()
+
+    result = supervise(attempt, max_restarts=cfg.max_restarts,
+                       logger=log, metrics=metrics)
+    return result, trainer
 
 
 def run(cfg: Config) -> int:
@@ -74,11 +116,25 @@ def run(cfg: Config) -> int:
         log.error("%s", e)
         return 2
     log.info("model=%s dataset=%s input=%s", model.name, ds.name, ds.input_shape)
+    rc, faults = _fault_setup(cfg, log)
+    if rc:
+        return rc
     # The context manager closes the JSONL sink even when the trainer
     # raises mid-run — the records written so far must survive.
     with MetricsLogger(path=cfg.metrics_jsonl) as metrics:
-        trainer = Trainer(model, ds, cfg, metrics=metrics)
-        result = trainer.train()
+        def make_trainer(c):
+            return Trainer(model, ds, c, metrics=metrics, faults=faults)
+
+        # First construction outside the retry loop AND outside
+        # _supervised: a config error (bad nan-policy, indivisible
+        # batch, ...) can never succeed on retry — it fails once, fast
+        # — while mid-training errors propagate with their tracebacks.
+        try:
+            first = make_trainer(cfg)
+        except ValueError as e:
+            log.error("trainer setup failed: %s", e)
+            return 2
+        result, _ = _supervised(cfg, log, metrics, first, make_trainer)
     log.info(
         "done: epochs=%d acc=%.4f mean_step=%.3fms",
         result.epochs_run,
@@ -98,19 +154,27 @@ def run_lm(argv: list[str]) -> int:
     log = get_logger()
     if not _select_device(cfg, log):
         return 2
+    rc, faults = _fault_setup(cfg, log)
+    if rc:
+        return rc
     initialize_distributed()
     with MetricsLogger(path=cfg.metrics_jsonl) as metrics:
+        def make_trainer(c):
+            return LMTrainer(c, metrics=metrics, faults=faults)
+
+        # First construction outside _supervised: setup errors map to
+        # rc=2 exactly once; mid-training errors keep their tracebacks.
         try:
-            trainer = LMTrainer(cfg, metrics=metrics)
+            first = make_trainer(cfg)
         except (OSError, ValueError) as e:
             log.error("lm setup failed: %s", e)
             return 2
         log.info(
             "lm model=d%dx%d h%d seq=%d vocab=%d moe=%d mesh=%s attn=%s",
-            cfg.dim, cfg.depth, cfg.heads, cfg.seq_len, trainer.model.vocab,
-            cfg.moe_experts, dict(trainer.mesh.shape), trainer.attn_impl,
+            cfg.dim, cfg.depth, cfg.heads, cfg.seq_len, first.model.vocab,
+            cfg.moe_experts, dict(first.mesh.shape), first.attn_impl,
         )
-        result = trainer.train()
+        result, trainer = _supervised(cfg, log, metrics, first, make_trainer)
         log.info(
             "done: steps=%d eval_ppl=%.3f tokens/s=%.0f",
             result.steps_run, result.eval_ppl, result.tokens_per_s,
@@ -129,6 +193,10 @@ def run_lm(argv: list[str]) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "train":
+        # Explicit alias for the default command, so the supervisor form
+        # reads naturally: `mctpu train --max-restarts 3 ...`.
+        argv = argv[1:]
     if argv and argv[0] == "lm":
         return run_lm(argv[1:])
     if argv and argv[0] == "report":

@@ -1,0 +1,384 @@
+"""Deterministic fault injection + the recovery primitives that answer it.
+
+The reference C program has no failure handling of any kind: a NaN, a
+bad read, or a killed rank loses the whole run (SURVEY.md §0). This
+module makes failure a first-class, *tested* input. It has two halves:
+
+Injection — a `FaultPlan` is a seeded, fully deterministic list of named
+faults, each bound to a hook SITE (a string like "train.step" or
+"serve.tick") and a trigger VALUE (the step / tick / save index the host
+code passes when it reaches the site). The trainers, the checkpoint
+writer, and the serve engine carry explicit hook points (`faults=`
+keyword arguments threaded down from the CLI's `--fault-plan` flag), so
+tests and chaos runs inject without monkeypatching anything. Fault
+kinds:
+
+- ``crash``   — raise InjectedCrash at the site (simulated process
+                death; the supervisor treats it like any crash)
+- ``io``      — raise InjectedIOError (an OSError) at the site
+- ``nan``     — poison the training batch with NaNs (the CNN trainer's
+                float image batches, via `poison_batch`; the LM's int
+                token batches can't carry NaN — its guard is exercised
+                by organic non-finite losses)
+- ``squeeze`` — steal ``pages`` pool pages for ``ticks`` engine ticks
+                (serve engine; exercises preemption + deadline expiry)
+- ``slow``    — stall a serve tick by ``s`` seconds (advances the
+                injector's FakeClock when one is attached, else sleeps)
+
+Recovery — `supervise()` is the `--max-restarts N` loop: it runs one
+training attempt, and on a crash rebuilds the trainer and resumes from
+the latest valid checkpoint, up to N times. Together with the
+step-exact-resume contract (tests/test_step_resume.py) this makes an
+interrupted-then-restarted run bitwise-equal to the uninterrupted one
+(tests/test_faults.py proves it end to end through an injected crash).
+
+Every fired fault, restart, and recovery lands in the obs JSONL schema
+as a ``fault`` event; `mctpu report` renders them in the robustness
+table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """Base class for exceptions raised by injected faults — lets tests
+    and the supervisor distinguish injected failures from real bugs."""
+
+
+class InjectedCrash(InjectedFault):
+    """Simulated process death at a hook point."""
+
+
+class InjectedIOError(OSError):
+    """Simulated IO failure at a hook point (an OSError, so it travels
+    the same except paths a real disk error would)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One planned fault: `kind` fires when the host code reaches hook
+    `site` with trigger value `at` (each fault fires exactly once)."""
+
+    kind: str
+    site: str
+    at: int
+    args: dict = dataclasses.field(default_factory=dict)
+
+    def arg(self, name: str, default=None):
+        return self.args.get(name, default)
+
+
+KINDS = ("crash", "io", "nan", "squeeze", "slow")
+
+
+def parse_plan(spec: str) -> list[Fault]:
+    """Parse a compact fault-plan spec into a list of Faults.
+
+    Grammar: faults are ';'-separated, each ``kind@site:at`` with
+    optional ``?key=val&key=val`` args (ints/floats parsed, anything
+    else kept as a string)::
+
+        crash@train.step:6
+        nan@train.batch:3;crash@train.step:6
+        squeeze@serve.tick:2?pages=4&ticks=8
+        slow@serve.tick:5?s=2.5
+
+    Raises ValueError with the offending fragment on any malformed
+    piece — a chaos run must fail at parse time, not mid-experiment.
+    """
+    faults = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, argstr = part.partition("?")
+        try:
+            kind, _, rest = head.partition("@")
+            site, _, at = rest.rpartition(":")
+            fault = Fault(kind=kind.strip(), site=site.strip(),
+                          at=int(at), args=_parse_args(argstr))
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault spec {part!r} (want kind@site:at[?k=v&k=v]): {e}"
+            ) from e
+        if fault.kind not in KINDS:
+            raise ValueError(
+                f"bad fault spec {part!r}: unknown kind {fault.kind!r} "
+                f"(want one of {KINDS})"
+            )
+        if not fault.site:
+            raise ValueError(f"bad fault spec {part!r}: empty site")
+        faults.append(fault)
+    return faults
+
+
+def _parse_args(argstr: str) -> dict:
+    args: dict = {}
+    for kv in argstr.split("&"):
+        if not kv:
+            continue
+        k, sep, v = kv.partition("=")
+        if not sep:
+            raise ValueError(f"bad fault arg {kv!r} (want key=val)")
+        try:
+            args[k] = int(v)
+        except ValueError:
+            try:
+                args[k] = float(v)
+            except ValueError:
+                args[k] = v
+    return args
+
+
+class FakeClock:
+    """A manually-advanced clock with the time.perf_counter call shape —
+    deadline/watchdog tests drive the serve engine with one of these so
+    expiry is deterministic, never wall-clock-raced."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += float(seconds)
+
+
+class FaultInjector:
+    """Deterministic dispenser for a FaultPlan.
+
+    Host code calls `poll(site, value)` (returns the matching unfired
+    faults and records them) or `fire(site, value)` (same, but raising
+    kinds — crash/io — raise immediately). Each fault fires at most
+    once, so a supervisor-restarted attempt does not re-trip the crash
+    that killed the previous attempt: the injector object is shared
+    across attempts, which is exactly what makes the e2e
+    crash-restart-bitwise test meaningful.
+
+    `events` accumulates one obs-schema-shaped field dict per fired
+    fault; producers drain it through MetricsLogger (the injector stays
+    logger-free so it can run inside the checkpoint worker thread).
+    """
+
+    def __init__(self, plan: list[Fault] | str | None = None, *,
+                 clock: FakeClock | None = None,
+                 sleep_fn: Callable[[float], None] | None = None):
+        if isinstance(plan, str):
+            plan = parse_plan(plan)
+        self.plan = list(plan or ())
+        self.clock = clock
+        self._sleep_fn = sleep_fn
+        self._fired: set[int] = set()
+        self.events: list[dict] = []
+        # poll() runs wherever the hook site lives — including the
+        # AsyncCheckpointer's worker thread — while the trainer thread
+        # swap-drains `events`; the lock keeps an event from landing on
+        # a just-discarded list.
+        self._lock = threading.Lock()
+
+    def poll(self, site: str, value: int) -> list[Fault]:
+        """Unfired faults matching (site, value), marked fired."""
+        hits = []
+        with self._lock:
+            for i, f in enumerate(self.plan):
+                if i in self._fired or f.site != site or f.at != int(value):
+                    continue
+                self._fired.add(i)
+                self.events.append({
+                    "kind": f"injected_{f.kind}", "site": site,
+                    "at": int(value), **f.args,
+                })
+                hits.append(f)
+        return hits
+
+    def fire(self, site: str, value: int) -> list[Fault]:
+        """poll(), then raise for the raising kinds; non-raising faults
+        are returned for the caller to apply (nan/squeeze/slow)."""
+        soft = []
+        for f in self.poll(site, value):
+            if f.kind == "crash":
+                raise InjectedCrash(f"injected crash at {site}:{value}")
+            if f.kind == "io":
+                raise InjectedIOError(
+                    f"injected IO error at {site}:{value}")
+            soft.append(f)
+        return soft
+
+    def sleep(self, seconds: float) -> None:
+        """A slow-fault's stall: advances the attached FakeClock when
+        one exists (deterministic tests), else really sleeps."""
+        if self.clock is not None:
+            self.clock.advance(seconds)
+        elif self._sleep_fn is not None:
+            self._sleep_fn(seconds)
+        else:
+            time.sleep(seconds)
+
+    def drain_events(self) -> list[dict]:
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
+
+
+def poison_batch(x, fault: Fault):
+    """Apply a ``nan`` fault to a host batch: NaN-poison a deterministic
+    slice of the array (the first row unless args say otherwise) — the
+    partial poisoning is what makes the NaN guard's detection, not the
+    injection, do the work."""
+    x = np.array(x, dtype=np.float32, copy=True)
+    rows = int(fault.arg("rows", 1))
+    x[:rows] = np.nan
+    return x
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised by --nan-policy=abort when a step's loss/metrics or the
+    post-update parameter norm go NaN/Inf."""
+
+
+class RollbackToCheckpoint(Exception):
+    """Control-flow signal raised inside a trainer's step loop when
+    --nan-policy=restore hits K consecutive non-finite steps: the
+    trainer's loop catches it, reloads the latest valid checkpoint, and
+    re-enters at the restored step."""
+
+
+# Persistent-NaN bound shared by both trainers: after this many
+# nan-policy=restore rollbacks the run raises instead of looping — a
+# deterministically-reproducing NaN must eventually surface.
+MAX_NAN_ROLLBACKS = 5
+
+
+class NanGuard:
+    """The NaN/Inf guard's policy state machine, shared by both trainers
+    (train/trainer.py and train/lm_trainer.py hold ONE implementation of
+    the streak/skip/rollback rules; only snapshot placement differs).
+
+    Policies: "off" (inactive), "abort" (raise on the first bad step),
+    "skip" (drop the bad update, keep going), "restore" (skip, then
+    RollbackToCheckpoint after `max_bad` consecutive bad steps).
+    """
+
+    def __init__(self, policy: str, max_bad: int = 3):
+        if policy not in ("off", "abort", "skip", "restore"):
+            raise ValueError(
+                f"--nan-policy {policy!r}: want off|abort|skip|restore"
+            )
+        self.policy = policy
+        self.max_bad = max_bad
+        self.streak = 0   # consecutive non-finite steps
+        self.skipped = 0  # dropped updates (skip/restore)
+
+    @property
+    def active(self) -> bool:
+        return self.policy != "off"
+
+    @property
+    def snapshots(self) -> bool:
+        """Whether the pre-step state must be snapshotted (skip/restore
+        drop the bad update by reinstalling it)."""
+        return self.policy in ("skip", "restore")
+
+    def step_ok(self) -> None:
+        self.streak = 0
+
+    def bad_step(self, step: int, *, logger, metrics) -> None:
+        """Record a non-finite step and apply the policy: raises
+        NonFiniteLossError for abort, RollbackToCheckpoint when restore
+        hits max_bad; RETURNS for a plain skip — the caller reinstalls
+        its pre-step snapshot with the step counter advanced."""
+        self.streak += 1
+        metrics.log("fault", kind="nonfinite_step", step=step,
+                    policy=self.policy, streak=self.streak)
+        if self.policy == "abort":
+            raise NonFiniteLossError(
+                f"step {step}: non-finite loss/metrics or state "
+                "(--nan-policy=abort)"
+            )
+        self.skipped += 1
+        logger.warning(
+            "step %d: non-finite update dropped (%s, streak %d)",
+            step, self.policy, self.streak,
+        )
+        if self.policy == "restore" and self.streak >= self.max_bad:
+            raise RollbackToCheckpoint
+
+
+def step_is_finite(m, finite_fn, state) -> bool:
+    """The guard's per-step check, shared by both trainers: every step
+    metric (loss + the reference metrics) AND the whole post-update
+    state (params, optimizer moments — a NaN gradient with a finite
+    loss lands there) must be finite. `finite_fn` is the trainer's
+    jitted all_finite; the check costs one scalar sync."""
+    import jax
+
+    vals = jax.device_get(m)
+    for v in jax.tree.leaves(vals):
+        if not np.all(np.isfinite(np.asarray(v, np.float64))):
+            return False
+    return bool(jax.device_get(finite_fn(state)))
+
+
+def all_finite(tree):
+    """Traced all-isfinite over a pytree's inexact leaves (int leaves —
+    step counters — are always finite and are skipped). Trainers jit
+    this once and call it per guarded step: ONE boolean comes back, so
+    the guard costs a scalar sync, not a state download."""
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.asarray(True)
+    for leaf in jax.tree.leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def supervise(attempt_fn: Callable[[int], object], *, max_restarts: int,
+              logger=None, metrics=None) -> object:
+    """The crash-safe training supervisor: run `attempt_fn(attempt)` and,
+    on a crash, rerun it up to `max_restarts` more times.
+
+    `attempt_fn` receives the attempt index (0 = first run) and must
+    itself arrange resume-from-checkpoint for attempt > 0 (the CLI does
+    this by forcing cfg.resume on retries). KeyboardInterrupt,
+    SystemExit, and NonFiniteLossError pass through — the operator's
+    kill and the NaN guard's verdict are not faults to retry (an
+    organic NaN replays deterministically from the checkpoint).
+    Exhausted restarts re-raise the last crash. Each restart emits a
+    ``fault`` obs event (kind="restart") when a metrics sink is given.
+    """
+    last: BaseException | None = None
+    for attempt in range(max_restarts + 1):
+        try:
+            return attempt_fn(attempt)
+        except (KeyboardInterrupt, SystemExit, NonFiniteLossError):
+            # The operator's kill is not a fault to retry — and neither
+            # is the NaN guard's abort/rollback-exhausted verdict: an
+            # organic NaN replays deterministically from the checkpoint,
+            # so a restart would burn every retry reproducing it.
+            raise
+        except Exception as e:  # noqa: BLE001 — a supervisor catches broadly
+            last = e
+            if attempt >= max_restarts:
+                break
+            if logger is not None:
+                logger.warning(
+                    "training attempt %d crashed (%s: %s); restarting "
+                    "from the latest valid checkpoint (%d restart(s) "
+                    "left)", attempt, type(e).__name__, e,
+                    max_restarts - attempt,
+                )
+            if metrics is not None:
+                metrics.log("fault", kind="restart", attempt=attempt,
+                            error=f"{type(e).__name__}: {e}")
+    assert last is not None
+    raise last

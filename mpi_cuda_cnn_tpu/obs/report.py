@@ -127,16 +127,27 @@ def summarize(records: Iterable[dict], *,
             by_mode.setdefault(r.get("mode", "?"), []).append(r)
         rows = []
         for mode, rs in sorted(by_mode.items()):
-            ttft = [r["ttft_ms"] for r in rs]
+            # Latency stats cover FINISHED requests only: an aborted
+            # request carries null where the moment never happened
+            # (pre-ISSUE-4 records have no status and count finished).
+            fin = [r for r in rs if r.get("status", "finished") == "finished"]
+            ttft = [r["ttft_ms"] for r in fin if r.get("ttft_ms") is not None]
             # Per-output-token latency after the first token (TPOT).
             tpot = [
                 (r["latency_ms"] - r["ttft_ms"])
                 / max(r["output_tokens"] - 1, 1)
-                for r in rs
+                for r in fin
+                if r.get("latency_ms") is not None
+                and r.get("ttft_ms") is not None
             ]
+            statuses: dict[str, int] = {}
+            for r in rs:
+                st = r.get("status", "finished")
+                statuses[st] = statuses.get(st, 0) + 1
             rows.append({
                 "mode": mode,
                 "requests": len(rs),
+                "statuses": statuses,
                 "prompt_tokens": sum(r["prompt_tokens"] for r in rs),
                 "output_tokens": sum(r["output_tokens"] for r in rs),
                 "preemptions": sum(r.get("preemptions", 0) for r in rs),
@@ -147,12 +158,27 @@ def summarize(records: Iterable[dict], *,
             })
         summary["requests"] = rows
 
+    faults = ev.get("fault", [])
+    if faults:
+        by_kind: dict[str, int] = {}
+        for r in faults:
+            kind = r.get("kind", "?")
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+        summary["robustness"] = {
+            "events": len(faults),
+            "by_kind": dict(sorted(by_kind.items())),
+            "restarts": by_kind.get("restart", 0),
+            "nonfinite_steps": by_kind.get("nonfinite_step", 0),
+            "checkpoint_fallbacks": by_kind.get("ckpt_fallback", 0),
+        }
+
     serves = ev.get("serve", [])
     if serves:
         summary["serve"] = [
             {k: r.get(k) for k in
-             ("mode", "requests", "output_tokens", "decode_ticks",
-              "prefill_chunks", "preemptions", "tokens_per_s",
+             ("mode", "requests", "statuses", "output_tokens",
+              "decode_ticks", "prefill_chunks", "preemptions",
+              "watchdog_slow_ticks", "tokens_per_s",
               "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms")}
             for r in serves
         ]
@@ -270,18 +296,31 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
         lines.append("")
     if "requests" in summary:
         lines += [
-            "| serving (per-request) | requests | out tokens | preempt "
-            "| TTFT p50 ms | TTFT p99 ms | tok p50 ms | tok p99 ms |",
-            "|---|---|---|---|---|---|---|---|",
+            "| serving (per-request) | requests | statuses | out tokens "
+            "| preempt | TTFT p50 ms | TTFT p99 ms | tok p50 ms "
+            "| tok p99 ms |",
+            "|---|---|---|---|---|---|---|---|---|",
         ]
         for r in summary["requests"]:
             lines.append(
-                f"| {r['mode']} | {r['requests']} | {r['output_tokens']} "
+                f"| {r['mode']} | {r['requests']} "
+                f"| {_fmt(r.get('statuses'))} | {r['output_tokens']} "
                 f"| {r['preemptions']} | {_fmt(r['ttft_p50_ms'])} "
                 f"| {_fmt(r['ttft_p99_ms'])} | {_fmt(r['tpot_p50_ms'])} "
                 f"| {_fmt(r['tpot_p99_ms'])} |"
             )
         lines.append("")
+    if "robustness" in summary:
+        rb = summary["robustness"]
+        lines += [
+            "| robustness | events | restarts | non-finite steps "
+            "| ckpt fallbacks | by kind |",
+            "|---|---|---|---|---|---|",
+            f"| | {rb['events']} | {rb['restarts']} "
+            f"| {rb['nonfinite_steps']} | {rb['checkpoint_fallbacks']} "
+            f"| {_fmt(rb['by_kind'])} |",
+            "",
+        ]
     if "serve" in summary:
         lines += [
             "| serve run | requests | tokens/s | decode ticks "

@@ -50,11 +50,20 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # Host-side span (obs.trace.span): nested name and duration.
     "span": ("name", "ms"),
     # One served request (serve/engine.py): latency from arrival to
-    # first token (ttft_ms) and to completion (latency_ms).
+    # first token (ttft_ms) and to completion (latency_ms). Aborted
+    # requests carry null where the moment never happened; "status" is
+    # the terminal status (finished/expired/cancelled/rejected/failed)
+    # — absent in pre-ISSUE-4 records, treated as "finished".
     "request": ("id", "mode", "prompt_tokens", "output_tokens",
                 "ttft_ms", "latency_ms"),
     # One serving-bench run summary per scheduler mode (serve/bench.py).
     "serve": ("mode", "requests", "tokens_per_s"),
+    # One fault-domain occurrence (faults.py / trainers / serve engine):
+    # injected faults (kind="injected_*"), supervisor restarts, NaN-guard
+    # actions (nonfinite_step / nan_restore), checkpoint fallbacks,
+    # request aborts/rejections, watchdog breaches. Free-form beyond
+    # "kind" — the robustness table aggregates by kind.
+    "fault": ("kind",),
 }
 
 

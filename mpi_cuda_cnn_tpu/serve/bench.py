@@ -27,11 +27,14 @@ import numpy as np
 
 
 def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
-                  out_min: int, out_max: int, rate: float, seed: int):
+                  out_min: int, out_max: int, rate: float, seed: int,
+                  deadline_s: float = 0.0):
     """n seeded requests: uniform prompt/output lengths in the given
     ranges, Poisson arrivals at `rate` req/s (exponential gaps; rate 0
-    = everything arrives at t=0). Regenerating with the same seed gives
-    an identical workload — the cross-mode comparison contract."""
+    = everything arrives at t=0). deadline_s > 0 gives every request an
+    absolute deadline of arrival + deadline_s. Regenerating with the
+    same seed gives an identical workload — the cross-mode comparison
+    contract."""
     from .scheduler import Request
 
     rng = np.random.default_rng(seed)
@@ -44,7 +47,9 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
         olen = int(rng.integers(out_min, out_max + 1))
         prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
         reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=olen,
-                            arrival=t))
+                            arrival=t,
+                            deadline=t + deadline_s if deadline_s > 0
+                            else None))
     return reqs
 
 
@@ -83,6 +88,22 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "t=0: the pure-throughput comparison)")
     ap.add_argument("--mode", default="both",
                     choices=["both", "static", "continuous"])
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request deadline (arrival + this many ms; "
+                         "0 = none): expired queued requests are "
+                         "dropped, in-flight ones aborted with their "
+                         "pages returned")
+    ap.add_argument("--max-queue", type=int, default=0,
+                    help="bound on ARRIVED-but-waiting requests; "
+                         "arrivals past it are rejected with a terminal "
+                         "status (backpressure; 0 = unbounded)")
+    ap.add_argument("--watchdog-ms", type=float, default=0.0,
+                    help="tick watchdog: count + record engine "
+                         "iterations slower than this (0 = off)")
+    ap.add_argument("--fault-plan", default=None,
+                    help="deterministic fault injection, e.g. "
+                         "'squeeze@serve.tick:5?pages=4&ticks=8;"
+                         "slow@serve.tick:9?s=0.2' (faults.parse_plan)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-jsonl", default=None,
                     help="append per-request obs records here")
@@ -126,20 +147,36 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         n=args.requests, vocab=args.vocab, prompt_min=args.prompt_min,
         prompt_max=args.prompt_max, out_min=args.out_min,
         out_max=args.out_max, rate=args.rate, seed=args.seed,
+        deadline_s=args.deadline_ms / 1e3,
+    )
+    run_kw = dict(
+        max_queue=args.max_queue or None,
+        watchdog_s=args.watchdog_ms / 1e3,
     )
     summaries = {}
     with MetricsLogger(path=args.metrics_jsonl, echo=False) as metrics:
         # Warm both compiled programs (engine-level: the same two serve
         # every mode) on one throwaway request, so no mode pays
         # compilation inside its latencies.
-        engine.run(make_workload(**{**workload_kw, "n": 1, "rate": 0.0}),
+        engine.run(make_workload(**{**workload_kw, "n": 1, "rate": 0.0,
+                                    "deadline_s": 0.0}),
                    mode=modes[0])
         for mode in modes:
-            result = engine.run(make_workload(**workload_kw), mode=mode)
+            faults = None
+            if args.fault_plan:
+                # Fresh injector per mode: both modes see the identical
+                # fault schedule (the cross-mode comparison contract).
+                from ..faults import FaultInjector
+
+                faults = FaultInjector(args.fault_plan)
+            result = engine.run(make_workload(**workload_kw), mode=mode,
+                                faults=faults, **run_kw)
             s = result.summary()
             summaries[mode] = s
             for rec in result.request_records():
                 metrics.log("request", **rec)
+            for ev in result.events:
+                metrics.log("fault", **{"mode": mode, **ev})
             metrics.log("serve", **{
                 "bench": "serve", "backend": jax.default_backend(),
                 "cache_dtype": args.cache_dtype, "rate": args.rate,

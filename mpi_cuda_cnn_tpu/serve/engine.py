@@ -19,12 +19,23 @@ Sampling is greedy — the serving benches measure schedule/memory
 effects, and greedy keeps static-vs-continuous token streams bitwise
 comparable per request.
 
-The host loop (`run`) is one scheduler iteration per pass: admit ->
-at most one prefill chunk -> one decode tick over every decoding slot.
+The host loop (`run`) is one scheduler iteration per pass: sweep
+deadlines/cancellations -> enforce the queue bound -> admit -> at most
+one prefill chunk -> one decode tick over every decoding slot.
 Interleaving the single chunk between ticks bounds how long a long
 prompt can stall token emission for in-flight sequences (the Orca
 iteration-level property); `decode_ticks`/`prefill_chunks` counts are
 the deterministic cost model the CPU tests compare schedulers on.
+
+Failure-awareness (ISSUE 4): `run` accepts a faults.FaultInjector whose
+"serve.tick" site can squeeze the page pool (steal pages for a window
+of ticks) or stall a tick; a tick watchdog counts iterations slower
+than `watchdog_s`; every abort, rejection, expiry, injected fault, and
+watchdog breach lands in `ServeResult.events` (obs `fault` records —
+serve/bench.py writes them to the JSONL sink). Every submitted request
+leaves with a terminal status; aborted slots return their pages through
+the ownership-checked PagePool.free, and the pool invariant is checked
+every iteration.
 """
 
 from __future__ import annotations
@@ -48,8 +59,10 @@ from .scheduler import ContinuousScheduler, Request, StaticScheduler
 
 @dataclasses.dataclass
 class ServeResult:
-    """One engine run: the finished requests (with their timestamps
-    filled in) plus the aggregate counters the bench reports."""
+    """One engine run: every submitted request in a terminal status
+    (with its timestamps filled in) plus the aggregate counters the
+    bench reports. `requests` includes aborted ones — filter by
+    `status` or use `finished_requests`."""
 
     mode: str
     requests: list[Request]
@@ -57,39 +70,59 @@ class ServeResult:
     prefill_chunks: int
     preemptions: int
     duration_s: float
+    events: list[dict] = dataclasses.field(default_factory=list)
+    watchdog_slow_ticks: int = 0
+
+    @property
+    def finished_requests(self) -> list[Request]:
+        return [r for r in self.requests if r.status == "finished"]
 
     @property
     def output_tokens(self) -> int:
+        # Tokens emitted before an abort were still served.
         return sum(len(r.out) for r in self.requests)
 
     @property
     def tokens_per_s(self) -> float:
         return self.output_tokens / max(self.duration_s, 1e-9)
 
+    def status_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for r in self.requests:
+            counts[r.status] = counts.get(r.status, 0) + 1
+        return counts
+
     def ttft_ms(self) -> list[float]:
         return [1e3 * (r.first_token_at - r.arrival)
-                for r in self.requests]
+                for r in self.finished_requests]
 
     def tpot_ms(self) -> list[float]:
         """Per-output-token latency (time-per-output-token) after the
-        first token, per request; requests with one token report 0."""
+        first token, per finished request; requests with one token
+        report 0."""
         return [
             1e3 * (r.finished_at - r.first_token_at) / max(len(r.out) - 1, 1)
-            for r in self.requests
+            for r in self.finished_requests
         ]
 
     def request_records(self) -> list[dict]:
         """Per-request field dicts in the obs `request` event shape
-        (the caller stamps them through MetricsLogger/make_record)."""
+        (the caller stamps them through MetricsLogger/make_record).
+        Aborted requests carry null latencies where the moment never
+        happened (no first token -> ttft_ms null)."""
         return [
             {
                 "id": r.rid,
                 "mode": self.mode,
+                "status": r.status,
                 "prompt_tokens": int(r.prompt.size),
                 "output_tokens": len(r.out),
-                "ttft_ms": round(1e3 * (r.first_token_at - r.arrival), 3),
-                "latency_ms": round(1e3 * (r.finished_at - r.arrival), 3),
+                "ttft_ms": (None if r.first_token_at is None
+                            else round(1e3 * (r.first_token_at - r.arrival), 3)),
+                "latency_ms": (None if r.finished_at is None
+                               else round(1e3 * (r.finished_at - r.arrival), 3)),
                 "preemptions": r.preemptions,
+                **({"reason": r.fail_reason} if r.fail_reason else {}),
             }
             for r in sorted(self.requests, key=lambda r: r.rid)
         ]
@@ -104,10 +137,12 @@ class ServeResult:
         return {
             "mode": self.mode,
             "requests": len(self.requests),
+            "statuses": self.status_counts(),
             "output_tokens": self.output_tokens,
             "decode_ticks": self.decode_ticks,
             "prefill_chunks": self.prefill_chunks,
             "preemptions": self.preemptions,
+            "watchdog_slow_ticks": self.watchdog_slow_ticks,
             "duration_s": round(self.duration_s, 4),
             "tokens_per_s": round(self.tokens_per_s, 2),
             "ttft_p50_ms": pct_nearest(ttft, 50),
@@ -188,32 +223,78 @@ class PagedEngine:
             req.first_token_at = now
 
     def run(self, requests: list[Request], *, mode: str = "continuous",
-            time_fn=time.perf_counter) -> ServeResult:
-        """Serve `requests` to completion and return the ServeResult.
+            time_fn=time.perf_counter, faults=None, max_queue: int | None = None,
+            watchdog_s: float = 0.0, sleep_fn=time.sleep) -> ServeResult:
+        """Serve `requests` to a terminal status each; return ServeResult.
 
-        Requests are mutated in place (out/timestamps); arrivals are
-        seconds relative to run start — the loop idles (sleeps) until
-        the next arrival when there is nothing admitted to work on.
+        Requests are mutated in place (out/timestamps/status); arrivals
+        and deadlines are seconds relative to run start on `time_fn`'s
+        clock — the loop idles (sleep_fn) until the next arrival when
+        there is nothing admitted to work on. `faults` injects
+        squeeze/slow faults at the "serve.tick" site (tick value = the
+        iteration index); watchdog_s > 0 counts iterations slower than
+        that budget. Deterministic tests drive time_fn/sleep_fn with a
+        faults.FakeClock.
         """
         if mode == "continuous":
             sched = ContinuousScheduler(
                 slots=self.slots, pool=PagePool(self.num_pages),
                 page_size=self.page_size, max_len=self.max_len,
+                max_queue=max_queue,
             )
         elif mode == "static":
             sched = StaticScheduler(
                 slots=self.slots, pool=PagePool(self.num_pages),
                 page_size=self.page_size, max_len=self.max_len,
+                max_queue=max_queue,
             )
         else:
             raise ValueError(f"mode {mode!r}: want 'continuous' or 'static'")
         sched.submit(requests)
         n_reqs = sched.unfinished
         decode_ticks = prefill_chunks = 0
+        events: list[dict] = []
+        failed_logged: set[int] = set()  # rids with a request_failed event
+        watchdog_slow = 0
+        squeezes: list[dict] = []  # {"pages": [...], "until": tick}
+        tick_idx = 0
         t0 = time_fn()
         while sched.unfinished:
+            iter_t0 = time_fn()
+            if faults is not None:
+                for f in faults.fire("serve.tick", tick_idx):
+                    if f.kind == "squeeze":
+                        # Steal up to `pages` pages for `ticks` ticks —
+                        # ownership-checked like any sequence's, so the
+                        # end-of-run pool invariant still proves zero
+                        # leaks with faults active.
+                        want = int(f.arg("pages", 1))
+                        got = sched.pool.try_alloc(
+                            min(want, sched.pool.free_pages),
+                            f"_fault_squeeze_{tick_idx}",
+                        ) or []
+                        squeezes.append({
+                            "pages": got,
+                            "owner": f"_fault_squeeze_{tick_idx}",
+                            "until": tick_idx + int(f.arg("ticks", 1)),
+                        })
+                    elif f.kind == "slow":
+                        faults.sleep(float(f.arg("s", 0.05)))
+                events.extend(faults.drain_events())
+            for sq in [s for s in squeezes if s["until"] <= tick_idx]:
+                if sq["pages"]:
+                    sched.pool.free(sq["pages"], sq["owner"])
+                squeezes.remove(sq)
             now = time_fn() - t0
+            for r in sched.sweep(now):
+                events.append({"kind": f"request_{r.status}", "id": r.rid,
+                               "mode": mode, "t_rel": round(now, 4)})
             sched.admit(now)
+            # Backpressure AFTER admission: the bound applies to what
+            # remains waiting once free slots have been filled.
+            for r in sched.enforce_queue_bound(now):
+                events.append({"kind": "request_rejected", "id": r.rid,
+                               "mode": mode, "t_rel": round(now, 4)})
             progressed = False
 
             # At most ONE prefill chunk per iteration: long prompts
@@ -246,7 +327,14 @@ class PagedEngine:
                                                     ContinuousScheduler):
                         sched.finish(slot, time_fn() - t0)
 
-            dslots = sched.grow_for_decode()
+            dslots = sched.grow_for_decode(time_fn() - t0)
+            for r in sched.dropped:
+                # admit/grow_for_decode may have failed a livelocked
+                # request; log each rid once.
+                if r.status == "failed" and r.rid not in failed_logged:
+                    failed_logged.add(r.rid)
+                    events.append({"kind": "request_failed", "id": r.rid,
+                                   "mode": mode, "reason": r.fail_reason})
             if dslots:
                 toks = np.zeros((self.slots,), np.int32)
                 pos = np.zeros((self.slots,), np.int32)
@@ -276,26 +364,57 @@ class PagedEngine:
                 sched.drain(time_fn() - t0)
                 progressed = True
 
-            if not progressed:
-                nxt_arrival = sched.next_arrival()
-                if nxt_arrival is None:
-                    raise RuntimeError("scheduler stalled with no queue")
-                if nxt_arrival <= now:
-                    raise RuntimeError(
-                        f"request {sched.queue[0].rid} cannot be admitted "
-                        f"into an idle engine — page pool ({self.num_pages}"
-                        f" pages of {self.page_size}) too small"
-                    )
-                time.sleep(min(nxt_arrival - now, 0.05))
-            sched.pool.check()
+            # Watchdog window closes HERE: the idle branch below sleeps
+            # on purpose (waiting for the next arrival / a squeeze to
+            # lift), and counting that wait would turn every sparse
+            # workload into a stream of false slow-tick alarms.
+            busy_s = time_fn() - iter_t0
 
-        if len(sched.finished) != n_reqs:
+            if not progressed and sched.unfinished:
+                nxt_arrival = sched.next_arrival()
+                now = time_fn() - t0
+                if squeezes:
+                    # An injected squeeze holds the pages the next step
+                    # needs (admission or decode growth): idle one tick
+                    # until the squeeze lifts.
+                    sleep_fn(0.001)
+                elif nxt_arrival is None:
+                    raise RuntimeError("scheduler stalled with no queue")
+                elif nxt_arrival <= now:
+                    raise RuntimeError(
+                        f"request {sched.queue[0].rid} cannot be "
+                        f"admitted into an idle engine — page pool "
+                        f"({self.num_pages} pages of {self.page_size})"
+                        " too small"
+                    )
+                else:
+                    sleep_fn(min(nxt_arrival - now, 0.05))
+            if watchdog_s > 0 and busy_s > watchdog_s:
+                watchdog_slow += 1
+                events.append({
+                    "kind": "watchdog_slow_tick", "tick": tick_idx,
+                    "mode": mode, "seconds": round(busy_s, 4),
+                })
+            sched.pool.check()
+            tick_idx += 1
+
+        # Release any squeeze that outlived the workload, then prove the
+        # pool clean: zero leaked, zero double-booked pages — with or
+        # without faults.
+        for sq in squeezes:
+            if sq["pages"]:
+                sched.pool.free(sq["pages"], sq["owner"])
+        sched.pool.check()
+        terminal = sched.finished + sched.dropped
+        if len(terminal) != n_reqs:
             raise RuntimeError(
-                f"run lost requests: {len(sched.finished)} of {n_reqs}"
+                f"run lost requests: {len(terminal)} of {n_reqs} reached "
+                "a terminal status"
             )
         assert sched.pool.free_pages == sched.pool.usable, "pages leaked"
         return ServeResult(
-            mode=mode, requests=sched.finished, decode_ticks=decode_ticks,
+            mode=mode, requests=terminal, decode_ticks=decode_ticks,
             prefill_chunks=prefill_chunks, preemptions=sched.preemptions,
-            duration_s=time_fn() - t0,
+            duration_s=time_fn() - t0, events=events,
+            watchdog_slow_ticks=watchdog_slow,
         )

@@ -23,6 +23,18 @@ head, and readmission recomputes the grown context via the normal
 chunked prefill (recompute-style preemption: pages-over-wire swapping
 has nowhere to go on one chip). Emitted tokens stay emitted; TTFT is
 unaffected; only tail latency pays.
+
+Failure-awareness (ISSUE 4): every request carries a terminal `status`.
+Orca assumes requests can be aborted mid-flight — here that is real:
+per-request deadlines and client cancellation abort queued AND in-flight
+requests (`sweep()` — pages ownership-checked back into the pool), a
+bounded admission queue rejects arrivals past `max_queue` (backpressure
+instead of unbounded memory), admission refuses requests whose prompt
+alone exceeds the pool (they could only ever preempt-loop), and a
+request whose GROWN context can never fit is failed with a terminal
+status instead of being requeued forever (the preemption-livelock
+guard). Elastic-serving systems (Varuna, Athlur et al., EuroSys '22)
+treat this abort/resume traffic as the steady state, not the exception.
 """
 
 from __future__ import annotations
@@ -35,18 +47,28 @@ import numpy as np
 
 from .paged_cache import PagePool, pages_for
 
+# A request leaves the system in exactly one of these states.
+TERMINAL_STATUSES = ("finished", "expired", "cancelled", "rejected", "failed")
+
 
 @dataclasses.dataclass
 class Request:
     """One serving request plus its runtime bookkeeping. `prompt` is a
     1-D int32 array; `out` accumulates emitted tokens (they survive
-    preemption — recompute re-prefills prompt + out)."""
+    preemption — recompute re-prefills prompt + out). `deadline` is an
+    absolute time on the engine's clock (same timeline as `arrival`);
+    past it the request is dropped/aborted with status "expired".
+    `cancel()` requests client-side abort at the next tick boundary."""
 
     rid: int
     prompt: np.ndarray
     max_new_tokens: int
     arrival: float = 0.0
+    deadline: float | None = None
     out: list[int] = dataclasses.field(default_factory=list)
+    status: str = "queued"
+    fail_reason: str | None = None
+    cancel_requested: bool = False
     admitted_at: float | None = None
     first_token_at: float | None = None
     finished_at: float | None = None
@@ -66,6 +88,18 @@ class Request:
     @property
     def done(self) -> bool:
         return len(self.out) >= self.max_new_tokens
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in TERMINAL_STATUSES
+
+    def cancel(self) -> None:
+        """Client cancellation: the scheduler aborts the request at the
+        next sweep (queued: dropped; in-flight: slot + pages released)."""
+        self.cancel_requested = True
+
+    def expired_by(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
 
 
 @dataclasses.dataclass
@@ -98,19 +132,34 @@ class Slot:
 
 class _SchedulerBase:
     def __init__(self, *, slots: int, pool: PagePool, page_size: int,
-                 max_len: int):
+                 max_len: int, max_queue: int | None = None):
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.slots = [Slot(i) for i in range(slots)]
         self.pool = pool
         self.page_size = page_size
         self.max_len = max_len
+        self.max_queue = max_queue
         self.queue: deque[Request] = deque()
         self.finished: list[Request] = []
+        # Terminal non-finished requests (expired/cancelled/rejected/
+        # failed) — with `finished`, every submitted request lands in
+        # exactly one of the two lists.
+        self.dropped: list[Request] = []
         self.preemptions = 0
         self._admit_seq = 0
 
     def submit(self, requests: Iterable[Request]) -> None:
+        """Enqueue requests (FCFS by arrival). Structurally impossible
+        requests raise ValueError at submission — a clear error beats a
+        request that can only ever preempt-loop:
+
+        - prompt + max_new_tokens past max_len (block table can't hold it)
+        - a prompt alone needing more pages than the pool owns (it could
+          never be admitted, let alone decode)
+        """
         reqs = sorted(requests, key=lambda r: (r.arrival, r.rid))
         for r in reqs:
             total = r.prompt.size + r.max_new_tokens
@@ -118,6 +167,13 @@ class _SchedulerBase:
                 raise ValueError(
                     f"request {r.rid}: prompt {r.prompt.size} + "
                     f"{r.max_new_tokens} new exceeds max_len {self.max_len}"
+                )
+            if pages_for(r.prompt.size + 1, self.page_size) > self.pool.usable:
+                raise ValueError(
+                    f"request {r.rid}: prompt of {r.prompt.size} tokens "
+                    f"needs {pages_for(r.prompt.size + 1, self.page_size)} "
+                    f"pages but the pool owns {self.pool.usable} — it can "
+                    "never be admitted (size the pool or shrink the prompt)"
                 )
             self.queue.append(r)
 
@@ -131,8 +187,11 @@ class _SchedulerBase:
     def prefill_slot(self) -> Slot | None:
         """The earliest-admitted slot still prefilling (FCFS: one
         sequence's prompt finishes before the next's starts, so TTFT
-        ordering follows admission ordering)."""
-        cands = [s for s in self.slots if s.prefilling]
+        ordering follows admission ordering). Aborted requests whose
+        slot is still held (static's reserve-until-drain) never
+        prefill."""
+        cands = [s for s in self.slots
+                 if s.prefilling and not s.req.terminal]
         return min(cands, key=lambda s: s.admit_seq, default=None)
 
     def decode_slots(self) -> list[Slot]:
@@ -146,6 +205,7 @@ class _SchedulerBase:
         slot.target = req.context_len
         slot.admit_seq = self._admit_seq
         self._admit_seq += 1
+        req.status = "running"
         if req.admitted_at is None:
             req.admitted_at = now
 
@@ -159,9 +219,84 @@ class _SchedulerBase:
         slot.admit_seq = -1
 
     def finish(self, slot: Slot, now: float) -> None:
+        slot.req.status = "finished"
         slot.req.finished_at = now
         self.finished.append(slot.req)
         self._release(slot)
+
+    def _drop(self, req: Request, status: str, now: float,
+              reason: str | None = None) -> Request:
+        req.status = status
+        req.fail_reason = reason
+        req.finished_at = now
+        self.dropped.append(req)
+        return req
+
+    # Whether sweep() releases an in-flight aborted request's slot and
+    # pages immediately (continuous) or holds the reservation until the
+    # batch drains (static — the reserve-until-drain discipline; the
+    # aborted row just stops decoding).
+    release_on_abort = True
+
+    def sweep(self, now: float) -> list[Request]:
+        """Abort expired and cancelled requests, queued AND in-flight.
+
+        Queued ones are dropped before ever holding a page; in-flight
+        ones have their slot aborted and (under continuous batching)
+        their pages ownership-checked back into the pool. Returns the
+        requests dropped by THIS call, for event logging."""
+        dropped = []
+        kept: deque[Request] = deque()
+        for r in self.queue:
+            if r.cancel_requested:
+                dropped.append(self._drop(r, "cancelled", now))
+            elif r.expired_by(now):
+                dropped.append(self._drop(r, "expired", now, "deadline"))
+            else:
+                kept.append(r)
+        self.queue = kept
+        for slot in self.slots:
+            if slot.free or slot.req.terminal:
+                continue  # terminal slot awaiting static drain
+            r = slot.req
+            status = ("cancelled" if r.cancel_requested
+                      else "expired" if r.expired_by(now) else None)
+            if status is None:
+                continue
+            dropped.append(self._drop(r, status, now,
+                                      None if status == "cancelled"
+                                      else "deadline"))
+            if self.release_on_abort:
+                self._release(slot)
+        return dropped
+
+    def enforce_queue_bound(self, now: float) -> list[Request]:
+        """Backpressure: keep at most max_queue ARRIVED requests waiting;
+        later arrivals beyond the bound are rejected with a terminal
+        status (explicit rejection instead of unbounded queue memory).
+        Returns the requests rejected by this call.
+
+        Only NEVER-ADMITTED requests count toward (and can be evicted
+        by) the bound: a preempted request back in the queue is not an
+        arrival — rejecting it would silently drop work the engine
+        already served tokens for."""
+        if self.max_queue is None:
+            return []
+        arrived = [r for r in self.queue
+                   if r.arrival <= now and r.admitted_at is None]
+        excess = len(arrived) - self.max_queue
+        if excess <= 0:
+            return []
+        victims = set(id(r) for r in arrived[-excess:])
+        rejected = []
+        kept: deque[Request] = deque()
+        for r in self.queue:
+            if id(r) in victims:
+                rejected.append(self._drop(r, "rejected", now, "queue full"))
+            else:
+                kept.append(r)
+        self.queue = kept
+        return rejected
 
 
 class ContinuousScheduler(_SchedulerBase):
@@ -173,7 +308,10 @@ class ContinuousScheduler(_SchedulerBase):
         whole prefill extent AND its first decode row (so an admission
         can never preempt an existing sequence on its very first decode
         token). Head-of-line FCFS: if the head doesn't fit, nothing
-        behind it jumps ahead."""
+        behind it jumps ahead — except a head whose grown context can
+        NEVER fit the pool (a preempted-and-requeued request that kept
+        generating): that one is failed terminally, the livelock guard's
+        admission half."""
         bound = []
         for slot in self.slots:
             if not slot.free or not self.queue:
@@ -181,8 +319,17 @@ class ContinuousScheduler(_SchedulerBase):
             req = self.queue[0]
             if req.arrival > now:
                 break
-            if pages_for(req.context_len + 1,
-                         self.page_size) > self.pool.free_pages:
+            need = pages_for(req.context_len + 1, self.page_size)
+            if need > self.pool.usable:
+                # Livelock guard: no sequence of preemptions can ever
+                # free enough pages — requeueing forever would starve
+                # the head-of-line forever. Terminal failure.
+                self.queue.popleft()
+                self._drop(req, "failed", now,
+                           f"context of {req.context_len} tokens needs "
+                           f"{need} pages; pool owns {self.pool.usable}")
+                continue
+            if need > self.pool.free_pages:
                 break
             pages = self.pool.try_alloc(
                 pages_for(req.context_len, self.page_size), req.rid
@@ -200,18 +347,23 @@ class ContinuousScheduler(_SchedulerBase):
         req = slot.req
         req.preemptions += 1
         self.preemptions += 1
+        req.status = "queued"
         self.queue.appendleft(req)
         self._release(slot)
 
-    def grow_for_decode(self) -> list[Slot]:
+    def grow_for_decode(self, now: float = 0.0) -> list[Slot]:
         """Give every decoding slot the page its next cache row needs,
         preempting latest-admitted sequences while the pool is dry.
         Returns the decoding slots that survived, oldest-first (the
-        engine's tick order)."""
+        engine's tick order). A slot that is dry and ALONE can never
+        grow — no victim remains — so its request is failed terminally
+        (the livelock guard's decode half) instead of raising: the
+        engine keeps serving everything else."""
         survivors = []
         for slot in sorted(self.decode_slots(), key=lambda s: s.admit_seq):
             if slot.free or not slot.decoding:
                 continue  # preempted by an earlier iteration below
+            stalled = False
             while slot.pages and len(slot.pages) * self.page_size <= slot.cached:
                 got = self.pool.try_alloc(1, slot.req.rid)
                 if got is not None:
@@ -220,14 +372,27 @@ class ContinuousScheduler(_SchedulerBase):
                 victims = [s for s in self.slots if not s.free]
                 victim = max(victims, key=lambda s: s.admit_seq)
                 if victim is slot and len(victims) == 1:
-                    raise RuntimeError(
-                        f"page pool ({self.pool.num_pages} pages of "
-                        f"{self.page_size}) cannot hold request "
-                        f"{slot.req.rid} alone — size the pool for at "
-                        "least one max_len sequence"
-                    )
+                    req = slot.req
+                    if pages_for(slot.cached + 1,
+                                 self.page_size) > self.pool.usable:
+                        # STRUCTURALLY impossible: even owning every
+                        # usable page it could not hold the next row.
+                        self._drop(
+                            req, "failed", now,
+                            f"context of {req.context_len} tokens cannot "
+                            f"fit the pool ({self.pool.usable} usable "
+                            f"pages of {self.page_size}) even alone",
+                        )
+                        self._release(slot)
+                    else:
+                        # Transiently dry (e.g. an injected squeeze or a
+                        # concurrent prefill holds pages): sit out this
+                        # tick — writing without the page would land in
+                        # the scratch page and corrupt the read mask.
+                        stalled = True
+                    break
                 self.preempt(victim)
-            if not slot.free and slot.decoding:
+            if not stalled and not slot.free and slot.decoding:
                 survivors.append(slot)
         return survivors
 
@@ -238,7 +403,11 @@ class StaticScheduler(_SchedulerBase):
     worst-case page extent up front (the contiguous cache's reservation
     discipline, expressed in pages — what makes the tick/latency
     comparison against ContinuousScheduler apples-to-apples), never
-    preempt, and hold every slot until the whole batch drains."""
+    preempt, and hold every slot until the whole batch drains. Aborted
+    (expired/cancelled) in-flight rows keep their reservation until the
+    drain — they only stop decoding."""
+
+    release_on_abort = False
 
     def admit(self, now: float) -> list[Slot]:
         if any(not s.free for s in self.slots):
@@ -252,34 +421,43 @@ class StaticScheduler(_SchedulerBase):
             # token (which is never written back).
             need = pages_for(req.context_len + req.max_new_tokens - 1,
                              self.page_size)
+            if need > self.pool.usable:
+                # Even an empty pool could never reserve it: terminal
+                # failure (static's livelock-guard analog).
+                self.queue.popleft()
+                self._drop(req, "failed", now,
+                           f"worst-case extent of {need} pages exceeds "
+                           f"the pool's {self.pool.usable}")
+                continue
             pages = self.pool.try_alloc(need, req.rid)
             if pages is None:
-                if not bound:
-                    raise RuntimeError(
-                        f"page pool ({self.pool.num_pages} pages) cannot "
-                        f"hold request {req.rid}'s worst case — static "
-                        "batching reserves max extent up front"
-                    )
                 break
             self.queue.popleft()
             self._bind(slot, req, pages, now)
             bound.append(slot)
         return bound
 
-    def grow_for_decode(self) -> list[Slot]:
+    def grow_for_decode(self, now: float = 0.0) -> list[Slot]:
         """No growth, no preemption — pages were reserved at admission.
-        Decoding slots whose request is already done still HOLD their
-        slot and pages (the batch drains as one); the engine keeps
-        them out of the tick's valid mask."""
-        return [s for s in self.decode_slots() if not s.req.done]
+        Decoding slots whose request is already done (or aborted) still
+        HOLD their slot and pages (the batch drains as one); the engine
+        keeps them out of the tick's valid mask."""
+        return [s for s in self.decode_slots()
+                if not s.req.done and not s.req.terminal]
 
     def batch_done(self) -> bool:
         occupied = [s for s in self.slots if not s.free]
         return bool(occupied) and all(
-            s.req.done and s.decoding for s in occupied
+            s.req.terminal or (s.req.done and s.decoding) for s in occupied
         )
 
     def drain(self, now: float) -> None:
         for slot in self.slots:
-            if not slot.free:
+            if slot.free:
+                continue
+            if slot.req.terminal:
+                # Aborted mid-batch: already in `dropped`, only the
+                # reservation remained.
+                self._release(slot)
+            else:
                 self.finish(slot, now)

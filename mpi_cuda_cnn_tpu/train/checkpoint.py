@@ -6,18 +6,35 @@ This module provides the missing capability as flat `.npz` archives: the
 state pytree is flattened with `jax.tree_util` key paths as array names, so
 checkpoints are a stable, inspectable format independent of Python pickling
 (and of this framework — `np.load` reads them anywhere).
+
+Crash safety (ISSUE 4): every file lands via tmp-write + atomic rename
+(the npz AND the manifest — a crash mid-write can poison neither), the
+manifest records a per-array crc32 for each live checkpoint,
+`restore_checkpoint` verifies those checksums (raising
+CheckpointCorruptError on mismatch), and `restore_latest` walks the
+checkpoint list newest-first, falling back past corrupt or truncated
+files to the newest one that verifies. A missing or unparsable manifest
+degrades to the `ckpt_*.npz` glob with verification skipped — an old or
+half-written manifest can never block a restore.
 """
 
 from __future__ import annotations
 
 import json
 import re
+import zlib
 from pathlib import Path
 
 import jax
 import numpy as np
 
 _STEP_RE = re.compile(r"ckpt_(\d+)\.npz$")
+
+MANIFEST = "manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint's bytes do not match its manifest checksums."""
 
 
 def _flatten(state) -> dict[str, np.ndarray]:
@@ -28,8 +45,44 @@ def _flatten(state) -> dict[str, np.ndarray]:
     return flat
 
 
-def save_checkpoint(ckpt_dir: str | Path, state, step: int, *, keep: int = 3) -> Path:
-    """Write state as ckpt_{step}.npz + a small JSON manifest; prune old."""
+def _checksum(arr: np.ndarray) -> str:
+    """crc32 over the array bytes (+dtype/shape so a reinterpretation
+    can't collide). Fast enough to run on every save at LM scale —
+    integrity, not cryptography."""
+    crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+    crc = zlib.crc32(f"{arr.dtype}:{arr.shape}".encode(), crc)
+    return f"{crc:08x}"
+
+
+def _load_manifest(ckpt_dir: Path) -> dict | None:
+    """The directory manifest, or None when missing/unparsable — restore
+    falls back to the ckpt_*.npz glob either way (ISSUE 4 satellite)."""
+    path = ckpt_dir / MANIFEST
+    try:
+        mf = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    return mf if isinstance(mf, dict) else None
+
+
+def _atomic_write_text(path: Path, text: str) -> None:
+    tmp = path.parent / f".{path.name}.tmp"
+    tmp.write_text(text)
+    tmp.rename(path)
+
+
+def save_checkpoint(ckpt_dir: str | Path, state, step: int, *, keep: int = 3,
+                    faults=None) -> Path:
+    """Write state as ckpt_{step}.npz + the JSON manifest; prune old.
+
+    Both files are tmp-written then renamed: a crash at ANY point leaves
+    either the previous consistent (files, manifest) pair or the new
+    one, never a torn file under a live name. `faults` is a
+    faults.FaultInjector hook (sites "ckpt.pre_rename" — between the
+    npz tmp write and its rename — and "ckpt.manifest", before the
+    manifest update), used by the crash-during-save tests; None is a
+    no-op.
+    """
     ckpt_dir = Path(ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     flat = _flatten(jax.device_get(state))
@@ -39,12 +92,27 @@ def save_checkpoint(ckpt_dir: str | Path, state, step: int, *, keep: int = 3) ->
     # end in .npz or np.savez appends the suffix itself.
     tmp = ckpt_dir / f".ckpt_{step}.tmp.npz"
     np.savez(tmp, **flat)
+    if faults is not None:
+        faults.fire("ckpt.pre_rename", step)
     tmp.rename(path)
-    (ckpt_dir / "manifest.json").write_text(
-        json.dumps({"latest_step": step, "keys": sorted(flat)}, indent=2)
-    )
-    for p in _list_checkpoints(ckpt_dir)[:-keep]:
+    if faults is not None:
+        faults.fire("ckpt.manifest", step)
+    mf = _load_manifest(ckpt_dir) or {}
+    checksums = mf.get("checksums")
+    if not isinstance(checksums, dict):
+        checksums = {}
+    checksums[path.name] = {k: _checksum(v) for k, v in flat.items()}
+    live = _list_checkpoints(ckpt_dir)
+    for p in live[:-keep]:
         p.unlink()
+        checksums.pop(p.name, None)
+    kept = {p.name for p in live[-keep:]}
+    _atomic_write_text(ckpt_dir / MANIFEST, json.dumps({
+        "latest_step": step,
+        "keys": sorted(flat),
+        "checksums": {n: c for n, c in sorted(checksums.items())
+                      if n in kept},
+    }, indent=2))
     return path
 
 
@@ -66,9 +134,10 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, ckpt_dir: str | Path, *, keep: int = 3,
-                 async_: bool = True):
+                 async_: bool = True, faults=None):
         self.ckpt_dir = Path(ckpt_dir)
         self.keep = keep
+        self.faults = faults
         self._executor = None
         self._pending = None
         if async_:
@@ -82,12 +151,13 @@ class AsyncCheckpointer:
         """Snapshot `state` (device or host pytree) and schedule the write."""
         if self._executor is None:
             save_checkpoint(self.ckpt_dir, jax.device_get(state),
-                            step, keep=self.keep)
+                            step, keep=self.keep, faults=self.faults)
             return
         self.wait()  # drain (and re-raise from) any in-flight write
         host = jax.device_get(state)
         self._pending = self._executor.submit(
-            save_checkpoint, self.ckpt_dir, host, step, keep=self.keep
+            save_checkpoint, self.ckpt_dir, host, step, keep=self.keep,
+            faults=self.faults,
         )
 
     def wait(self) -> None:
@@ -154,22 +224,79 @@ def latest_checkpoint(ckpt_dir: str | Path) -> Path | None:
     return ckpts[-1] if ckpts else None
 
 
-def restore_checkpoint(path: str | Path, state_template):
+def restore_checkpoint(path: str | Path, state_template, *,
+                       verify: bool = True):
     """Restore into the structure of state_template (same pytree as saved).
 
     The template supplies the pytree structure; arrays come from the
     archive. Missing or extra keys raise — a resume must be exact.
+    verify=True checks each array against the manifest's crc32s when the
+    manifest records this file (CheckpointCorruptError on mismatch); a
+    missing/unparsable manifest, or one without this file's entry, skips
+    verification rather than blocking the restore.
     """
-    archive = np.load(Path(path))
+    path = Path(path)
+    try:
+        archive = np.load(path)
+    except ValueError as e:
+        # np.load reports unrecognized bytes as ValueError ("pickled
+        # data"); keep plain ValueError for STRUCTURE mismatches below —
+        # those are config bugs, this is corruption.
+        raise CheckpointCorruptError(
+            f"{path.name}: unreadable archive: {e}"
+        ) from e
     flat_template = _flatten(state_template)
     if set(archive.files) != set(flat_template):
         missing = set(flat_template) - set(archive.files)
         extra = set(archive.files) - set(flat_template)
         raise ValueError(f"checkpoint mismatch: missing={missing} extra={extra}")
+    sums = None
+    if verify:
+        mf = _load_manifest(path.parent)
+        if mf is not None:
+            entry = mf.get("checksums", {})
+            sums = entry.get(path.name) if isinstance(entry, dict) else None
     leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(state_template)
     new_leaves = []
     for path_keys, leaf in leaves_paths:
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path_keys)
         arr = archive[key]
+        if sums is not None and key in sums and _checksum(arr) != sums[key]:
+            raise CheckpointCorruptError(
+                f"{path.name}: array {key!r} fails its manifest checksum "
+                "— the file is corrupt"
+            )
         new_leaves.append(np.asarray(arr, dtype=np.asarray(leaf).dtype))
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def restore_latest(ckpt_dir: str | Path, state_template, *, logger=None,
+                   metrics=None):
+    """Restore the newest checkpoint that verifies, falling back past
+    corrupt/truncated files to older ones.
+
+    Returns (state, path) or (None, None) when no checkpoint restores.
+    Structure mismatches (ValueError) propagate — those are config bugs,
+    not corruption; corruption-class failures (checksum mismatch, a
+    torn/unreadable archive) log a warning, emit a ``fault`` obs event
+    when a metrics sink is given, and move on to the previous file.
+    """
+    import zipfile
+
+    ckpt_dir = Path(ckpt_dir)
+    if not ckpt_dir.is_dir():
+        return None, None
+    for path in reversed(_list_checkpoints(ckpt_dir)):
+        try:
+            return restore_checkpoint(path, state_template), path
+        except (CheckpointCorruptError, zipfile.BadZipFile, OSError,
+                EOFError, KeyError) as e:
+            if logger is not None:
+                logger.warning(
+                    "checkpoint %s is corrupt (%s: %s); falling back to "
+                    "the previous one", path.name, type(e).__name__, e,
+                )
+            if metrics is not None:
+                metrics.log("fault", kind="ckpt_fallback", path=path.name,
+                            error=f"{type(e).__name__}: {e}")
+    return None, None

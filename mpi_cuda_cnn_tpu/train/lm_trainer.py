@@ -28,14 +28,18 @@ from ..obs.trace import span
 from ..parallel.dp import replicate
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, make_mesh
 from ..parallel.sp import SEQ_AXIS, make_sp_lm_train_step
+from ..faults import (
+    MAX_NAN_ROLLBACKS,
+    NanGuard,
+    NonFiniteLossError,
+    RollbackToCheckpoint,
+    all_finite,
+    step_is_finite,
+)
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer
 from ..utils.sync import hard_block
-from .checkpoint import (
-    AsyncCheckpointer,
-    latest_checkpoint,
-    restore_checkpoint,
-)
+from .checkpoint import AsyncCheckpointer, restore_latest
 from .lm import get_attn_fn, lm_loss, make_lm_state, make_lm_train_step, pick_attn_impl
 from .optimizer import make_optimizer
 
@@ -87,10 +91,19 @@ class LMTrainer:
     is mean NLL over deterministic windows of the held-out tail (10%).
     """
 
-    def __init__(self, cfg, *, mesh=None, metrics: MetricsLogger | None = None):
+    def __init__(self, cfg, *, mesh=None,
+                 metrics: MetricsLogger | None = None, faults=None):
         self.cfg = cfg
         self.log = get_logger()
         self.metrics = metrics or MetricsLogger()
+        # Fault hooks + NaN/Inf guard (ISSUE 4) — same contract as the
+        # CNN Trainer: `faults` is a faults.FaultInjector shared across
+        # supervisor restarts; the guard's policy rules are the shared
+        # faults.NanGuard (one implementation for both trainers).
+        self.faults = faults
+        self._nan = NanGuard(getattr(cfg, "nan_policy", "off"),
+                             getattr(cfg, "nan_max_bad", 3))
+        self._finite_fn = jax.jit(all_finite) if self._nan.active else None
 
         tokens = load_corpus(cfg.corpus)
         vocab = int(tokens.max()) + 1
@@ -525,7 +538,7 @@ class LMTrainer:
         self._eval_fn = None
         self._ckpt = (
             AsyncCheckpointer(cfg.checkpoint_dir,
-                              async_=cfg.async_checkpoint)
+                              async_=cfg.async_checkpoint, faults=faults)
             if cfg.checkpoint_dir else None
         )
 
@@ -612,14 +625,57 @@ class LMTrainer:
             p = from_tp_layout(p, self.model)
         return p
 
+    def _place_host_state(self, host_state) -> None:
+        """Install a host-side state pytree with the live shardings."""
+        shardings = jax.tree.map(lambda a: a.sharding, self.state)
+        self.state = jax.device_put(host_state, shardings)
+
+    def _drop_bad_update(self, step: int, snap) -> None:
+        """Apply --nan-policy to a non-finite step (faults.NanGuard owns
+        the rules; abort and rollback raise there). A plain skip drops
+        the update by reinstalling the pre-step snapshot with the step
+        counter ADVANCED past the dropped batch — state["step"] must
+        equal batches consumed, or a crash-restart would resume short by
+        the skipped steps (see Trainer._drop_bad_update)."""
+        self._nan.bad_step(step, logger=self.log, metrics=self.metrics)
+        snap = dict(snap)
+        snap["step"] = np.asarray(snap["step"]) + 1
+        self._place_host_state(snap)
+
+    def _rollback_to_checkpoint(self) -> int:
+        """Reload the newest valid checkpoint after a nan-policy=restore
+        rollback; returns the step to re-enter at."""
+        if self._ckpt is not None:
+            self._ckpt.wait()  # the in-flight write may be newest
+        restored, path = restore_latest(
+            self.cfg.checkpoint_dir, jax.device_get(self.state),
+            logger=self.log, metrics=self.metrics,
+        ) if self.cfg.checkpoint_dir else (None, None)
+        if restored is None:
+            raise NonFiniteLossError(
+                "nan-policy=restore: no valid checkpoint to roll "
+                "back to (set --checkpoint-dir/--checkpoint-every)"
+            )
+        self._place_host_state(restored)
+        self._nan.step_ok()
+        step0 = int(jax.device_get(self.state["step"]))
+        self.metrics.log("fault", kind="nan_restore", step=step0,
+                         path=path.name)
+        self.log.warning("nan-policy=restore: rolled back to %s (step %d)",
+                         path, step0)
+        return step0
+
     def train(self) -> LMResult:
         cfg = self.cfg
         start_step = 0
         if cfg.resume and cfg.checkpoint_dir:
-            ckpt = latest_checkpoint(cfg.checkpoint_dir)
-            if ckpt is not None:
-                host = jax.device_get(self.state)
-                restored = restore_checkpoint(ckpt, host)
+            host = jax.device_get(self.state)
+            # restore_latest verifies manifest checksums and falls back
+            # past corrupt files to the newest valid checkpoint.
+            restored, ckpt = restore_latest(cfg.checkpoint_dir, host,
+                                            logger=self.log,
+                                            metrics=self.metrics)
+            if restored is not None:
                 shardings = jax.tree.map(lambda a: a.sharding, self.state)
                 self.state = jax.device_put(restored, shardings)
                 start_step = int(jax.device_get(self.state["step"]))
@@ -633,12 +689,16 @@ class LMTrainer:
         m = None
         timer = StepTimer()
         timer.start()
+        logged_cost = False
+        rollbacks = 0
         try:
-            for step in range(start_step, cfg.steps):
+            step = start_step
+            while step < cfg.steps:
                 with timer.phase("data"):
                     tokens, targets = self._sample_batch(step)
                     tokens, targets = self._place(tokens), self._place(targets)
-                if step == start_step and self.metrics.jsonl_enabled:
+                if not logged_cost and self.metrics.jsonl_enabled:
+                    logged_cost = True
                     # exclude(): the analysis costs an AOT compile that
                     # must not land in the step-phase attribution.
                     with timer.exclude():
@@ -651,17 +711,48 @@ class LMTrainer:
                                 "obs: cost analysis unavailable for "
                                 "lm_train_step"
                             )
+                # skip/restore must drop the bad update — hold the
+                # pre-step state on host (donation consumes the buffers).
+                snap = (jax.device_get(self.state)
+                        if self._nan.snapshots else None)
                 with timer.phase("dispatch"):
                     self.state, m = self.train_step(self.state, tokens, targets)
-                if cfg.log_every and (step + 1) % cfg.log_every == 0:
-                    with timer.phase("device"):
-                        loss = float(m["loss"])
-                    self.metrics.log("train", step=step + 1, loss=loss)
+                try:
+                    if self._nan.active and not step_is_finite(
+                        m, self._finite_fn, self.state
+                    ):
+                        # Drop the update (abort/rollback raise); the
+                        # checkpoint + crash hooks below still run — a
+                        # skipped step consumed its batch, and a planned
+                        # fault at this step value must not evaporate.
+                        self._drop_bad_update(step, snap)
+                    else:
+                        self._nan.step_ok()
+                        if cfg.log_every and (step + 1) % cfg.log_every == 0:
+                            with timer.phase("device"):
+                                loss = float(m["loss"])
+                            self.metrics.log("train", step=step + 1,
+                                             loss=loss)
+                except RollbackToCheckpoint:
+                    rollbacks += 1
+                    if rollbacks > MAX_NAN_ROLLBACKS:
+                        raise NonFiniteLossError(
+                            f"nan-policy=restore: rolled back "
+                            f"{MAX_NAN_ROLLBACKS} times and the run "
+                            "still goes non-finite"
+                        ) from None
+                    step = self._rollback_to_checkpoint()
+                    continue
                 if cfg.checkpoint_dir and cfg.checkpoint_every and (
                     (step + 1) % cfg.checkpoint_every == 0
                 ):
                     with timer.phase("checkpoint"):
                         self._ckpt.save(self.state, step + 1)
+                if self.faults is not None:
+                    self.faults.fire("train.step", step + 1)
+                    for ev in self.faults.drain_events():
+                        self.metrics.log("fault", **ev)
+                step += 1
             with timer.phase("device"):
                 hard_block(self.state)
             # Exclude the obs AOT compile from the headline tokens/s —
@@ -674,6 +765,11 @@ class LMTrainer:
             # its failure re-raises (chained) — it cannot be dropped.
             if self._ckpt is not None:
                 self._ckpt.close()
+            # Flush fault events fired after the last in-loop drain
+            # (e.g. the injected crash that aborted this attempt).
+            if self.faults is not None:
+                for ev in self.faults.drain_events():
+                    self.metrics.log("fault", **ev)
         steps_run = cfg.steps - start_step
         loss = float(m["loss"]) if m is not None else loss
         timer.stop(max(steps_run, 1))

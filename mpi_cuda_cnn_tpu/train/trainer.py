@@ -49,14 +49,19 @@ from ..parallel.tp import (
 from ..obs import cost as obs_cost
 from ..obs.device import emit_step_telemetry
 from ..obs.trace import span
+from ..faults import (
+    MAX_NAN_ROLLBACKS,
+    NanGuard,
+    NonFiniteLossError,
+    RollbackToCheckpoint,
+    all_finite,
+    poison_batch,
+    step_is_finite,
+)
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer, profile_trace
 from ..utils.sync import hard_block
-from .checkpoint import (
-    AsyncCheckpointer,
-    latest_checkpoint,
-    restore_checkpoint,
-)
+from .checkpoint import AsyncCheckpointer, restore_latest
 from .optimizer import make_optimizer
 
 
@@ -97,12 +102,22 @@ class Trainer:
     train step there is.
     """
 
-    def __init__(self, model, dataset, config, *, mesh=None, metrics: MetricsLogger | None = None):
+    def __init__(self, model, dataset, config, *, mesh=None,
+                 metrics: MetricsLogger | None = None, faults=None):
         self.model = model
         self.ds = dataset
         self.cfg = config
         self.log = get_logger()
         self.metrics = metrics or MetricsLogger()
+        # Fault hooks + the NaN/Inf guard (ISSUE 4). `faults` is a
+        # faults.FaultInjector; the CLI builds one from --fault-plan and
+        # shares it across supervisor restarts (fired faults stay fired).
+        # The guard's policy rules live in faults.NanGuard — ONE
+        # implementation for this trainer and the LM's.
+        self.faults = faults
+        self._nan = NanGuard(getattr(config, "nan_policy", "off"),
+                             getattr(config, "nan_max_bad", 3))
+        self._finite_fn = jax.jit(all_finite) if self._nan.active else None
 
         ndev = config.num_devices or len(jax.devices())
         if mesh is None:
@@ -299,7 +314,8 @@ class Trainer:
         # the next steps; train() drains it before returning).
         self._ckpt = (
             AsyncCheckpointer(config.checkpoint_dir,
-                              async_=config.async_checkpoint)
+                              async_=config.async_checkpoint,
+                              faults=faults)
             if config.checkpoint_dir else None
         )
 
@@ -321,6 +337,51 @@ class Trainer:
             return
         if global_step and global_step % cfg.checkpoint_every_steps == 0:
             self._ckpt.save(self.state, global_step)
+
+    def _drain_fault_events(self) -> None:
+        """Forward the injector's fired-fault records to the obs sink."""
+        if self.faults is not None:
+            for ev in self.faults.drain_events():
+                self.metrics.log("fault", **ev)
+
+    def _drop_bad_update(self, gstep: int, snap) -> None:
+        """Apply --nan-policy to a non-finite step (faults.NanGuard owns
+        the rules; abort and rollback raise there). A plain skip drops
+        the bad update by reinstalling the pre-step snapshot — with the
+        step counter still ADVANCED past the dropped batch:
+        state["step"] must stay equal to batches CONSUMED, or a later
+        crash-restart / rollback would re-derive its resume position
+        short by the skipped steps and replay already-applied batches
+        (breaking the bitwise restart contract). An organic NaN replays
+        deterministically to the same skip, so positions stay exact."""
+        self._nan.bad_step(gstep, logger=self.log, metrics=self.metrics)
+        snap = dict(snap)
+        snap["step"] = np.asarray(snap["step"]) + 1
+        self.place_state(snap)
+
+    def _rollback_to_checkpoint(self) -> tuple[int, int]:
+        """Reload the newest valid checkpoint after a nan-policy=restore
+        rollback; returns the (epoch, skip_steps) to re-enter at."""
+        if self._ckpt is not None:
+            self._ckpt.wait()  # the in-flight write may BE the newest
+        restored, path = restore_latest(
+            self.cfg.checkpoint_dir or "", jax.device_get(self.state),
+            logger=self.log, metrics=self.metrics,
+        ) if self.cfg.checkpoint_dir else (None, None)
+        if restored is None:
+            raise NonFiniteLossError(
+                "nan-policy=restore: no valid checkpoint to roll back to "
+                "(set --checkpoint-dir and --checkpoint-every-steps)"
+            )
+        self.place_state(restored)
+        self._nan.step_ok()
+        spe = max(self.steps_per_epoch, 1)
+        step0 = self._global_step()
+        self.metrics.log("fault", kind="nan_restore", step=step0,
+                         path=path.name)
+        self.log.warning("nan-policy=restore: rolled back to %s (step %d)",
+                         path, step0)
+        return step0 // spe, step0 % spe
 
     def _maybe_log_program(self, label: str, fn, *args,
                            steps_per_dispatch: int = 1,
@@ -404,6 +465,33 @@ class Trainer:
         size. Identical math either way (test_scan_and_loop_paths_...)."""
         if not self.cfg.scan:
             return False
+        if self.faults is not None and any(
+            f.site == "train.batch" for f in self.faults.plan
+        ):
+            # A planned batch fault can only fire on the per-batch loop
+            # (the scanned epoch builds batches on device); silently
+            # no-op'ing the injection would let a chaos run believe it
+            # exercised a fault that never happened.
+            if not getattr(self, "_fault_scan_logged", False):
+                self._fault_scan_logged = True
+                self.log.warning(
+                    "fault plan targets train.batch: per-batch stepping "
+                    "(scanned epochs cannot inject batch faults)"
+                )
+            return False
+        if self._nan.active:
+            # The guard checks loss/metrics and state finiteness per
+            # STEP (skip must drop exactly the bad update); the scanned
+            # epoch dispatches many steps at once, so guarded runs step
+            # per batch. Robustness mode trades throughput knowingly.
+            if not getattr(self, "_nan_scan_logged", False):
+                self._nan_scan_logged = True
+                self.log.warning(
+                    "--nan-policy=%s active: per-batch stepping (the "
+                    "scanned epoch cannot skip/rollback single steps)",
+                    self.cfg.nan_policy,
+                )
+            return False
         if self._oversized():
             if not getattr(self, "_scan_fallback_logged", False):
                 self._scan_fallback_logged = True
@@ -443,14 +531,23 @@ class Trainer:
         # materialization this path exists to avoid (see _use_scan).
         stream = self._oversized()
         labels = np.asarray(self.ds.train_labels) if stream else None
+        ngood = 0  # steps whose update was kept (== nsteps unguarded)
         for start in range(skip_steps * b, self.num_train - self.num_train % b, b):
             idx = order[start : start + b]
+            # 0-based global index of the step ABOUT to run; +1 below is
+            # the completed-step count the checkpoint/crash hooks see.
+            gstep = epoch * self.steps_per_epoch + skip_steps + nsteps
             with timer.phase("data"):
                 if stream:
                     bx = normalize_images(self.ds.train_images[idx])
                     by = one_hot(labels[idx], self.ds.num_classes)
                 else:
                     bx, by = self.train_x[idx], self.train_y[idx]
+                if self.faults is not None:
+                    for f in self.faults.fire("train.batch", gstep):
+                        if f.kind == "nan":
+                            bx = poison_batch(bx, f)
+                            self._drain_fault_events()
                 batch = self._place_batch(bx, by)
             if nsteps == 0:
                 # exclude(): the analysis costs an AOT compile that must
@@ -458,27 +555,45 @@ class Trainer:
                 with timer.exclude():
                     self._maybe_log_program("train_step", self.train_step,
                                             self.state, *batch)
+            # skip/restore must be able to DROP the update: hold a host
+            # snapshot of the pre-step state (donation consumes the
+            # device buffers). Guard-only cost, documented in README.
+            snap = jax.device_get(self.state) if self._nan.snapshots else None
             with timer.phase("dispatch"):
                 self.state, m = self.train_step(self.state, *batch)
-            running = m if running is None else jax.tree.map(jnp.add, running, m)
             nsteps += 1
-            # step is the ABSOLUTE in-epoch position (skip included) so a
-            # resumed run's metric stream lines up with the scanned path's.
-            if cfg.log_every > 0 and (skip_steps + nsteps) % cfg.log_every == 0:
-                with timer.phase("device"):
-                    jax.block_until_ready(running)
-                self.metrics.log(
-                    "train",
-                    epoch=epoch,
-                    step=skip_steps + nsteps,
-                    loss=float(running["loss"]) / nsteps,
-                    etotal=float(running["etotal"]) / nsteps,
-                    acc=float(running["acc"]) / nsteps,
-                )
+            if self._nan.active and not step_is_finite(m, self._finite_fn,
+                                                       self.state):
+                # Drop the update (abort/rollback raise inside); the
+                # checkpoint + crash hooks below still run — a skipped
+                # step consumed its batch, and a planned fault at this
+                # step value must not silently evaporate.
+                self._drop_bad_update(gstep, snap)
+            else:
+                self._nan.step_ok()
+                running = (m if running is None
+                           else jax.tree.map(jnp.add, running, m))
+                ngood += 1
+                # step is the ABSOLUTE in-epoch position (skip included)
+                # so a resumed run's metric stream lines up with the
+                # scanned path's.
+                if cfg.log_every > 0 and \
+                        (skip_steps + nsteps) % cfg.log_every == 0:
+                    with timer.phase("device"):
+                        jax.block_until_ready(running)
+                    self.metrics.log(
+                        "train",
+                        epoch=epoch,
+                        step=skip_steps + nsteps,
+                        loss=float(running["loss"]) / ngood,
+                        etotal=float(running["etotal"]) / ngood,
+                        acc=float(running["acc"]) / ngood,
+                    )
             with timer.phase("checkpoint"):
-                self._maybe_step_checkpoint(
-                    epoch * self.steps_per_epoch + skip_steps + nsteps
-                )
+                self._maybe_step_checkpoint(gstep + 1)
+            if self.faults is not None:
+                self.faults.fire("train.step", gstep + 1)
+                self._drain_fault_events()
         # hard_block, not block_until_ready: the epoch wall-clock must
         # cover the COMPUTE, and under this env's remote-TPU tunnel
         # block_until_ready returns at enqueue (utils/sync.py).
@@ -494,12 +609,14 @@ class Trainer:
                 f"no full batches: train set of {self.num_train} yields "
                 f"0 batches of {cfg.batch_size}"
             )
+        # Guarded epochs can drop every update (running is None): report
+        # NaN metrics rather than crash — the fault events carry the why.
         return {
             "epoch": epoch,
             "steps": nsteps,
-            "loss": float(running["loss"]) / nsteps,
-            "etotal": float(running["etotal"]) / nsteps,
-            "acc": float(running["acc"]) / nsteps,
+            "loss": float(running["loss"]) / ngood if ngood else float("nan"),
+            "etotal": float(running["etotal"]) / ngood if ngood else float("nan"),
+            "acc": float(running["acc"]) / ngood if ngood else float("nan"),
             "seconds": seconds,
         }
 
@@ -676,6 +793,12 @@ class Trainer:
                 )
             with timer.phase("checkpoint"):
                 self._maybe_step_checkpoint(epoch * nsteps + done)
+            if self.faults is not None:
+                # Scanned epochs advance chunk-by-chunk: crash faults
+                # fire at chunk/checkpoint boundaries, where the step
+                # count is exact (align `at` with a boundary).
+                self.faults.fire("train.step", epoch * nsteps + done)
+                self._drain_fault_events()
         with timer.phase("device"):
             hard_block(self.state)  # see run_epoch: must wait for compute
         seconds = time.perf_counter() - t0 - timer.excluded_s  # see run_epoch
@@ -697,10 +820,14 @@ class Trainer:
         skip_steps = 0  # mid-epoch resume position within start_epoch
 
         if cfg.resume and cfg.checkpoint_dir:
-            ckpt = latest_checkpoint(cfg.checkpoint_dir)
-            if ckpt is not None:
-                host_state = jax.device_get(self.state)
-                self.place_state(restore_checkpoint(ckpt, host_state))
+            host_state = jax.device_get(self.state)
+            # restore_latest walks past corrupt checkpoints (manifest
+            # crc32 verification) to the newest one that restores clean.
+            restored, ckpt = restore_latest(cfg.checkpoint_dir, host_state,
+                                            logger=self.log,
+                                            metrics=self.metrics)
+            if restored is not None:
+                self.place_state(restored)
                 spe = max(self.steps_per_epoch, 1)
                 step0 = self._global_step()
                 start_epoch = step0 // spe
@@ -713,11 +840,29 @@ class Trainer:
         timer = StepTimer()
         epoch_seconds: list[float] = []
         result_acc, ncorrect = 0.0, 0
+        rollbacks = 0
 
         try:
             with profile_trace(cfg.profile_dir):
-                for epoch in range(start_epoch, cfg.epochs):
-                    em = self.run_epoch(epoch, skip_steps=skip_steps)
+                epoch = start_epoch
+                while epoch < cfg.epochs:
+                    try:
+                        em = self.run_epoch(epoch, skip_steps=skip_steps)
+                    except RollbackToCheckpoint:
+                        # --nan-policy=restore: K consecutive bad steps.
+                        # Reload the newest valid checkpoint and re-enter
+                        # the loop at its exact step (the derived shuffle
+                        # order makes the re-run deterministic). Bounded:
+                        # persistent NaNs must eventually surface.
+                        rollbacks += 1
+                        if rollbacks > MAX_NAN_ROLLBACKS:
+                            raise NonFiniteLossError(
+                                f"nan-policy=restore: rolled back "
+                                f"{MAX_NAN_ROLLBACKS} times and the run "
+                                "still goes non-finite"
+                            ) from None
+                        epoch, skip_steps = self._rollback_to_checkpoint()
+                        continue
                     skip_steps = 0  # only the resumed epoch is partial
                     # Fold in the epoch's own measurement (which already
                     # excludes the obs AOT compile) instead of re-timing
@@ -740,6 +885,7 @@ class Trainer:
                     ):
                         with span("checkpoint", metrics=self.metrics.sink_or_none()):
                             self._ckpt.save(self.state, self._global_step())
+                    epoch += 1
 
             if cfg.checkpoint_dir:
                 with span("checkpoint", metrics=self.metrics.sink_or_none()):
@@ -750,6 +896,10 @@ class Trainer:
             # worker thread; on the normal path this is the usual close.
             if self._ckpt is not None:
                 self._ckpt.close()
+            # A fault that ABORTED the loop (injected crash) fired after
+            # the last in-loop drain: flush its event here so the obs
+            # stream records the fault in the attempt that hit it.
+            self._drain_fault_events()
         if not (cfg.eval_every and cfg.epochs > start_epoch
                 and cfg.epochs % cfg.eval_every == 0):
             ntests, ncorrect = self.evaluate()

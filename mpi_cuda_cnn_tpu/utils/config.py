@@ -99,6 +99,25 @@ class Config(_JsonConfig):
                                   # npz write; --no-async-checkpoint
                                   # restores fully synchronous saves
     resume: bool = False
+    # Robustness (ISSUE 4). max_restarts > 0 turns the CLI into a
+    # crash-safe supervisor: a crashed attempt relaunches from the
+    # latest valid checkpoint (needs --checkpoint-dir; pair with
+    # --checkpoint-every-steps for tight recovery points).
+    max_restarts: int = 0
+    nan_policy: str = "off"       # off | abort | skip | restore — the
+                                  # NaN/Inf guard on loss/metrics and the
+                                  # post-update state. skip drops the bad
+                                  # update; restore also rolls back to
+                                  # the last checkpoint after
+                                  # --nan-max-bad consecutive bad steps.
+                                  # Any non-off policy steps per batch
+                                  # (no scanned epochs) and costs a
+                                  # per-step sync — robustness mode.
+    nan_max_bad: int = 3          # consecutive non-finite steps before
+                                  # nan_policy=restore rolls back
+    fault_plan: str | None = None  # deterministic fault injection spec
+                                  # (faults.parse_plan), e.g.
+                                  # "crash@train.step:6;nan@train.batch:3"
     log_every: int = 100          # steps; reference prints every 1000 samples
     profile_dir: str | None = None
     metrics_jsonl: str | None = None  # write schema-stamped JSONL metrics
@@ -188,6 +207,14 @@ class LMConfig(_JsonConfig):
     async_checkpoint: bool = True    # background checkpoint writes (see
                                      # Config.async_checkpoint)
     resume: bool = False
+    max_restarts: int = 0            # crash-safe supervisor retries (see
+                                     # Config.max_restarts)
+    nan_policy: str = "off"          # off|abort|skip|restore NaN/Inf
+                                     # guard (see Config.nan_policy)
+    nan_max_bad: int = 3             # consecutive bad steps before
+                                     # nan_policy=restore rolls back
+    fault_plan: str | None = None    # fault injection spec
+                                     # (faults.parse_plan)
     log_every: int = 20
     metrics_jsonl: str | None = None  # JSONL metrics + telemetry sink
                                      # (see Config.metrics_jsonl)

@@ -22,7 +22,9 @@ a synthetic run can never be labeled MNIST.
 from __future__ import annotations
 
 import hashlib
+import random
 import sys
+import time
 import urllib.request
 from pathlib import Path
 
@@ -60,6 +62,38 @@ SYNTHETIC_SHA256S = {
 }
 
 
+def fetch_with_retry(url: str, *, opener=None,
+                     tries: int = 3, base_delay: float = 0.5,
+                     sleep=time.sleep, jitter=random.random,
+                     timeout: float = 30.0) -> bytes:
+    """Fetch `url`, retrying transient failures with exponential backoff
+    plus jitter (delay = base * 2^attempt * (1 + U[0,1)) — the jitter
+    de-synchronizes parallel fetchers hammering a recovering mirror).
+
+    `opener`/`sleep`/`jitter` are injection points: tests drive this
+    with a flaky opener and a recording sleep, no network and no
+    monkeypatching (tests/test_get_mnist.py). Raises the last error
+    after `tries` attempts — the caller's mirror loop then moves on.
+    """
+    if opener is None:
+        # Resolved at CALL time so tests patching urllib.request.urlopen
+        # (or passing opener=) always win over the import-time binding.
+        opener = urllib.request.urlopen
+    last: Exception | None = None
+    for attempt in range(tries):
+        try:
+            return opener(url, timeout=timeout).read()
+        except Exception as e:  # noqa: BLE001 — any fetch error retries
+            last = e
+            if attempt + 1 < tries:
+                delay = base_delay * (2 ** attempt) * (1.0 + jitter())
+                print(f"  attempt {attempt + 1}/{tries} failed: {e}; "
+                      f"retrying in {delay:.2f}s", file=sys.stderr)
+                sleep(delay)
+    assert last is not None
+    raise last
+
+
 def _sha256(path: Path) -> str:
     h = hashlib.sha256()
     with path.open("rb") as fh:
@@ -80,7 +114,8 @@ def _cache_is_poisoned(out: Path) -> bool:
     return any(_sha256(p) == SYNTHETIC_SHA256S[p.name] for p in existing)
 
 
-def main(out_dir: str) -> int:
+def main(out_dir: str, *, opener=None, sleep=time.sleep,
+         tries: int = 3) -> int:
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     poisoned = _cache_is_poisoned(out)
@@ -99,7 +134,11 @@ def main(out_dir: str) -> int:
         for mirror in MIRRORS:
             try:
                 print(f"fetching {mirror}{name}.gz", file=sys.stderr)
-                data = urllib.request.urlopen(mirror + name + ".gz", timeout=30).read()
+                # Bounded retry + backoff PER mirror fetch: one transient
+                # hiccup must not dump a healthy mirror (ISSUE 4).
+                data = fetch_with_retry(mirror + name + ".gz",
+                                        opener=opener, tries=tries,
+                                        sleep=sleep)
                 import gzip
 
                 dest.write_bytes(gzip.decompress(data))

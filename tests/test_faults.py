@@ -1,0 +1,481 @@
+"""Fault-injection harness + crash-safe training (ISSUE 4).
+
+The tentpole contracts, all deterministic on CPU:
+
+- plan parsing / injector determinism (faults fire exactly once, at the
+  named site and value, and record obs-shaped events);
+- the supervisor e2e: a run killed mid-epoch by an injected crash,
+  restarted by `supervise`, reaches a final state BITWISE-identical to
+  the uninterrupted run (the step-exact-resume contract proven through
+  an actual crash path, not just a polite resume);
+- the NaN/Inf guard: --nan-policy abort raises, skip drops exactly the
+  poisoned update, restore rolls back to the last valid checkpoint
+  after K consecutive bad steps;
+- checkpoint integrity: per-array checksums in the manifest, corrupt
+  checkpoints detected and skipped by restore_latest, crash-during-save
+  leaves only an ignorable dotfile tmp, AsyncCheckpointer's deferred
+  error re-raise fires.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+from mpi_cuda_cnn_tpu.faults import (
+    FakeClock,
+    FaultInjector,
+    InjectedCrash,
+    NonFiniteLossError,
+    parse_plan,
+    supervise,
+)
+from mpi_cuda_cnn_tpu.models.presets import get_model
+from mpi_cuda_cnn_tpu.train.checkpoint import (
+    CheckpointCorruptError,
+    latest_checkpoint,
+    restore_checkpoint,
+    restore_latest,
+    save_checkpoint,
+)
+from mpi_cuda_cnn_tpu.train.trainer import Trainer
+from mpi_cuda_cnn_tpu.utils.config import Config
+from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+
+def _quiet(capture=False):
+    return MetricsLogger(echo=False, capture=capture)
+
+
+def _cfg(**kw):
+    base = dict(
+        dataset="synthetic", model="reference_cnn", epochs=2,
+        batch_size=16, num_devices=1, eval_every=0, log_every=0,
+        lr=0.05, seed=7,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _ds():
+    return synthetic_stripes(num_train=64, num_test=32)  # 4 steps/epoch
+
+
+def _params_of(t):
+    return jax.device_get(t.state["params"])
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------- plan / injector
+
+
+def test_parse_plan_grammar():
+    plan = parse_plan(
+        "crash@train.step:6; nan@train.batch:3?rows=2;"
+        "squeeze@serve.tick:2?pages=4&ticks=8;slow@serve.tick:5?s=2.5"
+    )
+    assert [(f.kind, f.site, f.at) for f in plan] == [
+        ("crash", "train.step", 6), ("nan", "train.batch", 3),
+        ("squeeze", "serve.tick", 2), ("slow", "serve.tick", 5),
+    ]
+    assert plan[1].arg("rows") == 2
+    assert plan[2].args == {"pages": 4, "ticks": 8}
+    assert plan[3].arg("s") == 2.5
+    for bad in ("boom@x:1", "crash@:3", "crash@a.b", "crash@a.b:x",
+                "nan@train.batch:1?rows"):
+        with pytest.raises(ValueError, match="bad fault"):
+            parse_plan(bad)
+
+
+def test_injector_fires_once_at_site_and_value():
+    inj = FaultInjector("nan@train.batch:3;crash@train.step:5")
+    assert inj.poll("train.batch", 2) == []
+    assert inj.poll("train.step", 3) == []   # site must match too
+    hits = inj.poll("train.batch", 3)
+    assert [f.kind for f in hits] == ["nan"]
+    assert inj.poll("train.batch", 3) == []  # fires exactly once
+    with pytest.raises(InjectedCrash):
+        inj.fire("train.step", 5)
+    assert inj.fire("train.step", 5) == []   # consumed by the raise
+    evs = inj.drain_events()
+    assert [e["kind"] for e in evs] == ["injected_nan", "injected_crash"]
+    assert inj.drain_events() == []
+
+
+def test_fake_clock_drives_injector_sleep():
+    clock = FakeClock()
+    inj = FaultInjector("slow@serve.tick:0?s=2.5", clock=clock)
+    (f,) = inj.poll("serve.tick", 0)
+    inj.sleep(f.arg("s"))
+    assert clock() == 2.5
+
+
+# ---------------------------------------------------------------- supervisor e2e
+
+
+@pytest.mark.parametrize("scan", [True, False])
+def test_supervised_crash_restart_is_bitwise_exact(tmp_path, scan):
+    """THE acceptance e2e: a training run killed mid-epoch by an
+    injected crash (after step 6 of 8; checkpoints every 3 steps),
+    restarted by the supervisor, ends bitwise-identical to the
+    uninterrupted run."""
+    ds = _ds()
+    full = Trainer(get_model("reference_cnn"), ds, _cfg(scan=scan),
+                   metrics=_quiet())
+    full.train()
+    want = _params_of(full)
+
+    ck = tmp_path / "ck"
+    faults = FaultInjector("crash@train.step:6")
+    metrics = _quiet(capture=True)
+    attempts = []
+
+    def attempt(n):
+        cfg = _cfg(scan=scan, checkpoint_dir=str(ck),
+                   checkpoint_every_steps=3, resume=n > 0)
+        t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=metrics,
+                    faults=faults)
+        attempts.append(t)
+        return t.train()
+
+    res = supervise(attempt, max_restarts=2, metrics=metrics)
+    assert len(attempts) == 2          # one crash, one clean finish
+    assert res.final_step == full._global_step()
+    _assert_trees_equal(want, _params_of(attempts[-1]))
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert "injected_crash" in kinds
+    assert "restart" in kinds
+
+
+def test_supervisor_exhausts_restarts_and_reraises(tmp_path):
+    ds = _ds()
+    faults = FaultInjector("crash@train.step:2;crash@train.step:3")
+
+    def attempt(n):
+        cfg = _cfg(checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every_steps=1, resume=n > 0)
+        return Trainer(get_model("reference_cnn"), ds, cfg,
+                       metrics=_quiet(), faults=faults).train()
+
+    with pytest.raises(InjectedCrash):
+        supervise(attempt, max_restarts=1)  # two crashes, one restart
+
+
+def test_cli_train_supervisor_e2e(tmp_path):
+    """`mctpu train --max-restarts N --fault-plan crash@...` end to end
+    through the CLI: the crashed attempt restarts, resumes from the
+    checkpoint, exits 0, and the JSONL sink carries the fault events."""
+    from mpi_cuda_cnn_tpu import cli
+    from mpi_cuda_cnn_tpu.obs.schema import load_records
+
+    sink = tmp_path / "run.jsonl"
+    rc = cli.main([
+        "train", "--dataset", "synthetic", "--model", "reference_cnn",
+        "--epochs", "1", "--batch-size", "500", "--num-devices", "1",
+        "--eval-every", "0", "--log-every", "0", "--device", "cpu",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-every-steps", "2", "--max-restarts", "1",
+        "--fault-plan", "crash@train.step:2",
+        "--metrics-jsonl", str(sink),
+    ])
+    assert rc == 0
+    kinds = [r["kind"] for r in load_records(sink, strict=True)
+             if r["event"] == "fault"]
+    assert "restart" in kinds
+    assert "injected_crash" in kinds
+    # Supervisor without a checkpoint dir is a config error, caught
+    # before any training.
+    assert cli.main(["train", "--dataset", "synthetic",
+                     "--max-restarts", "1"]) == 2
+
+
+# ---------------------------------------------------------------- NaN guard
+
+
+def test_nan_policy_abort_raises():
+    ds = _ds()
+    t = Trainer(
+        get_model("reference_cnn"), ds,
+        _cfg(epochs=1, nan_policy="abort"), metrics=_quiet(),
+        faults=FaultInjector("nan@train.batch:2"),
+    )
+    with pytest.raises(NonFiniteLossError):
+        t.train()
+
+
+def test_supervisor_does_not_retry_nan_abort(tmp_path):
+    """Regression (review finding): the NaN guard's abort verdict is a
+    policy decision, not a crash — an organic NaN replays
+    deterministically from the checkpoint, so the supervisor must pass
+    it through instead of burning every restart reproducing it."""
+    ds = _ds()
+    attempts = []
+
+    def attempt(n):
+        cfg = _cfg(epochs=1, nan_policy="abort",
+                   checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every_steps=1, resume=n > 0)
+        t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet(),
+                    faults=FaultInjector("nan@train.batch:2"))
+        attempts.append(t)
+        return t.train()
+
+    with pytest.raises(NonFiniteLossError):
+        supervise(attempt, max_restarts=3)
+    assert len(attempts) == 1  # no futile replays
+
+
+def test_skipped_step_still_fires_planned_step_faults(tmp_path):
+    """Regression (review finding): a NaN-skipped step must not swallow
+    a planned crash at the same step value — the batch was consumed, so
+    the train.step hook fires and the chaos run exercises its crash."""
+    ds = _ds()
+    faults = FaultInjector("nan@train.batch:3;crash@train.step:4")
+    metrics = _quiet(capture=True)
+    attempts = []
+
+    def attempt(n):
+        cfg = _cfg(epochs=1, nan_policy="skip",
+                   checkpoint_dir=str(tmp_path / "ck"),
+                   checkpoint_every_steps=2, resume=n > 0)
+        t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=metrics,
+                    faults=faults)
+        attempts.append(t)
+        return t.train()
+
+    res = supervise(attempt, max_restarts=1, metrics=metrics)
+    assert len(attempts) == 2  # the crash DID fire, then recovery ran
+    assert res.final_step == 4
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert "injected_crash" in kinds
+    assert "nonfinite_step" in kinds
+
+
+def test_nan_policy_skip_drops_exactly_the_poisoned_update():
+    """skip counts and drops the bad update: params stay finite,
+    exactly one step is dropped, and state["step"] still counts batches
+    CONSUMED (4) — not updates applied — so a later crash-restart's
+    resume position can never go short by the skipped steps."""
+    ds = _ds()
+    metrics = _quiet(capture=True)
+    t = Trainer(
+        get_model("reference_cnn"), ds,
+        _cfg(epochs=1, nan_policy="skip"), metrics=metrics,
+        faults=FaultInjector("nan@train.batch:2"),
+    )
+    res = t.train()
+    assert t._nan.skipped == 1
+    # 4 batches consumed (one update dropped): the step counter tracks
+    # the DATA position, keeping resume exact after skips.
+    assert res.final_step == 4
+    for leaf in jax.tree.leaves(_params_of(t)):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert kinds.count("nonfinite_step") == 1
+    assert kinds.count("injected_nan") == 1
+
+
+def test_nan_policy_restore_rolls_back_to_checkpoint(tmp_path):
+    """Two consecutive poisoned batches with nan_max_bad=2: the guard
+    skips both, then rolls the state back to the last checkpoint and
+    replays — the run completes with finite params and a nan_restore
+    event."""
+    ds = _ds()
+    metrics = _quiet(capture=True)
+    t = Trainer(
+        get_model("reference_cnn"), ds,
+        _cfg(epochs=1, nan_policy="restore", nan_max_bad=2,
+             checkpoint_dir=str(tmp_path / "ck"), checkpoint_every_steps=1),
+        metrics=metrics,
+        faults=FaultInjector("nan@train.batch:1;nan@train.batch:2"),
+    )
+    res = t.train()
+    assert res.final_step == 4  # every batch's update eventually lands
+    for leaf in jax.tree.leaves(_params_of(t)):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert "nan_restore" in kinds
+    assert kinds.count("nonfinite_step") == 2
+
+
+def test_skip_then_crash_restart_stays_bitwise_exact(tmp_path):
+    """Regression (review finding): nan-policy=skip must not
+    desynchronize the resume position from the data position. A run
+    that SKIPS batch 4 and then crashes after batch 5 must, once
+    restarted, land bitwise on the reference guarded run (same skip, no
+    crash) — i.e. batch 5's update is never applied twice."""
+    ds = _ds()
+    ref = Trainer(
+        get_model("reference_cnn"), ds, _cfg(nan_policy="skip"),
+        metrics=_quiet(), faults=FaultInjector("nan@train.batch:4"),
+    )
+    ref.train()
+    want = _params_of(ref)
+
+    ck = tmp_path / "ck"
+    faults = FaultInjector("nan@train.batch:4;crash@train.step:6")
+    attempts = []
+
+    def attempt(n):
+        cfg = _cfg(nan_policy="skip", checkpoint_dir=str(ck),
+                   checkpoint_every_steps=3, resume=n > 0)
+        t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet(),
+                    faults=faults)
+        attempts.append(t)
+        return t.train()
+
+    res = supervise(attempt, max_restarts=2)
+    assert len(attempts) == 2
+    assert res.final_step == 8  # batches consumed, skip included
+    _assert_trees_equal(want, _params_of(attempts[-1]))
+
+
+def test_nan_guard_forces_per_batch_stepping():
+    ds = _ds()
+    t = Trainer(get_model("reference_cnn"), ds,
+                _cfg(nan_policy="skip"), metrics=_quiet())
+    assert not t._use_scan()
+    t2 = Trainer(get_model("reference_cnn"), ds, _cfg(), metrics=_quiet())
+    assert t2._use_scan()
+
+
+def test_bad_nan_policy_rejected():
+    with pytest.raises(ValueError, match="nan-policy"):
+        Trainer(get_model("reference_cnn"), _ds(),
+                _cfg(nan_policy="bogus"), metrics=_quiet())
+
+
+# ---------------------------------------------------------------- checkpoint integrity
+
+
+def _state(seed=0):
+    model = get_model("reference_cnn")
+    from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+
+    params = model.init(jax.random.key(seed), get_initializer("normal"))
+    opt = make_optimizer(0.1, momentum=0.9)
+    import jax.numpy as jnp
+
+    return {"params": params, "opt_state": opt.init(params),
+            "step": jnp.asarray(7, jnp.int32)}
+
+
+def test_manifest_records_checksums_and_is_atomic(tmp_path):
+    state = _state()
+    save_checkpoint(tmp_path, state, 3)
+    mf = json.loads((tmp_path / "manifest.json").read_text())
+    assert mf["latest_step"] == 3
+    assert set(mf["checksums"]) == {"ckpt_3.npz"}
+    assert set(mf["checksums"]["ckpt_3.npz"]) == set(mf["keys"])
+    # No tmp litter from the atomic writes.
+    assert not list(tmp_path.glob(".manifest*"))
+    # Pruned checkpoints leave the manifest too.
+    for step in (6, 9, 12):
+        save_checkpoint(tmp_path, state, step, keep=2)
+    mf = json.loads((tmp_path / "manifest.json").read_text())
+    assert set(mf["checksums"]) == {"ckpt_9.npz", "ckpt_12.npz"}
+
+
+def test_corrupt_checkpoint_detected_and_skipped(tmp_path):
+    good = _state(seed=0)
+    save_checkpoint(tmp_path, good, 1)
+    save_checkpoint(tmp_path, _state(seed=1), 2)
+    # Corrupt ckpt_2 with a VALID npz holding different bytes — only
+    # the manifest checksums can catch this class of corruption.
+    other = {k: np.asarray(v) + 1.0 if np.issubdtype(
+        np.asarray(v).dtype, np.floating) else np.asarray(v)
+        for k, v in _flat(_state(seed=1)).items()}
+    np.savez(tmp_path / "ckpt_2.npz", **other)
+    with pytest.raises(CheckpointCorruptError):
+        restore_checkpoint(tmp_path / "ckpt_2.npz", _state(seed=2))
+    restored, path = restore_latest(tmp_path, _state(seed=2))
+    assert path.name == "ckpt_1.npz"
+    _assert_trees_equal(jax.device_get(good), restored)
+    # Torn-file corruption (not even a zip) also falls back.
+    (tmp_path / "ckpt_2.npz").write_bytes(b"torn write")
+    restored, path = restore_latest(tmp_path, _state(seed=2))
+    assert path.name == "ckpt_1.npz"
+
+
+def _flat(state):
+    from mpi_cuda_cnn_tpu.train.checkpoint import _flatten
+
+    return _flatten(jax.device_get(state))
+
+
+def test_restore_without_manifest_globs_and_skips_verification(tmp_path):
+    state = _state()
+    save_checkpoint(tmp_path, state, 5)
+    (tmp_path / "manifest.json").unlink()
+    restored = restore_checkpoint(latest_checkpoint(tmp_path), _state(1))
+    _assert_trees_equal(jax.device_get(state), restored)
+    # Unparsable manifest: same degradation, not an error.
+    (tmp_path / "manifest.json").write_text("{torn json")
+    restored, path = restore_latest(tmp_path, _state(1))
+    assert path.name == "ckpt_5.npz"
+    _assert_trees_equal(jax.device_get(state), restored)
+
+
+def test_crash_between_tmp_write_and_rename(tmp_path):
+    """ISSUE 4 satellite: kill the writer between the npz tmp write and
+    the rename — the dotfile tmp is invisible to the glob, the previous
+    checkpoint restores, and the manifest still names only live files."""
+    state = _state()
+    save_checkpoint(tmp_path, state, 3)
+    faults = FaultInjector("crash@ckpt.pre_rename:6")
+    with pytest.raises(InjectedCrash):
+        save_checkpoint(tmp_path, _state(seed=1), 6, faults=faults)
+    assert (tmp_path / ".ckpt_6.tmp.npz").exists()  # the torn write
+    assert latest_checkpoint(tmp_path).name == "ckpt_3.npz"
+    restored, path = restore_latest(tmp_path, _state(seed=2))
+    assert path.name == "ckpt_3.npz"
+    _assert_trees_equal(jax.device_get(state), restored)
+    mf = json.loads((tmp_path / "manifest.json").read_text())
+    assert "ckpt_6.npz" not in mf["checksums"]
+
+
+def test_async_checkpointer_deferred_crash_reraises(tmp_path):
+    """A crash injected inside the BACKGROUND write must re-raise at the
+    next save()/wait() — the deferred-error contract under faults."""
+    from mpi_cuda_cnn_tpu.train.checkpoint import AsyncCheckpointer
+
+    faults = FaultInjector("crash@ckpt.pre_rename:2")
+    ck = AsyncCheckpointer(tmp_path, faults=faults)
+    ck.save(_state(), 1)
+    ck.wait()
+    ck.save(_state(), 2)  # the worker hits the injected crash
+    with pytest.raises(InjectedCrash):
+        ck.wait()
+    assert latest_checkpoint(tmp_path).name == "ckpt_1.npz"
+    ck.close()
+
+
+def test_trainer_resume_skips_corrupt_latest(tmp_path):
+    """End to end through Trainer: corrupt the newest checkpoint after a
+    checkpointed run; a resumed trainer must fall back to the previous
+    valid one instead of crashing or silently training on garbage."""
+    ds = _ds()
+    ck = tmp_path / "ck"
+    t = Trainer(get_model("reference_cnn"), ds,
+                _cfg(epochs=1, checkpoint_dir=str(ck),
+                     checkpoint_every_steps=1, scan=False),
+                metrics=_quiet())
+    t.train()
+    newest = latest_checkpoint(ck)
+    newest.write_bytes(b"torn")
+    metrics = _quiet(capture=True)
+    resumed = Trainer(get_model("reference_cnn"), ds,
+                      _cfg(epochs=1, checkpoint_dir=str(ck), resume=True,
+                           scan=False),
+                      metrics=metrics)
+    resumed.train()
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert "ckpt_fallback" in kinds

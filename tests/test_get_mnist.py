@@ -72,10 +72,15 @@ def _fake_real_fetch(monkeypatch):
     monkeypatch.setattr(get_mnist.urllib.request, "urlopen", fake)
 
 
+def _nosleep(_s):
+    """Retry backoff without the wait (the no-network tests would
+    otherwise pay the full exponential-backoff schedule per file)."""
+
+
 def test_fallback_writes_sentinel_and_refetch_replaces(tmp_path, monkeypatch):
     _tiny_synth(monkeypatch)
     _fail_fetch(monkeypatch)
-    assert get_mnist.main(str(tmp_path)) == 0
+    assert get_mnist.main(str(tmp_path), sleep=_nosleep) == 0
     sentinel = tmp_path / get_mnist.SENTINEL
     assert sentinel.exists(), "synthetic fallback must mark the directory"
     poisoned_bytes = (tmp_path / get_mnist.FILES[0]).read_bytes()
@@ -116,10 +121,82 @@ def test_real_cache_is_kept(tmp_path, monkeypatch):
     stamps = {n: (tmp_path / n).read_bytes() for n in get_mnist.FILES}
 
     _fail_fetch(monkeypatch)  # cached real files: no fetch needed
-    assert get_mnist.main(str(tmp_path)) == 0
+    assert get_mnist.main(str(tmp_path), sleep=_nosleep) == 0
     assert not (tmp_path / get_mnist.SENTINEL).exists()
     for n, b in stamps.items():
         assert (tmp_path / n).read_bytes() == b
+
+
+def test_fetch_retries_flaky_opener_with_backoff():
+    """ISSUE 4 satellite: a transient mirror failure must be retried
+    with exponential backoff + jitter, via an injected flaky opener —
+    no monkeypatching, no network, no real sleeping."""
+
+    class Flaky:
+        def __init__(self, fail_times):
+            self.fail_times = fail_times
+            self.calls = 0
+
+        def __call__(self, url, timeout=0):
+            self.calls += 1
+            if self.calls <= self.fail_times:
+                raise OSError(f"flaky failure {self.calls}")
+
+            class Resp:
+                def read(self_inner):
+                    return b"payload"
+
+            return Resp()
+
+    delays = []
+    opener = Flaky(fail_times=2)
+    data = get_mnist.fetch_with_retry(
+        "http://mirror/x.gz", opener=opener, tries=3,
+        base_delay=0.5, sleep=delays.append, jitter=lambda: 0.5,
+    )
+    assert data == b"payload"
+    assert opener.calls == 3
+    # Exponential backoff with the fixed jitter: 0.5*2^0*1.5, 0.5*2^1*1.5.
+    assert delays == [0.75, 1.5]
+
+    # Exhausted tries re-raise the LAST error; sleeps stop after the
+    # final attempt (two retries -> two waits).
+    delays2 = []
+    always = Flaky(fail_times=99)
+    with pytest.raises(OSError, match="flaky failure 3"):
+        get_mnist.fetch_with_retry(
+            "http://mirror/x.gz", opener=always, tries=3,
+            base_delay=0.5, sleep=delays2.append, jitter=lambda: 0.0,
+        )
+    assert always.calls == 3
+    assert delays2 == [0.5, 1.0]
+
+
+def test_main_recovers_from_transient_mirror_failures(tmp_path, monkeypatch):
+    """main() threads the injected opener through: a mirror flaky ONCE
+    per URL still yields a full real fetch (no synthetic fallback)."""
+    _tiny_synth(monkeypatch)
+    _fake_real_fetch(monkeypatch)
+    import urllib.request as _ur
+
+    real = _ur.urlopen  # the patched fake-real fetch
+
+    calls = {}
+
+    def flaky_once(url, timeout=0):
+        n = calls.get(url, 0)
+        calls[url] = n + 1
+        if n == 0:
+            raise OSError("transient mirror hiccup")
+        return real(url, timeout=timeout)
+
+    assert get_mnist.main(str(tmp_path), opener=flaky_once,
+                          sleep=_nosleep) == 0
+    # Every file fetched for real despite each URL failing once: no
+    # sentinel, real bytes present.
+    assert not (tmp_path / get_mnist.SENTINEL).exists()
+    for name in get_mnist.FILES:
+        assert (tmp_path / name).exists()
 
 
 def test_loader_refuses_sentinel_directory(tmp_path, monkeypatch):
@@ -128,7 +205,7 @@ def test_loader_refuses_sentinel_directory(tmp_path, monkeypatch):
     labeling a synthetic run as MNIST."""
     _tiny_synth(monkeypatch)
     _fail_fetch(monkeypatch)
-    assert get_mnist.main(str(tmp_path)) == 0
+    assert get_mnist.main(str(tmp_path), sleep=_nosleep) == 0
     paths = [tmp_path / n for n in get_mnist.FILES]
     with pytest.raises(IdxError, match="SYNTHETIC-DATA"):
         load_idx_dataset("mnist", *paths)

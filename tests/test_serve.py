@@ -306,8 +306,11 @@ def test_scheduler_and_engine_rejections():
         Request(rid=0, prompt=np.zeros(0, np.int32), max_new_tokens=1)
     with pytest.raises(ValueError, match="num_pages"):
         PagePool(1)
+    # A prompt alone needing more pages than the pool owns could only
+    # ever preempt-loop: rejected AT SUBMISSION with a clear error
+    # (ISSUE 4 satellite), not discovered as an idle-engine stall.
     engine = PagedEngine(MODEL, params, slots=1, num_pages=2, page_size=4,
                          max_len=16)
-    with pytest.raises(RuntimeError, match="too small"):
+    with pytest.raises(ValueError, match="never be admitted"):
         engine.run([Request(rid=0, prompt=np.zeros(8, np.int32),
                             max_new_tokens=4)], mode="continuous")

@@ -1,0 +1,348 @@
+"""Failure-aware serving (ISSUE 4): deadlines, cancellation,
+backpressure, the preemption-livelock guard, injected page-pool
+squeezes, and the tick watchdog — all deterministic on CPU via
+faults.FakeClock (no wall-clock races).
+
+The acceptance e2e lives here too: a serve run with an injected
+page-pool squeeze + expiring deadlines completes every non-expired
+request, fails/rejects the rest with terminal statuses, and ends with
+the PagePool clean (zero leaked or double-booked pages — the engine
+asserts it after every iteration AND at exit)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+from mpi_cuda_cnn_tpu.serve.paged_cache import PagePool
+from mpi_cuda_cnn_tpu.serve.scheduler import ContinuousScheduler, Request
+
+MODEL = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return MODEL.init(jax.random.key(0))
+
+
+def _engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("num_pages", 13)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("max_len", 24)
+    return PagedEngine(MODEL, params, **kw)
+
+
+def _req(rid, plen=4, new=6, arrival=0.0, deadline=None):
+    return Request(rid=rid, prompt=np.arange(plen) % 13, max_new_tokens=new,
+                   arrival=arrival, deadline=deadline)
+
+
+def _clock_run(engine, reqs, plan=None, mode="continuous", **kw):
+    clock = FakeClock()
+    faults = FaultInjector(plan, clock=clock) if plan else None
+    res = engine.run(reqs, mode=mode, time_fn=clock,
+                     sleep_fn=clock.advance, faults=faults, **kw)
+    return res
+
+
+def test_queued_deadline_expiry_drops_before_admission(params):
+    """A request already past its deadline when the engine reaches it is
+    dropped from the queue with zero tokens; the rest complete."""
+    engine = _engine(params)
+    reqs = [
+        _req(0, new=4, deadline=100.0),
+        _req(1, new=4, deadline=0.5),  # expires at the tick-0 jump
+    ]
+    res = _clock_run(engine, reqs, plan="slow@serve.tick:0?s=1.0")
+    by = {r.rid: r for r in res.requests}
+    assert by[0].status == "finished" and len(by[0].out) == 4
+    assert by[1].status == "expired" and by[1].out == []
+    assert by[1].finished_at is not None
+    assert res.status_counts() == {"finished": 1, "expired": 1}
+    assert any(e["kind"] == "request_expired" for e in res.events)
+
+
+def test_inflight_deadline_abort_returns_pages(params):
+    """A deadline passing MID-decode aborts the slot: emitted tokens
+    stay, status goes terminal, and the pages go back through the
+    ownership-checked pool free (engine checks the pool every tick)."""
+    engine = _engine(params)
+    reqs = [
+        _req(0, new=12, deadline=100.0),
+        _req(1, new=12, deadline=2.0),
+    ]
+    # Both admit and decode at t=0; tick 4's jump expires request 1.
+    res = _clock_run(engine, reqs, plan="slow@serve.tick:4?s=5.0")
+    by = {r.rid: r for r in res.requests}
+    assert by[0].status == "finished" and len(by[0].out) == 12
+    assert by[1].status == "expired"
+    assert 0 < len(by[1].out) < 12  # partial progress preserved
+    assert any(e["kind"] == "request_expired" for e in res.events)
+
+
+def test_client_cancellation_queued_and_inflight(params):
+    # Queued: cancel before the engine ever sees it -> zero tokens.
+    engine = _engine(params)
+    reqs = [_req(0, new=4), _req(1, new=4)]
+    reqs[1].cancel()
+    res = _clock_run(engine, reqs)
+    by = {r.rid: r for r in res.requests}
+    assert by[0].status == "finished"
+    assert by[1].status == "cancelled" and by[1].out == []
+
+    # In-flight: scheduler-level — cancel mid-decode, sweep aborts the
+    # slot and the pool invariant holds.
+    pool = PagePool(9)
+    sched = ContinuousScheduler(slots=2, pool=pool, page_size=4, max_len=24)
+    rs = [_req(0, plen=8, new=8), _req(1, plen=8, new=8)]
+    sched.submit(rs)
+    bound = sched.admit(0.0)
+    assert len(bound) == 2
+    for s in bound:
+        s.cached = s.target
+        s.req.out.append(1)
+    rs[1].cancel()
+    dropped = sched.sweep(1.0)
+    assert [r.rid for r in dropped] == [1]
+    assert rs[1].status == "cancelled"
+    assert sched.slots[1].free
+    pool.check()
+    sched.finish(sched.slots[0], 2.0)
+    pool.check()
+    assert pool.free_pages == pool.usable
+
+
+def test_bounded_queue_rejects_overflow(params):
+    """Backpressure: with one slot and max_queue=1, a 3-request burst
+    keeps one running + one waiting and REJECTS the rest with a
+    terminal status — no unbounded queue memory."""
+    engine = _engine(params, slots=1)
+    reqs = [_req(i, new=3) for i in range(3)]
+    res = _clock_run(engine, reqs, max_queue=1)
+    by = {r.rid: r for r in res.requests}
+    assert by[0].status == "finished"
+    assert by[1].status == "finished"   # waited within the bound
+    assert by[2].status == "rejected" and by[2].out == []
+    assert by[2].fail_reason == "queue full"
+    assert any(e["kind"] == "request_rejected" for e in res.events)
+
+
+def test_scheduler_queue_bound_rejects_latest_arrivals():
+    pool = PagePool(20)
+    sched = ContinuousScheduler(slots=1, pool=pool, page_size=4,
+                                max_len=24, max_queue=2)
+    reqs = [_req(i, arrival=0.1 * i) for i in range(4)]
+    sched.submit(reqs)
+    rejected = sched.enforce_queue_bound(now=1.0)
+    assert [r.rid for r in rejected] == [2, 3]  # latest arrivals go
+    assert all(r.status == "rejected" for r in rejected)
+    assert [r.rid for r in sched.queue] == [0, 1]
+    # Not-yet-arrived requests never count against the bound.
+    sched2 = ContinuousScheduler(slots=1, pool=PagePool(20), page_size=4,
+                                 max_len=24, max_queue=2)
+    sched2.submit([_req(i, arrival=10.0) for i in range(4)])
+    assert sched2.enforce_queue_bound(now=0.0) == []
+
+
+def test_queue_bound_never_rejects_preempted_requests():
+    """Regression (review finding): a preempted request requeued at the
+    head is NOT an arrival — the backpressure bound must neither count
+    it nor evict it, or already-served work is silently dropped."""
+    pool = PagePool(20)
+    sched = ContinuousScheduler(slots=2, pool=pool, page_size=4,
+                                max_len=24, max_queue=1)
+    reqs = [_req(i, plen=4, new=8) for i in range(2)]
+    sched.submit(reqs)
+    bound = sched.admit(0.0)
+    assert len(bound) == 2
+    for s in bound:  # prefill done, one token out
+        s.cached = s.target
+        s.req.out.append(1)
+    sched.preempt(sched.slots[1])
+    sched.preempt(sched.slots[0])
+    assert len(sched.queue) == 2  # both previously admitted, re-queued
+    assert sched.enforce_queue_bound(now=1.0) == []
+    assert all(r.status == "queued" for r in reqs)
+    # A NEVER-admitted late arrival still counts toward the bound.
+    late = [_req(10, arrival=0.5), _req(11, arrival=0.6)]
+    sched.submit(late)
+    rejected = sched.enforce_queue_bound(now=1.0)
+    assert [r.rid for r in rejected] == [11]  # 10 fills the bound
+    pool.check()
+
+
+def test_train_batch_fault_forces_loop_path_and_fires():
+    """Regression (review finding): a planned train.batch fault must
+    not be silently inert on the default scanned path — the trainer
+    falls back to per-batch stepping and the fault actually fires."""
+    from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+    from mpi_cuda_cnn_tpu.models.presets import get_model
+    from mpi_cuda_cnn_tpu.train.trainer import Trainer
+    from mpi_cuda_cnn_tpu.utils.config import Config
+    from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+    ds = synthetic_stripes(num_train=64, num_test=32)
+    metrics = MetricsLogger(echo=False, capture=True)
+    t = Trainer(
+        get_model("reference_cnn"), ds,
+        Config(dataset="synthetic", epochs=1, batch_size=16,
+               num_devices=1, eval_every=0, log_every=0, scan=True),
+        metrics=metrics, faults=FaultInjector("nan@train.batch:2"),
+    )
+    assert not t._use_scan()  # forced off the scanned path
+    t.train()
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert "injected_nan" in kinds  # the fault really fired
+
+
+def test_livelock_guard_fails_oversized_context_terminally(params):
+    """A request whose prompt fits the pool but whose GROWN context can
+    never fit gets a terminal 'failed' status — not an endless
+    preempt/requeue loop, and not a run-killing exception: the engine
+    keeps serving everything else."""
+    # 3 usable pages of 4 = 12 cache rows; request 1 grows to 16.
+    engine = _engine(params, slots=2, num_pages=4, page_size=4, max_len=20)
+    reqs = [_req(0, plen=4, new=2), _req(1, plen=6, new=10)]
+    res = _clock_run(engine, reqs)
+    by = {r.rid: r for r in res.requests}
+    assert by[0].status == "finished" and len(by[0].out) == 2
+    assert by[1].status == "failed"
+    assert "cannot fit" in by[1].fail_reason
+    assert 0 < len(by[1].out) < 10  # made real progress before failing
+    assert any(e["kind"] == "request_failed" for e in res.events)
+
+
+def test_livelock_guard_at_admission_for_grown_context():
+    """The admission half: a preempted-and-requeued request whose grown
+    context can never be readmitted is failed at the queue head instead
+    of blocking FCFS forever."""
+    pool = PagePool(4)  # 3 usable pages of 4
+    sched = ContinuousScheduler(slots=1, pool=pool, page_size=4, max_len=24)
+    grown = _req(0, plen=6, new=12)
+    grown.out.extend([1] * 8)  # context 14; pages_for(15) = 4 > 3
+    sched.queue.append(grown)  # as a preemption requeue would
+    assert sched.admit(0.0) == []
+    assert grown.status == "failed"
+    assert grown in sched.dropped
+    pool.check()
+
+
+def test_watchdog_counts_slow_ticks(params):
+    engine = _engine(params)
+    res = _clock_run(engine, [_req(0, new=4)],
+                     plan="slow@serve.tick:1?s=2.0", watchdog_s=0.5)
+    assert res.watchdog_slow_ticks >= 1
+    ev = [e for e in res.events if e["kind"] == "watchdog_slow_tick"]
+    assert ev and ev[0]["seconds"] >= 2.0
+    assert res.requests[0].status == "finished"
+
+
+def test_static_mode_deadline_holds_reservation_until_drain(params):
+    """Under static batching an aborted in-flight request keeps its
+    reservation until the batch drains (the reserve-until-drain
+    discipline) — it just stops decoding; the batch still completes and
+    the pool ends clean."""
+    engine = _engine(params, num_pages=13)
+    reqs = [
+        _req(0, new=10, deadline=100.0),
+        _req(1, new=10, deadline=2.0),
+    ]
+    res = _clock_run(engine, reqs, plan="slow@serve.tick:4?s=5.0",
+                     mode="static")
+    by = {r.rid: r for r in res.requests}
+    assert by[0].status == "finished" and len(by[0].out) == 10
+    assert by[1].status == "expired" and len(by[1].out) < 10
+
+
+def test_squeeze_plus_deadlines_acceptance_e2e(params):
+    """THE serving acceptance: an injected page-pool squeeze + expiring
+    deadlines. Every non-expired request completes, the rest leave with
+    terminal statuses, and the pool ends clean — the engine asserts the
+    no-leak/no-double-book invariant every iteration and at exit, with
+    the squeeze's own pages ownership-checked back."""
+    engine = _engine(params, slots=2, num_pages=13, page_size=4,
+                     max_len=24)
+    reqs = [
+        _req(0, plen=8, new=10, deadline=100.0),
+        _req(1, plen=8, new=10, deadline=100.0),
+        _req(2, plen=8, new=10, deadline=3.0),  # dies during the squeeze
+    ]
+    # Tick 2: steal 6 pages for 6 ticks (starves decode growth and the
+    # queue); tick 3: the clock jumps past request 2's deadline.
+    res = _clock_run(
+        engine, reqs,
+        plan="squeeze@serve.tick:2?pages=6&ticks=6;slow@serve.tick:3?s=4.0",
+    )
+    by = {r.rid: r for r in res.requests}
+    assert len(res.requests) == 3
+    assert all(r.terminal for r in res.requests)
+    assert by[2].status == "expired"
+    for rid in (0, 1):
+        assert by[rid].status == "finished", by[rid].status
+        assert len(by[rid].out) == 10
+    assert any(e["kind"] == "injected_squeeze" for e in res.events)
+    assert any(e["kind"] == "request_expired" for e in res.events)
+
+
+def test_fault_events_validate_and_report_robustness_table(params):
+    """Engine fault events round-trip the obs schema and surface in the
+    `mctpu report` robustness table."""
+    from mpi_cuda_cnn_tpu.obs.report import render_markdown, summarize
+    from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
+
+    engine = _engine(params)
+    reqs = [_req(0, new=4, deadline=100.0), _req(1, new=4, deadline=0.5)]
+    res = _clock_run(engine, reqs, plan="slow@serve.tick:0?s=1.0")
+    records = [validate_record(make_record("fault", 0.1, **ev))
+               for ev in res.events]
+    records += [validate_record(make_record("request", 0.2, **rec))
+                for rec in res.request_records()]
+    s = summarize(records)
+    assert s["robustness"]["by_kind"]["injected_slow"] == 1
+    assert s["robustness"]["by_kind"]["request_expired"] == 1
+    md = render_markdown(s)
+    assert "robustness" in md
+    # The per-request table covers aborted requests (null TTFT) without
+    # blowing up, and counts statuses.
+    row = s["requests"][0]
+    assert row["statuses"] == {"finished": 1, "expired": 1}
+    assert row["ttft_p50_ms"] is not None  # from the finished request
+
+
+def test_serve_bench_cli_with_faults_and_deadlines(tmp_path):
+    """The serve-bench surface end to end with the failure knobs: fault
+    plan, deadlines, queue bound, watchdog. Generous real-time deadline
+    so nothing expires on a slow CI box; the squeeze still fires."""
+    import json
+
+    from mpi_cuda_cnn_tpu.obs.schema import load_records
+    from mpi_cuda_cnn_tpu.serve.bench import serve_bench_main
+
+    sink = tmp_path / "serve.jsonl"
+    rc = serve_bench_main([
+        "--requests", "6", "--dim", "32", "--depth", "1", "--heads", "2",
+        "--vocab", "64", "--max-seq", "128", "--prompt-min", "4",
+        "--prompt-max", "12", "--out-min", "4", "--out-max", "12",
+        "--slots", "2", "--page-size", "8", "--prefill-chunk", "8",
+        "--deadline-ms", "60000", "--max-queue", "64",
+        "--watchdog-ms", "60000",
+        "--fault-plan", "squeeze@serve.tick:2?pages=2&ticks=3",
+        "--metrics-jsonl", str(sink),
+    ])
+    assert rc == 0
+    recs = load_records(sink, strict=True)
+    serves = [r for r in recs if r["event"] == "serve"]
+    assert len(serves) == 2
+    for s in serves:
+        assert s["statuses"] == {"finished": 6}
+    faults = [r for r in recs if r["event"] == "fault"]
+    # One injected squeeze per mode (fresh injector each).
+    assert sum(r["kind"] == "injected_squeeze" for r in faults) == 2
+    reqs = [r for r in recs if r["event"] == "request"]
+    assert all(r["status"] == "finished" for r in reqs)
+    assert len(json.dumps(serves[0])) > 0
