@@ -20,7 +20,7 @@ import sys
 
 from .data.datasets import get_dataset, load_idx_dataset
 from .data.idx import IdxError
-from .faults import FaultInjector, supervise
+from .faults import FaultInjector, Preempted, PreemptionGuard, supervise
 from .models.presets import get_model
 from .parallel.distributed import initialize_distributed
 from .train.trainer import Trainer
@@ -121,9 +121,16 @@ def run(cfg: Config) -> int:
         return rc
     # The context manager closes the JSONL sink even when the trainer
     # raises mid-run — the records written so far must survive.
-    with MetricsLogger(path=cfg.metrics_jsonl) as metrics:
+    # The preemption guard hooks SIGTERM/SIGINT for the whole run
+    # (ISSUE 5): a scheduler's eviction notice finishes the in-flight
+    # step, snapshots, and exits EXIT_PREEMPTED instead of dying
+    # mid-write; uninstalled on the way out so embedding callers (tests,
+    # the C ABI) never inherit our handlers.
+    with MetricsLogger(path=cfg.metrics_jsonl) as metrics, \
+            PreemptionGuard() as guard:
         def make_trainer(c):
-            return Trainer(model, ds, c, metrics=metrics, faults=faults)
+            return Trainer(model, ds, c, metrics=metrics, faults=faults,
+                           preempt=guard)
 
         # First construction outside the retry loop AND outside
         # _supervised: a config error (bad nan-policy, indivisible
@@ -134,7 +141,16 @@ def run(cfg: Config) -> int:
         except ValueError as e:
             log.error("trainer setup failed: %s", e)
             return 2
-        result, _ = _supervised(cfg, log, metrics, first, make_trainer)
+        try:
+            result, _ = _supervised(cfg, log, metrics, first, make_trainer)
+        except Preempted as e:
+            if e.resumable:
+                log.warning("run preempted (%s); exiting %d — relaunch "
+                            "with --resume to continue", e, e.code)
+            else:
+                log.warning("run preempted (%s) with no checkpoint to "
+                            "resume from; exiting %d", e, e.code)
+            return int(e.code)
     log.info(
         "done: epochs=%d acc=%.4f mean_step=%.3fms",
         result.epochs_run,
@@ -158,9 +174,11 @@ def run_lm(argv: list[str]) -> int:
     if rc:
         return rc
     initialize_distributed()
-    with MetricsLogger(path=cfg.metrics_jsonl) as metrics:
+    with MetricsLogger(path=cfg.metrics_jsonl) as metrics, \
+            PreemptionGuard() as guard:
         def make_trainer(c):
-            return LMTrainer(c, metrics=metrics, faults=faults)
+            return LMTrainer(c, metrics=metrics, faults=faults,
+                             preempt=guard)
 
         # First construction outside _supervised: setup errors map to
         # rc=2 exactly once; mid-training errors keep their tracebacks.
@@ -174,7 +192,17 @@ def run_lm(argv: list[str]) -> int:
             cfg.dim, cfg.depth, cfg.heads, cfg.seq_len, first.model.vocab,
             cfg.moe_experts, dict(first.mesh.shape), first.attn_impl,
         )
-        result, trainer = _supervised(cfg, log, metrics, first, make_trainer)
+        try:
+            result, trainer = _supervised(cfg, log, metrics, first,
+                                          make_trainer)
+        except Preempted as e:
+            if e.resumable:
+                log.warning("run preempted (%s); exiting %d — relaunch "
+                            "with --resume to continue", e, e.code)
+            else:
+                log.warning("run preempted (%s) with no checkpoint to "
+                            "resume from; exiting %d", e, e.code)
+            return int(e.code)
         log.info(
             "done: steps=%d eval_ppl=%.3f tokens/s=%.0f",
             result.steps_run, result.eval_ppl, result.tokens_per_s,

@@ -24,6 +24,11 @@ kinds:
                 (serve engine; exercises preemption + deadline expiry)
 - ``slow``    — stall a serve tick by ``s`` seconds (advances the
                 injector's FakeClock when one is attached, else sleeps)
+- ``preempt`` — simulated scheduler SIGTERM (ISSUE 5): the trainer's
+                PreemptionGuard flags it, the run finishes the
+                in-flight step, snapshots through the atomic
+                checkpoint path, and exits Preempted (code 75) — the
+                deterministic twin of a real preemption notice
 
 Recovery — `supervise()` is the `--max-restarts N` loop: it runs one
 training attempt, and on a crash rebuilds the trainer and resumes from
@@ -40,11 +45,15 @@ table.
 from __future__ import annotations
 
 import dataclasses
+import random
+import signal as _signal
 import threading
 import time
 from typing import Callable
 
 import numpy as np
+
+from .utils.retry import backoff_delay
 
 
 class InjectedFault(RuntimeError):
@@ -75,7 +84,7 @@ class Fault:
         return self.args.get(name, default)
 
 
-KINDS = ("crash", "io", "nan", "squeeze", "slow")
+KINDS = ("crash", "io", "nan", "squeeze", "slow", "preempt")
 
 
 def parse_plan(spec: str) -> list[Fault]:
@@ -150,6 +159,132 @@ class FakeClock:
 
     def advance(self, seconds: float) -> None:
         self.now += float(seconds)
+
+
+# The distinguished "preempted, resumable" exit code (BSD EX_TEMPFAIL):
+# a supervisor or cluster scheduler seeing it knows the run snapshotted
+# cleanly and wants to be relaunched with --resume on whatever hardware
+# comes back — unlike a crash (traceback, nonzero generic) or a NaN
+# abort (policy verdict, not retryable).
+EXIT_PREEMPTED = 75
+
+
+class Preempted(SystemExit):
+    """Raised by a trainer after a preemption notice (SIGTERM/SIGINT or
+    an injected ``preempt`` fault) once the in-flight step finished.
+    Derives SystemExit so `supervise` passes it through — an in-process
+    retry cannot answer a scheduler's eviction; the relaunch happens on
+    the NEXT placement, via --resume.
+
+    The exit code keeps the EXIT_PREEMPTED contract honest: 75 is
+    raised ONLY when a snapshot actually landed (resumable=True); a
+    preemption with no checkpoint dir exits 1 — a supervisor must not
+    relaunch-with-resume a run that has nothing to resume from."""
+
+    def __init__(self, msg: str = "preempted", *, resumable: bool = True):
+        super().__init__(EXIT_PREEMPTED if resumable else 1)
+        self.msg = msg
+        self.resumable = resumable
+
+    def __str__(self) -> str:  # SystemExit.__str__ shows the code only
+        return self.msg
+
+
+def drain_preemption(guard: "PreemptionGuard", *, state, global_step: int,
+                     ckpt, metrics, logger) -> None:
+    """The orderly preemption exit, shared by both trainers (ONE
+    implementation, the NanGuard precedent): no-op unless the guard is
+    flagged; otherwise snapshot through the atomic checksummed path,
+    make it durable, emit the obs trail, raise Preempted.
+
+    Runs at step/chunk boundaries only (the callers guarantee the
+    in-flight step finished). `ckpt` is the trainer's AsyncCheckpointer
+    or None; a save already issued for this exact step (an interval
+    save on the same boundary) is not repeated — the drain just waits
+    for it, so the eviction grace window never pays the same write
+    twice. Without a checkpointer the run still exits in an orderly way
+    but as NOT resumable (exit 1, no false snapshot claim)."""
+    if not guard.requested:
+        return
+    snapshotted = ckpt is not None
+    if snapshotted:
+        if ckpt.last_step != global_step:
+            ckpt.save(state, global_step)
+        ckpt.wait()  # durable BEFORE the process exits
+        metrics.log("ckpt", step=global_step, reason="preempt")
+    else:
+        logger.warning(
+            "preempted with no --checkpoint-dir: progress up to step "
+            "%d is lost", global_step,
+        )
+    metrics.log("fault", kind="preempt", step=global_step,
+                signum=guard.signum, resumable=snapshotted)
+    if snapshotted:
+        logger.warning(
+            "preempted at step %d: snapshot written, exiting %d "
+            "(resume with --resume on whatever topology comes back)",
+            global_step, EXIT_PREEMPTED,
+        )
+    raise Preempted(f"preempted at step {global_step}",
+                    resumable=snapshotted)
+
+
+class PreemptionGuard:
+    """Deferred-preemption flag shared by the signal handler, the fault
+    injector, and the trainer step loop.
+
+    The handler/injector only ever SETS a flag; the trainer polls it at
+    step (or scanned-chunk) boundaries, where the state is consistent,
+    and performs the orderly exit itself: finish the in-flight step,
+    write a checkpoint through the atomic/checksummed path, emit the
+    obs events, raise Preempted. install() hooks SIGTERM+SIGINT (the
+    preemptible-VM notice and the operator's ^C take the same orderly
+    path); uninstall() restores the previous handlers, and the guard is
+    a context manager so tests can't leak handlers. A second signal
+    while the first is still draining falls through to the PREVIOUS
+    handler (default: die) — a stuck drain must stay killable.
+    """
+
+    def __init__(self):
+        self.requested = False
+        self.signum: int | None = None
+        self._prev: dict[int, object] = {}
+
+    def request(self, signum: int | None = None) -> None:
+        self.requested = True
+        if self.signum is None:
+            self.signum = signum
+
+    def _handle(self, signum, frame) -> None:
+        if self.requested:
+            # Second notice: restore + re-raise via the previous handler
+            # so an impatient operator's repeat ^C still kills the run.
+            self.uninstall()
+            _signal.raise_signal(signum)
+            return
+        self.request(signum)
+
+    def install(self, signals=(_signal.SIGTERM, _signal.SIGINT)) -> "PreemptionGuard":
+        for s in signals:
+            try:
+                self._prev[s] = _signal.signal(s, self._handle)
+            except ValueError:
+                # Not the main thread (embedded caller): injected
+                # preempt faults still work — only OS signals don't
+                # reach this guard.
+                pass
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            _signal.signal(s, prev)
+        self._prev.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
 
 
 class FaultInjector:
@@ -343,18 +478,29 @@ def all_finite(tree):
 
 
 def supervise(attempt_fn: Callable[[int], object], *, max_restarts: int,
-              logger=None, metrics=None) -> object:
+              logger=None, metrics=None, backoff_base: float = 0.5,
+              sleep=time.sleep, jitter=random.random) -> object:
     """The crash-safe training supervisor: run `attempt_fn(attempt)` and,
     on a crash, rerun it up to `max_restarts` more times.
 
     `attempt_fn` receives the attempt index (0 = first run) and must
     itself arrange resume-from-checkpoint for attempt > 0 (the CLI does
     this by forcing cfg.resume on retries). KeyboardInterrupt,
-    SystemExit, and NonFiniteLossError pass through — the operator's
-    kill and the NaN guard's verdict are not faults to retry (an
-    organic NaN replays deterministically from the checkpoint).
-    Exhausted restarts re-raise the last crash. Each restart emits a
-    ``fault`` obs event (kind="restart") when a metrics sink is given.
+    SystemExit (which covers Preempted — a scheduler's eviction is
+    answered by relaunch-with-resume, not an in-process retry), and
+    NonFiniteLossError pass through — the operator's kill and the NaN
+    guard's verdict are not faults to retry (an organic NaN replays
+    deterministically from the checkpoint). Exhausted restarts re-raise
+    the last crash.
+
+    Restarts are paced with exponential backoff plus jitter
+    (utils/retry.backoff_delay: backoff_base * 2^attempt * (1+U[0,1));
+    backoff_base=0 disables) — an immediate-restart storm against a
+    sick filesystem or coordinator just reproduces the crash faster,
+    and the jitter de-synchronizes a fleet of supervisors relaunching
+    into the same recovering dependency. Each restart emits a ``fault``
+    obs event (kind="restart", with the delay) when a metrics sink is
+    given; `sleep`/`jitter` are test injection points.
     """
     last: BaseException | None = None
     for attempt in range(max_restarts + 1):
@@ -370,15 +516,19 @@ def supervise(attempt_fn: Callable[[int], object], *, max_restarts: int,
             last = e
             if attempt >= max_restarts:
                 break
+            delay = backoff_delay(attempt, backoff_base, jitter)
             if logger is not None:
                 logger.warning(
                     "training attempt %d crashed (%s: %s); restarting "
-                    "from the latest valid checkpoint (%d restart(s) "
-                    "left)", attempt, type(e).__name__, e,
-                    max_restarts - attempt,
+                    "from the latest valid checkpoint in %.2fs "
+                    "(%d restart(s) left)", attempt, type(e).__name__, e,
+                    delay, max_restarts - attempt,
                 )
             if metrics is not None:
                 metrics.log("fault", kind="restart", attempt=attempt,
+                            delay_s=round(delay, 4),
                             error=f"{type(e).__name__}: {e}")
+            if delay > 0:
+                sleep(delay)
     assert last is not None
     raise last

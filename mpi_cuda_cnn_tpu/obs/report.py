@@ -159,7 +159,8 @@ def summarize(records: Iterable[dict], *,
         summary["requests"] = rows
 
     faults = ev.get("fault", [])
-    if faults:
+    ckpts = ev.get("ckpt", [])
+    if faults or ckpts:
         by_kind: dict[str, int] = {}
         for r in faults:
             kind = r.get("kind", "?")
@@ -170,6 +171,14 @@ def summarize(records: Iterable[dict], *,
             "restarts": by_kind.get("restart", 0),
             "nonfinite_steps": by_kind.get("nonfinite_step", 0),
             "checkpoint_fallbacks": by_kind.get("ckpt_fallback", 0),
+            # Elasticity trail (ISSUE 5): preemption snapshots taken,
+            # resumes that changed the mesh underneath the run.
+            "preemptions": by_kind.get("preempt", 0),
+            "topology_changes": by_kind.get("topology_change", 0),
+            "ckpt_events": {
+                reason: sum(1 for r in ckpts if r.get("reason") == reason)
+                for reason in sorted({r.get("reason", "?") for r in ckpts})
+            },
         }
 
     serves = ev.get("serve", [])
@@ -313,14 +322,25 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
     if "robustness" in summary:
         rb = summary["robustness"]
         lines += [
-            "| robustness | events | restarts | non-finite steps "
+            "| robustness | events | restarts | preempted "
+            "| topology changes | non-finite steps "
             "| ckpt fallbacks | by kind |",
-            "|---|---|---|---|---|---|",
+            "|---|---|---|---|---|---|---|---|",
             f"| | {rb['events']} | {rb['restarts']} "
+            f"| {rb.get('preemptions', 0)} "
+            f"| {rb.get('topology_changes', 0)} "
             f"| {rb['nonfinite_steps']} | {rb['checkpoint_fallbacks']} "
             f"| {_fmt(rb['by_kind'])} |",
             "",
         ]
+        if rb.get("ckpt_events"):
+            lines += [
+                "| checkpoints | " + " | ".join(rb["ckpt_events"]) + " |",
+                "|---|" + "---|" * len(rb["ckpt_events"]),
+                "| | " + " | ".join(str(v) for v in
+                                    rb["ckpt_events"].values()) + " |",
+                "",
+            ]
     if "serve" in summary:
         lines += [
             "| serve run | requests | tokens/s | decode ticks "

@@ -61,9 +61,17 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # One fault-domain occurrence (faults.py / trainers / serve engine):
     # injected faults (kind="injected_*"), supervisor restarts, NaN-guard
     # actions (nonfinite_step / nan_restore), checkpoint fallbacks,
-    # request aborts/rejections, watchdog breaches. Free-form beyond
-    # "kind" — the robustness table aggregates by kind.
+    # preemptions (kind="preempt") and cross-resume topology changes
+    # (kind="topology_change" — ISSUE 5), request aborts/rejections,
+    # watchdog breaches. Free-form beyond "kind" — the robustness table
+    # aggregates by kind.
     "fault": ("kind",),
+    # One checkpoint lifecycle moment (trainers, ISSUE 5): "reason" is
+    # why it happened (preempt = the preemption snapshot, resume = a
+    # restore into a fresh process); "step" is the global step it
+    # captures. Interval saves stay un-evented (they'd dominate the
+    # stream); the elasticity-relevant moments are what reports need.
+    "ckpt": ("step", "reason"),
 }
 
 

@@ -65,3 +65,17 @@ def process_info() -> ProcessInfo:
         local_devices=jax.local_device_count(),
         global_devices=jax.device_count(),
     )
+
+
+def barrier(name: str) -> None:
+    """Block until every process reaches this point (the multihost
+    checkpoint-write ordering fence: process 0 writes, everyone meets
+    here, so no process can act on "the checkpoint exists" before it
+    does — train/checkpoint.save_checkpoint). Single-process runs
+    return immediately; `name` keys the rendezvous so two different
+    barrier sites can't accidentally pair up."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

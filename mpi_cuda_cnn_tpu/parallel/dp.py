@@ -150,6 +150,8 @@ def _make_step_body(
     augment=None,
     aug_seed: int = 0,
     grad_accum: int = 1,
+    elastic_width: int = 0,
+    axis_size: int = 1,
 ):
     """The per-step SPMD body shared by the one-batch step and the scanned
     epoch: local grads, ONE fused gradient all-reduce, identical update on
@@ -159,7 +161,55 @@ def _make_step_body(
     keyed by (step, data-axis index) so every device and every step draws
     independent transforms, and a resumed run (step restored from a
     checkpoint) replays the same stream.
+
+    elastic_width > 0 swaps the local-mean + pmean gradient for the
+    width-invariant canonical-tree reduction (parallel/elastic.py): the
+    update — and therefore the whole trajectory — is bitwise identical
+    on any power-of-two data-axis width with >= 2 canonical microbatches
+    per device, which is what makes a preempted run resumable on a
+    different topology (ISSUE 5). On that path the augment key folds in
+    the GLOBAL canonical-shard index, not the device rank, so the pixel
+    stream is width-invariant too.
     """
+
+    def elastic_step(state: TrainState, x, y):
+        from .elastic import elastic_grads
+
+        def grad_fn(px, py):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state["params"], px, py)
+            return loss, aux, grads
+
+        prepare = None
+        if augment is not None:
+            def prepare(px, py, shard_idx):
+                key = jax.random.fold_in(
+                    jax.random.key(aug_seed), state["step"]
+                )
+                key = jax.random.fold_in(key, shard_idx)
+                return augment(key, px), py
+
+        with annotate("dp.elastic_grads"):
+            # Every metric make_loss_fn returns is mean-semantics
+            # (loss, acc, and etotal — squared_error_total divides by
+            # its batch size), so the mean over canonical microbatches
+            # keeps every metric on the plain step's scale
+            # (test_elastic_metrics_match_plain_scale pins it).
+            loss, aux, grads = elastic_grads(
+                grad_fn, x, y, elastic_width=elastic_width, axis=axis,
+                axis_size=axis_size, prepare=prepare,
+            )
+        with annotate("dp.update"):
+            updates, opt_state = optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": loss, **aux},
+        )
 
     def step(state: TrainState, x, y):
         if augment is not None:
@@ -188,7 +238,7 @@ def _make_step_body(
                      "step": state["step"] + 1}
         return new_state, {"loss": loss, **aux}
 
-    return step
+    return elastic_step if elastic_width else step
 
 
 def make_dp_train_step(
@@ -201,14 +251,19 @@ def make_dp_train_step(
     augment=None,
     aug_seed: int = 0,
     grad_accum: int = 1,
+    elastic_width: int = 0,
 ):
     """Build the jitted DP train step.
 
     loss_fn(params, x, y) -> (scalar loss, aux dict); x/y are the
     per-device shard inside shard_map. Returns step(state, x, y) ->
     (state, metrics) with state replicated and batches sharded on `axis`.
+    elastic_width > 0 selects the width-invariant gradient reduction
+    (see _make_step_body / parallel/elastic.py).
     """
-    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed, grad_accum)
+    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed,
+                           grad_accum, elastic_width,
+                           mesh.shape.get(axis, 1))
 
     # check_vma=False: collective typing stays classic/explicit (local grads
     # until the pmean above). Also required for Pallas interpreter-mode
@@ -234,6 +289,7 @@ def make_dp_scan_epoch(
     augment=None,
     aug_seed: int = 0,
     grad_accum: int = 1,
+    elastic_width: int = 0,
 ):
     """Build a jitted many-steps-per-dispatch trainer: the whole (chunk of
     an) epoch is ONE `lax.scan` over a batch-index permutation, with the raw
@@ -250,7 +306,9 @@ def make_dp_scan_epoch(
       perm:   (nsteps, batch) int32, batch dim sharded on `axis`.
       metric_sums: metrics summed over the scanned steps.
     """
-    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed, grad_accum)
+    step = _make_step_body(loss_fn, optimizer, axis, augment, aug_seed,
+                           grad_accum, elastic_width,
+                           mesh.shape.get(axis, 1))
 
     def epoch(state: TrainState, images, labels, perm):
         def body(state, idx):

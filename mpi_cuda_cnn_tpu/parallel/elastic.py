@@ -1,0 +1,203 @@
+"""Width-invariant data parallelism — the numerics behind elastic resume.
+
+The problem (ISSUE 5): a preempted run must resume on whatever data-axis
+width the scheduler hands back (dp=4 -> dp=2 -> dp=8), and the elastic
+contract we prove is BITWISE — the resumed trajectory equals the
+uninterrupted one. The standard DP step cannot give that: each device
+takes the mean gradient of its local shard and `pmean`s the results, so
+changing the width regroups the floating-point reductions (a 16-sample
+local mean is not bitwise the sum of two 8-sample means) and the
+trajectories drift apart within one step (measured ~1e-8/step on this
+container's CPU backend — see tests/test_elastic.py).
+
+The fix is to make the reduction tree a function of the CONFIG, not the
+hardware: a fixed "elastic width" W0 defines B/W0-sample *canonical
+microbatches*, and the step always computes
+
+    grad = (1/W0) * balanced-binary-tree-sum of per-microbatch mean grads
+
+no matter how many devices execute it. Each device scans its contiguous
+W0/n microbatches (same per-microbatch program at every width — the
+shapes are fixed by W0, not n), reduces them with the LOW levels of the
+global balanced tree (reshape-halving: adjacent pairs, then pairs of
+pairs), and a recursive-doubling ppermute all-reduce supplies the HIGH
+levels (rank r adds rank r^1, then r^2, then r^4 — the same balanced
+tree, and IEEE addition is commutative so every rank converges to
+identical bits). Because each device's microbatches are an ALIGNED
+contiguous block of a power-of-two size, its local subtree is exactly a
+complete subtree of the global one — the total association is identical
+for every power-of-two width n with W0/n >= 2.
+
+Two compiler effects have to be fenced, both found empirically (this
+container's XLA CPU; the guards are cheap everywhere):
+
+- trip-count-1 loops are fully unrolled and re-fused with their
+  surroundings, changing the microbatch computation's rounding — hence
+  the W0 >= 2*n floor (every width keeps a real loop);
+- the optimizer's multiply-add chains fuse differently depending on the
+  gradient-producing program feeding them — an `optimization_barrier`
+  around the scan body and between the reduced gradient and the
+  optimizer pins both (without it, AdamW's moments drift ~1e-9/step
+  across widths even on identical gradients).
+
+Cost: the scan stacks W0/n per-microbatch gradient trees before the
+tree reduce, so peak gradient memory is (W0/n)x the plain step's, and
+per-microbatch kernels are smaller than full-shard ones. That is the
+price of the bitwise contract; runs that don't need elasticity leave
+`--elastic-width 0` and keep the plain pmean step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DATA_AXIS
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def check_elastic_width(elastic_width: int, batch_size: int,
+                        n_data: int) -> None:
+    """Validate the (W0, batch, width) triple, raising ValueError with
+    the constraint that failed. The rules exist for bitwise-ness, so
+    they are hard errors, not clamps: W0 and the data-axis size must be
+    powers of two (the balanced tree needs complete subtrees), W0 must
+    divide the batch (fixed canonical microbatch size), and every
+    width must keep >= 2 microbatches per device (XLA unrolls
+    trip-count-1 loops and re-fuses the body — the one case measured to
+    break bitwise equality)."""
+    if not _is_pow2(elastic_width):
+        raise ValueError(
+            f"--elastic-width {elastic_width} must be a power of two "
+            "(the width-invariant reduction is a balanced binary tree)"
+        )
+    if batch_size % elastic_width:
+        raise ValueError(
+            f"--elastic-width {elastic_width} must divide batch_size "
+            f"{batch_size} (it fixes the canonical microbatch size)"
+        )
+    if not _is_pow2(n_data):
+        raise ValueError(
+            f"--elastic-width needs a power-of-two data-axis size "
+            f"(got {n_data}): device blocks must be complete subtrees "
+            "of the canonical reduction tree"
+        )
+    if elastic_width < 2 * n_data:
+        raise ValueError(
+            f"--elastic-width {elastic_width} must be >= 2x the "
+            f"data-axis size ({n_data}): each device needs >= 2 "
+            "canonical microbatches (a trip-count-1 scan is unrolled "
+            "and re-fused by XLA, breaking the bitwise contract)"
+        )
+
+
+def local_tree_reduce(stacked):
+    """Balanced binary tree sum over the leading axis (a power of two):
+    adjacent pairs first, then pairs of pairs — the LOW levels of the
+    global canonical tree. Explicit pairwise adds (r[:,0] + r[:,1]), so
+    the association is pinned in the HLO graph rather than left to a
+    reduce op's implementation-chosen order."""
+
+    def halve(t):
+        r = t.reshape(t.shape[0] // 2, 2, *t.shape[1:])
+        return r[:, 0] + r[:, 1]
+
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    while n > 1:
+        stacked = jax.tree.map(halve, stacked)
+        n //= 2
+    return jax.tree.map(lambda t: t[0], stacked)
+
+
+def tree_allreduce(tree, axis: str, n: int):
+    """Recursive-doubling all-reduce over mesh axis `axis` (size `n`, a
+    power of two) via ppermute: round r adds the partner at XOR-distance
+    2^r, so rank 0 accumulates ((x0+x1)+(x2+x3))+... — the HIGH levels
+    of the canonical balanced tree — and every rank converges to the
+    SAME bits (IEEE addition is commutative, so partner-order mirroring
+    cancels). n == 1 is the identity."""
+    dist = 1
+    while dist < n:
+        perm = [(i, i ^ dist) for i in range(n)]
+        tree = jax.tree.map(
+            lambda t: t + jax.lax.ppermute(t, axis, perm), tree
+        )
+        dist *= 2
+    return tree
+
+
+def elastic_grads(
+    grad_fn: Callable,
+    x,
+    y,
+    *,
+    elastic_width: int,
+    axis: str = DATA_AXIS,
+    axis_size: int = 1,
+    prepare: Callable | None = None,
+):
+    """Width-invariant (loss, aux, grads) over the local batch shard.
+
+    `grad_fn(px, py) -> (loss, aux, grads)` computes one canonical
+    microbatch (params closed over — keeps the scan carry empty so the
+    stacked ys are the only growth). `prepare(px, py, shard_index)`
+    optionally transforms a microbatch first with its GLOBAL canonical
+    index (0..W0) — augmentation must key on the canonical shard, not
+    the device rank, or the pixel stream would change with the width.
+
+    Every (loss, aux, grad) triple is reduced with the SAME canonical
+    tree and divided by W0, so loss/aux come back as the mean over
+    canonical microbatches — width-invariant like the grads (the plain
+    step's pmean-of-shard-means equals this only in exact arithmetic).
+    The scan body and the reduced outputs are optimization_barrier'd:
+    the per-microbatch program and the downstream optimizer fusion must
+    not vary with what surrounds them (module docstring).
+    """
+    k = elastic_width // axis_size  # canonical microbatches per device
+    mb = x.shape[0] // k
+
+    def split(t):
+        return t.reshape(k, mb, *t.shape[1:])
+
+    xs, ys = split(x), split(y)
+    if prepare is not None:
+        base = jax.lax.axis_index(axis) * k
+
+    def body(i, xy):
+        px, py = jax.lax.optimization_barrier(xy)
+        if prepare is not None:
+            px, py = prepare(px, py, base + i)
+        out = grad_fn(px, py)
+        return i + 1, jax.lax.optimization_barrier(out)
+
+    _, stacked = jax.lax.scan(body, jnp.zeros((), jnp.int32), (xs, ys))
+    reduced = tree_allreduce(local_tree_reduce(stacked), axis, axis_size)
+    reduced = jax.tree.map(lambda t: t / elastic_width, reduced)
+    return jax.lax.optimization_barrier(reduced)
+
+
+def host_shard_rows(batch_size: int, process_index: int,
+                    process_count: int) -> tuple[int, int]:
+    """[start, stop) rows of the GLOBAL batch owned by this host — pure
+    function of (batch index layout, process), never a stored per-rank
+    cursor (ISSUE 5 data-order elasticity): a run resumed on a
+    different host count re-derives its shard from the same global
+    batch sequence, so the consumed data stream is identical. Row
+    blocks are contiguous and equal-sized, matching the mesh's
+    process-major device order.
+
+    This is the CONTRACT for a future multihost data loader, pinned by
+    tests; today's trainers feed global arrays in a single process and
+    do not consume it yet (README "Data-order elasticity")."""
+    if batch_size % process_count:
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by process_count "
+            f"{process_count}"
+        )
+    per = batch_size // process_count
+    return process_index * per, (process_index + 1) * per

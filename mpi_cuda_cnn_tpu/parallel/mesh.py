@@ -24,6 +24,14 @@ def local_device_count() -> int:
     return jax.local_device_count()
 
 
+def describe_mesh(mesh: Mesh) -> dict:
+    """JSON-able mesh summary for checkpoint manifests (axis name ->
+    size, plus the device count): what topology-change-tolerant restore
+    records at save time and compares at resume time (ISSUE 5). Axis
+    ORDER is preserved — it is part of the device layout."""
+    return {"axes": dict(mesh.shape), "devices": int(mesh.size)}
+
+
 def make_mesh(axes: dict[str, int] | None = None, *, devices=None) -> Mesh:
     """Build a Mesh from an axis-name -> size dict.
 

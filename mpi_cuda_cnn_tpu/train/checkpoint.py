@@ -72,21 +72,53 @@ def _atomic_write_text(path: Path, text: str) -> None:
 
 
 def save_checkpoint(ckpt_dir: str | Path, state, step: int, *, keep: int = 3,
-                    faults=None) -> Path:
+                    faults=None, meta: dict | None = None,
+                    protect: str | None = None, process=None,
+                    barrier=None) -> Path:
     """Write state as ckpt_{step}.npz + the JSON manifest; prune old.
 
     Both files are tmp-written then renamed: a crash at ANY point leaves
     either the previous consistent (files, manifest) pair or the new
-    one, never a torn file under a live name. `faults` is a
-    faults.FaultInjector hook (sites "ckpt.pre_rename" — between the
-    npz tmp write and its rename — and "ckpt.manifest", before the
-    manifest update), used by the crash-during-save tests; None is a
-    no-op.
+    one, never a torn file under a live name — and pruning runs only
+    AFTER the new checkpoint's rename, so the window where the
+    directory holds fewer than `keep` restorable checkpoints never
+    opens (ISSUE 5 satellite). `faults` is a faults.FaultInjector hook
+    (sites "ckpt.pre_rename" — between the npz tmp write and its
+    rename — and "ckpt.manifest", before the manifest update), used by
+    the crash-during-save tests; None is a no-op.
+
+    `meta` (e.g. mesh axes + elastic width, Trainer._ckpt_meta) is
+    recorded per checkpoint in the manifest — what topology-change
+    restore validates against. `protect` names one checkpoint file that
+    pruning must never delete: the trainers pass the checkpoint the
+    CURRENT run resumed from, so a crash right after a resume always
+    leaves the known-good restore point in place.
+
+    `process` (parallel/distributed.ProcessInfo) + `barrier` make the
+    write multihost-safe: only process 0 touches the filesystem; every
+    process then meets at the barrier, so no process can read (or exit
+    into a restore) before the writer finished. Defaults keep the
+    single-process behavior byte-identical.
     """
     ckpt_dir = Path(ckpt_dir)
+    path = ckpt_dir / f"ckpt_{step}.npz"
+    # The barrier name is keyed by STEP: if two processes ever reach
+    # save_checkpoint for different steps (e.g. a preemption drain on
+    # one host racing an interval save on another), the rendezvous
+    # mismatch fails loudly instead of silently pairing unrelated save
+    # events. Coordinating the drain step itself across hosts is the
+    # missing piece of true multihost preemption — future work; today's
+    # supported reality is single-process (barrier is then a no-op).
+    fence = f"ckpt_save_{step}"
+    if process is not None and process.process_index != 0:
+        # Non-writers: just meet the writer at the barrier. The shared
+        # filesystem's rename is the publication point; the barrier is
+        # the ordering proof (tests/test_elastic.py multihost suite).
+        if barrier is not None:
+            barrier(fence)
+        return path
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     flat = _flatten(jax.device_get(state))
-    path = ckpt_dir / f"ckpt_{step}.npz"
     # Tmp is a dotfile (invisible to the ckpt_*.npz glob), so a crash
     # between write and rename can't poison later listing; it must still
     # end in .npz or np.savez appends the suffix itself.
@@ -102,18 +134,74 @@ def save_checkpoint(ckpt_dir: str | Path, state, step: int, *, keep: int = 3,
     if not isinstance(checksums, dict):
         checksums = {}
     checksums[path.name] = {k: _checksum(v) for k, v in flat.items()}
+    metas = mf.get("meta")
+    if not isinstance(metas, dict):
+        metas = {}
+    if meta is not None:
+        metas[path.name] = meta
     live = _list_checkpoints(ckpt_dir)
-    for p in live[:-keep]:
+    drop = [p for p in live[:-keep] if p.name != protect]
+    for p in drop:
         p.unlink()
         checksums.pop(p.name, None)
-    kept = {p.name for p in live[-keep:]}
+    kept = {p.name for p in live if p not in drop}
     _atomic_write_text(ckpt_dir / MANIFEST, json.dumps({
         "latest_step": step,
         "keys": sorted(flat),
         "checksums": {n: c for n, c in sorted(checksums.items())
                       if n in kept},
+        "meta": {n: m for n, m in sorted(metas.items()) if n in kept},
     }, indent=2))
+    if barrier is not None:
+        barrier(fence)
     return path
+
+
+def checkpoint_meta(ckpt_dir: str | Path, name: str) -> dict | None:
+    """The manifest's per-checkpoint meta entry (mesh axes, elastic
+    width, process count — whatever the writer recorded), or None for
+    pre-meta checkpoints / missing manifest. Restore-side topology
+    validation reads this (validate_resume_meta below)."""
+    mf = _load_manifest(Path(ckpt_dir))
+    if mf is None:
+        return None
+    metas = mf.get("meta")
+    entry = metas.get(name) if isinstance(metas, dict) else None
+    return entry if isinstance(entry, dict) else None
+
+
+def validate_resume_meta(ckpt_path, *, mesh, elastic_width: int, metrics,
+                         logger) -> None:
+    """Check a restored checkpoint's recorded topology against the live
+    one — shared by both trainers (ONE implementation). A changed mesh
+    is the POINT of elasticity: log it and emit a topology_change obs
+    event (full-array checkpoints reshard on placement). A changed
+    elastic width is a hard error — the width-invariant reduction tree
+    is keyed by W0, so changing it silently breaks the bitwise contract
+    mid-run. Pre-meta checkpoints validate vacuously."""
+    meta = checkpoint_meta(Path(ckpt_path).parent, Path(ckpt_path).name)
+    if meta is None:
+        return
+    saved_w = meta.get("elastic_width")
+    if saved_w is not None and int(saved_w) != int(elastic_width):
+        raise ValueError(
+            f"checkpoint {Path(ckpt_path).name} was written with "
+            f"--elastic-width {saved_w}, this run uses {elastic_width}: "
+            "the canonical reduction tree would change mid-run — "
+            "resume with the original width"
+        )
+    from ..parallel.mesh import describe_mesh
+
+    saved_mesh = meta.get("mesh") or {}
+    live = describe_mesh(mesh)
+    if saved_mesh and saved_mesh != live:
+        metrics.log("fault", kind="topology_change", saved=saved_mesh,
+                    live=live)
+        logger.info(
+            "topology changed across resume: checkpoint written under "
+            "%s, resuming under %s (full-array checkpoints reshard on "
+            "placement)", saved_mesh, live,
+        )
 
 
 class AsyncCheckpointer:
@@ -134,10 +222,23 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, ckpt_dir: str | Path, *, keep: int = 3,
-                 async_: bool = True, faults=None):
+                 async_: bool = True, faults=None, meta: dict | None = None,
+                 process=None, barrier=None):
         self.ckpt_dir = Path(ckpt_dir)
         self.keep = keep
         self.faults = faults
+        # Per-checkpoint manifest metadata (mesh/elastic topology) and
+        # the resumed-from checkpoint pruning must never delete; the
+        # trainer sets `protect` after a successful resume.
+        self.meta = meta
+        self.protect: str | None = None
+        self.process = process
+        self.barrier = barrier
+        # The step of the most recently issued save — lets the
+        # preemption drain skip re-writing a checkpoint an interval
+        # save already produced on the same boundary (faults.
+        # drain_preemption).
+        self.last_step: int | None = None
         self._executor = None
         self._pending = None
         if async_:
@@ -147,17 +248,34 @@ class AsyncCheckpointer:
                 max_workers=1, thread_name_prefix="ckpt"
             )
 
+    def _kwargs(self, barrier=None) -> dict:
+        return dict(keep=self.keep, faults=self.faults, meta=self.meta,
+                    protect=self.protect, process=self.process,
+                    barrier=barrier)
+
     def save(self, state, step: int) -> None:
-        """Snapshot `state` (device or host pytree) and schedule the write."""
-        if self._executor is None:
+        """Snapshot `state` (device or host pytree) and schedule the write.
+
+        Multihost runs (process_count > 1) save SYNCHRONOUSLY on the
+        calling thread even when async_ is on: the publication barrier
+        is a device collective, and a collective issued from the worker
+        thread would be unordered against the main thread's train-step
+        collectives — mismatched collective order across processes
+        deadlocks the runtime. Correctness over overlap there; the
+        single-process path (where the barrier is a no-op) keeps the
+        background write."""
+        self.last_step = step
+        if self._executor is None or (
+            self.process is not None and self.process.process_count > 1
+        ):
             save_checkpoint(self.ckpt_dir, jax.device_get(state),
-                            step, keep=self.keep, faults=self.faults)
+                            step, **self._kwargs(barrier=self.barrier))
             return
         self.wait()  # drain (and re-raise from) any in-flight write
         host = jax.device_get(state)
+        # barrier=None: the worker thread must never issue collectives.
         self._pending = self._executor.submit(
-            save_checkpoint, self.ckpt_dir, host, step, keep=self.keep,
-            faults=self.faults,
+            save_checkpoint, self.ckpt_dir, host, step, **self._kwargs(),
         )
 
     def wait(self) -> None:

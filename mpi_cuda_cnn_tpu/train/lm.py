@@ -223,6 +223,75 @@ def make_lm_train_step(
     return step
 
 
+def make_elastic_lm_train_step(
+    model: TransformerLM,
+    optimizer,
+    mesh,
+    *,
+    elastic_width: int,
+    attn_impl: str = "auto",
+    seq_len: int | None = None,
+    compute_dtype=None,
+    remat: bool = False,
+    donate: bool = True,
+    moe_aux_weight: float = 0.01,
+    ce_chunk: int = 0,
+):
+    """The LM train step with the width-invariant gradient reduction
+    (parallel/elastic.py) — the elastic twin of make_lm_train_step.
+
+    The plain LM step is a GSPMD jit: data parallelism falls out of the
+    batch sharding, and XLA chooses how the batch reductions partition —
+    which is exactly what changes bit patterns when the width changes.
+    This step is an explicit shard_map over the 'data' axis instead, so
+    the gradient is the canonical balanced-tree sum over fixed-size
+    microbatches at every width: a run preempted at dp=4 and resumed at
+    dp=2 stays on the uninterrupted run's bitwise trajectory (ISSUE 5;
+    proven in tests/test_elastic.py). Pure-DP meshes only — the trainer
+    rejects elastic_width on seq/model/pipe/expert meshes.
+    """
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.elastic import elastic_grads
+    from ..parallel.mesh import DATA_AXIS
+
+    impl = pick_attn_impl(attn_impl, seq_len or model.max_seq, compute_dtype)
+    attn_fn = get_attn_fn(impl)
+    loss = partial(
+        lm_loss, model, attn_fn=attn_fn, compute_dtype=compute_dtype,
+        remat=remat, moe_aux_weight=moe_aux_weight, ce_chunk=ce_chunk,
+    )
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+
+    def step(state, tokens, targets):
+        def grad_fn(px, py):
+            l, grads = jax.value_and_grad(loss)(state["params"], px, py)
+            return l, grads
+
+        l, grads = elastic_grads(
+            grad_fn, tokens, targets, elastic_width=elastic_width,
+            axis=DATA_AXIS, axis_size=n_data,
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        return (
+            {"params": params, "opt_state": opt_state,
+             "step": state["step"] + 1},
+            {"loss": l},
+        )
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return donate_jit(sharded, donate=donate), impl
+
+
 def make_lm_state(model: TransformerLM, optimizer, seed: int = 0) -> dict:
     """Fresh {"params", "opt_state", "step"} for the LM train step."""
     params = model.init(jax.random.key(seed))

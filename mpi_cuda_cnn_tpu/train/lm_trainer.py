@@ -32,14 +32,21 @@ from ..faults import (
     MAX_NAN_ROLLBACKS,
     NanGuard,
     NonFiniteLossError,
+    PreemptionGuard,
     RollbackToCheckpoint,
     all_finite,
+    drain_preemption,
     step_is_finite,
 )
+from ..parallel.distributed import barrier, process_info
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer
 from ..utils.sync import hard_block
-from .checkpoint import AsyncCheckpointer, restore_latest
+from .checkpoint import (
+    AsyncCheckpointer,
+    restore_latest,
+    validate_resume_meta,
+)
 from .lm import get_attn_fn, lm_loss, make_lm_state, make_lm_train_step, pick_attn_impl
 from .optimizer import make_optimizer
 
@@ -92,7 +99,8 @@ class LMTrainer:
     """
 
     def __init__(self, cfg, *, mesh=None,
-                 metrics: MetricsLogger | None = None, faults=None):
+                 metrics: MetricsLogger | None = None, faults=None,
+                 preempt: PreemptionGuard | None = None):
         self.cfg = cfg
         self.log = get_logger()
         self.metrics = metrics or MetricsLogger()
@@ -101,6 +109,10 @@ class LMTrainer:
         # supervisor restarts; the guard's policy rules are the shared
         # faults.NanGuard (one implementation for both trainers).
         self.faults = faults
+        # Preemption guard (ISSUE 5) — same contract as the CNN
+        # Trainer's: the CLI installs signal handlers and shares one;
+        # the default answers injected `preempt` faults only.
+        self._preempt = preempt if preempt is not None else PreemptionGuard()
         self._nan = NanGuard(getattr(cfg, "nan_policy", "off"),
                              getattr(cfg, "nan_max_bad", 3))
         self._finite_fn = jax.jit(all_finite) if self._nan.active else None
@@ -309,6 +321,34 @@ class LMTrainer:
                 "--fsdp needs a 'data' mesh axis of size > 1 "
                 f"(mesh_shape={cfg.mesh_shape!r})"
             )
+        if cfg.elastic_width:
+            # Elastic (width-invariant) training rides the pure-DP
+            # shard_map step only — sharded-param layouts change WHAT
+            # is reduced when the width changes, and the dispatch-dtype
+            # knobs aren't threaded through the elastic body.
+            from ..parallel.elastic import check_elastic_width
+
+            if (self.n_seq > 1 or self.n_model > 1 or self.n_pipe > 1
+                    or self.n_expert > 1 or cfg.fsdp):
+                raise ValueError(
+                    "--elastic-width needs a pure data-parallel mesh "
+                    f"(mesh_shape={cfg.mesh_shape!r}/--fsdp shard the "
+                    "state; cross-width bitwise resume is only defined "
+                    "for replicated params)"
+                )
+            if cfg.grad_accum > 1:
+                raise ValueError(
+                    "--elastic-width already scans canonical "
+                    "microbatches; --grad-accum is redundant with it"
+                )
+            if cfg.moe_dispatch_chunk or cfg.moe_dispatch_dtype:
+                raise ValueError(
+                    "--moe-dispatch-chunk/--moe-dispatch-dtype ride the "
+                    "plain jitted step; the elastic shard_map step does "
+                    "not thread them — drop one of the two"
+                )
+            check_elastic_width(cfg.elastic_width, cfg.batch_size,
+                                self.n_data)
 
         # Cosine needs positive decay_steps: clamp warmup only when it
         # would swallow the whole (short) run, and say so.
@@ -484,6 +524,20 @@ class LMTrainer:
                 grad_clip=cfg.grad_clip if cfg.fsdp else 0.0,
                 grad_accum=cfg.grad_accum, donate=cfg.donate,
             )
+        elif cfg.elastic_width:
+            # Width-invariant canonical-tree DP (ISSUE 5): the explicit
+            # shard_map step whose trajectory is bitwise identical on
+            # any supported data width — what makes a preempted run
+            # resumable on a different topology (train/lm.py).
+            from .lm import make_elastic_lm_train_step
+
+            self.train_step, self.attn_impl = make_elastic_lm_train_step(
+                self.model, self.optimizer, self.mesh,
+                elastic_width=cfg.elastic_width, attn_impl=cfg.attn_impl,
+                seq_len=cfg.seq_len, compute_dtype=compute_dtype,
+                remat=cfg.remat, ce_chunk=cfg.ce_chunk,
+                donate=cfg.donate,
+            )
         else:
             self.attn_impl = pick_attn_impl(
                 cfg.attn_impl, cfg.seq_len, compute_dtype
@@ -536,9 +590,23 @@ class LMTrainer:
                 self.mesh,
             )
         self._eval_fn = None
+        # Checkpoint topology metadata + multihost write discipline —
+        # same scheme as the CNN Trainer (ISSUE 5): manifest records
+        # the mesh/elastic width per checkpoint, process 0 is the only
+        # writer, a barrier fences publication.
+        from ..parallel.mesh import describe_mesh
+
+        self._proc = process_info()
+        self._ckpt_meta = {
+            "mesh": describe_mesh(self.mesh),
+            "elastic_width": cfg.elastic_width,
+            "process_count": self._proc.process_count,
+        }
         self._ckpt = (
             AsyncCheckpointer(cfg.checkpoint_dir,
-                              async_=cfg.async_checkpoint, faults=faults)
+                              async_=cfg.async_checkpoint, faults=faults,
+                              meta=self._ckpt_meta, process=self._proc,
+                              barrier=barrier)
             if cfg.checkpoint_dir else None
         )
 
@@ -665,6 +733,21 @@ class LMTrainer:
                          path, step0)
         return step0
 
+    def _step_boundary(self, global_step: int) -> None:
+        """Per-step fault/preemption hook (the CNN Trainer's twin): an
+        injected ``preempt`` fault sets the same flag a real SIGTERM
+        would; a pending preemption then drains the shared orderly exit
+        (faults.drain_preemption)."""
+        if self.faults is not None:
+            for f in self.faults.fire("train.step", global_step):
+                if f.kind == "preempt":
+                    self._preempt.request()
+            for ev in self.faults.drain_events():
+                self.metrics.log("fault", **ev)
+        drain_preemption(self._preempt, state=self.state,
+                         global_step=global_step, ckpt=self._ckpt,
+                         metrics=self.metrics, logger=self.log)
+
     def train(self) -> LMResult:
         cfg = self.cfg
         start_step = 0
@@ -676,9 +759,19 @@ class LMTrainer:
                                             logger=self.log,
                                             metrics=self.metrics)
             if restored is not None:
+                validate_resume_meta(ckpt, mesh=self.mesh,
+                                     elastic_width=cfg.elastic_width,
+                                     metrics=self.metrics, logger=self.log)
                 shardings = jax.tree.map(lambda a: a.sharding, self.state)
                 self.state = jax.device_put(restored, shardings)
+                # The resumed-from checkpoint must survive later prunes
+                # — it is the only valid restore point until the next
+                # save lands.
+                if self._ckpt is not None:
+                    self._ckpt.protect = ckpt.name
                 start_step = int(jax.device_get(self.state["step"]))
+                self.metrics.log("ckpt", step=start_step, reason="resume",
+                                 path=ckpt.name)
                 self.log.info("resumed from %s at step %d", ckpt, start_step)
                 # A checkpoint past --steps means nothing left to run; the
                 # loop below is empty and steps_run clamps to 0.
@@ -748,10 +841,7 @@ class LMTrainer:
                 ):
                     with timer.phase("checkpoint"):
                         self._ckpt.save(self.state, step + 1)
-                if self.faults is not None:
-                    self.faults.fire("train.step", step + 1)
-                    for ev in self.faults.drain_events():
-                        self.metrics.log("fault", **ev)
+                self._step_boundary(step + 1)
                 step += 1
             with timer.phase("device"):
                 hard_block(self.state)

@@ -53,15 +53,22 @@ from ..faults import (
     MAX_NAN_ROLLBACKS,
     NanGuard,
     NonFiniteLossError,
+    PreemptionGuard,
     RollbackToCheckpoint,
     all_finite,
+    drain_preemption,
     poison_batch,
     step_is_finite,
 )
+from ..parallel.distributed import barrier, process_info
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer, profile_trace
 from ..utils.sync import hard_block
-from .checkpoint import AsyncCheckpointer, restore_latest
+from .checkpoint import (
+    AsyncCheckpointer,
+    restore_latest,
+    validate_resume_meta,
+)
 from .optimizer import make_optimizer
 
 
@@ -103,7 +110,8 @@ class Trainer:
     """
 
     def __init__(self, model, dataset, config, *, mesh=None,
-                 metrics: MetricsLogger | None = None, faults=None):
+                 metrics: MetricsLogger | None = None, faults=None,
+                 preempt: PreemptionGuard | None = None):
         self.model = model
         self.ds = dataset
         self.cfg = config
@@ -115,6 +123,11 @@ class Trainer:
         # The guard's policy rules live in faults.NanGuard — ONE
         # implementation for this trainer and the LM's.
         self.faults = faults
+        # Preemption guard (ISSUE 5): the CLI installs one on
+        # SIGTERM/SIGINT and shares it; an un-installed default still
+        # answers injected `preempt@train.step` faults, so elasticity
+        # tests never touch real signals.
+        self._preempt = preempt if preempt is not None else PreemptionGuard()
         self._nan = NanGuard(getattr(config, "nan_policy", "off"),
                              getattr(config, "nan_max_bad", 3))
         self._finite_fn = jax.jit(all_finite) if self._nan.active else None
@@ -136,6 +149,30 @@ class Trainer:
                 f"per-device batch {config.batch_size // n_data} not divisible "
                 f"by grad_accum {config.grad_accum}"
             )
+        if config.elastic_width:
+            # Width-invariant reduction rides the plain shard_map DP
+            # step only: sharded-param layouts (TP/FSDP/PP) change WHAT
+            # is reduced with the width, not just how — cross-width
+            # bitwise resume is out of reach there by construction.
+            from ..parallel.elastic import check_elastic_width
+
+            if (self.mesh.shape.get(MODEL_AXIS, 1) > 1
+                    or self.mesh.shape.get(PIPE_AXIS, 1) > 1
+                    or config.fsdp):
+                raise ValueError(
+                    "--elastic-width needs a pure data-parallel mesh "
+                    f"(mesh_shape={config.mesh_shape!r}/--fsdp shard "
+                    "params; cross-width bitwise resume is only defined "
+                    "for replicated state)"
+                )
+            if config.grad_accum > 1:
+                raise ValueError(
+                    "--elastic-width already scans canonical "
+                    "microbatches; --grad-accum is redundant with it — "
+                    "drop one of the two"
+                )
+            check_elastic_width(config.elastic_width, config.batch_size,
+                                n_data)
 
         compute_dtype = (
             jnp.bfloat16 if config.compute_dtype == "bfloat16" else None
@@ -279,6 +316,7 @@ class Trainer:
                 self.loss_fn, self.optimizer, self.mesh, donate=config.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
                 grad_accum=config.grad_accum,
+                elastic_width=config.elastic_width,
             )
             self.eval_step = make_dp_eval_step(predict, self.mesh)
         # Scanned-epoch path: built lazily on first use (run_epoch), since
@@ -311,11 +349,25 @@ class Trainer:
 
         # One checkpointer for every save site; async by default (the
         # step loop pays only the host snapshot, the npz write overlaps
-        # the next steps; train() drains it before returning).
+        # the next steps; train() drains it before returning). Each
+        # checkpoint's manifest entry records the topology it was
+        # written under (mesh axes + elastic width — what a
+        # topology-changed resume validates against), and on multihost
+        # runs process 0 is the only writer with a barrier fencing the
+        # publication (train/checkpoint.py).
+        from ..parallel.mesh import describe_mesh
+
+        self._proc = process_info()
+        self._ckpt_meta = {
+            "mesh": describe_mesh(self.mesh),
+            "elastic_width": config.elastic_width,
+            "process_count": self._proc.process_count,
+        }
         self._ckpt = (
             AsyncCheckpointer(config.checkpoint_dir,
                               async_=config.async_checkpoint,
-                              faults=faults)
+                              faults=faults, meta=self._ckpt_meta,
+                              process=self._proc, barrier=barrier)
             if config.checkpoint_dir else None
         )
 
@@ -343,6 +395,22 @@ class Trainer:
         if self.faults is not None:
             for ev in self.faults.drain_events():
                 self.metrics.log("fault", **ev)
+
+    def _step_boundary(self, global_step: int) -> None:
+        """The per-step fault/preemption hook shared by the loop and
+        scanned paths: fire planned train.step faults (an injected
+        ``preempt`` sets the same flag a real SIGTERM would), then
+        drain the orderly-exit path (faults.drain_preemption — ONE
+        implementation for this trainer and the LM's) if a preemption
+        is pending."""
+        if self.faults is not None:
+            for f in self.faults.fire("train.step", global_step):
+                if f.kind == "preempt":
+                    self._preempt.request()
+            self._drain_fault_events()
+        drain_preemption(self._preempt, state=self.state,
+                         global_step=global_step, ckpt=self._ckpt,
+                         metrics=self.metrics, logger=self.log)
 
     def _drop_bad_update(self, gstep: int, snap) -> None:
         """Apply --nan-policy to a non-finite step (faults.NanGuard owns
@@ -591,9 +659,7 @@ class Trainer:
                     )
             with timer.phase("checkpoint"):
                 self._maybe_step_checkpoint(gstep + 1)
-            if self.faults is not None:
-                self.faults.fire("train.step", gstep + 1)
-                self._drain_fault_events()
+            self._step_boundary(gstep + 1)
         # hard_block, not block_until_ready: the epoch wall-clock must
         # cover the COMPUTE, and under this env's remote-TPU tunnel
         # block_until_ready returns at enqueue (utils/sync.py).
@@ -653,6 +719,7 @@ class Trainer:
                 donate=self.cfg.donate,
                 augment=self._augment, aug_seed=self._aug_seed,
                 grad_accum=self.cfg.grad_accum,
+                elastic_width=self.cfg.elastic_width,
             )
 
     def device_epoch_seconds(self, *, reps: int = 3, k: int = 2,
@@ -793,12 +860,11 @@ class Trainer:
                 )
             with timer.phase("checkpoint"):
                 self._maybe_step_checkpoint(epoch * nsteps + done)
-            if self.faults is not None:
-                # Scanned epochs advance chunk-by-chunk: crash faults
-                # fire at chunk/checkpoint boundaries, where the step
-                # count is exact (align `at` with a boundary).
-                self.faults.fire("train.step", epoch * nsteps + done)
-                self._drain_fault_events()
+            # Scanned epochs advance chunk-by-chunk: crash/preempt
+            # faults fire at chunk/checkpoint boundaries, where the
+            # step count is exact (align `at` with a boundary) — and a
+            # real SIGTERM drains here too, after the in-flight chunk.
+            self._step_boundary(epoch * nsteps + done)
         with timer.phase("device"):
             hard_block(self.state)  # see run_epoch: must wait for compute
         seconds = time.perf_counter() - t0 - timer.excluded_s  # see run_epoch
@@ -827,11 +893,21 @@ class Trainer:
                                             logger=self.log,
                                             metrics=self.metrics)
             if restored is not None:
+                validate_resume_meta(ckpt, mesh=self.mesh,
+                                     elastic_width=cfg.elastic_width,
+                                     metrics=self.metrics, logger=self.log)
                 self.place_state(restored)
+                # The checkpoint this run stands on must survive every
+                # later prune: a crash before the NEXT save would
+                # otherwise have no valid restore point behind it.
+                if self._ckpt is not None:
+                    self._ckpt.protect = ckpt.name
                 spe = max(self.steps_per_epoch, 1)
                 step0 = self._global_step()
                 start_epoch = step0 // spe
                 skip_steps = step0 % spe
+                self.metrics.log("ckpt", step=step0, reason="resume",
+                                 path=ckpt.name)
                 self.log.info(
                     "resumed from %s at epoch %d step %d (in-epoch %d)",
                     ckpt, start_epoch, step0, skip_steps,

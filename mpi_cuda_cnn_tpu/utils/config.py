@@ -118,6 +118,14 @@ class Config(_JsonConfig):
     fault_plan: str | None = None  # deterministic fault injection spec
                                   # (faults.parse_plan), e.g.
                                   # "crash@train.step:6;nan@train.batch:3"
+    elastic_width: int = 0        # >0: width-invariant gradient
+                                  # reduction over this many canonical
+                                  # microbatches (parallel/elastic.py) —
+                                  # a preempted run resumes BITWISE on
+                                  # any power-of-two data width n with
+                                  # elastic_width >= 2n. Power of two,
+                                  # must divide batch_size; plain-DP
+                                  # meshes only. 0 keeps the pmean step
     log_every: int = 100          # steps; reference prints every 1000 samples
     profile_dir: str | None = None
     metrics_jsonl: str | None = None  # write schema-stamped JSONL metrics
@@ -215,6 +223,11 @@ class LMConfig(_JsonConfig):
                                      # nan_policy=restore rolls back
     fault_plan: str | None = None    # fault injection spec
                                      # (faults.parse_plan)
+    elastic_width: int = 0           # >0: width-invariant canonical-
+                                     # tree gradient reduction (see
+                                     # Config.elastic_width) — cross-
+                                     # width bitwise resume; pure-DP
+                                     # meshes only
     log_every: int = 20
     metrics_jsonl: str | None = None  # JSONL metrics + telemetry sink
                                      # (see Config.metrics_jsonl)
@@ -243,6 +256,41 @@ class LMConfig(_JsonConfig):
 
 
 
+def _fault_plan_arg(spec: str) -> str:
+    """argparse type for --fault-plan: parse NOW so a typo dies at the
+    command line with parse_plan's one-line message instead of as a
+    traceback from deep inside the trainer (ISSUE 5 satellite). The
+    original string is returned — the trainer re-parses it."""
+    from ..faults import parse_plan
+
+    try:
+        parse_plan(spec)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from e
+    return spec
+
+
+# Per-field argparse overrides shared by both auto-generated parsers:
+# flags whose values have grammar are validated AT PARSE TIME (clear
+# one-line errors, exit 2) instead of wherever the value is first used.
+_ARG_OVERRIDES: dict[str, dict] = {
+    "nan_policy": {"choices": ("off", "abort", "skip", "restore")},
+    "fault_plan": {"type": _fault_plan_arg},
+}
+
+
+def _add_flag(p: argparse.ArgumentParser, name: str, default) -> None:
+    """One auto-generated dataclass flag, with any _ARG_OVERRIDES."""
+    flag = "--" + name.replace("_", "-")
+    if isinstance(default, bool):
+        p.add_argument(flag, action=argparse.BooleanOptionalAction,
+                       default=default)
+        return
+    extra = dict(_ARG_OVERRIDES.get(name, ()))
+    ftype = extra.pop("type", str if default is None else type(default))
+    p.add_argument(flag, type=ftype, default=default, **extra)
+
+
 def build_lm_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="mpi_cuda_cnn_tpu lm",
@@ -251,14 +299,7 @@ def build_lm_parser() -> argparse.ArgumentParser:
     )
     defaults = LMConfig()
     for f in dataclasses.fields(LMConfig):
-        flag = "--" + f.name.replace("_", "-")
-        default = getattr(defaults, f.name)
-        if isinstance(default, bool):
-            p.add_argument(flag, action=argparse.BooleanOptionalAction,
-                           default=default)
-        else:
-            ftype = str if default is None else type(default)
-            p.add_argument(flag, type=ftype, default=default)
+        _add_flag(p, f.name, getattr(defaults, f.name))
     return p
 
 
@@ -279,13 +320,7 @@ def build_parser() -> argparse.ArgumentParser:
     for f in dataclasses.fields(Config):
         if f.name in ("train_images", "train_labels", "test_images", "test_labels"):
             continue
-        flag = "--" + f.name.replace("_", "-")
-        default = getattr(defaults, f.name)
-        if f.type == "bool" or isinstance(default, bool):
-            p.add_argument(flag, action=argparse.BooleanOptionalAction, default=default)
-        else:
-            ftype = str if default is None else type(default)
-            p.add_argument(flag, type=ftype, default=default)
+        _add_flag(p, f.name, getattr(defaults, f.name))
     return p
 
 

@@ -1,0 +1,26 @@
+"""Exponential backoff with jitter — the one delay formula every
+retry loop shares.
+
+Two consumers with very different failure domains want the same math:
+`scripts/get_mnist.py` retries a flaky mirror fetch, and
+`faults.supervise` paces crash-restart attempts (ISSUE 5 satellite —
+an immediate restart storm against a sick filesystem or coordinator
+just reproduces the crash faster). Keeping the formula here means the
+two can never drift: delay = base * 2^attempt * (1 + U[0,1)), where
+the jitter term de-synchronizes parallel retriers hammering a
+recovering dependency.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def backoff_delay(attempt: int, base: float,
+                  jitter=random.random) -> float:
+    """Delay in seconds before retry number `attempt` (0-based: the
+    delay AFTER the first failure is attempt 0). `jitter` is an
+    injection point returning U[0,1) — tests pass a constant."""
+    if base <= 0:
+        return 0.0
+    return base * (2 ** attempt) * (1.0 + jitter())

@@ -22,11 +22,32 @@ a synthetic run can never be labeled MNIST.
 from __future__ import annotations
 
 import hashlib
+import importlib.util
 import random
 import sys
 import time
 import urllib.request
 from pathlib import Path
+
+# Load utils/retry.py by FILE PATH, not through the package: this is the
+# environment-bootstrap script (runs before the training stack matters),
+# and `import mpi_cuda_cnn_tpu` would drag in jax + every subpackage —
+# a hard dependency and ~seconds of import for a 3-line delay formula.
+# The formula still has exactly ONE definition (utils/retry.py, shared
+# with the crash-restart supervisor's pacing).
+_ROOT = Path(__file__).resolve().parent.parent
+_retry_spec = importlib.util.spec_from_file_location(
+    "_mctpu_retry", _ROOT / "mpi_cuda_cnn_tpu" / "utils" / "retry.py",
+)
+_retry = importlib.util.module_from_spec(_retry_spec)
+_retry_spec.loader.exec_module(_retry)
+backoff_delay = _retry.backoff_delay
+
+# The package itself is imported ONLY on the no-network fallback (to
+# write the synthetic dataset); make that lazy import work when the
+# script is run directly (`python scripts/get_mnist.py`, where
+# sys.path[0] is scripts/, not the repo root).
+sys.path.insert(0, str(_ROOT))
 
 FILES = [
     "train-images-idx3-ubyte",
@@ -67,7 +88,8 @@ def fetch_with_retry(url: str, *, opener=None,
                      sleep=time.sleep, jitter=random.random,
                      timeout: float = 30.0) -> bytes:
     """Fetch `url`, retrying transient failures with exponential backoff
-    plus jitter (delay = base * 2^attempt * (1 + U[0,1)) — the jitter
+    plus jitter (utils/retry.backoff_delay — the ONE delay formula,
+    shared with the crash-restart supervisor's pacing; the jitter
     de-synchronizes parallel fetchers hammering a recovering mirror).
 
     `opener`/`sleep`/`jitter` are injection points: tests drive this
@@ -86,7 +108,7 @@ def fetch_with_retry(url: str, *, opener=None,
         except Exception as e:  # noqa: BLE001 — any fetch error retries
             last = e
             if attempt + 1 < tries:
-                delay = base_delay * (2 ** attempt) * (1.0 + jitter())
+                delay = backoff_delay(attempt, base_delay, jitter)
                 print(f"  attempt {attempt + 1}/{tries} failed: {e}; "
                       f"retrying in {delay:.2f}s", file=sys.stderr)
                 sleep(delay)

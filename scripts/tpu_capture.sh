@@ -102,6 +102,21 @@ step bench_serve 900 python scripts/bench_serve.py --requests 32 \
 step bench_serve_gqa_int8 900 python scripts/bench_serve.py \
     --requests 32 --rate 200 --kv-heads 1 --cache-dtype int8
 step profile_lm 900 python scripts/profile_lm.py
+# PR-5 (elasticity): the width-invariant canonical-tree step on a real
+# chip mesh — banks the elastic-vs-plain step-time ratio for PERF.md
+# (CPU-banked 2x at the reference config; TPU fusion/collective costs
+# differ) and smoke-proves a preempt -> exit-75 -> cross-width resume
+# cycle on real hardware.
+# (exits 75 by design — the preemption snapshot; the note records it)
+step elastic_bench 900 python -m mpi_cuda_cnn_tpu train \
+    --dataset synthetic --model reference_cnn --epochs 2 --batch-size 32 \
+    --elastic-width 16 --mesh-shape data:4 --eval-every 0 \
+    --checkpoint-dir /tmp/elastic_ck --checkpoint-every-steps 50 \
+    --fault-plan "preempt@train.step:100"
+step elastic_resume 900 python -m mpi_cuda_cnn_tpu train \
+    --dataset synthetic --model reference_cnn --epochs 2 --batch-size 32 \
+    --elastic-width 16 --mesh-shape data:2 --eval-every 0 \
+    --checkpoint-dir /tmp/elastic_ck --resume
 # make prints recipes/compiler lines on stdout — keep the JSONL clean by
 # sending this step's stdout to the log; its result is the note() line.
 echo "== native_tpu (timeout 900s) ==" >&2

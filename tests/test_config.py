@@ -46,3 +46,36 @@ def test_mesh_spec():
         parse_mesh_shape("data:3,model", 8)  # 3 does not divide 8
     with pytest.raises(ValueError):
         parse_mesh_shape("data,model", 8)  # two unsized axes
+
+
+def test_fault_plan_validated_at_argparse_time(capsys):
+    """ISSUE 5 satellite: a malformed --fault-plan dies AT THE COMMAND
+    LINE with parse_plan's one-line message (argparse exit 2), never as
+    a traceback from deep inside the trainer."""
+    with pytest.raises(SystemExit) as ei:
+        parse_args(["--fault-plan", "boom@train.step:1"])
+    assert ei.value.code == 2
+    err = capsys.readouterr().err
+    assert "bad fault spec" in err and "unknown kind" in err
+    # A valid plan parses through unchanged (the trainer re-parses it).
+    cfg = parse_args(["--fault-plan", "crash@train.step:6"])
+    assert cfg.fault_plan == "crash@train.step:6"
+
+
+def test_nan_policy_validated_at_argparse_time(capsys):
+    from mpi_cuda_cnn_tpu.utils.config import parse_lm_args
+
+    for parse in (parse_args, parse_lm_args):
+        with pytest.raises(SystemExit) as ei:
+            parse(["--nan-policy", "bogus"])
+        assert ei.value.code == 2
+        assert "invalid choice: 'bogus'" in capsys.readouterr().err
+
+
+def test_lm_fault_plan_validated_at_argparse_time(capsys):
+    from mpi_cuda_cnn_tpu.utils.config import parse_lm_args
+
+    with pytest.raises(SystemExit) as ei:
+        parse_lm_args(["--fault-plan", "crash@a.b"])  # missing :at
+    assert ei.value.code == 2
+    assert "bad fault spec" in capsys.readouterr().err

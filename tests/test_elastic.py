@@ -1,0 +1,507 @@
+"""Elastic training (ISSUE 5): preemption-safe snapshots +
+topology-change-tolerant resume.
+
+The acceptance contract, all deterministic on CPU:
+
+- THE cross-width bitwise e2e: a run preempted (injected ``preempt``
+  fault) under one data-parallel width and resumed under another — a
+  dp=4 -> dp=2 -> dp=8 chain for the CNN, dp=4 -> dp=2 -> dp=4 for the
+  LM — lands bitwise on the uninterrupted single-width run, for both
+  trainers and (CNN) both the scanned and per-batch paths. This only
+  holds because the elastic step's gradient is a canonical balanced-tree
+  reduction keyed by --elastic-width, not by the hardware
+  (parallel/elastic.py);
+- the width-invariance primitive itself: identical train-step results
+  at dp=1/2/4 and a demonstration that the PLAIN pmean step does NOT
+  have the property (the reason the machinery exists);
+- preemption mechanics: the ``preempt`` fault kind parses, a real
+  SIGTERM sets the guard and drains an orderly snapshot-exit
+  (Preempted, code 75), the CLI maps it to the distinguished exit code,
+  and the supervisor passes it through rather than burning restarts;
+- topology metadata: the manifest records mesh + elastic width, a
+  changed mesh logs a topology_change event, a changed elastic width is
+  a hard error;
+- multihost checkpoint discipline (mocked ProcessInfo): exactly one
+  writer, barrier ordering, non-writers restore the same bytes.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpi_cuda_cnn_tpu.data.datasets import synthetic_stripes
+from mpi_cuda_cnn_tpu.faults import (
+    EXIT_PREEMPTED,
+    FaultInjector,
+    Preempted,
+    PreemptionGuard,
+    parse_plan,
+    supervise,
+)
+from mpi_cuda_cnn_tpu.models.presets import get_model
+from mpi_cuda_cnn_tpu.parallel.distributed import ProcessInfo
+from mpi_cuda_cnn_tpu.parallel.elastic import (
+    check_elastic_width,
+    host_shard_rows,
+    local_tree_reduce,
+    tree_allreduce,
+)
+from mpi_cuda_cnn_tpu.train.checkpoint import (
+    checkpoint_meta,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from mpi_cuda_cnn_tpu.train.trainer import Trainer
+from mpi_cuda_cnn_tpu.utils.config import Config, LMConfig
+from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+
+def _quiet(capture=False):
+    return MetricsLogger(echo=False, capture=capture)
+
+
+def _cfg(**kw):
+    base = dict(
+        dataset="synthetic", model="reference_cnn", epochs=2,
+        batch_size=16, num_devices=0, eval_every=0, log_every=0,
+        lr=0.05, seed=7, elastic_width=16,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _lm_cfg(**kw):
+    base = dict(
+        corpus="synthetic", dim=32, depth=2, heads=4, seq_len=32,
+        steps=6, batch_size=8, log_every=0, warmup_steps=2,
+        elastic_width=8, num_devices=0,
+    )
+    base.update(kw)
+    return LMConfig(**base)
+
+
+def _ds():
+    return synthetic_stripes(num_train=64, num_test=32)  # 4 steps/epoch
+
+
+def _params_of(t):
+    return jax.device_get(t.state["params"])
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _trees_equal(a, b) -> bool:
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# ------------------------------------------------------------ primitives
+
+
+def test_check_elastic_width_rules():
+    check_elastic_width(8, 16, 4)
+    with pytest.raises(ValueError, match="power of two"):
+        check_elastic_width(6, 12, 2)
+    with pytest.raises(ValueError, match="divide batch_size"):
+        check_elastic_width(8, 12, 2)
+    with pytest.raises(ValueError, match="power-of-two data-axis"):
+        check_elastic_width(16, 48, 3)
+    with pytest.raises(ValueError, match=">= 2x"):
+        check_elastic_width(4, 16, 4)  # would leave 1 microbatch/device
+
+
+def test_tree_allreduce_sums_over_ranks(eight_devices):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+
+    def body(x):
+        return tree_allreduce({"v": x}, "data", 4)["v"]
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                              out_specs=P("data"), check_vma=False))
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    got = jax.device_get(f(jax.device_put(
+        x, NamedSharding(mesh, P("data")))))
+    # Every rank ends with the elementwise sum of the four local blocks.
+    want = np.tile(x.reshape(4, 2, 2).sum(axis=0), (4, 1))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_local_tree_reduce_is_balanced_sum():
+    x = np.arange(8, dtype=np.float32)
+    got = local_tree_reduce({"v": x})["v"]
+    assert float(got) == x.sum()
+
+
+def test_host_shard_rows_partitions_exactly():
+    rows = [host_shard_rows(16, i, 4) for i in range(4)]
+    assert rows == [(0, 4), (4, 8), (8, 12), (12, 16)]
+    with pytest.raises(ValueError, match="not divisible"):
+        host_shard_rows(10, 0, 4)
+
+
+def test_elastic_step_is_width_invariant_and_pmean_is_not(eight_devices):
+    """The core numerics claim, isolated at one train step x 2: the
+    elastic step's updated params are bitwise identical at dp=1/2/4;
+    the plain pmean step's are not (which is WHY the elastic reduction
+    exists — if this half ever starts passing, the plain step became
+    width-invariant and the elastic machinery can be retired)."""
+    ds = _ds()
+
+    def run(n, elastic):
+        cfg = _cfg(mesh_shape=f"data:{n}", epochs=1, scan=False,
+                   elastic_width=16 if elastic else 0)
+        t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
+        t.run_epoch(0)
+        return _params_of(t)
+
+    elastic = [run(n, True) for n in (1, 2, 4)]
+    assert _trees_equal(elastic[0], elastic[1])
+    assert _trees_equal(elastic[0], elastic[2])
+    plain = [run(n, False) for n in (1, 4)]
+    assert not _trees_equal(plain[0], plain[1]), (
+        "the plain pmean step became width-invariant — the elastic "
+        "reduction may no longer be needed"
+    )
+
+
+def test_elastic_metrics_match_plain_scale():
+    """Metrics keep their scale under the elastic step: every metric
+    make_loss_fn returns is mean-semantics (etotal divides by its
+    batch size — ops/losses.squared_error_total), so the mean over
+    canonical microbatches equals the plain step's per-batch value and
+    enabling elasticity cannot silently rescale the logged stream."""
+    ds = _ds()
+    ems = []
+    for ew in (0, 16):
+        cfg = _cfg(mesh_shape="data:1", epochs=1, scan=False,
+                   elastic_width=ew)
+        t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
+        ems.append(t.run_epoch(0))
+    assert ems[0]["etotal"] == pytest.approx(ems[1]["etotal"], rel=1e-4)
+    assert ems[0]["loss"] == pytest.approx(ems[1]["loss"], rel=1e-4)
+
+
+def test_elastic_augment_keys_on_canonical_shard(eight_devices):
+    """Augmentation under the elastic step folds the GLOBAL canonical
+    shard index into its key — not the device rank — so the augmented
+    pixel stream (and therefore the trajectory) stays width-invariant."""
+    ds = _ds()
+    outs = []
+    for n in (1, 4):
+        cfg = _cfg(mesh_shape=f"data:{n}", epochs=1, scan=False,
+                   augment="shift")
+        t = Trainer(get_model("reference_cnn"), ds, cfg, metrics=_quiet())
+        t.run_epoch(0)
+        outs.append(_params_of(t))
+    assert _trees_equal(outs[0], outs[1])
+
+
+# ------------------------------------------------- cross-width bitwise e2e
+
+
+@pytest.mark.parametrize("scan", [True, False])
+def test_cnn_preempt_resume_across_widths_bitwise(tmp_path, scan):
+    """THE acceptance e2e (CNN, scan and loop paths): a run preempted
+    at dp=4 (injected preempt fault -> snapshot -> exit 75), resumed at
+    dp=2, preempted again, resumed at dp=8 to completion, is BITWISE
+    equal to the uninterrupted single-width run — the full
+    shrink-then-grow round trip on one checkpoint directory."""
+    ds = _ds()
+    full = Trainer(get_model("reference_cnn"), ds,
+                   _cfg(scan=scan, mesh_shape="data:2"), metrics=_quiet())
+    full.train()
+    want = _params_of(full)
+
+    ck = tmp_path / "ck"
+    metrics = _quiet(capture=True)
+
+    def attempt(width, plan):
+        t = Trainer(
+            get_model("reference_cnn"), ds,
+            _cfg(scan=scan, mesh_shape=f"data:{width}",
+                 checkpoint_dir=str(ck), checkpoint_every_steps=3,
+                 resume=True),
+            metrics=metrics,
+            faults=FaultInjector(plan) if plan else None,
+        )
+        return t, t.train()
+
+    with pytest.raises(Preempted):
+        attempt(4, "preempt@train.step:3")
+    assert (ck / "ckpt_3.npz").exists()
+    with pytest.raises(Preempted):
+        attempt(2, "preempt@train.step:6")
+    assert (ck / "ckpt_6.npz").exists()
+    t, res = attempt(8, None)
+
+    assert res.final_step == full._global_step()
+    _assert_trees_equal(want, _params_of(t))
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert kinds.count("preempt") == 2
+    assert kinds.count("injected_preempt") == 2
+    # Both resumes crossed a topology change and said so.
+    assert kinds.count("topology_change") == 2
+    reasons = [r["reason"] for r in metrics.rows if r["event"] == "ckpt"]
+    assert reasons.count("preempt") == 2
+    assert reasons.count("resume") == 2
+
+
+def test_lm_preempt_resume_across_widths_bitwise(tmp_path):
+    """THE acceptance e2e (LM trainer): dp=4 -> preempt -> dp=2 ->
+    preempt -> dp=4, bitwise equal to the uninterrupted run."""
+    full = LMTrainerFactory(_lm_cfg(mesh_shape="data:2"))
+    full.train()
+    want = _params_of(full)
+
+    ck = tmp_path / "ck"
+    metrics = _quiet(capture=True)
+
+    def attempt(width, plan):
+        from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+
+        t = LMTrainer(
+            _lm_cfg(mesh_shape=f"data:{width}", checkpoint_dir=str(ck),
+                    checkpoint_every=2, resume=True),
+            metrics=metrics,
+            faults=FaultInjector(plan) if plan else None,
+        )
+        return t, t.train()
+
+    with pytest.raises(Preempted):
+        attempt(4, "preempt@train.step:2")
+    with pytest.raises(Preempted):
+        attempt(2, "preempt@train.step:4")
+    t, res = attempt(4, None)
+
+    _assert_trees_equal(want, _params_of(t))
+    kinds = [r["kind"] for r in metrics.rows if r["event"] == "fault"]
+    assert kinds.count("preempt") == 2
+    assert kinds.count("topology_change") == 2
+
+
+def LMTrainerFactory(cfg, **kw):
+    from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+
+    return LMTrainer(cfg, metrics=_quiet(), **kw)
+
+
+# ------------------------------------------------------ preemption mechanics
+
+
+def test_preempt_kind_parses_and_fires_once():
+    (f,) = parse_plan("preempt@train.step:3")
+    assert (f.kind, f.site, f.at) == ("preempt", "train.step", 3)
+    inj = FaultInjector("preempt@train.step:3")
+    hits = inj.fire("train.step", 3)  # soft kind: returned, not raised
+    assert [h.kind for h in hits] == ["preempt"]
+    assert inj.fire("train.step", 3) == []
+
+
+def test_sigterm_sets_guard_and_restores_handler():
+    guard = PreemptionGuard()
+    prev = signal.getsignal(signal.SIGTERM)
+    with guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested
+        assert guard.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_sigterm_drains_orderly_snapshot_exit(tmp_path):
+    """A real SIGTERM mid-run: the trainer finishes the in-flight step,
+    writes the snapshot durably, and exits Preempted with code 75 —
+    the checkpoint restores."""
+    ds = _ds()
+    guard = PreemptionGuard().install()
+    try:
+        t = Trainer(
+            get_model("reference_cnn"), ds,
+            _cfg(scan=False, checkpoint_dir=str(tmp_path / "ck"),
+                 checkpoint_every_steps=0),
+            metrics=_quiet(), preempt=guard,
+        )
+        os.kill(os.getpid(), signal.SIGTERM)
+        with pytest.raises(Preempted) as ei:
+            t.train()
+        assert ei.value.code == EXIT_PREEMPTED
+    finally:
+        guard.uninstall()
+    # The snapshot landed at the first step boundary and restores.
+    resumed = Trainer(
+        get_model("reference_cnn"), ds,
+        _cfg(scan=False, checkpoint_dir=str(tmp_path / "ck"), resume=True),
+        metrics=_quiet(),
+    )
+    res = resumed.train()
+    assert res.final_step == 8
+
+
+def test_supervisor_passes_preemption_through():
+    """A preemption is not a crash: supervise must NOT burn restarts
+    replaying it in-process — the relaunch happens out-of-process, on
+    the next placement."""
+    attempts = []
+
+    def attempt(n):
+        attempts.append(n)
+        raise Preempted("preempted at step 3")
+
+    with pytest.raises(Preempted):
+        supervise(attempt, max_restarts=3)
+    assert attempts == [0]
+
+
+def test_cli_preempt_exit_code_and_resume(tmp_path):
+    """Through the CLI: an injected preemption exits EXIT_PREEMPTED
+    (75) with the snapshot on disk; the relaunch with --resume
+    completes and exits 0."""
+    from mpi_cuda_cnn_tpu import cli
+
+    args = [
+        "train", "--dataset", "synthetic", "--model", "reference_cnn",
+        "--epochs", "1", "--batch-size", "500", "--num-devices", "1",
+        "--eval-every", "0", "--log-every", "0", "--device", "cpu",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-every-steps", "1",
+    ]
+    rc = cli.main(args + ["--fault-plan", "preempt@train.step:2"])
+    assert rc == EXIT_PREEMPTED
+    assert (tmp_path / "ck" / "ckpt_2.npz").exists()
+    assert cli.main(args + ["--resume"]) == 0
+
+
+# ------------------------------------------------------- config validation
+
+
+def test_elastic_width_rejects_sharded_state_meshes(eight_devices):
+    ds = _ds()
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        Trainer(get_model("reference_cnn"), ds,
+                _cfg(mesh_shape="data:2,model:2"), metrics=_quiet())
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        Trainer(get_model("reference_cnn"), ds,
+                _cfg(mesh_shape="data:2", fsdp=True), metrics=_quiet())
+    with pytest.raises(ValueError, match="grad-accum"):
+        Trainer(get_model("reference_cnn"), ds,
+                _cfg(mesh_shape="data:2", grad_accum=2), metrics=_quiet())
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        LMTrainerFactory(_lm_cfg(mesh_shape="data:2,seq:2", seq_len=32))
+
+
+def test_resume_with_changed_elastic_width_is_an_error(tmp_path):
+    """The reduction tree is keyed by W0 — silently resuming with a
+    different width would break the bitwise contract mid-run."""
+    ds = _ds()
+    ck = tmp_path / "ck"
+    t = Trainer(get_model("reference_cnn"), ds,
+                _cfg(epochs=1, checkpoint_dir=str(ck),
+                     checkpoint_every_steps=2),
+                metrics=_quiet())
+    t.train()
+    with pytest.raises(ValueError, match="elastic-width"):
+        Trainer(get_model("reference_cnn"), ds,
+                _cfg(epochs=1, elastic_width=8, checkpoint_dir=str(ck),
+                     resume=True),
+                metrics=_quiet()).train()
+
+
+# -------------------------------------------------- checkpoint meta/multihost
+
+
+def _state(seed=0):
+    from mpi_cuda_cnn_tpu.models.initializers import get_initializer
+    from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
+    import jax.numpy as jnp
+
+    model = get_model("reference_cnn")
+    params = model.init(jax.random.key(seed), get_initializer("normal"))
+    opt = make_optimizer(0.1, momentum=0.9)
+    return {"params": params, "opt_state": opt.init(params),
+            "step": jnp.asarray(7, jnp.int32)}
+
+
+def test_manifest_records_topology_meta(tmp_path):
+    meta = {"mesh": {"axes": {"data": 4}, "devices": 4},
+            "elastic_width": 8, "process_count": 1}
+    save_checkpoint(tmp_path, _state(), 3, meta=meta)
+    assert checkpoint_meta(tmp_path, "ckpt_3.npz") == meta
+    assert checkpoint_meta(tmp_path, "ckpt_999.npz") is None
+    # Pruned checkpoints leave the meta table with their checksums.
+    for step in (6, 9, 12):
+        save_checkpoint(tmp_path, _state(), step, keep=2, meta=meta)
+    import json
+
+    mf = json.loads((tmp_path / "manifest.json").read_text())
+    assert set(mf["meta"]) == {"ckpt_9.npz", "ckpt_12.npz"}
+
+
+def test_prune_never_deletes_protected_checkpoint(tmp_path):
+    """ISSUE 5 satellite: the checkpoint the current run resumed from
+    survives keep-pruning — a crash before the next save always has a
+    known-good restore point behind it."""
+    state = _state()
+    for step in range(6):
+        save_checkpoint(tmp_path, state, step, keep=2,
+                        protect="ckpt_0.npz")
+    names = sorted(p.name for p in tmp_path.glob("ckpt_*.npz"))
+    assert "ckpt_0.npz" in names
+    assert names[-2:] == ["ckpt_4.npz", "ckpt_5.npz"]
+    # The protected file stays restorable (its checksums were kept).
+    restored = restore_checkpoint(tmp_path / "ckpt_0.npz", _state(1))
+    _assert_trees_equal(jax.device_get(state), restored)
+
+
+def test_multihost_exactly_one_writer_with_barrier_ordering(tmp_path):
+    """ISSUE 5 satellite: mocked N=3 process set — process 0 is the
+    only writer, every process meets the barrier, the writer's barrier
+    fires AFTER its rename (so a non-writer that passed the barrier can
+    rely on the file), and non-writers restore the same bytes."""
+    state = _state()
+    calls = []
+
+    def barrier_for(pid):
+        def barrier(name):
+            calls.append((pid, name, (tmp_path / "ckpt_5.npz").exists()))
+        return barrier
+
+    # Non-writers: no file activity, one barrier visit each.
+    for pid in (1, 2):
+        p = ProcessInfo(pid, 3, 2, 6)
+        path = save_checkpoint(tmp_path, state, 5, process=p,
+                               barrier=barrier_for(pid))
+        assert path.name == "ckpt_5.npz"
+    assert not list(tmp_path.glob("*"))  # nothing written by non-writers
+    # Writer: file + manifest land, THEN its barrier fires.
+    p0 = ProcessInfo(0, 3, 2, 6)
+    path = save_checkpoint(tmp_path, state, 5, process=p0,
+                           barrier=barrier_for(0))
+    assert [(pid, seen) for pid, _, seen in calls] == [
+        (1, False), (2, False), (0, True),
+    ]
+    # Step-keyed fence: saves for different steps can never silently
+    # rendezvous with each other.
+    assert all(name == "ckpt_save_5" for _, name, _ in calls)
+    # Every process (the non-writers included) restores the same bytes.
+    restored = restore_checkpoint(path, _state(1))
+    _assert_trees_equal(jax.device_get(state), restored)
+
+
+def test_async_checkpointer_skips_write_on_non_writer(tmp_path):
+    from mpi_cuda_cnn_tpu.train.checkpoint import AsyncCheckpointer
+
+    hits = []
+    ck = AsyncCheckpointer(tmp_path, process=ProcessInfo(1, 2, 4, 8),
+                           barrier=lambda name: hits.append(name))
+    ck.save(_state(), 3)
+    ck.close()
+    assert not list(tmp_path.glob("ckpt_*.npz"))
+    assert hits == ["ckpt_save_3"]  # step-keyed fence

@@ -117,6 +117,30 @@ def test_fake_clock_drives_injector_sleep():
     assert clock() == 2.5
 
 
+def test_supervisor_backs_off_exponentially_with_jitter(tmp_path):
+    """ISSUE 5 satellite: restarts are paced — delay_k = base * 2^k *
+    (1 + jitter) — and each restart's fault event records the delay.
+    sleep/jitter injected, so no wall-clock in the test."""
+    slept = []
+    metrics = MetricsLogger(echo=False, capture=True)
+
+    def attempt(n):
+        raise RuntimeError(f"boom {n}")
+
+    with pytest.raises(RuntimeError):
+        supervise(attempt, max_restarts=3, metrics=metrics,
+                  backoff_base=0.5, sleep=slept.append, jitter=lambda: 0.0)
+    assert slept == [0.5, 1.0, 2.0]  # exponential, 3 restarts
+    delays = [r["delay_s"] for r in metrics.rows
+              if r["event"] == "fault" and r["kind"] == "restart"]
+    assert delays == [0.5, 1.0, 2.0]
+    # backoff_base=0 keeps the old immediate-restart behavior.
+    slept.clear()
+    with pytest.raises(RuntimeError):
+        supervise(attempt, max_restarts=2, backoff_base=0)
+    assert slept == []
+
+
 # ---------------------------------------------------------------- supervisor e2e
 
 

@@ -159,3 +159,40 @@ def test_epoch_order_is_stateless():
     t2 = Trainer(get_model("reference_cnn"), ds, _cfg(), metrics=_quiet())
     np.testing.assert_array_equal(t1._epoch_order(3), t2._epoch_order(3))
     assert not np.array_equal(t1._epoch_order(0), t1._epoch_order(1))
+
+
+def test_global_batch_sequence_is_width_independent(eight_devices):
+    """Data-order elasticity (ISSUE 5): the GLOBAL batch sequence is a
+    pure function of (seed, epoch/step) — never of the mesh — so a run
+    resumed on a different dp width consumes exactly the batches the
+    original would have. Each host's shard is then derived from the
+    global batch + (process_index, process_count), not a stored cursor
+    (parallel/elastic.host_shard_rows)."""
+    ds = synthetic_stripes(num_train=64, num_test=32)
+    orders = []
+    for n in (1, 2, 4):
+        t = Trainer(get_model("reference_cnn"), ds,
+                    _cfg(mesh_shape=f"data:{n}", num_devices=0),
+                    metrics=_quiet())
+        orders.append(t._epoch_order(1))
+    np.testing.assert_array_equal(orders[0], orders[1])
+    np.testing.assert_array_equal(orders[0], orders[2])
+
+    from mpi_cuda_cnn_tpu.train.lm_trainer import LMTrainer
+    from mpi_cuda_cnn_tpu.utils.config import LMConfig
+
+    def lm_batches(n):
+        t = LMTrainer(LMConfig(corpus="synthetic", dim=32, depth=1,
+                               heads=4, seq_len=32, steps=1, batch_size=8,
+                               mesh_shape=f"data:{n}", num_devices=0),
+                      metrics=_quiet())
+        return np.asarray(t._sample_batch(5)[0])
+
+    np.testing.assert_array_equal(lm_batches(1), lm_batches(4))
+
+    # The per-host shard bounds tile the same global batch exactly.
+    from mpi_cuda_cnn_tpu.parallel.elastic import host_shard_rows
+
+    spans = [host_shard_rows(8, i, 4) for i in range(4)]
+    assert [s for s, _ in spans] == [0, 2, 4, 6]
+    assert all(b - a == 2 for a, b in spans)
