@@ -11,6 +11,9 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu --metrics-jsonl run.jsonl ...   # telemetry sink
     python -m mpi_cuda_cnn_tpu report run.jsonl                # summary tables
     python -m mpi_cuda_cnn_tpu serve-bench --requests 32       # serving bench
+    python -m mpi_cuda_cnn_tpu trace run.jsonl --request 3     # lifecycle trace
+    python -m mpi_cuda_cnn_tpu top run.jsonl                   # live dashboard
+    python -m mpi_cuda_cnn_tpu compare base.jsonl new.jsonl    # regression gate
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ from .data.datasets import get_dataset, load_idx_dataset
 from .data.idx import IdxError
 from .faults import FaultInjector, Preempted, PreemptionGuard, supervise
 from .models.presets import get_model
+from .obs.metrics import MetricsRegistry
 from .parallel.distributed import initialize_distributed
 from .train.trainer import Trainer
 from .utils.config import Config, parse_args
@@ -62,16 +66,18 @@ def _fault_setup(cfg, log):
         return 2, None
 
 
-def _supervised(cfg, log, metrics, first_trainer, make_trainer):
+def _supervised(cfg, log, metrics, first_trainer, make_trainer,
+                registry=None):
     """Run training under the crash-safe supervisor.
 
     `first_trainer` was built by the caller OUTSIDE this call (so a
     construction/config error surfaces once, with the caller's own
     error handling, and is never mistaken for a mid-training crash);
     each restarted attempt rebuilds with resume forced — the
-    supervisor's whole contract is continue-from-checkpoint. Returns
-    (result, last_trainer); training exceptions propagate once restarts
-    are exhausted."""
+    supervisor's whole contract is continue-from-checkpoint. `registry`
+    is the run-wide obs.MetricsRegistry the trainers share (restart and
+    step totals survive the rebuilds). Returns (result, last_trainer);
+    training exceptions propagate once restarts are exhausted."""
     trainer = first_trainer
 
     def attempt(n: int):
@@ -81,7 +87,7 @@ def _supervised(cfg, log, metrics, first_trainer, make_trainer):
         return trainer.train()
 
     result = supervise(attempt, max_restarts=cfg.max_restarts,
-                       logger=log, metrics=metrics)
+                       logger=log, metrics=metrics, registry=registry)
     return result, trainer
 
 
@@ -128,9 +134,13 @@ def run(cfg: Config) -> int:
     # the C ABI) never inherit our handlers.
     with MetricsLogger(path=cfg.metrics_jsonl) as metrics, \
             PreemptionGuard() as guard:
+        # ONE runtime registry for the whole (possibly supervised) run:
+        # restart/step totals must survive per-attempt trainer rebuilds.
+        registry = MetricsRegistry()
+
         def make_trainer(c):
             return Trainer(model, ds, c, metrics=metrics, faults=faults,
-                           preempt=guard)
+                           preempt=guard, registry=registry)
 
         # First construction outside the retry loop AND outside
         # _supervised: a config error (bad nan-policy, indivisible
@@ -142,7 +152,8 @@ def run(cfg: Config) -> int:
             log.error("trainer setup failed: %s", e)
             return 2
         try:
-            result, _ = _supervised(cfg, log, metrics, first, make_trainer)
+            result, _ = _supervised(cfg, log, metrics, first, make_trainer,
+                                    registry=registry)
         except Preempted as e:
             if e.resumable:
                 log.warning("run preempted (%s); exiting %d — relaunch "
@@ -176,9 +187,11 @@ def run_lm(argv: list[str]) -> int:
     initialize_distributed()
     with MetricsLogger(path=cfg.metrics_jsonl) as metrics, \
             PreemptionGuard() as guard:
+        registry = MetricsRegistry()  # shared across supervised attempts
+
         def make_trainer(c):
             return LMTrainer(c, metrics=metrics, faults=faults,
-                             preempt=guard)
+                             preempt=guard, registry=registry)
 
         # First construction outside _supervised: setup errors map to
         # rc=2 exactly once; mid-training errors keep their tracebacks.
@@ -194,7 +207,7 @@ def run_lm(argv: list[str]) -> int:
         )
         try:
             result, trainer = _supervised(cfg, log, metrics, first,
-                                          make_trainer)
+                                          make_trainer, registry=registry)
         except Preempted as e:
             if e.resumable:
                 log.warning("run preempted (%s); exiting %d — relaunch "
@@ -233,6 +246,24 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.report import report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "trace":
+        # Offline: reconstruct per-request lifecycles from a serving
+        # run's tick records (obs.timeline) — jax-free.
+        from .obs.timeline import trace_main
+
+        return trace_main(argv[1:])
+    if argv and argv[0] == "top":
+        # Live dashboard: tail (or replay) a metrics JSONL and render
+        # the engine/trainer gauges in place (obs.top) — jax-free.
+        from .obs.top import top_main
+
+        return top_main(argv[1:])
+    if argv and argv[0] == "compare":
+        # Perf-regression gate: compare run files / bench captures on
+        # named metrics, exit 1 on regression (obs.regress) — jax-free.
+        from .obs.regress import compare_main
+
+        return compare_main(argv[1:])
     if argv and argv[0] == "serve-bench":
         # Serving bench: paged-KV continuous batching vs static
         # batching under Poisson arrivals (serve/bench.py).

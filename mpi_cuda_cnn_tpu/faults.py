@@ -478,7 +478,8 @@ def all_finite(tree):
 
 
 def supervise(attempt_fn: Callable[[int], object], *, max_restarts: int,
-              logger=None, metrics=None, backoff_base: float = 0.5,
+              logger=None, metrics=None, registry=None,
+              backoff_base: float = 0.5,
               sleep=time.sleep, jitter=random.random) -> object:
     """The crash-safe training supervisor: run `attempt_fn(attempt)` and,
     on a crash, rerun it up to `max_restarts` more times.
@@ -500,7 +501,11 @@ def supervise(attempt_fn: Callable[[int], object], *, max_restarts: int,
     and the jitter de-synchronizes a fleet of supervisors relaunching
     into the same recovering dependency. Each restart emits a ``fault``
     obs event (kind="restart", with the delay) when a metrics sink is
-    given; `sleep`/`jitter` are test injection points.
+    given, and bumps the ``train.restarts`` counter when an
+    obs.MetricsRegistry is given — the supervisor outlives every
+    attempt, so the registry is where restart totals survive the
+    trainer rebuilds (`mctpu top` shows them live). `sleep`/`jitter`
+    are test injection points.
     """
     last: BaseException | None = None
     for attempt in range(max_restarts + 1):
@@ -524,6 +529,8 @@ def supervise(attempt_fn: Callable[[int], object], *, max_restarts: int,
                     "(%d restart(s) left)", attempt, type(e).__name__, e,
                     delay, max_restarts - attempt,
                 )
+            if registry is not None:
+                registry.inc("train.restarts")
             if metrics is not None:
                 metrics.log("fault", kind="restart", attempt=attempt,
                             delay_s=round(delay, 4),

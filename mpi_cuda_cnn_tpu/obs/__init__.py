@@ -30,6 +30,14 @@ from .device import (  # noqa: F401
     hbm_peak_bytes,
     memory_snapshot,
 )
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_bucket_bounds,
+    percentiles_from_record,
+)
 from .report import render_markdown, report_main, summarize  # noqa: F401
 from .schema import (  # noqa: F401
     RUN_MARKER,

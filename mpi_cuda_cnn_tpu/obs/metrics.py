@@ -1,0 +1,254 @@
+"""Runtime metrics: counters, gauges, and log-bucket histograms.
+
+The JSONL substrate (obs.schema) records *moments*; nothing aggregated
+them at runtime — the serving engine emitted per-request records with
+no queue-depth time series and no percentile accounting, and a live
+`mctpu top` had nothing to tail. This registry is that aggregation
+layer, deliberately jax-free and wall-clock-free in its MATH:
+
+- `Counter`:   monotonically increasing totals (decode ticks, tokens
+               emitted, restarts, heartbeats).
+- `Gauge`:     last-set values with a running min/max (queue depth,
+               free pages, tokens/s).
+- `Histogram`: fixed LOG-SPACED buckets (Prometheus-style cumulative-
+               free counts): observation math is pure arithmetic on the
+               observed value — no clock reads, no randomness — so a
+               registry driven by a faults.FakeClock produces bitwise-
+               identical snapshots run to run. Percentiles are
+               estimated by linear interpolation inside the bucket
+               (upper-bound conservative at the tail).
+
+The injectable `clock` is used ONLY to stamp snapshot records ("t" on
+the emitted `metrics` event) — never inside aggregation — which is what
+makes telemetry tests deterministic under FakeClock (the PR-4
+contract).
+
+Snapshots are schema-validated `metrics` events; `mctpu top` tails
+them, `mctpu report` summarizes them, and `mctpu compare` gates their
+named values against a baseline.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .schema import make_record, validate_record
+
+# Default histogram range: 10 us .. ~100 s in milliseconds terms
+# (1e-2 ms .. 1e5 ms) at 10 buckets/decade — wide enough for TTFT and
+# step times alike; values outside land in the open edge buckets.
+DEFAULT_LO = 1e-2
+DEFAULT_HI = 1e5
+BUCKETS_PER_DECADE = 10
+
+
+def log_bucket_bounds(lo: float = DEFAULT_LO, hi: float = DEFAULT_HI,
+                      per_decade: int = BUCKETS_PER_DECADE) -> list[float]:
+    """Upper bounds of log-spaced buckets covering [lo, hi]. The bounds
+    are a pure function of (lo, hi, per_decade) — every producer and
+    consumer derives the same edges, so bucket counts are comparable
+    across runs without shipping the edges in every record."""
+    if not (lo > 0 and hi > lo):
+        raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
+    n = int(round(math.log10(hi / lo) * per_decade))
+    return [lo * 10 ** (i / per_decade) for i in range(1, n + 1)]
+
+
+class Counter:
+    """A monotonically increasing total."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter increment must be >= 0, got {amount}")
+        self.value += amount
+
+
+class Gauge:
+    """Last-set value with a running min/max envelope (the envelope is
+    what `mctpu top` scales its bars against)."""
+
+    __slots__ = ("value", "lo", "hi")
+
+    def __init__(self):
+        self.value = None
+        self.lo = None
+        self.hi = None
+
+    def set(self, value: float) -> None:
+        value = float(value)
+        self.value = value
+        self.lo = value if self.lo is None else min(self.lo, value)
+        self.hi = value if self.hi is None else max(self.hi, value)
+
+
+class Histogram:
+    """Fixed log-spaced-bucket histogram with exact count/sum/min/max.
+
+    `bounds` are bucket UPPER bounds (ascending); observations above
+    the last bound land in a final overflow bucket, at-or-below the
+    first bound in bucket 0. Deterministic: observing the same sequence
+    of values yields identical state — no clock, no sampling.
+    """
+
+    __slots__ = ("bounds", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, bounds: list[float] | None = None):
+        self.bounds = list(bounds) if bounds is not None \
+            else log_bucket_bounds()
+        if any(b <= a for a, b in zip(self.bounds, self.bounds[1:])):
+            raise ValueError("histogram bounds must be ascending")
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        # Binary search would be O(log n); n is ~70 and observe runs on
+        # the host between ticks — linear keeps it obvious.
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    def percentile(self, q: float) -> float | None:
+        """Estimate the q-th percentile (0..100) from bucket counts by
+        linear interpolation inside the winning bucket, clamped to the
+        exact observed min/max (so p0/p100 are never estimates)."""
+        if self.count == 0:
+            return None
+        rank = max(1, math.ceil(q / 100.0 * self.count))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else (
+                    self.min if self.min is not None else 0.0)
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                frac = (rank - seen) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, self.min), self.max)
+            seen += c
+        return self.max
+
+    def to_fields(self) -> dict:
+        """The compact record form: sparse nonzero buckets as
+        [index, count] pairs (a 70-bucket histogram with 5 live buckets
+        ships 5 pairs, not 70 zeros)."""
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 4),
+            "min": self.min if self.min is None else round(self.min, 4),
+            "max": self.max if self.max is None else round(self.max, 4),
+            "buckets": [[i, c] for i, c in enumerate(self.counts) if c],
+        }
+
+    @classmethod
+    def from_fields(cls, fields: dict,
+                    bounds: list[float] | None = None) -> "Histogram":
+        """Rebuild from to_fields() output — the consumer half used by
+        `mctpu top`/report to compute percentiles from a record."""
+        h = cls(bounds)
+        for i, c in fields.get("buckets", []):
+            h.counts[i] = int(c)
+        h.count = int(fields.get("count", sum(h.counts)))
+        h.sum = float(fields.get("sum", 0.0))
+        h.min = fields.get("min")
+        h.max = fields.get("max")
+        return h
+
+
+class MetricsRegistry:
+    """One process's named counters/gauges/histograms + snapshotting.
+
+    `clock` has the time.perf_counter call shape and is read ONLY when a
+    snapshot is stamped; aggregation (inc/set/observe) never touches it,
+    which is the determinism contract tests pin under faults.FakeClock.
+    """
+
+    def __init__(self, *, clock=None):
+        import time
+
+        self._clock = clock if clock is not None else time.perf_counter
+        self._t0 = self._clock()
+        self.counters: dict[str, Counter] = {}
+        self.gauges: dict[str, Gauge] = {}
+        self.histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self.counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self.gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str,
+                  bounds: list[float] | None = None) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(bounds)
+        return h
+
+    # -- convenience single-call forms ---------------------------------
+
+    def inc(self, name: str, amount: float = 1.0) -> None:
+        self.counter(name).inc(amount)
+
+    def set(self, name: str, value: float) -> None:
+        self.gauge(name).set(value)
+
+    def observe(self, name: str, value: float | None) -> None:
+        """None observations are skipped (aborted requests carry null
+        where a moment never happened — the serving convention)."""
+        if value is not None:
+            self.histogram(name).observe(value)
+
+    # -- snapshotting --------------------------------------------------
+
+    def snapshot_fields(self, **extra) -> dict:
+        """The `metrics` event's fields (no schema/event/t stamp)."""
+        return {
+            "counters": {k: round(c.value, 6)
+                         for k, c in sorted(self.counters.items())},
+            "gauges": {k: {"value": g.value, "lo": g.lo, "hi": g.hi}
+                       for k, g in sorted(self.gauges.items())},
+            "histograms": {k: h.to_fields()
+                           for k, h in sorted(self.histograms.items())},
+            **extra,
+        }
+
+    def snapshot(self, **extra) -> dict:
+        """A schema-validated `metrics` record stamped with the
+        injectable clock (the only clock read in this module)."""
+        rec = make_record("metrics", self._clock() - self._t0,
+                          **self.snapshot_fields(**extra))
+        return validate_record(rec)
+
+    def emit(self, metrics, **extra) -> None:
+        """Log one snapshot through a MetricsLogger when its JSONL sink
+        is open (the trainers' cheap-no-sink discipline)."""
+        if metrics is not None and metrics.jsonl_enabled:
+            metrics.log("metrics", **self.snapshot_fields(**extra))
+
+
+def percentiles_from_record(rec: dict, name: str,
+                            qs=(50, 95, 99)) -> dict[str, float | None]:
+    """p50/p95/p99 (by default) of one named histogram inside a
+    `metrics` record — the consumer-side helper top/report share."""
+    fields = rec.get("histograms", {}).get(name)
+    if not fields:
+        return {f"p{q}": None for q in qs}
+    h = Histogram.from_fields(fields)
+    return {f"p{q}": h.percentile(q) for q in qs}

@@ -19,7 +19,28 @@ import sys
 from typing import Iterable
 
 from .cost import mfu, peak_flops
+from .metrics import Histogram
+from .schema import fmt_cell as _fmt
 from .schema import iter_runs
+
+
+def _merge_hist_fields(a: dict, b: dict) -> dict:
+    """Sum two Histogram.to_fields() dicts (same implied bucket edges —
+    obs.metrics.log_bucket_bounds): bucket counts added index-wise,
+    count/sum added, min/max enveloped. The cross-segment half of
+    --merge: one restarted process's histogram continues the other's."""
+    counts = {i: c for i, c in a.get("buckets", [])}
+    for i, c in b.get("buckets", []):
+        counts[i] = counts.get(i, 0) + c
+    mins = [m for m in (a.get("min"), b.get("min")) if m is not None]
+    maxs = [m for m in (a.get("max"), b.get("max")) if m is not None]
+    return {
+        "count": a.get("count", 0) + b.get("count", 0),
+        "sum": a.get("sum", 0.0) + b.get("sum", 0.0),
+        "min": min(mins) if mins else None,
+        "max": max(maxs) if maxs else None,
+        "buckets": sorted([i, c] for i, c in counts.items()),
+    }
 
 
 def _by_event(records: Iterable[dict]) -> dict[str, list[dict]]:
@@ -192,6 +213,51 @@ def summarize(records: Iterable[dict], *,
             for r in serves
         ]
 
+    snaps = ev.get("metrics", [])
+    if snaps:
+        # The NEWEST registry snapshot per (segment, label): within one
+        # process counters/histograms are cumulative, so the last
+        # snapshot subsumes the earlier ones — but each relaunched
+        # process (a supervisor restart under --merge, tagged "_seg" by
+        # report_main) restarts its registry at zero, so segment-latest
+        # snapshots are FOLDED: counters summed, histograms merged
+        # bucket-wise, gauges last-segment-wins. "mode" labels serving
+        # registries; trainers default to train.
+        latest: dict[tuple[int, str], dict] = {}
+        for r in snaps:
+            latest[(r.get("_seg", 0), r.get("mode", "train"))] = r
+        folded: dict[str, dict] = {}
+        for (_, label), r in sorted(latest.items()):
+            f = folded.setdefault(
+                label, {"counters": {}, "gauges": {}, "histograms": {}})
+            for k, v in (r.get("counters") or {}).items():
+                f["counters"][k] = f["counters"].get(k, 0) + v
+            for k, g in (r.get("gauges") or {}).items():
+                f["gauges"][k] = (g or {}).get("value")
+            for k, fields in (r.get("histograms") or {}).items():
+                prev = f["histograms"].get(k)
+                f["histograms"][k] = fields if prev is None \
+                    else _merge_hist_fields(prev, fields)
+        out: dict[str, dict] = {}
+        for label, f in sorted(folded.items()):
+            hists = {}
+            for name, fields in sorted(f["histograms"].items()):
+                h = Histogram.from_fields(fields)
+                hists[name] = {
+                    "count": h.count,
+                    "p50": h.percentile(50),
+                    "p95": h.percentile(95),
+                    "p99": h.percentile(99),
+                    "min": h.min,
+                    "max": h.max,
+                }
+            out[label] = {
+                "counters": dict(sorted(f["counters"].items())),
+                "gauges": dict(sorted(f["gauges"].items())),
+                "histograms": hists,
+            }
+        summary["metrics"] = out
+
     spans = ev.get("span", [])
     if spans:
         agg: dict[str, list[float]] = {}
@@ -219,16 +285,6 @@ def pct_nearest(vals: list[float], q: float) -> float | None:
 
 
 _pct = pct_nearest
-
-
-def _fmt(v) -> str:
-    if v is None:
-        return "—"
-    if isinstance(v, float):
-        return f"{v:.6g}"
-    if isinstance(v, dict):
-        return ", ".join(f"{k}:{n}" for k, n in sorted(v.items())) or "—"
-    return str(v)
 
 
 def render_markdown(summary: dict, title: str = "Run report") -> str:
@@ -355,6 +411,32 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 f"| {_fmt(s['ttft_p99_ms'])} | {_fmt(s['tpot_p99_ms'])} |"
             )
         lines.append("")
+    if "metrics" in summary:
+        # Runtime-registry snapshots (ISSUE 6): the p50/p95/p99 tables
+        # the serving sections of PERF.md are made from, produced by
+        # obs.metrics histograms instead of hand-assembled.
+        lines += [
+            "| runtime histogram | count | p50 | p95 | p99 | min | max |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for label, m in summary["metrics"].items():
+            for name, h in m["histograms"].items():
+                lines.append(
+                    f"| {label}: {name} | {h['count']} | {_fmt(h['p50'])} "
+                    f"| {_fmt(h['p95'])} | {_fmt(h['p99'])} "
+                    f"| {_fmt(h['min'])} | {_fmt(h['max'])} |"
+                )
+        lines.append("")
+        for label, m in summary["metrics"].items():
+            kv = {**m["counters"],
+                  **{k: v for k, v in m["gauges"].items()
+                     if v is not None}}
+            if kv:
+                lines.append(
+                    f"Runtime totals [{label}]: "
+                    + ", ".join(f"{k}={_fmt(v)}" for k, v in kv.items())
+                )
+        lines.append("")
     if "memory" in summary:
         m = summary["memory"]
         peak = m["hbm_peak_bytes"]
@@ -382,21 +464,48 @@ def report_main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("paths", nargs="+", help="metrics JSONL file(s)")
     ap.add_argument("--format", choices=("md", "json"), default="md")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge every run segment of every file into ONE "
+                         "report — a supervised run's pre/post-restart "
+                         "segments (or a multi-file capture) render as "
+                         "one table instead of one report per segment")
     ap.add_argument("--peak-tflops", type=float, default=None,
                     help="chip bf16 peak for the MFU column (defaults to "
                          "v5e when records say backend=tpu)")
     args = ap.parse_args(argv)
     rc = 0
+    per_path: list[tuple[str, list[list[dict]]]] = []
     for path in args.paths:
         try:
             # Per-run segments ('# run' markers from MetricsLogger's
             # append mode): aggregating across unrelated runs would pair
-            # one run's FLOPs with another's step times.
-            runs = [r for r in iter_runs(path) if r]
+            # one run's FLOPs with another's step times — unless --merge
+            # says the segments ARE one logical run (supervisor
+            # restarts resume the same training).
+            per_path.append((path, [r for r in iter_runs(path) if r]))
         except (OSError, ValueError) as e:
             print(f"error: {path}: {e}", file=sys.stderr)
             rc = 1
-            continue
+    if args.merge:
+        # Tag each record with its run-segment ordinal: registry
+        # snapshots are cumulative only WITHIN a process, so summarize
+        # needs the segment boundary to fold counters across restarts
+        # instead of letting the last segment's totals shadow the rest.
+        segments = [records for _, runs in per_path for records in runs]
+        merged = [dict(rec, _seg=seg)
+                  for seg, records in enumerate(segments)
+                  for rec in records]
+        nseg = len(segments)
+        summary = summarize(merged, peak_tflops=args.peak_tflops)
+        label = (f"merged ({nseg} segment(s) from "
+                 f"{len(per_path)} file(s))")
+        if args.format == "json":
+            print(json.dumps({"paths": [p for p, _ in per_path],
+                              "segments": nseg, **summary}))
+        else:
+            print(render_markdown(summary, title=f"Run report — {label}"))
+        return rc
+    for path, runs in per_path:
         for i, records in enumerate(runs, 1):
             summary = summarize(records, peak_tflops=args.peak_tflops)
             label = path if len(runs) == 1 else f"{path} (run {i}/{len(runs)})"

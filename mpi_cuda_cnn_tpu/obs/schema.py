@@ -72,6 +72,20 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # captures. Interval saves stay un-evented (they'd dominate the
     # stream); the elasticity-relevant moments are what reports need.
     "ckpt": ("step", "reason"),
+    # One MetricsRegistry snapshot (obs/metrics.py, ISSUE 6): aggregated
+    # counters (monotonic totals), gauges ({value, lo, hi}), and
+    # log-bucket histograms ({count, sum, min, max, buckets: [[i, n]]}
+    # over obs.metrics.log_bucket_bounds edges). `mctpu top` tails
+    # these; `mctpu compare` gates their named values.
+    "metrics": ("counters", "gauges", "histograms"),
+    # One serving-engine scheduler iteration (serve/engine.py, ISSUE 6):
+    # the per-tick state `mctpu trace` reconstructs request lifecycles
+    # from — queue depth, free pages, and the tick's scheduling moments
+    # (admitted [[slot, rid]], prefill [slot, rid, n] | null, decoded
+    # [[slot, rid]], finished/preempted/failed rids, aborted
+    # [[rid, status]]). "now" is seconds since run start on the
+    # engine's (injectable) clock.
+    "tick": ("tick", "now", "queue", "free_pages"),
 }
 
 
@@ -175,3 +189,18 @@ def dump_records(records: Iterable[dict], path: str | Path) -> None:
     with Path(path).open("w") as fh:
         for rec in records:
             fh.write(json.dumps(rec) + "\n")
+
+
+def fmt_cell(v, prec: int = 6) -> str:
+    """The one table-cell formatter every obs renderer (report, trace,
+    top, compare) shares: None is an em-dash (a moment that never
+    happened), floats render at `prec` significant digits, dicts as
+    sorted k:v pairs. Golden-output tests pin this formatting — change
+    it here and every renderer moves together."""
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.{prec}g}"
+    if isinstance(v, dict):
+        return ", ".join(f"{k}:{n}" for k, n in sorted(v.items())) or "—"
+    return str(v)

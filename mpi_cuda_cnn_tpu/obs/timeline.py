@@ -1,0 +1,391 @@
+"""Per-request trace timelines: `mctpu trace RUN [--request ID]`.
+
+The serving engine's tick records (obs `tick` events — one per
+scheduler iteration, carrying that iteration's admissions, prefill
+chunk, decode set, preemptions, and terminal requests) plus the
+per-request `request` records are a complete account of a run. This
+module reconstructs each request's lifecycle from them:
+
+    submit -> queued -> admit -> prefill chunks -> first token ->
+    decode ticks -> (preempt -> requeue -> readmit -> re-prefill)* ->
+    terminal status
+
+and renders two views:
+
+- a per-slot tick Gantt (which slot did what on every engine
+  iteration: P = prefill chunk, D = decode, . = idle) — the schedule
+  itself, visible;
+- a per-request latency breakdown (queued vs prefilling vs decoding vs
+  preempted-waiting milliseconds), the answer to "why was THIS request
+  slow".
+
+Reconstruction is also a cross-check: the lifecycle derived purely
+from tick events must land every request in the same terminal status
+its `request` record claims, and its emitted-token account (one per
+completed prefill + one per decode tick) must match `output_tokens`.
+`trace_main` exits nonzero when any lifecycle is inconsistent — drift
+between the engine and its telemetry fails loudly, in CI.
+
+Times are approximate to one tick (a tick record's "now" is stamped at
+iteration end); the breakdown sums segment durations between those
+stamps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from .schema import fmt_cell as _fmt
+from .schema import iter_runs
+
+
+@dataclasses.dataclass
+class Lifecycle:
+    """One request's reconstructed history within one mode's run."""
+
+    rid: int
+    mode: str
+    record: dict | None = None      # its `request` record, when present
+    # (tick index, now, kind, detail) in tick order; kinds: admitted,
+    # prefill, first_token, decode, preempted, finished, aborted.
+    events: list[tuple] = dataclasses.field(default_factory=list)
+    admissions: int = 0
+    prefill_chunks: int = 0
+    decode_ticks: int = 0
+    preemptions: int = 0
+    derived_status: str | None = None
+    terminal_now: float | None = None
+    # Milliseconds spent per state, summed across segments.
+    breakdown: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def tokens_accounted(self) -> int:
+        """Tokens the tick trail accounts for: one at each completed
+        prefill (the engine emits the first token at prefill
+        completion, per readmission) + one per decode tick."""
+        first_tokens = sum(1 for e in self.events if e[2] == "first_token")
+        return first_tokens + self.decode_ticks
+
+    @property
+    def consistent(self) -> bool:
+        """The reconstruction agrees with the request record: same
+        terminal status, and (for requests that produced tokens) the
+        tick-derived token count matches output_tokens."""
+        if self.record is None:
+            return False
+        if self.derived_status != self.record.get("status", "finished"):
+            return False
+        return self.tokens_accounted == self.record.get("output_tokens", 0)
+
+    def arrival_s(self) -> float | None:
+        return self.record.get("arrival_s") if self.record else None
+
+
+def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
+    """Lifecycles per mode per rid from one run's records.
+
+    Reads `tick` events (the per-iteration trail) and `request` events
+    (the terminal claims being cross-checked). A file with request
+    records but no tick records (pre-ISSUE-6) yields lifecycles with
+    record-only data and consistent=False — trace needs the trail.
+    """
+    out: dict[str, dict[int, Lifecycle]] = {}
+
+    def life(mode: str, rid: int) -> Lifecycle:
+        per = out.setdefault(mode, {})
+        lc = per.get(rid)
+        if lc is None:
+            lc = per[rid] = Lifecycle(rid=rid, mode=mode)
+        return lc
+
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "request":
+            life(rec.get("mode", "?"), rec["id"]).record = rec
+        elif ev == "tick":
+            mode = rec.get("mode", "?")
+            tick, now = rec.get("tick"), rec.get("now")
+            for slot, rid in rec.get("admitted") or []:
+                lc = life(mode, rid)
+                lc.admissions += 1
+                lc.events.append((tick, now, "admitted", slot))
+            pf = rec.get("prefill")
+            if pf:
+                lc = life(mode, pf[1])
+                lc.prefill_chunks += 1
+                lc.events.append((tick, now, "prefill", pf[2]))
+                if pf[-1] == "emit":
+                    lc.events.append((tick, now, "first_token", None))
+            for slot, rid in rec.get("decoded") or []:
+                lc = life(mode, rid)
+                lc.decode_ticks += 1
+                lc.events.append((tick, now, "decode", slot))
+            for rid in rec.get("preempted") or []:
+                lc = life(mode, rid)
+                lc.preemptions += 1
+                lc.events.append((tick, now, "preempted", None))
+            for rid in rec.get("finished") or []:
+                lc = life(mode, rid)
+                lc.derived_status = "finished"
+                lc.terminal_now = now
+                lc.events.append((tick, now, "finished", None))
+            for rid, status in rec.get("aborted") or []:
+                lc = life(mode, rid)
+                lc.derived_status = status
+                lc.terminal_now = now
+                lc.events.append((tick, now, "aborted", status))
+
+    for per in out.values():
+        for lc in per.values():
+            _compute_breakdown(lc)
+    return out
+
+
+def _compute_breakdown(lc: Lifecycle) -> None:
+    """Attribute the request's wall-clock to states by walking its
+    events: queued (arrival -> first admit), prefilling (admit ->
+    first token / last chunk), decoding, preempted-waiting (preempt ->
+    readmit). Milliseconds, rounded; None arrival -> empty breakdown."""
+    arrival = lc.arrival_s()
+    if arrival is None or lc.terminal_now is None:
+        return
+    acc = {"queued_ms": 0.0, "prefill_ms": 0.0, "decode_ms": 0.0,
+           "preempted_ms": 0.0}
+    state, since = "queued", arrival
+    state_key = {"queued": "queued_ms", "prefill": "prefill_ms",
+                 "decode": "decode_ms", "preempted": "preempted_ms"}
+    for _tick, now, kind, _detail in lc.events:
+        if kind == "admitted":
+            acc[state_key[state]] += now - since
+            state, since = "prefill", now
+        elif kind == "first_token":
+            acc[state_key[state]] += now - since
+            state, since = "decode", now
+        elif kind == "preempted":
+            acc[state_key[state]] += now - since
+            state, since = "preempted", now
+        elif kind in ("finished", "aborted"):
+            acc[state_key[state]] += now - since
+            since = now
+    lc.breakdown = {k: round(1e3 * v, 3) for k, v in acc.items()}
+
+
+# -- rendering ---------------------------------------------------------
+
+
+def render_gantt(records: list[dict], mode: str, *, width: int = 96,
+                 rid: int | None = None) -> str:
+    """Per-slot tick Gantt for one mode: one row per engine slot, one
+    column per tick (bucketed down to `width` columns for long runs).
+    P = prefill chunk, D = decode, both = '#', idle = '.'. With `rid`,
+    only that request's activity is drawn (its queue time shows as
+    'q', preempted-waiting as 'x', on the row of the slot it next
+    occupies)."""
+    ticks = [r for r in records if r.get("event") == "tick"
+             and r.get("mode", "?") == mode]
+    if not ticks:
+        return "(no tick records)"
+    n_ticks = max(t["tick"] for t in ticks) + 1
+    slots = 0
+    for t in ticks:
+        for s, _ in (t.get("admitted") or []):
+            slots = max(slots, s + 1)
+        for s, _ in (t.get("decoded") or []):
+            slots = max(slots, s + 1)
+        if t.get("prefill"):
+            slots = max(slots, t["prefill"][0] + 1)
+    slots = max(slots, 1)
+    per_col = max(1, -(-n_ticks // width))  # ceil: ticks per column
+    cols = -(-n_ticks // per_col)
+    # grid[slot][col] accumulates flags: 1 = prefill, 2 = decode.
+    grid = [[0] * cols for _ in range(slots)]
+    for t in ticks:
+        col = t["tick"] // per_col
+        pf = t.get("prefill")
+        if pf and (rid is None or pf[1] == rid):
+            grid[pf[0]][col] |= 1
+        for s, r in (t.get("decoded") or []):
+            if rid is None or r == rid:
+                grid[s][col] |= 2
+    if rid is not None:
+        # Waiting intervals for the focused request, drawn on the row of
+        # the slot it lands on NEXT: arrival -> first admission is queue
+        # time (flag 4, 'q'), preemption -> readmission is preempted-
+        # waiting (flag 8, 'x'). Activity flags win inside a bucketed
+        # column; 'x' outranks 'q' (a requeue is the rarer signal).
+        admits = [(t["tick"], s) for t in ticks
+                  for s, r in (t.get("admitted") or []) if r == rid]
+        req = next((r for r in records if r.get("event") == "request"
+                    and r.get("id") == rid
+                    and r.get("mode", "?") == mode), None)
+        waits = []  # (start_tick, end_tick_exclusive, flag)
+        if admits and req and req.get("arrival_s") is not None:
+            arrive = next((t["tick"] for t in ticks
+                           if t["now"] >= req["arrival_s"]), admits[0][0])
+            waits.append((arrive, admits[0][0], 4))
+        preempt_ticks = [t["tick"] for t in ticks
+                         if rid in (t.get("preempted") or [])]
+        for pt in preempt_ticks:
+            readmit = next((a for a, _ in admits if a > pt), n_ticks)
+            waits.append((pt, readmit, 8))
+        for start, end, flag in waits:
+            slot = next((s for a, s in admits if a >= end),
+                        admits[-1][1] if admits else 0)
+            for tick in range(start, end):
+                grid[slot][tick // per_col] |= flag
+    chars = {0: ".", 4: "q", 8: "x", 12: "x"}
+
+    def cell(c: int) -> str:
+        # Activity (P/D/#) beats waiting flags within a bucket.
+        return {1: "P", 2: "D", 3: "#"}[c & 3] if c & 3 else chars[c]
+    lines = [f"ticks 0..{n_ticks - 1}"
+             + (f" ({per_col} ticks/column)" if per_col > 1 else "")
+             + f" — mode {mode}"
+             + (f", request {rid}" if rid is not None else "")]
+    for s in range(slots):
+        lines.append(f"slot {s:>2} |" + "".join(cell(c) for c in grid[s]))
+    return "\n".join(lines)
+
+
+def render_request_table(lifecycles: dict[int, Lifecycle]) -> str:
+    lines = [
+        "| rid | status | arrival s | queued ms | prefill ms | decode ms "
+        "| preempt wait ms | preempts | chunks | dticks | tokens | ok |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for rid in sorted(lifecycles):
+        lc = lifecycles[rid]
+        b = lc.breakdown
+        rec = lc.record or {}
+        lines.append(
+            f"| {rid} | {_fmt(lc.derived_status)} | {_fmt(lc.arrival_s())} "
+            f"| {_fmt(b.get('queued_ms'))} | {_fmt(b.get('prefill_ms'))} "
+            f"| {_fmt(b.get('decode_ms'))} | {_fmt(b.get('preempted_ms'))} "
+            f"| {lc.preemptions} | {lc.prefill_chunks} | {lc.decode_ticks} "
+            f"| {lc.tokens_accounted}/{_fmt(rec.get('output_tokens'))} "
+            f"| {'yes' if lc.consistent else 'NO'} |"
+        )
+    return "\n".join(lines)
+
+
+def render_request_detail(lc: Lifecycle) -> str:
+    rec = lc.record or {}
+    head = [
+        f"request {lc.rid} [{lc.mode}] — status {_fmt(lc.derived_status)} "
+        f"(record: {_fmt(rec.get('status'))}), "
+        f"prompt {_fmt(rec.get('prompt_tokens'))} tokens, "
+        f"out {_fmt(rec.get('output_tokens'))} tokens, "
+        f"ttft {_fmt(rec.get('ttft_ms'))} ms, "
+        f"latency {_fmt(rec.get('latency_ms'))} ms",
+        "breakdown: " + ", ".join(f"{k}={_fmt(v)}"
+                                  for k, v in lc.breakdown.items()),
+        f"arrival t={_fmt(lc.arrival_s())} s; lifecycle:",
+    ]
+    body = [
+        f"  tick {tick:>5} t={now:.4f}s  {kind}"
+        + (f" ({detail})" if detail is not None else "")
+        for tick, now, kind, detail in lc.events
+    ]
+    return "\n".join(head + body)
+
+
+def trace_main(argv: list[str] | None = None) -> int:
+    """`mctpu trace RUN [--request ID]` — lifecycle reconstruction.
+
+    Exits 1 when any reconstructed lifecycle disagrees with its
+    request record (missing tick trail counts as disagreement): the
+    engine and its telemetry drifting apart is a failure, not a
+    rendering choice.
+    """
+    ap = argparse.ArgumentParser(
+        prog="mctpu trace",
+        description="Reconstruct per-request lifecycles from a serving "
+                    "run's metrics JSONL: per-slot tick Gantt + latency "
+                    "breakdown (queued/prefill/decode/preempted).",
+    )
+    ap.add_argument("path", help="metrics JSONL with tick + request records")
+    ap.add_argument("--request", type=int, default=None,
+                    help="detail one request id instead of the summary")
+    ap.add_argument("--mode", default=None,
+                    help="restrict to one scheduler mode "
+                         "(default: every mode in the file)")
+    ap.add_argument("--width", type=int, default=96,
+                    help="Gantt width in columns (ticks are bucketed)")
+    ap.add_argument("--format", choices=("md", "json"), default="md")
+    args = ap.parse_args(argv)
+
+    try:
+        runs = [r for r in iter_runs(args.path) if r]
+    except (OSError, ValueError) as e:
+        print(f"error: {args.path}: {e}", file=sys.stderr)
+        return 2
+    rc = 0
+    for i, records in enumerate(runs, 1):
+        by_mode = reconstruct(records)
+        if args.mode is not None:
+            by_mode = {m: v for m, v in by_mode.items() if m == args.mode}
+        if not by_mode:
+            continue
+        label = args.path if len(runs) == 1 \
+            else f"{args.path} (run {i}/{len(runs)})"
+        for mode, lifecycles in sorted(by_mode.items()):
+            bad = [rid for rid, lc in lifecycles.items() if not lc.consistent]
+            if args.format == "json":
+                print(json.dumps({
+                    "path": args.path, "run": i, "mode": mode,
+                    "requests": len(lifecycles),
+                    "inconsistent": sorted(bad),
+                    "statuses": _status_counts(lifecycles),
+                    "lifecycles": {
+                        str(rid): {
+                            "status": lc.derived_status,
+                            "breakdown": lc.breakdown,
+                            "preemptions": lc.preemptions,
+                            "prefill_chunks": lc.prefill_chunks,
+                            "decode_ticks": lc.decode_ticks,
+                            "tokens": lc.tokens_accounted,
+                            "consistent": lc.consistent,
+                        }
+                        for rid, lc in sorted(lifecycles.items())
+                    },
+                }))
+            elif args.request is not None:
+                lc = lifecycles.get(args.request)
+                if lc is None:
+                    print(f"error: no request {args.request} in mode "
+                          f"{mode} of {label}", file=sys.stderr)
+                    rc = max(rc, 2)
+                    continue
+                print(f"## Trace — {label}\n")
+                print(render_request_detail(lc))
+                print()
+                print(render_gantt(records, mode, width=args.width,
+                                   rid=args.request))
+                print()
+            else:
+                print(f"## Trace — {label} [{mode}]\n")
+                print(render_gantt(records, mode, width=args.width))
+                print()
+                print(render_request_table(lifecycles))
+                print()
+            if bad:
+                print(f"error: {len(bad)} request(s) with inconsistent "
+                      f"lifecycles in mode {mode}: {sorted(bad)[:10]}",
+                      file=sys.stderr)
+                rc = max(rc, 1)
+    return rc
+
+
+def _status_counts(lifecycles: dict[int, Lifecycle]) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for lc in lifecycles.values():
+        st = lc.derived_status or "unknown"
+        counts[st] = counts.get(st, 0) + 1
+    return counts
+
+
+if __name__ == "__main__":
+    sys.exit(trace_main())

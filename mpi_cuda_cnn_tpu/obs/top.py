@@ -1,0 +1,303 @@
+"""`mctpu top` — a live terminal dashboard over a metrics JSONL.
+
+Tails a run file (live, while a bench/trainer writes it) or replays a
+finished one, and renders the engine/trainer gauges refreshing in
+place: queue depth with a recent-history sparkline, running/prefilling
+slots, free pages, chunked-prefill backlog, counter totals, and the
+latency-histogram percentiles from the newest `metrics` snapshot. This
+is the single-process precursor of the fleet router's replica view
+(ROADMAP item 4): the same records, one engine instead of N.
+
+Deliberately jax-free (imports only obs.schema/obs.metrics): `top` must
+run on any machine that can read the file, including while the training
+process owns every accelerator.
+
+Modes:
+- default: follow — re-read appended records every --refresh seconds,
+  redraw in place; Ctrl-C exits.
+- --once:  ingest the whole file, print ONE frame without ANSI control
+  codes, exit (the test/CI path — also what you want in a pipe).
+- --replay: step through a finished file frame by frame at --refresh
+  per frame (a tape of the run, slowed down to watchable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import deque
+from pathlib import Path
+
+from .metrics import percentiles_from_record
+from .schema import RUN_MARKER, fmt_cell, validate_record
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 24) -> str:
+    """Last `width` values as block characters, scaled to their max."""
+    vals = list(values)[-width:]
+    if not vals:
+        return ""
+    hi = max(max(vals), 1e-9)
+    return "".join(_SPARK[min(int(v / hi * (len(_SPARK) - 1)), 7)]
+                   for v in vals)
+
+
+def bar(value, hi, width: int = 16) -> str:
+    """A [####....] gauge bar of value against its running max."""
+    if value is None:
+        return " " * (width + 2)
+    hi = max(hi if hi else value, value, 1e-9)
+    n = int(round(value / hi * width))
+    return "[" + "#" * n + "." * (width - n) + "]"
+
+
+class TopState:
+    """Aggregated view of the records seen so far (one run)."""
+
+    def __init__(self, history: int = 48):
+        self.records = 0
+        self.t = 0.0
+        self.metrics: dict[str, dict] = {}   # newest snapshot per label
+        self.tick: dict[str, dict] = {}      # newest tick per mode
+        self.queue_hist: dict[str, deque] = {}
+        self.train: dict | None = None
+        self.epochs = 0
+        self.epoch_s = None
+        self.serve: dict[str, dict] = {}
+        self.faults: dict[str, int] = {}
+        self._history = history
+
+    def reset(self) -> None:
+        self.__init__(self._history)
+
+    def ingest(self, rec: dict) -> None:
+        self.records += 1
+        self.t = max(self.t, rec.get("t", 0.0) or 0.0)
+        ev = rec.get("event")
+        if ev == "metrics":
+            self.metrics[rec.get("mode", "train")] = rec
+        elif ev == "tick":
+            mode = rec.get("mode", "?")
+            self.tick[mode] = rec
+            self.queue_hist.setdefault(
+                mode, deque(maxlen=self._history)
+            ).append(rec.get("queue", 0))
+        elif ev == "train":
+            self.train = rec
+        elif ev == "epoch":
+            self.epochs += 1
+            self.epoch_s = rec.get("seconds")
+        elif ev == "serve":
+            self.serve[rec.get("mode", "?")] = rec
+        elif ev == "fault":
+            kind = rec.get("kind", "?")
+            self.faults[kind] = self.faults.get(kind, 0) + 1
+
+
+def _fmt(v) -> str:
+    # 4 significant digits, not the tables' 6 — a refreshing dashboard
+    # column must not jitter in width.
+    return fmt_cell(v, prec=4)
+
+
+def _pcts(snap: dict, name: str) -> str:
+    p = percentiles_from_record(snap, name)
+    if p["p50"] is None:
+        return "—"
+    return "/".join(_fmt(p[k]) for k in ("p50", "p95", "p99"))
+
+
+def render(state: TopState, path: str, width: int = 96) -> str:
+    """One dashboard frame (pure string — no ANSI; callers position)."""
+    lines = [f"mctpu top — {path}  records={state.records}  "
+             f"t={state.t:.2f}s"]
+    for mode in sorted(set(state.tick) | set(m for m in state.metrics
+                                             if m != "train")):
+        tk = state.tick.get(mode, {})
+        snap = state.metrics.get(mode, {})
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        free = tk.get("free_pages")
+        free_hi = (gauges.get("serve.free_pages") or {}).get("hi")
+        lines.append("")
+        lines.append(
+            f"ENGINE [{mode}]  tick {_fmt(tk.get('tick'))}  "
+            f"queue {_fmt(tk.get('queue')):>4} "
+            f"{sparkline(state.queue_hist.get(mode, []))}"
+        )
+        lines.append(
+            f"  running {_fmt(tk.get('running'))}  "
+            f"prefilling {_fmt(tk.get('prefilling'))}  "
+            f"free pages {_fmt(free)} {bar(free, free_hi)}  "
+            f"backlog {_fmt(tk.get('backlog'))} tok"
+        )
+        if counters:
+            lines.append(
+                "  totals: "
+                + "  ".join(
+                    f"{k.removeprefix('serve.')} {_fmt(v)}"
+                    for k, v in counters.items()
+                    if k.startswith("serve.")
+                )
+            )
+        if snap.get("histograms"):
+            lines.append(
+                f"  ms p50/p95/p99 — ttft {_pcts(snap, 'serve.ttft_ms')}"
+                f"  tpot {_pcts(snap, 'serve.tpot_ms')}"
+                f"  queue-wait {_pcts(snap, 'serve.queue_wait_ms')}"
+            )
+        sv = state.serve.get(mode)
+        if sv:
+            lines.append(
+                f"  final: {_fmt(sv.get('tokens_per_s'))} tok/s  "
+                f"ticks {_fmt(sv.get('decode_ticks'))}  "
+                f"preempt {_fmt(sv.get('preemptions'))}  "
+                f"statuses {json.dumps(sv.get('statuses'))}"
+            )
+    snap = state.metrics.get("train")
+    if state.train or snap or state.epochs:
+        tr = state.train or {}
+        lines.append("")
+        lines.append(
+            f"TRAIN  step {_fmt(tr.get('step'))}  "
+            f"loss {_fmt(tr.get('loss'))}  epochs {state.epochs}"
+            + (f"  last epoch {_fmt(state.epoch_s)}s" if state.epoch_s
+               else "")
+        )
+        if snap:
+            c, g = snap.get("counters", {}), snap.get("gauges", {})
+            tps = (g.get("train.tokens_per_s") or {}).get("value")
+            lines.append(
+                f"  heartbeats {_fmt(c.get('train.heartbeats'))}  "
+                f"restarts {_fmt(c.get('train.restarts'))}  "
+                f"steps {_fmt(c.get('train.steps'))}"
+                + (f"  tokens/s {_fmt(tps)}" if tps is not None else "")
+            )
+            if snap.get("histograms"):
+                lines.append(
+                    f"  step ms p50/p95/p99 {_pcts(snap, 'train.step_ms')}"
+                )
+    if state.faults:
+        lines.append("")
+        lines.append("FAULTS  " + "  ".join(
+            f"{k}:{v}" for k, v in sorted(state.faults.items())))
+    return "\n".join(line[:width] for line in lines)
+
+
+def _parse_line(line: str):
+    """(is_run_marker, record | None) — the tail-follow twin of
+    schema._iter_lines, tolerant of torn/partial writes."""
+    line = line.strip()
+    if line.startswith(RUN_MARKER):
+        return True, None
+    if not line or line.startswith("#"):
+        return False, None
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return False, None
+    if isinstance(rec, dict) and "schema" in rec:
+        try:
+            validate_record(rec)
+        except ValueError:
+            return False, None
+    return False, rec if isinstance(rec, dict) else None
+
+
+def top_main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mctpu top",
+        description="Live dashboard over a metrics JSONL: tail a "
+                    "running bench/trainer (default), print one frame "
+                    "(--once), or replay a finished run (--replay).",
+    )
+    ap.add_argument("path", help="metrics JSONL to tail")
+    ap.add_argument("--refresh", type=float, default=0.5,
+                    help="seconds between redraws (follow/replay)")
+    ap.add_argument("--once", action="store_true",
+                    help="ingest everything, print one frame, exit "
+                         "(no ANSI — safe in pipes/CI)")
+    ap.add_argument("--replay", action="store_true",
+                    help="replay a finished file one frame per "
+                         "--refresh instead of tailing")
+    ap.add_argument("--frames", type=int, default=0,
+                    help="stop after N redraws (0 = until Ctrl-C / "
+                         "end of replay) — the bounded-session escape "
+                         "hatch for scripts")
+    ap.add_argument("--width", type=int, default=110)
+    args = ap.parse_args(argv)
+
+    path = Path(args.path)
+    if not path.exists():
+        print(f"error: {path}: no such file", file=sys.stderr)
+        return 2
+    state = TopState()
+
+    if args.once or args.replay:
+        with path.open() as fh:
+            lines = fh.readlines()
+        if args.once:
+            for line in lines:
+                marker, rec = _parse_line(line)
+                if marker:
+                    state.reset()  # frame shows the file's LAST run
+                elif rec is not None:
+                    state.ingest(rec)
+            print(render(state, str(path), width=args.width))
+            return 0
+        # Replay: one frame per tick/metrics record batch.
+        frames = 0
+        for line in lines:
+            marker, rec = _parse_line(line)
+            if marker:
+                state.reset()
+                continue
+            if rec is None:
+                continue
+            state.ingest(rec)
+            if rec.get("event") in ("tick", "metrics", "train", "epoch"):
+                sys.stdout.write("\x1b[2J\x1b[H"
+                                 + render(state, str(path),
+                                          width=args.width) + "\n")
+                sys.stdout.flush()
+                frames += 1
+                if args.frames and frames >= args.frames:
+                    return 0
+                time.sleep(args.refresh)
+        print(render(state, str(path), width=args.width))
+        return 0
+
+    # Follow: poll for appended complete lines, redraw in place.
+    frames = 0
+    buf = ""
+    try:
+        with path.open() as fh:
+            while True:
+                chunk = fh.read()
+                if chunk:
+                    buf += chunk
+                    *complete, buf = buf.split("\n")
+                    for line in complete:
+                        marker, rec = _parse_line(line)
+                        if marker:
+                            state.reset()
+                        elif rec is not None:
+                            state.ingest(rec)
+                sys.stdout.write("\x1b[2J\x1b[H"
+                                 + render(state, str(path),
+                                          width=args.width) + "\n")
+                sys.stdout.flush()
+                frames += 1
+                if args.frames and frames >= args.frames:
+                    return 0
+                time.sleep(args.refresh)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(top_main())

@@ -121,6 +121,7 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         return 1
 
     from ..models.transformer import TransformerLM
+    from ..obs.metrics import MetricsRegistry
     from ..utils.logging import MetricsLogger
     from .engine import PagedEngine
     from .paged_cache import pages_for
@@ -169,10 +170,25 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                 from ..faults import FaultInjector
 
                 faults = FaultInjector(args.fault_plan)
+            # The runtime metrics layer (ISSUE 6): one registry per mode
+            # (cross-mode aggregation would blend the two schedules) and
+            # tick records streamed to the JSONL sink AS THEY HAPPEN —
+            # `mctpu top run.jsonl` tails the file live; `mctpu trace`
+            # reconstructs lifecycles from the same records afterwards.
+            registry = MetricsRegistry()
+            tick_sink = None
+            if metrics.jsonl_enabled:
+                def tick_sink(rec, _snap_every=64):
+                    metrics.log("tick", **rec)
+                    if (rec["tick"] + 1) % _snap_every == 0:
+                        registry.emit(metrics, mode=rec["mode"])
             result = engine.run(make_workload(**workload_kw), mode=mode,
-                                faults=faults, **run_kw)
+                                faults=faults, registry=registry,
+                                tick_sink=tick_sink, **run_kw)
             s = result.summary()
             summaries[mode] = s
+            registry.set("serve.tokens_per_s", s["tokens_per_s"])
+            registry.emit(metrics, mode=mode, final=True)
             for rec in result.request_records():
                 metrics.log("request", **rec)
             for ev in result.events:

@@ -121,6 +121,14 @@ class ServeResult:
                             else round(1e3 * (r.first_token_at - r.arrival), 3)),
                 "latency_ms": (None if r.finished_at is None
                                else round(1e3 * (r.finished_at - r.arrival), 3)),
+                # Lifecycle anchors (ISSUE 6): absolute arrival on the
+                # run's clock (pairs with tick records' "now") and the
+                # time spent queued before FIRST admission — null for
+                # requests that never got a slot.
+                "arrival_s": round(r.arrival, 4),
+                "queue_wait_ms": (None if r.admitted_at is None
+                                  else round(1e3 * (r.admitted_at - r.arrival),
+                                             3)),
                 "preemptions": r.preemptions,
                 **({"reason": r.fail_reason} if r.fail_reason else {}),
             }
@@ -150,6 +158,26 @@ class ServeResult:
             "tpot_p50_ms": pct_nearest(tpot, 50),
             "tpot_p99_ms": pct_nearest(tpot, 99),
         }
+
+
+def _observe_request(registry, r: Request) -> None:
+    """Fold one terminal request into the registry: a per-status
+    counter plus the latency histograms (same formulas as
+    ServeResult.ttft_ms/tpot_ms, so the registry's percentiles and the
+    summary's can never disagree on the same run). Null moments —
+    aborted before admission or before the first token — are skipped,
+    the serving null convention."""
+    registry.inc(f"serve.requests_{r.status}")
+    if r.admitted_at is not None:
+        registry.observe("serve.queue_wait_ms",
+                         1e3 * (r.admitted_at - r.arrival))
+    if r.status != "finished":
+        return
+    registry.observe("serve.ttft_ms", 1e3 * (r.first_token_at - r.arrival))
+    registry.observe(
+        "serve.tpot_ms",
+        1e3 * (r.finished_at - r.first_token_at) / max(len(r.out) - 1, 1),
+    )
 
 
 class PagedEngine:
@@ -224,7 +252,8 @@ class PagedEngine:
 
     def run(self, requests: list[Request], *, mode: str = "continuous",
             time_fn=time.perf_counter, faults=None, max_queue: int | None = None,
-            watchdog_s: float = 0.0, sleep_fn=time.sleep) -> ServeResult:
+            watchdog_s: float = 0.0, sleep_fn=time.sleep,
+            registry=None, tick_sink=None) -> ServeResult:
         """Serve `requests` to a terminal status each; return ServeResult.
 
         Requests are mutated in place (out/timestamps/status); arrivals
@@ -235,6 +264,15 @@ class PagedEngine:
         iteration index); watchdog_s > 0 counts iterations slower than
         that budget. Deterministic tests drive time_fn/sleep_fn with a
         faults.FakeClock.
+
+        Observability (ISSUE 6): `registry` is an obs.MetricsRegistry
+        the engine updates in place — per-tick gauges (queue depth,
+        running/prefilling slots, free pages, chunked-prefill backlog)
+        and per-request histograms (ttft_ms/tpot_ms/queue_wait_ms) —
+        and `tick_sink` receives each per-iteration tick field dict as
+        it happens (serve/bench.py points it at the metrics JSONL, which
+        is what makes `mctpu top` live-tailable mid-run). Both default
+        to off: the hot loop pays nothing unless asked.
         """
         if mode == "continuous":
             sched = ContinuousScheduler(
@@ -258,6 +296,11 @@ class PagedEngine:
         watchdog_slow = 0
         squeezes: list[dict] = []  # {"pages": [...], "until": tick}
         tick_idx = 0
+        want_ticks = registry is not None or tick_sink is not None
+        # Terminal-request watermarks: sched.finished / sched.dropped
+        # are append-only, so the new tail since last iteration IS this
+        # tick's terminal set — no instrumentation at the call sites.
+        n_fin_seen = n_drop_seen = 0
         t0 = time_fn()
         while sched.unfinished:
             iter_t0 = time_fn()
@@ -289,13 +332,14 @@ class PagedEngine:
             for r in sched.sweep(now):
                 events.append({"kind": f"request_{r.status}", "id": r.rid,
                                "mode": mode, "t_rel": round(now, 4)})
-            sched.admit(now)
+            admitted = [[s.idx, s.req.rid] for s in sched.admit(now)]
             # Backpressure AFTER admission: the bound applies to what
             # remains waiting once free slots have been filled.
             for r in sched.enforce_queue_bound(now):
                 events.append({"kind": "request_rejected", "id": r.rid,
                                "mode": mode, "t_rel": round(now, 4)})
             progressed = False
+            prefill_rec = None
 
             # At most ONE prefill chunk per iteration: long prompts
             # advance without starving in-flight decodes.
@@ -314,6 +358,7 @@ class PagedEngine:
                 self._pages = cache.pages
                 slot.cached += n
                 prefill_chunks += 1
+                prefill_rec = [slot.idx, slot.req.rid, n]
                 progressed = True
                 if slot.cached >= slot.target:
                     # Prefill complete: the chunk's last valid logits
@@ -323,11 +368,13 @@ class PagedEngine:
                     # every reservation until the batch drains (the
                     # occupancy discipline the comparison measures).
                     self._emit(slot, int(nxt), time_fn() - t0)
+                    prefill_rec.append("emit")  # first token at completion
                     if slot.req.done and isinstance(sched,
                                                     ContinuousScheduler):
                         sched.finish(slot, time_fn() - t0)
 
             dslots = sched.grow_for_decode(time_fn() - t0)
+            decoded = [[s.idx, s.req.rid] for s in dslots]
             for r in sched.dropped:
                 # admit/grow_for_decode may have failed a livelocked
                 # request; log each rid once.
@@ -395,6 +442,61 @@ class PagedEngine:
                     "kind": "watchdog_slow_tick", "tick": tick_idx,
                     "mode": mode, "seconds": round(busy_s, 4),
                 })
+            # The tick record (obs `tick` event shape): this iteration's
+            # scheduling moments + end-of-iteration gauges. Terminal
+            # requests are the new tails of the append-only finished/
+            # dropped lists since last iteration. Built only when a
+            # telemetry consumer asked for it — the slot/queue scans are
+            # the cost the docstring promises a bare run never pays; the
+            # record itself is streamed, never retained (the JSONL sink
+            # is the tick store — an in-memory list would grow without
+            # bound on a long-lived serve).
+            preempted = sched.drain_preempted()
+            if not want_ticks:
+                sched.pool.check()
+                tick_idx += 1
+                continue
+            new_fin = sched.finished[n_fin_seen:]
+            new_drop = sched.dropped[n_drop_seen:]
+            n_fin_seen, n_drop_seen = len(sched.finished), len(sched.dropped)
+            now = time_fn() - t0
+            arrived_waiting = sum(1 for r in sched.queue if r.arrival <= now)
+            running = sum(1 for s in sched.slots if not s.free)
+            prefilling = sum(1 for s in sched.slots
+                             if s.prefilling and not s.req.terminal)
+            backlog = sched.prefill_backlog()
+            tick_rec = {
+                "tick": tick_idx, "now": round(now, 4), "mode": mode,
+                "queue": arrived_waiting, "running": running,
+                "prefilling": prefilling,
+                "free_pages": sched.pool.free_pages, "backlog": backlog,
+                "admitted": admitted, "prefill": prefill_rec,
+                "decoded": decoded,
+                "finished": [r.rid for r in new_fin],
+                "aborted": [[r.rid, r.status] for r in new_drop],
+                "preempted": preempted,
+            }
+            if tick_sink is not None:
+                tick_sink(tick_rec)
+            if registry is not None:
+                registry.set("serve.queue_depth", arrived_waiting)
+                registry.set("serve.running_slots", running)
+                registry.set("serve.prefilling_slots", prefilling)
+                registry.set("serve.free_pages", sched.pool.free_pages)
+                registry.set("serve.prefill_backlog", backlog)
+                if decoded:
+                    registry.inc("serve.decode_ticks")
+                if prefill_rec is not None:
+                    registry.inc("serve.prefill_chunks")
+                emitted = len(decoded) + (1 if prefill_rec is not None
+                                          and prefill_rec[-1] == "emit"
+                                          else 0)
+                if emitted:
+                    registry.inc("serve.tokens_emitted", emitted)
+                if preempted:
+                    registry.inc("serve.preemptions", len(preempted))
+                for r in new_fin + new_drop:
+                    _observe_request(registry, r)
             sched.pool.check()
             tick_idx += 1
 

@@ -149,6 +149,9 @@ class _SchedulerBase:
         # exactly one of the two lists.
         self.dropped: list[Request] = []
         self.preemptions = 0
+        # rids preempted since the last drain_preempted() — the engine
+        # folds them into the tick record it emits for the timeline.
+        self.preempted_log: list[int] = []
         self._admit_seq = 0
 
     def submit(self, requests: Iterable[Request]) -> None:
@@ -183,6 +186,18 @@ class _SchedulerBase:
 
     def next_arrival(self) -> float | None:
         return min((r.arrival for r in self.queue), default=None)
+
+    def drain_preempted(self) -> list[int]:
+        """rids preempted since the last call (tick-record bookkeeping)."""
+        out, self.preempted_log = self.preempted_log, []
+        return out
+
+    def prefill_backlog(self) -> int:
+        """Prompt tokens admitted but not yet cached — the chunked-
+        prefill backlog gauge (how far admissions are ahead of the
+        prefill interleave)."""
+        return sum(s.target - s.cached for s in self.slots
+                   if s.prefilling and not s.req.terminal)
 
     def prefill_slot(self) -> Slot | None:
         """The earliest-admitted slot still prefilling (FCFS: one
@@ -347,6 +362,7 @@ class ContinuousScheduler(_SchedulerBase):
         req = slot.req
         req.preemptions += 1
         self.preemptions += 1
+        self.preempted_log.append(req.rid)
         req.status = "queued"
         self.queue.appendleft(req)
         self._release(slot)

@@ -38,6 +38,7 @@ from ..faults import (
     drain_preemption,
     step_is_finite,
 )
+from ..obs.metrics import MetricsRegistry
 from ..parallel.distributed import barrier, process_info
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer
@@ -100,10 +101,21 @@ class LMTrainer:
 
     def __init__(self, cfg, *, mesh=None,
                  metrics: MetricsLogger | None = None, faults=None,
-                 preempt: PreemptionGuard | None = None):
+                 preempt: PreemptionGuard | None = None, registry=None,
+                 clock=None):
         self.cfg = cfg
         self.log = get_logger()
         self.metrics = metrics or MetricsLogger()
+        # Runtime metrics registry (ISSUE 6) — same contract as the CNN
+        # Trainer's: ONE shared registry across supervisor rebuilds
+        # (restart/step totals survive), a private one standalone.
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        # `clock` has the time.perf_counter call shape and is the ONLY
+        # time source the run loop and its telemetry fold read — a
+        # FakeClock here makes step_ms/tokens_per_s registry values
+        # deterministic (the PR-4 contract, same as StepTimer's).
+        self._clock = clock if clock is not None else time.perf_counter
         # Fault hooks + NaN/Inf guard (ISSUE 4) — same contract as the
         # CNN Trainer: `faults` is a faults.FaultInjector shared across
         # supervisor restarts; the guard's policy rules are the shared
@@ -777,13 +789,18 @@ class LMTrainer:
                 # loop below is empty and steps_run clamps to 0.
                 start_step = min(start_step, cfg.steps)
 
-        t0 = time.perf_counter()
+        t0 = self._clock()
         loss = float("nan")
         m = None
-        timer = StepTimer()
+        timer = StepTimer(clock=self._clock)
         timer.start()
         logged_cost = False
         rollbacks = 0
+        # Per-interval registry anchors (ISSUE 6): each log interval
+        # folds its step-time mean and tokens/s into the runtime
+        # registry, excluding the one-off obs AOT compile the timer
+        # already excludes from its own envelope.
+        last_t, last_step, last_exc = t0, start_step, 0.0
         try:
             step = start_step
             while step < cfg.steps:
@@ -826,6 +843,22 @@ class LMTrainer:
                                 loss = float(m["loss"])
                             self.metrics.log("train", step=step + 1,
                                              loss=loss)
+                            now = self._clock()
+                            n = step + 1 - last_step
+                            dt = (now - last_t
+                                  - (timer.excluded_s - last_exc))
+                            if n > 0 and dt > 0:
+                                reg = self.registry
+                                reg.inc("train.steps", n)
+                                reg.inc("train.heartbeats")
+                                reg.observe("train.step_ms", 1e3 * dt / n)
+                                reg.set(
+                                    "train.tokens_per_s",
+                                    n * cfg.batch_size * cfg.seq_len / dt,
+                                )
+                                reg.emit(self.metrics, step=step + 1)
+                            last_t, last_step = now, step + 1
+                            last_exc = timer.excluded_s
                 except RollbackToCheckpoint:
                     rollbacks += 1
                     if rollbacks > MAX_NAN_ROLLBACKS:
@@ -847,7 +880,7 @@ class LMTrainer:
                 hard_block(self.state)
             # Exclude the obs AOT compile from the headline tokens/s —
             # telemetry must not sink the number it reports.
-            dt = time.perf_counter() - t0 - timer.excluded_s
+            dt = self._clock() - t0 - timer.excluded_s
             if cfg.checkpoint_dir:
                 self._ckpt.save(self.state, cfg.steps)
         finally:
@@ -865,6 +898,17 @@ class LMTrainer:
         timer.stop(max(steps_run, 1))
         emit_step_telemetry(self.metrics, timer, steps_run,
                             devices=list(self.mesh.devices.flat))
+        if steps_run > 0:
+            # Final registry snapshot: the headline tokens/s (same dt
+            # the LMResult reports) plus any tail steps the log-interval
+            # anchors missed.
+            reg = self.registry
+            if cfg.steps > last_step:
+                reg.inc("train.steps", cfg.steps - last_step)
+            reg.set("train.tokens_per_s",
+                    steps_run * cfg.batch_size * cfg.seq_len
+                    / max(dt, 1e-9))
+            reg.emit(self.metrics, final=True)
 
         with span("eval", metrics=self.metrics.sink_or_none()):
             eval_loss = self.evaluate()

@@ -60,6 +60,7 @@ from ..faults import (
     poison_batch,
     step_is_finite,
 )
+from ..obs.metrics import MetricsRegistry
 from ..parallel.distributed import barrier, process_info
 from ..utils.logging import MetricsLogger, get_logger
 from ..utils.profiling import StepTimer, profile_trace
@@ -111,12 +112,25 @@ class Trainer:
 
     def __init__(self, model, dataset, config, *, mesh=None,
                  metrics: MetricsLogger | None = None, faults=None,
-                 preempt: PreemptionGuard | None = None):
+                 preempt: PreemptionGuard | None = None, registry=None,
+                 clock=None):
         self.model = model
         self.ds = dataset
         self.cfg = config
         self.log = get_logger()
         self.metrics = metrics or MetricsLogger()
+        # Runtime metrics registry (ISSUE 6): step-time histogram,
+        # samples/s gauge, liveness counters. The CLI passes ONE shared
+        # registry so totals (steps, restarts) survive supervisor
+        # rebuilds; standalone construction gets a private one.
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        # `clock` has the time.perf_counter call shape and is the time
+        # source for epoch wall-clocks and the step timers feeding the
+        # registry fold — a FakeClock makes telemetry deterministic (the
+        # PR-4 contract). device_epoch_seconds stays on real wall time:
+        # it measures hardware, not telemetry.
+        self._clock = clock if clock is not None else time.perf_counter
         # Fault hooks + the NaN/Inf guard (ISSUE 4). `faults` is a
         # faults.FaultInjector; the CLI builds one from --fault-plan and
         # shares it across supervisor restarts (fired faults stay fired).
@@ -472,10 +486,26 @@ class Trainer:
 
     def _emit_epoch_obs(self, epoch: int, timer: StepTimer,
                         nsteps: int) -> None:
-        """Per-epoch telemetry (the shared obs.device emit path)."""
+        """Per-epoch telemetry (the shared obs.device emit path), plus
+        the runtime-registry fold (ISSUE 6): step-time histogram,
+        samples/s gauge, and liveness counters — what `mctpu top`
+        renders and `mctpu compare` gates. Aggregation consumes only the
+        timer's already-measured intervals (no clock reads here), so a
+        FakeClock-driven timer yields bitwise-identical snapshots."""
         emit_step_telemetry(self.metrics, timer, nsteps,
                             devices=list(self.mesh.devices.flat),
                             epoch=epoch)
+        if nsteps <= 0:
+            return
+        reg = self.registry
+        reg.inc("train.steps", nsteps)
+        reg.inc("train.heartbeats")
+        step_ms = timer.mean_step_ms
+        reg.observe("train.step_ms", step_ms)
+        if step_ms > 0:
+            reg.set("train.samples_per_s",
+                    1e3 * self.cfg.batch_size / step_ms)
+        reg.emit(self.metrics, epoch=epoch)
 
     @staticmethod
     def _pick_eval_batch(ntest: int, granularity: int, target: int = 2048) -> int:
@@ -587,12 +617,12 @@ class Trainer:
         if self._use_scan():
             return self._run_epoch_scanned(epoch, skip_steps=skip_steps)
         cfg = self.cfg
-        t0 = time.perf_counter()
+        t0 = self._clock()
         running = None
         nsteps = 0
         order = self._epoch_order(epoch)
         b = cfg.batch_size
-        timer = StepTimer()
+        timer = StepTimer(clock=self._clock)
         timer.start()
         # Oversized datasets normalize PER BATCH: the cached train_x/train_y
         # copies are a 4x float32 blow-up of the whole set — the exact host
@@ -667,7 +697,7 @@ class Trainer:
             hard_block(self.state)
         # Subtract the obs AOT-compile time the timer excluded, so the
         # epoch record and step_phases record cannot disagree.
-        seconds = time.perf_counter() - t0 - timer.excluded_s
+        seconds = self._clock() - t0 - timer.excluded_s
         timer.stop(max(nsteps, 1))
         self._emit_epoch_obs(epoch, timer, nsteps)
         if nsteps == 0:
@@ -797,8 +827,8 @@ class Trainer:
         splits chunks at checkpoint boundaries so mid-epoch saves land on
         exact step counts."""
         cfg = self.cfg
-        t0 = time.perf_counter()
-        timer = StepTimer()
+        t0 = self._clock()
+        timer = StepTimer(clock=self._clock)
         timer.start()
         with timer.phase("data"):
             if self._scan_epoch_fn is None:
@@ -867,7 +897,7 @@ class Trainer:
             self._step_boundary(epoch * nsteps + done)
         with timer.phase("device"):
             hard_block(self.state)  # see run_epoch: must wait for compute
-        seconds = time.perf_counter() - t0 - timer.excluded_s  # see run_epoch
+        seconds = self._clock() - t0 - timer.excluded_s  # see run_epoch
         run = nsteps - skip_steps
         timer.stop(max(run, 1))
         self._emit_epoch_obs(epoch, timer, run)
@@ -913,7 +943,7 @@ class Trainer:
                     ckpt, start_epoch, step0, skip_steps,
                 )
 
-        timer = StepTimer()
+        timer = StepTimer(clock=self._clock)
         epoch_seconds: list[float] = []
         result_acc, ncorrect = 0.0, 0
         rollbacks = 0

@@ -42,11 +42,16 @@ class MetricsLogger:
 
         with MetricsLogger(path) as metrics:
             Trainer(..., metrics=metrics).train()
+
+    `clock` has the time.perf_counter call shape and stamps each
+    record's "t" relative to construction; fault-harness tests inject a
+    faults.FakeClock so telemetry timestamps are deterministic.
     """
 
     def __init__(self, path: str | Path | None = None, echo: bool = True,
-                 capture: bool = False):
+                 capture: bool = False, clock=None):
         self._file = None
+        self._clock = clock if clock is not None else time.perf_counter
         if path is not None:
             p = Path(path)
             p.parent.mkdir(parents=True, exist_ok=True)
@@ -61,7 +66,7 @@ class MetricsLogger:
             self._file.flush()
         self._echo = echo
         self._log = get_logger()
-        self._t0 = time.perf_counter()
+        self._t0 = self._clock()
         # In-memory record list, opt-in (unbounded — long-lived trainers
         # should leave it off and use the JSONL sink).
         self.rows: list[dict] | None = [] if capture else None
@@ -80,7 +85,7 @@ class MetricsLogger:
         return self if self.jsonl_enabled else None
 
     def log(self, event: str, **fields) -> None:
-        record = make_record(event, time.perf_counter() - self._t0, **fields)
+        record = make_record(event, self._clock() - self._t0, **fields)
         if self.rows is not None:
             self.rows.append(record)
         if self._file:

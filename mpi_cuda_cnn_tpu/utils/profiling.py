@@ -37,9 +37,14 @@ class StepTimer:
         timer.stop(n_steps)
 
     Phases nest with the start/stop envelope, not with each other.
+
+    `clock` has the time.perf_counter call shape; fault-harness tests
+    drive it with a faults.FakeClock so telemetry assertions are
+    deterministic — the timer itself never reads wall time elsewhere.
     """
 
-    def __init__(self):
+    def __init__(self, *, clock=None):
+        self._clock = clock if clock is not None else time.perf_counter
         self.reset()
 
     def reset(self) -> None:
@@ -51,7 +56,7 @@ class StepTimer:
         self._t0 = None
 
     def start(self) -> None:
-        self._t0 = time.perf_counter()
+        self._t0 = self._clock()
 
     def stop(self, n_steps: int = 1) -> float:
         if self._t0 is None:
@@ -59,7 +64,7 @@ class StepTimer:
                 "StepTimer.stop() before start() — call start() at the "
                 "top of the timed region (or reset() after an aborted one)"
             )
-        dt = time.perf_counter() - self._t0
+        dt = self._clock() - self._t0
         self._t0 = None
         self.steps += n_steps
         self.total_s += dt
@@ -68,12 +73,12 @@ class StepTimer:
     @contextlib.contextmanager
     def phase(self, name: str):
         """Attribute the enclosed wall-clock to `name` (accumulates)."""
-        t0 = time.perf_counter()
+        t0 = self._clock()
         try:
             yield
         finally:
             self.phase_s[name] = (
-                self.phase_s.get(name, 0.0) + time.perf_counter() - t0
+                self.phase_s.get(name, 0.0) + self._clock() - t0
             )
 
     @contextlib.contextmanager
@@ -84,11 +89,11 @@ class StepTimer:
         e.g. the obs cost-analysis AOT compile. The cumulative total is
         kept in `excluded_s` so callers can subtract it from their own
         independent wall-clocks too."""
-        t0 = time.perf_counter()
+        t0 = self._clock()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
+            dt = self._clock() - t0
             self.excluded_s += dt
             if self._t0 is not None:
                 self._t0 += dt

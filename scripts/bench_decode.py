@@ -38,9 +38,12 @@ import numpy as np
 
 from mpi_cuda_cnn_tpu.models.generate import generate, prefill
 from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.obs.schema import make_record
 from mpi_cuda_cnn_tpu.train.lm import count_params
 from mpi_cuda_cnn_tpu.utils.sync import hard_block as _force
 from mpi_cuda_cnn_tpu.utils.sync import two_point
+
+_T0 = time.perf_counter()
 
 
 def bench_decode_config(model, *, batch, prompt_len, gen_tokens,
@@ -181,15 +184,18 @@ def main():
 
     best = max(results.items(),
                key=lambda kv_: kv_[1]["decode_tokens_per_s"] or 0)
-    print(json.dumps({
-        "metric": "decode_tokens_per_s",
-        "value": best[1]["decode_tokens_per_s"],
-        "unit": "tokens/s",
-        "config": best[0],
-        "model": f"d{args.dim}x{args.depth} h{args.heads} v{args.vocab} "
-                 f"b{args.batch} prompt{args.prompt} cache{args.max_seq}",
-        "backend": jax.default_backend(),
-    }))
+    # Schema-stamped headline record (obs.schema `bench` event), like
+    # bench.py's: `mctpu compare` reads every bench output the same way.
+    print(json.dumps(make_record(
+        "bench", time.perf_counter() - _T0,
+        metric="decode_tokens_per_s",
+        value=best[1]["decode_tokens_per_s"],
+        unit="tokens/s",
+        config=best[0],
+        model=f"d{args.dim}x{args.depth} h{args.heads} v{args.vocab} "
+              f"b{args.batch} prompt{args.prompt} cache{args.max_seq}",
+        backend=jax.default_backend(),
+    )))
 
 
 if __name__ == "__main__":

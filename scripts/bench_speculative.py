@@ -35,9 +35,12 @@ import numpy as np
 
 from mpi_cuda_cnn_tpu.models.generate import generate, speculative_generate
 from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.obs.schema import make_record
 from mpi_cuda_cnn_tpu.train.lm import make_lm_state, make_lm_train_step
 from mpi_cuda_cnn_tpu.train.optimizer import make_optimizer
 from mpi_cuda_cnn_tpu.utils.sync import hard_block, two_point
+
+_T0 = time.perf_counter()
 
 
 def train_on_cycle(model, *, steps, batch, seq, lr=3e-3, seed=0):
@@ -404,14 +407,17 @@ def main():
             **({"suspect_fast": True} if sus_r else {}),
         }), flush=True)
 
-    print(json.dumps({
-        "metric": "speculative_decode_tokens_per_s",
-        "value": best[0], "unit": "tokens/s", "config": best[1],
-        "plain_tokens_per_s": rows[0]["tokens_per_s"],
-        "model": f"d{args.dim}x{args.depth} draft d{args.draft_dim}x"
-                 f"{args.draft_depth} v{args.vocab} B=1",
-        "backend": jax.default_backend(),
-    }))
+    # Schema-stamped headline record (obs.schema `bench` event), like
+    # bench.py's: `mctpu compare` reads every bench output the same way.
+    print(json.dumps(make_record(
+        "bench", time.perf_counter() - _T0,
+        metric="speculative_decode_tokens_per_s",
+        value=best[0], unit="tokens/s", config=best[1],
+        plain_tokens_per_s=rows[0]["tokens_per_s"],
+        model=f"d{args.dim}x{args.depth} draft d{args.draft_dim}x"
+              f"{args.draft_depth} v{args.vocab} B=1",
+        backend=jax.default_backend(),
+    )))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,121 @@
+"""Regenerate the checked-in observability sample run + goldens.
+
+Produces tests/data/sample_serve_run.jsonl — a small, fully
+deterministic serving run (FakeClock everywhere: engine time, fault
+injection, record stamps; no wall-clock leaks into any number) — plus
+the golden renderings tests/test_obs_runtime.py pins byte-for-byte:
+
+    tests/data/golden_serve_report.md   (`mctpu report` output)
+    tests/data/golden_serve_trace.md    (`mctpu trace` output)
+
+The workload is chosen for lifecycle diversity: a page pool far smaller
+than the worst case forces preemption/requeue cycles, an injected
+`slow` fault plus short deadlines expires one request mid-run, and
+Poisson arrivals stagger admissions — so the goldens exercise queued /
+prefill / decode / preempted / expired segments, not just the happy
+path. Rerun after any deliberate schema or rendering change:
+
+    JAX_PLATFORMS=cpu python scripts/make_obs_sample.py
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = Path(__file__).resolve().parents[1]
+DATA = REPO / "tests" / "data"
+
+
+def build_records():
+    import jax
+
+    from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
+    from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
+    from mpi_cuda_cnn_tpu.serve.bench import make_workload
+    from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+
+    model = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
+    params = model.init(jax.random.key(0))
+    engine = PagedEngine(model, params, slots=3, num_pages=10, page_size=4,
+                         prefill_chunk=8, max_len=40)
+    records: list[dict] = []
+    for mode in ("static", "continuous"):
+        clock = FakeClock()
+        registry = MetricsRegistry(clock=clock)
+
+        def sink(rec, clock=clock, registry=registry):
+            records.append(validate_record(
+                make_record("tick", clock.now, **rec)))
+            if (rec["tick"] + 1) % 32 == 0:
+                records.append(registry.snapshot(mode=rec["mode"]))
+
+        reqs = make_workload(n=8, vocab=13, prompt_min=4, prompt_max=8,
+                             out_min=6, out_max=18, rate=40.0, seed=5,
+                             deadline_s=0.35)
+        # Under a FakeClock, in-engine service is instantaneous (the
+        # clock only advances on idle waits), so deadlines would be
+        # all-or-nothing; the staggered slow faults ratchet the clock
+        # past SOME requests' deadlines mid-run — finished + expired +
+        # preempted lifecycles all appear in one small file.
+        faults = FaultInjector(
+            "slow@serve.tick:10?s=0.15;slow@serve.tick:20?s=0.15;"
+            "slow@serve.tick:30?s=0.15", clock=clock)
+        res = engine.run(reqs, mode=mode, time_fn=clock,
+                         sleep_fn=clock.advance, faults=faults,
+                         registry=registry, tick_sink=sink)
+        s = res.summary()
+        registry.set("serve.tokens_per_s", s["tokens_per_s"])
+        records.append(registry.snapshot(mode=mode, final=True))
+        for rec in res.request_records():
+            records.append(validate_record(
+                make_record("request", clock.now, **rec)))
+        for ev in res.events:
+            records.append(validate_record(
+                make_record("fault", clock.now, **{"mode": mode, **ev})))
+        records.append(validate_record(
+            make_record("serve", clock.now, bench="serve", **s)))
+        print(f"{mode}: statuses={s['statuses']} "
+              f"preemptions={s['preemptions']} ticks={s['decode_ticks']}")
+    return records
+
+
+def main() -> int:
+    from mpi_cuda_cnn_tpu.obs.report import report_main
+    from mpi_cuda_cnn_tpu.obs.schema import dump_records
+    from mpi_cuda_cnn_tpu.obs.timeline import trace_main
+
+    DATA.mkdir(parents=True, exist_ok=True)
+    run = DATA / "sample_serve_run.jsonl"
+    dump_records(build_records(), run)
+    print(f"wrote {run}")
+
+    # Render with the repo-relative path (and from the repo root) so
+    # the golden titles are machine-independent — the round-trip test
+    # invokes the renderers the same way.
+    os.chdir(REPO)
+    rel = str(run.relative_to(REPO))
+    for golden, fn, argv in (
+        ("golden_serve_report.md", report_main, [rel]),
+        ("golden_serve_trace.md", trace_main, [rel, "--width", "80"]),
+    ):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = fn(argv)
+        if rc != 0:
+            print(f"error: {golden} renderer exited {rc}", file=sys.stderr)
+            return rc
+        (DATA / golden).write_text(buf.getvalue())
+        print(f"wrote {DATA / golden}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
