@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative bench_serve profile_lm profile_moe report test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve bench_fleet serve-baseline profile_lm profile_moe report test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -139,6 +139,20 @@ bench_speculative:
 # (scripts/bench_serve.py == `mctpu serve-bench`).
 bench_serve:
 	$(PY) scripts/bench_serve.py
+
+# Fleet storm benchmark: N replicas behind the failure-aware router,
+# seeded Poisson arrivals, optional injected replica crashes/joins
+# (`mctpu fleet-bench`; serve/fleet.py).
+bench_fleet:
+	$(PY) -m mpi_cuda_cnn_tpu fleet-bench --replicas 4 --requests 2000 \
+	  --rate 500 --log summary
+
+# Regenerate the committed CI serving baseline (ci/serve_baseline.jsonl)
+# with the pinned arguments CI's candidate run uses — refresh after a
+# DELIBERATE scheduling change, commit alongside it; procedure in
+# scripts/make_serve_baseline.py and ci/serve_gate.json.
+serve-baseline:
+	$(PY) scripts/make_serve_baseline.py
 
 # Step-time attribution by ablation (full vs fwd-only vs identity-attn vs
 # no-head vs chunked-CE) — where the LM step's milliseconds go.

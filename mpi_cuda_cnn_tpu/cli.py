@@ -11,6 +11,7 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu --metrics-jsonl run.jsonl ...   # telemetry sink
     python -m mpi_cuda_cnn_tpu report run.jsonl                # summary tables
     python -m mpi_cuda_cnn_tpu serve-bench --requests 32       # serving bench
+    python -m mpi_cuda_cnn_tpu fleet-bench --replicas 4        # fleet storm
     python -m mpi_cuda_cnn_tpu trace run.jsonl --request 3     # lifecycle trace
     python -m mpi_cuda_cnn_tpu top run.jsonl                   # live dashboard
     python -m mpi_cuda_cnn_tpu compare base.jsonl new.jsonl    # regression gate
@@ -270,6 +271,13 @@ def main(argv: list[str] | None = None) -> int:
         from .serve.bench import serve_bench_main
 
         return serve_bench_main(argv[1:])
+    if argv and argv[0] == "fleet-bench":
+        # Fleet bench: N replicas behind the failure-aware router under
+        # a seeded Poisson storm with injected replica crashes/joins —
+        # deterministic under FakeClock (serve/fleet.py, ISSUE 7).
+        from .serve.bench import fleet_bench_main
+
+        return fleet_bench_main(argv[1:])
     cfg = parse_args(argv)
     return run(cfg)
 

@@ -29,6 +29,15 @@ kinds:
                 in-flight step, snapshots through the atomic
                 checkpoint path, and exits Preempted (code 75) — the
                 deterministic twin of a real preemption notice
+- ``replica_crash`` — kill fleet replica ``replica=K`` at a fleet tick
+                (ISSUE 7; ``zombie_ticks=N`` keeps it stepping as a
+                partitioned zombie whose post-failover output the
+                router's generation-token fence must discard)
+- ``replica_join``  — elastic scale-out: add ``replicas=N`` fresh
+                replicas to the fleet at a fleet tick
+- ``replica_leave`` — graceful drain: replica ``replica=K`` stops
+                taking dispatches, finishes its in-flight work, then
+                deregisters
 
 Recovery — `supervise()` is the `--max-restarts N` loop: it runs one
 training attempt, and on a crash rebuilds the trainer and resumes from
@@ -84,7 +93,86 @@ class Fault:
         return self.args.get(name, default)
 
 
-KINDS = ("crash", "io", "nan", "squeeze", "slow", "preempt")
+KINDS = ("crash", "io", "nan", "squeeze", "slow", "preempt",
+         "replica_crash", "replica_join", "replica_leave")
+
+# Hook sites each CLI surface actually registers, and the kinds each
+# site's consumer APPLIES (ISSUE 7 satellite): a plan naming a site the
+# chosen subcommand never reaches would silently never fire, and a kind
+# the site's consumer ignores (e.g. replica_crash@train.step) would
+# fire and silently do nothing — `validate_plan_sites` turns both into
+# argparse-time errors. crash/io are legal everywhere a site exists:
+# FaultInjector.fire raises them unconditionally, so they are always
+# observable. The trainers are two surfaces: both thread the injector
+# through train.step and the checkpoint hooks, but only the CNN
+# trainer fires train.batch (the nan-poisoning site) — nan@train.batch
+# on an LM run would validate and then silently never fire.
+SITES: dict[str, dict[str, frozenset[str]]] = {
+    "train": {
+        "train.batch": frozenset({"crash", "io", "nan"}),
+        "train.step": frozenset({"crash", "io", "preempt"}),
+        "ckpt.pre_rename": frozenset({"crash", "io"}),
+        "ckpt.manifest": frozenset({"crash", "io"}),
+    },
+    "train-lm": {
+        "train.step": frozenset({"crash", "io", "preempt"}),
+        "ckpt.pre_rename": frozenset({"crash", "io"}),
+        "ckpt.manifest": frozenset({"crash", "io"}),
+    },
+    "serve-bench": {
+        "serve.tick": frozenset({"crash", "io", "squeeze", "slow"}),
+    },
+    "fleet-bench": {
+        "fleet.tick": frozenset({"crash", "io", "replica_crash",
+                                 "replica_join", "replica_leave"}),
+    },
+}
+
+
+def fault_plan_arg(surface: str):
+    """argparse `type=` factory for --fault-plan: grammar AND hook-site/
+    kind validation at parse time, shared by every CLI surface (train,
+    serve-bench, fleet-bench) so the error contract cannot drift."""
+    def check(spec: str):
+        import argparse
+
+        try:
+            validate_plan_sites(parse_plan(spec), surface)
+        except ValueError as e:
+            raise argparse.ArgumentTypeError(str(e)) from e
+        return spec
+    return check
+
+
+def validate_plan_sites(plan: list[Fault] | str, surface: str) -> None:
+    """Raise ValueError if any fault in `plan` targets a site the
+    `surface` subcommand does not register, or a kind that site's
+    consumer never applies (SITES)."""
+    if isinstance(plan, str):
+        plan = parse_plan(plan)
+    allowed = SITES.get(surface)
+    if allowed is None:
+        # A drifted surface string is a programming error in the CLI
+        # wiring, but it must still surface as the one-line exit-2
+        # argparse error (fault_plan_arg wraps ValueError only).
+        raise ValueError(
+            f"unknown fault surface {surface!r} "
+            f"(known: {', '.join(sorted(SITES))})"
+        )
+    bad = sorted({f.site for f in plan if f.site not in allowed})
+    if bad:
+        raise ValueError(
+            f"fault site(s) {', '.join(bad)} are never reached by "
+            f"{surface!r} (its sites: {', '.join(sorted(allowed))}) — "
+            "the fault would silently never fire"
+        )
+    for f in plan:
+        if f.kind not in allowed[f.site]:
+            raise ValueError(
+                f"fault kind {f.kind!r} is never applied at {f.site} "
+                f"(its kinds: {', '.join(sorted(allowed[f.site]))}) — "
+                "the fault would fire and silently do nothing"
+            )
 
 
 def parse_plan(spec: str) -> list[Fault]:
@@ -333,6 +421,15 @@ class FaultInjector:
                 })
                 hits.append(f)
         return hits
+
+    def pending(self, site: str, kind: str | None = None) -> list[Fault]:
+        """Unfired faults at `site` (optionally filtered to `kind`), in
+        plan order — lets a driver see scheduled capacity it must wait
+        for (the fleet's replica_join) before declaring a dead end."""
+        with self._lock:
+            return [f for i, f in enumerate(self.plan)
+                    if i not in self._fired and f.site == site
+                    and (kind is None or f.kind == kind)]
 
     def fire(self, site: str, value: int) -> list[Fault]:
         """poll(), then raise for the raising kinds; non-raising faults

@@ -24,7 +24,13 @@ Thresholds JSON:
     {"default_tol_pct": 10,
      "metrics": {"serve.continuous.decode_ticks": {"tol_pct": 0},
                  "serve.continuous.tokens_per_s":
-                     {"tol_pct": 10, "direction": "higher"}}}
+                     {"tol_pct": 10, "direction": "higher"},
+                 "serve.fleet.trace_crc":
+                     {"tol_pct": 0, "direction": "equal"}}}
+
+Directions: "higher" (a drop regresses), "lower" (a rise regresses),
+or "equal" (ANY drift regresses — the determinism gate's two-sided
+form; never inferred from a name, only explicit).
 
 With --gate only the listed metrics are gated (a listed metric missing
 from either side fails loudly — a silently-vanishing metric is how
@@ -78,11 +84,18 @@ def _num(v) -> float | None:
         and not isinstance(v, bool) else None
 
 
-# serve-event keys worth gating (the engine summary's numeric columns).
+# serve-event keys worth gating (the engine summary's numeric columns,
+# plus the fleet summary's structural counts — absent keys are skipped,
+# so single-engine records don't grow phantom fleet metrics). The
+# statuses dict is additionally flattened to serve.<mode>.status.<k>:
+# the fleet determinism gate pins per-status totals at exact equality.
 _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                "preemptions", "output_tokens", "requests",
                "watchdog_slow_ticks", "ttft_p50_ms", "ttft_p99_ms",
-               "tpot_p50_ms", "tpot_p99_ms", "duration_s")
+               "tpot_p50_ms", "tpot_p99_ms", "duration_s",
+               "fleet_ticks", "dispatches", "redispatches",
+               "fenced_discards", "crashes", "joins", "leaves",
+               "restarts", "circuit_opens", "replicas", "trace_crc")
 
 
 def metrics_from_records(records: list[dict]) -> dict[str, float]:
@@ -97,6 +110,10 @@ def metrics_from_records(records: list[dict]) -> dict[str, float]:
                 v = _num(rec.get(k))
                 if v is not None:
                     out[f"serve.{mode}.{k}"] = v
+            for k, v in (rec.get("statuses") or {}).items():
+                v = _num(v)
+                if v is not None:
+                    out[f"serve.{mode}.status.{k}"] = v
         elif ev == "train":
             v = _num(rec.get("loss"))
             if v is not None:
@@ -226,8 +243,8 @@ def compare(base: dict[str, float], cand: dict[str, float],
             # prevent.
             raise ValueError(
                 f"gate metric {name!r}: direction neither specified nor "
-                'inferable from the name — add "direction": "higher" or '
-                '"lower" to its thresholds entry'
+                'inferable from the name — add "direction": "higher", '
+                '"lower", or "equal" to its thresholds entry'
             )
         delta_pct = (b - a) / abs(a) * 100.0 if a else \
             (0.0 if b == a else float("inf") * (1 if b > a else -1))
@@ -236,8 +253,16 @@ def compare(base: dict[str, float], cand: dict[str, float],
         if not is_gated or direction is None:
             row["verdict"] = "info"
         else:
-            worse = delta_pct < -tol if direction == "higher" \
-                else delta_pct > tol
+            # "equal" is the determinism direction (ISSUE 7): ANY drift
+            # past tolerance regresses, both ways — two identical-seed
+            # fleet runs must match their structural counts exactly, and
+            # a one-sided gate would wave through half of all drifts
+            # (a trace-crc change moves in a random direction).
+            if direction == "equal":
+                worse = abs(delta_pct) > tol
+            else:
+                worse = delta_pct < -tol if direction == "higher" \
+                    else delta_pct > tol
             row["verdict"] = "REGRESS" if worse else "ok"
             if worse:
                 regressed.append(name)

@@ -202,6 +202,33 @@ def summarize(records: Iterable[dict], *,
             },
         }
 
+    replicas = ev.get("replica", [])
+    fleets = ev.get("fleet", [])
+    if replicas or fleets:
+        # Replica lifecycle (ISSUE 7): joins/crashes/restarts/circuit
+        # opens per replica, plus the last router-tick state. The fleet
+        # run's aggregate counters land in the `serve` table below
+        # (mode "fleet") like any other serving summary.
+        by_replica: dict[str, dict[str, int]] = {}
+        for r in replicas:
+            per = by_replica.setdefault(r.get("name", "?"), {})
+            kind = r.get("kind", "?")
+            per[kind] = per.get(kind, 0) + 1
+        kinds: dict[str, int] = {}
+        for per in by_replica.values():
+            for k, v in per.items():
+                kinds[k] = kinds.get(k, 0) + v
+        last = fleets[-1] if fleets else {}
+        summary["fleet"] = {
+            "replica_events": len(replicas),
+            "by_kind": dict(sorted(kinds.items())),
+            "by_replica": {name: dict(sorted(per.items()))
+                           for name, per in sorted(by_replica.items())},
+            "ticks_logged": len(fleets),
+            "replicas_last": last.get("replicas"),
+            "pending_last": last.get("pending"),
+        }
+
     serves = ev.get("serve", [])
     if serves:
         summary["serve"] = [
@@ -397,6 +424,24 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                                     rb["ckpt_events"].values()) + " |",
                 "",
             ]
+    if "fleet" in summary:
+        fl = summary["fleet"]
+        bk = fl["by_kind"]
+        lines += [
+            "| fleet | joins | crashes | restarts | circuit opens "
+            "| leaves | last replicas | last pending |",
+            "|---|---|---|---|---|---|---|---|",
+            f"| | {bk.get('join', 0)} | {bk.get('crash', 0)} "
+            f"| {bk.get('restart', 0)} | {bk.get('circuit_open', 0)} "
+            f"| {bk.get('leave', 0)} | {_fmt(fl['replicas_last'])} "
+            f"| {_fmt(fl['pending_last'])} |",
+            "",
+        ]
+        if fl["by_replica"]:
+            lines += ["| replica | lifecycle |", "|---|---|"]
+            for name, per in fl["by_replica"].items():
+                lines.append(f"| {name} | {_fmt(per)} |")
+            lines.append("")
     if "serve" in summary:
         lines += [
             "| serve run | requests | tokens/s | decode ticks "

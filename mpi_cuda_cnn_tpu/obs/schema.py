@@ -78,6 +78,16 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # over obs.metrics.log_bucket_bounds edges). `mctpu top` tails
     # these; `mctpu compare` gates their named values.
     "metrics": ("counters", "gauges", "histograms"),
+    # One fleet-router iteration (serve/fleet.py, ISSUE 7): healthy
+    # replica count, undispatched backlog, this tick's routing moments
+    # (dispatched/redispatched rids) and the per-replica load map
+    # {name: [queue, running, free_pages]} the dispatch policy reads.
+    "fleet": ("tick", "now", "replicas"),
+    # One replica lifecycle moment (serve/fleet.py, ISSUE 7): kind is
+    # join / crash / dead / restart_scheduled / restart / circuit_open
+    # / leave / drain_complete; free-form beyond (name, kind) — the
+    # fleet report table aggregates by kind per replica.
+    "replica": ("name", "kind"),
     # One serving-engine scheduler iteration (serve/engine.py, ISSUE 6):
     # the per-tick state `mctpu trace` reconstructs request lifecycles
     # from — queue depth, free pages, and the tick's scheduling moments

@@ -65,9 +65,20 @@ class Lifecycle:
     def tokens_accounted(self) -> int:
         """Tokens the tick trail accounts for: one at each completed
         prefill (the engine emits the first token at prefill
-        completion, per readmission) + one per decode tick."""
-        first_tokens = sum(1 for e in self.events if e[2] == "first_token")
-        return first_tokens + self.decode_ticks
+        completion, per readmission) + one per decode tick. A fleet
+        re-dispatch under the "discard" policy throws the dead
+        replica's partial output away — the trail records the fact (a
+        `redispatched` event with detail "discard", ordered BEFORE the
+        new replica's first emission), so the account resets with it.
+        Under "resume" the committed tokens carry over and the count
+        just keeps accumulating across replicas."""
+        n = 0
+        for e in self.events:
+            if e[2] in ("first_token", "decode"):
+                n += 1
+            elif e[2] == "redispatched" and e[3] == "discard":
+                n = 0
+        return n
 
     @property
     def consistent(self) -> bool:
@@ -105,8 +116,24 @@ def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
         ev = rec.get("event")
         if ev == "request":
             life(rec.get("mode", "?"), rec["id"]).record = rec
+        elif ev == "fleet":
+            # Router tick (ISSUE 7): a re-dispatch moves the request to
+            # another replica. The marker lands between the old
+            # replica's last record and the new one's first (the fleet
+            # emits it before stepping replicas), so the lifecycle
+            # stays ordered across the failover.
+            tick, now = rec.get("tick"), rec.get("now")
+            for rid in rec.get("redispatched") or []:
+                lc = life("fleet", rid)
+                lc.events.append((tick, now, "redispatched",
+                                  rec.get("redispatch", "resume")))
         elif ev == "tick":
             mode = rec.get("mode", "?")
+            if mode.startswith("fleet/"):
+                # Per-replica trail of one fleet: all replicas fold
+                # into the ONE logical mode "fleet" — a request's
+                # lifecycle spans every replica that ever held it.
+                mode = "fleet"
             tick, now = rec.get("tick"), rec.get("now")
             for slot, rid in rec.get("admitted") or []:
                 lc = life(mode, rid)
@@ -164,7 +191,10 @@ def _compute_breakdown(lc: Lifecycle) -> None:
         elif kind == "first_token":
             acc[state_key[state]] += now - since
             state, since = "decode", now
-        elif kind == "preempted":
+        elif kind in ("preempted", "redispatched"):
+            # Crash failover is accounted like a preemption wait: the
+            # request holds no slot between losing a replica and
+            # readmission elsewhere.
             acc[state_key[state]] += now - since
             state, since = "preempted", now
         elif kind in ("finished", "aborted"):
@@ -183,40 +213,52 @@ def render_gantt(records: list[dict], mode: str, *, width: int = 96,
     P = prefill chunk, D = decode, both = '#', idle = '.'. With `rid`,
     only that request's activity is drawn (its queue time shows as
     'q', preempted-waiting as 'x', on the row of the slot it next
-    occupies)."""
+    occupies). Mode "fleet" draws every replica's trail (tick modes
+    "fleet/<name>") as replica-qualified rows ("r0:2" = replica r0,
+    slot 2) — a re-dispatched request's activity visibly jumps rows at
+    the failover."""
     ticks = [r for r in records if r.get("event") == "tick"
-             and r.get("mode", "?") == mode]
+             and (r.get("mode", "?") == mode
+                  or r.get("mode", "?").startswith(mode + "/"))]
     if not ticks:
         return "(no tick records)"
     n_ticks = max(t["tick"] for t in ticks) + 1
-    slots = 0
+
+    def rkey(t: dict, slot: int) -> tuple[str, int]:
+        # ("", slot) for the exact mode; ("r0", slot) for "fleet/r0".
+        return (t.get("mode", "?")[len(mode) + 1:], slot)
+
+    keys: set[tuple[str, int]] = set()
     for t in ticks:
         for s, _ in (t.get("admitted") or []):
-            slots = max(slots, s + 1)
+            keys.add(rkey(t, s))
         for s, _ in (t.get("decoded") or []):
-            slots = max(slots, s + 1)
+            keys.add(rkey(t, s))
         if t.get("prefill"):
-            slots = max(slots, t["prefill"][0] + 1)
-    slots = max(slots, 1)
+            keys.add(rkey(t, t["prefill"][0]))
+    if not keys:
+        keys = {("", 0)}
+    rows = sorted(keys)
+    row_of = {k: i for i, k in enumerate(rows)}
     per_col = max(1, -(-n_ticks // width))  # ceil: ticks per column
     cols = -(-n_ticks // per_col)
-    # grid[slot][col] accumulates flags: 1 = prefill, 2 = decode.
-    grid = [[0] * cols for _ in range(slots)]
+    # grid[row][col] accumulates flags: 1 = prefill, 2 = decode.
+    grid = [[0] * cols for _ in rows]
     for t in ticks:
         col = t["tick"] // per_col
         pf = t.get("prefill")
         if pf and (rid is None or pf[1] == rid):
-            grid[pf[0]][col] |= 1
+            grid[row_of[rkey(t, pf[0])]][col] |= 1
         for s, r in (t.get("decoded") or []):
             if rid is None or r == rid:
-                grid[s][col] |= 2
+                grid[row_of[rkey(t, s)]][col] |= 2
     if rid is not None:
         # Waiting intervals for the focused request, drawn on the row of
         # the slot it lands on NEXT: arrival -> first admission is queue
         # time (flag 4, 'q'), preemption -> readmission is preempted-
         # waiting (flag 8, 'x'). Activity flags win inside a bucketed
         # column; 'x' outranks 'q' (a requeue is the rarer signal).
-        admits = [(t["tick"], s) for t in ticks
+        admits = [(t["tick"], row_of[rkey(t, s)]) for t in ticks
                   for s, r in (t.get("admitted") or []) if r == rid]
         req = next((r for r in records if r.get("event") == "request"
                     and r.get("id") == rid
@@ -232,10 +274,10 @@ def render_gantt(records: list[dict], mode: str, *, width: int = 96,
             readmit = next((a for a, _ in admits if a > pt), n_ticks)
             waits.append((pt, readmit, 8))
         for start, end, flag in waits:
-            slot = next((s for a, s in admits if a >= end),
-                        admits[-1][1] if admits else 0)
+            row = next((r for a, r in admits if a >= end),
+                       admits[-1][1] if admits else 0)
             for tick in range(start, end):
-                grid[slot][tick // per_col] |= flag
+                grid[row][tick // per_col] |= flag
     chars = {0: ".", 4: "q", 8: "x", 12: "x"}
 
     def cell(c: int) -> str:
@@ -245,8 +287,9 @@ def render_gantt(records: list[dict], mode: str, *, width: int = 96,
              + (f" ({per_col} ticks/column)" if per_col > 1 else "")
              + f" — mode {mode}"
              + (f", request {rid}" if rid is not None else "")]
-    for s in range(slots):
-        lines.append(f"slot {s:>2} |" + "".join(cell(c) for c in grid[s]))
+    for (sub, s), row in zip(rows, grid):
+        label = f"{sub}:{s}" if sub else f"slot {s:>2}"
+        lines.append(f"{label:>7} |" + "".join(cell(c) for c in row))
     return "\n".join(lines)
 
 

@@ -69,6 +69,13 @@ class TopState:
         self.epoch_s = None
         self.serve: dict[str, dict] = {}
         self.faults: dict[str, int] = {}
+        self.fleet: dict | None = None       # newest fleet-router tick
+        self.pending_hist: deque = deque(maxlen=history)
+        self.replica_kinds: dict[str, int] = {}
+        # Per-replica free-pages high-water (an empty replica's free
+        # count = its pool size): the fixed scale its pressure bar
+        # renders against.
+        self.free_hi: dict[str, float] = {}
         self._history = history
 
     def reset(self) -> None:
@@ -96,6 +103,17 @@ class TopState:
         elif ev == "fault":
             kind = rec.get("kind", "?")
             self.faults[kind] = self.faults.get(kind, 0) + 1
+        elif ev == "fleet":
+            self.fleet = rec
+            self.pending_hist.append(rec.get("pending", 0))
+            for name, triple in (rec.get("load") or {}).items():
+                free = (triple + [None, None, None])[2]
+                if free is not None:
+                    self.free_hi[name] = max(self.free_hi.get(name, 0.0),
+                                             free)
+        elif ev == "replica":
+            kind = rec.get("kind", "?")
+            self.replica_kinds[kind] = self.replica_kinds.get(kind, 0) + 1
 
 
 def _fmt(v) -> str:
@@ -117,6 +135,8 @@ def render(state: TopState, path: str, width: int = 96) -> str:
              f"t={state.t:.2f}s"]
     for mode in sorted(set(state.tick) | set(m for m in state.metrics
                                              if m != "train")):
+        if mode == "fleet" or mode.startswith("fleet/"):
+            continue  # fleet + per-replica ticks render in FLEET below
         tk = state.tick.get(mode, {})
         snap = state.metrics.get(mode, {})
         counters = snap.get("counters", {})
@@ -134,6 +154,14 @@ def render(state: TopState, path: str, width: int = 96) -> str:
             f"prefilling {_fmt(tk.get('prefilling'))}  "
             f"free pages {_fmt(free)} {bar(free, free_hi)}  "
             f"backlog {_fmt(tk.get('backlog'))} tok"
+        )
+        # Always-on health counts (ISSUE 7 satellite): the engine has
+        # counted these since ISSUE 4/6 but the panel never showed them
+        # — a zero is information (nothing preempted, no slow ticks).
+        lines.append(
+            f"  preemptions {_fmt(counters.get('serve.preemptions', 0))}  "
+            "watchdog-slow "
+            f"{_fmt(counters.get('serve.watchdog_slow_ticks', 0))}"
         )
         if counters:
             lines.append(
@@ -156,6 +184,56 @@ def render(state: TopState, path: str, width: int = 96) -> str:
                 f"  final: {_fmt(sv.get('tokens_per_s'))} tok/s  "
                 f"ticks {_fmt(sv.get('decode_ticks'))}  "
                 f"preempt {_fmt(sv.get('preemptions'))}  "
+                f"wd-slow {_fmt(sv.get('watchdog_slow_ticks'))}  "
+                f"statuses {json.dumps(sv.get('statuses'))}"
+            )
+    if state.fleet is not None or state.replica_kinds:
+        fl = state.fleet or {}
+        lines.append("")
+        lines.append(
+            f"FLEET  tick {_fmt(fl.get('tick'))}  "
+            f"replicas {_fmt(fl.get('replicas'))}  "
+            f"pending {_fmt(fl.get('pending')):>5} "
+            f"{sparkline(state.pending_hist)}"
+        )
+        # Per-replica load rows: what least-loaded dispatch reads —
+        # queue depth, occupied slots, free pages — plus each replica's
+        # recent queue sparkline from its own tick trail.
+        load = fl.get("load") or {}
+        for name in sorted(load):
+            q, running, free = (load[name] + [None, None, None])[:3]
+            hist = state.queue_hist.get(f"fleet/{name}", [])
+            lines.append(
+                f"  {name:<4} queue {_fmt(q):>4} {sparkline(hist, 16):<16} "
+                f"running {_fmt(running)}  free pages {_fmt(free)} "
+                f"{bar(free, state.free_hi.get(name), width=10)}"
+            )
+        if state.replica_kinds:
+            lines.append("  lifecycle: " + "  ".join(
+                f"{k}:{v}" for k, v in sorted(state.replica_kinds.items())))
+        snap = state.metrics.get("fleet", {})
+        if snap.get("counters"):
+            lines.append(
+                "  totals: "
+                + "  ".join(
+                    f"{k.removeprefix('fleet.')} {_fmt(v)}"
+                    for k, v in snap["counters"].items()
+                    if k.startswith("fleet.")
+                )
+            )
+        if snap.get("histograms"):
+            lines.append(
+                f"  ms p50/p95/p99 — ttft {_pcts(snap, 'serve.ttft_ms')}"
+                f"  tpot {_pcts(snap, 'serve.tpot_ms')}"
+                f"  queue-wait {_pcts(snap, 'serve.queue_wait_ms')}"
+            )
+        sv = state.serve.get("fleet")
+        if sv:
+            lines.append(
+                f"  final: {_fmt(sv.get('tokens_per_s'))} tok/s  "
+                f"dispatches {_fmt(sv.get('dispatches'))}  "
+                f"redispatches {_fmt(sv.get('redispatches'))}  "
+                f"fenced {_fmt(sv.get('fenced_discards'))}  "
                 f"statuses {json.dumps(sv.get('statuses'))}"
             )
     snap = state.metrics.get("train")

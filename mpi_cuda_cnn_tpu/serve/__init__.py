@@ -3,11 +3,21 @@
 The decode-side counterpart of the scanned-epoch training design — see
 paged_cache.py (the memory layout), scheduler.py (the admission /
 preemption policy), engine.py (the jitted ticks), bench.py (the
-`mctpu serve-bench` harness).
+`mctpu serve-bench` / `mctpu fleet-bench` harnesses), router.py (the
+fleet's dispatch/health/fencing policy), fleet.py (N replicas behind
+the router, failure-aware re-dispatch — ISSUE 7).
 """
 
 from .engine import PagedEngine, ServeResult
+from .fleet import (
+    EngineCompute,
+    Fleet,
+    FleetResult,
+    Replica,
+    SimCompute,
+)
 from .paged_cache import PagedKVCache, PagePool, init_paged_cache
+from .router import Router
 from .scheduler import (
     ContinuousScheduler,
     Request,
@@ -17,11 +27,17 @@ from .scheduler import (
 
 __all__ = [
     "ContinuousScheduler",
+    "EngineCompute",
+    "Fleet",
+    "FleetResult",
     "PagedEngine",
     "PagedKVCache",
     "PagePool",
+    "Replica",
     "Request",
+    "Router",
     "ServeResult",
+    "SimCompute",
     "StaticScheduler",
     "init_paged_cache",
     "pages_for",

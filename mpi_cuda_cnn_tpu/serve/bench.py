@@ -1,4 +1,4 @@
-"""`mctpu serve-bench` — static vs continuous batching on one chip.
+"""`mctpu serve-bench` / `mctpu fleet-bench` — serving benchmarks.
 
 Drives the PagedEngine with a Poisson-arrival workload of mixed
 prompt/output lengths (the serving regime the schedulers differ on:
@@ -15,6 +15,16 @@ costs do not depend on what the tokens say.
 
     python -m mpi_cuda_cnn_tpu serve-bench --requests 32 --rate 50
     python scripts/bench_serve.py --mode continuous --cache-dtype int8
+
+`fleet-bench` (ISSUE 7) drives serve/fleet.py instead: N replicas
+behind the router on one FakeClock, a seeded Poisson storm, optional
+injected replica crashes/joins/leaves — the determinism acceptance
+(two identical-seed runs bitwise-equal in dispatch trace and
+per-status counts) is what CI's fleet gate compares.
+
+    python -m mpi_cuda_cnn_tpu fleet-bench --replicas 4 --requests 1000
+    python scripts/bench_fleet.py --fault-plan \
+        'replica_crash@fleet.tick:40?replica=1'
 """
 
 from __future__ import annotations
@@ -22,8 +32,18 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 import numpy as np
+
+
+def _fault_plan_arg(surface: str):
+    """--fault-plan argparse type: grammar + hook-site/kind validation
+    at parse time (ISSUE 7 satellite) — `replica_crash@fleet.tick` on
+    plain serve-bench would silently never fire; it errors here."""
+    from ..faults import fault_plan_arg
+
+    return fault_plan_arg(surface)
 
 
 def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
@@ -101,9 +121,12 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                     help="tick watchdog: count + record engine "
                          "iterations slower than this (0 = off)")
     ap.add_argument("--fault-plan", default=None,
+                    type=_fault_plan_arg("serve-bench"),
                     help="deterministic fault injection, e.g. "
                          "'squeeze@serve.tick:5?pages=4&ticks=8;"
-                         "slow@serve.tick:9?s=0.2' (faults.parse_plan)")
+                         "slow@serve.tick:9?s=0.2' (faults.parse_plan; "
+                         "sites checked against serve-bench's hook "
+                         "points at parse time)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-jsonl", default=None,
                     help="append per-request obs records here")
@@ -215,6 +238,221 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                              "continuous": ct["decode_ticks"]},
             "ttft_p99_ms": {"static": st["ttft_p99_ms"],
                             "continuous": ct["ttft_p99_ms"]},
+        }))
+    return 0
+
+
+def fleet_bench_main(argv: list[str] | None = None) -> int:
+    """`mctpu fleet-bench` — the multi-replica storm harness (ISSUE 7).
+
+    Everything host-side runs on a FakeClock advanced `--tick-ms` per
+    fleet tick, so the schedule — dispatches, failovers, re-dispatches
+    — is a pure function of (workload seed, fault plan, fleet shape):
+    two identical invocations are bitwise-equal in dispatch trace and
+    per-status counts, which is exactly what CI's fleet determinism
+    gate compares (`mctpu compare ... --gate ci/fleet_gate.json`).
+    Latency/throughput figures are in fleet-clock units unless marked
+    wall_*.
+    """
+    ap = argparse.ArgumentParser(
+        prog="mctpu fleet-bench",
+        description="Failure-aware fleet bench: N single-engine "
+                    "replicas behind the router under a seeded Poisson "
+                    "storm, with optional injected replica crashes / "
+                    "joins / leaves (exactly-once re-dispatch).",
+    )
+    ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--policy", default="least_loaded",
+                    choices=["least_loaded", "session"])
+    ap.add_argument("--redispatch", default="resume",
+                    choices=["resume", "discard"],
+                    help="failover semantics for in-flight requests: "
+                         "resume re-prefills prompt + committed tokens "
+                         "on the new replica; discard restarts from "
+                         "the prompt")
+    ap.add_argument("--heartbeat-miss", type=int, default=3,
+                    help="consecutive missed heartbeat ticks before a "
+                         "replica is declared dead")
+    ap.add_argument("--max-flaps", type=int, default=3,
+                    help="crashes before a flapping replica's circuit "
+                         "opens (it never rejoins)")
+    ap.add_argument("--backoff-base", type=float, default=0.05,
+                    help="restart backoff base, fleet-clock seconds "
+                         "(utils/retry.backoff_delay; 0 = immediate)")
+    ap.add_argument("--tick-ms", type=float, default=1.0,
+                    help="fleet-clock advance per tick")
+    ap.add_argument("--check-every", type=int, default=16,
+                    help="page-pool invariant check cadence per replica "
+                         "(1 = every step; always checked at exit)")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--pages", type=int, default=0,
+                    help="pages per replica incl. scratch (0 = size for "
+                         "slots full-length sequences)")
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--max-queue", type=int, default=0,
+                    help="per-replica bound on waiting arrivals "
+                         "(0 = unbounded)")
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--prompt-min", type=int, default=8)
+    ap.add_argument("--prompt-max", type=int, default=96)
+    ap.add_argument("--out-min", type=int, default=8)
+    ap.add_argument("--out-max", type=int, default=96)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="Poisson arrival rate in fleet-clock req/s "
+                         "(0 = everything at t=0)")
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="session keys for the affinity policy: request "
+                         "i belongs to session i %% N (0 = sessionless)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request fleet-clock deadline (0 = none)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fault-plan", default=None,
+                    type=_fault_plan_arg("fleet-bench"),
+                    help="deterministic replica faults, e.g. "
+                         "'replica_crash@fleet.tick:40?replica=1&"
+                         "zombie_ticks=3;replica_join@fleet.tick:90' "
+                         "(sites checked against fleet-bench's hook "
+                         "points at parse time)")
+    ap.add_argument("--compute", default="sim", choices=["sim", "engine"],
+                    help="sim: device-free pure-token replicas (the "
+                         "10^5-storm scale mode); engine: one real "
+                         "PagedEngine per replica, shared weights")
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=0)
+    ap.add_argument("--cache-dtype", default="float32",
+                    choices=["float32", "bfloat16", "int8"])
+    ap.add_argument("--device", default="auto",
+                    choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append obs records here (fleet/replica/"
+                         "request/fault events + registry snapshots)")
+    ap.add_argument("--log", default="full", choices=["full", "summary"],
+                    help="full: per-tick fleet + per-replica tick + "
+                         "per-request records (what `mctpu trace`/`top` "
+                         "consume); summary: lifecycle + totals only "
+                         "(the 10^5-storm mode — per-tick JSONL would "
+                         "dominate the run)")
+    args = ap.parse_args(argv)
+
+    from ..faults import FakeClock, FaultInjector
+    from ..obs.metrics import MetricsRegistry
+    from ..utils.logging import MetricsLogger
+    from .fleet import EngineCompute, Fleet, SimCompute, make_fleet_workload
+    from .paged_cache import pages_for
+
+    max_len = args.prompt_max + args.out_max
+    pages = args.pages or args.slots * pages_for(max_len, args.page_size) + 1
+    if args.compute == "engine":
+        import jax
+
+        if args.device == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        elif args.device == "tpu" and jax.default_backend() != "tpu":
+            print("--device=tpu requested but the backend is "
+                  f"{jax.default_backend()}", file=sys.stderr)
+            return 1
+        from ..models.transformer import TransformerLM
+        from .engine import PagedEngine
+
+        model = TransformerLM(
+            vocab=args.vocab, dim=args.dim, heads=args.heads,
+            depth=args.depth, max_seq=max_len, kv_heads=args.kv_heads,
+        )
+        params = model.init(jax.random.key(args.seed))
+
+        def compute_factory(name):
+            # One engine (own page pools) per replica INCARNATION: a
+            # restarted replica comes back with an empty cache. The
+            # weights are shared — same params on every replica, which
+            # is what makes cross-replica re-dispatch output-exact.
+            return EngineCompute(PagedEngine(
+                model, params, slots=args.slots, num_pages=pages,
+                page_size=args.page_size, prefill_chunk=args.prefill_chunk,
+                cache_dtype=args.cache_dtype, max_len=max_len,
+            ))
+    else:
+        def compute_factory(name):
+            return SimCompute(vocab=args.vocab, chunk=args.prefill_chunk,
+                              salt=args.seed)
+
+    try:
+        reqs = make_fleet_workload(
+            n=args.requests, vocab=args.vocab, prompt_min=args.prompt_min,
+            prompt_max=args.prompt_max, out_min=args.out_min,
+            out_max=args.out_max, rate=args.rate, seed=args.seed,
+            sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    clock = FakeClock()
+    registry = MetricsRegistry(clock=clock)
+    faults = FaultInjector(args.fault_plan) if args.fault_plan else None
+    with MetricsLogger(path=args.metrics_jsonl, echo=False) as metrics:
+        fleet_sink = replica_tick_sink = None
+        if metrics.jsonl_enabled and args.log == "full":
+            def fleet_sink(rec):
+                metrics.log("fleet", **rec)
+
+            def replica_tick_sink(rec):
+                metrics.log("tick", **rec)
+        try:
+            fleet = Fleet(
+                compute_factory, replicas=args.replicas, slots=args.slots,
+                num_pages=pages, page_size=args.page_size, max_len=max_len,
+                max_queue=args.max_queue or None, policy=args.policy,
+                heartbeat_miss=args.heartbeat_miss,
+                backoff_base=args.backoff_base, max_flaps=args.max_flaps,
+                redispatch=args.redispatch, tick_s=args.tick_ms / 1e3,
+                check_every=args.check_every, faults=faults, clock=clock,
+                registry=registry, fleet_sink=fleet_sink,
+                replica_tick_sink=replica_tick_sink,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        t_wall = time.perf_counter()
+        try:
+            result = fleet.run(reqs)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        wall_s = time.perf_counter() - t_wall
+        s = result.summary()
+        s["wall_s"] = round(wall_s, 3)
+        s["wall_tokens_per_s"] = round(
+            result.output_tokens / max(wall_s, 1e-9), 1)
+        registry.set("serve.tokens_per_s", s["tokens_per_s"])
+        registry.emit(metrics, mode="fleet", final=True)
+        for rec in result.replica_log:
+            metrics.log("replica", **rec)
+        for ev in result.events:
+            metrics.log("fault", **{"mode": "fleet", **ev})
+        if metrics.jsonl_enabled and args.log == "full":
+            for rec in result.request_records():
+                metrics.log("request", **rec)
+        metrics.log("serve", **{
+            "bench": "fleet", "policy": args.policy,
+            "redispatch": args.redispatch,
+            "replicas_initial": args.replicas, "rate": args.rate,
+            "slots": args.slots, "page_size": args.page_size,
+            "pages": pages, "compute": args.compute, **s,
+        })
+        print(json.dumps({"bench": "fleet", "compute": args.compute,
+                          "policy": args.policy, **s}))
+        print(json.dumps({
+            "metric": "fleet_tokens_per_s", "value": s["tokens_per_s"],
+            "unit": "tokens/s (fleet-clock)",
+            "wall_s": s["wall_s"],
+            "wall_tokens_per_s": s["wall_tokens_per_s"],
+            "requests": len(result.requests),
+            "replicas": result.replicas_final,
+            "redispatches": result.redispatches,
+            "trace_crc": result.trace_crc,
         }))
     return 0
 

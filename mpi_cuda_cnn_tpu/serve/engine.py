@@ -57,6 +57,32 @@ from .paged_cache import (
 from .scheduler import ContinuousScheduler, Request, StaticScheduler
 
 
+def request_record(r: Request, mode: str) -> dict:
+    """One request as an obs `request` field dict — THE record shape
+    report/trace consume, shared by ServeResult and FleetResult so the
+    two surfaces cannot drift. Aborted requests carry null latencies
+    where the moment never happened (no first token -> ttft_ms null);
+    queue_wait_ms anchors on FIRST admission, null if never admitted."""
+    return {
+        "id": r.rid,
+        "mode": mode,
+        "status": r.status,
+        "prompt_tokens": int(r.prompt.size),
+        "output_tokens": len(r.out),
+        "ttft_ms": (None if r.first_token_at is None
+                    else round(1e3 * (r.first_token_at - r.arrival), 3)),
+        "latency_ms": (None if r.finished_at is None
+                       else round(1e3 * (r.finished_at - r.arrival), 3)),
+        # Lifecycle anchors (ISSUE 6): absolute arrival on the run's
+        # clock (pairs with tick records' "now").
+        "arrival_s": round(r.arrival, 4),
+        "queue_wait_ms": (None if r.admitted_at is None
+                          else round(1e3 * (r.admitted_at - r.arrival), 3)),
+        "preemptions": r.preemptions,
+        **({"reason": r.fail_reason} if r.fail_reason else {}),
+    }
+
+
 @dataclasses.dataclass
 class ServeResult:
     """One engine run: every submitted request in a terminal status
@@ -110,30 +136,8 @@ class ServeResult:
         (the caller stamps them through MetricsLogger/make_record).
         Aborted requests carry null latencies where the moment never
         happened (no first token -> ttft_ms null)."""
-        return [
-            {
-                "id": r.rid,
-                "mode": self.mode,
-                "status": r.status,
-                "prompt_tokens": int(r.prompt.size),
-                "output_tokens": len(r.out),
-                "ttft_ms": (None if r.first_token_at is None
-                            else round(1e3 * (r.first_token_at - r.arrival), 3)),
-                "latency_ms": (None if r.finished_at is None
-                               else round(1e3 * (r.finished_at - r.arrival), 3)),
-                # Lifecycle anchors (ISSUE 6): absolute arrival on the
-                # run's clock (pairs with tick records' "now") and the
-                # time spent queued before FIRST admission — null for
-                # requests that never got a slot.
-                "arrival_s": round(r.arrival, 4),
-                "queue_wait_ms": (None if r.admitted_at is None
-                                  else round(1e3 * (r.admitted_at - r.arrival),
-                                             3)),
-                "preemptions": r.preemptions,
-                **({"reason": r.fail_reason} if r.fail_reason else {}),
-            }
-            for r in sorted(self.requests, key=lambda r: r.rid)
-        ]
+        return [request_record(r, self.mode)
+                for r in sorted(self.requests, key=lambda r: r.rid)]
 
     def summary(self) -> dict:
         # Nearest-rank percentiles (obs.report.pct_nearest) — the ONE
@@ -250,6 +254,49 @@ class PagedEngine:
         if req.first_token_at is None:
             req.first_token_at = now
 
+    def run_prefill_chunk(self, slot):
+        """Advance `slot`'s prefill by one chunk on the device. Returns
+        (rows written, next-token argmax of the chunk's last valid row
+        — the request's first generated token iff this chunk completes
+        the prefill). The token stays a device array so intermediate
+        chunks pipeline under async dispatch: the caller converts it
+        (int()) only on the COMPLETING chunk, where it is emitted.
+        Scheduler bookkeeping (slot.cached, emission) is the caller's:
+        run() and the fleet's EngineCompute (ISSUE 7) share this one
+        device path."""
+        ctx = np.concatenate(
+            [slot.req.prompt, np.asarray(slot.req.out, np.int32)]
+        )
+        n = min(self.prefill_chunk, slot.target - slot.cached)
+        toks = np.zeros((1, self.prefill_chunk), np.int32)
+        toks[0, :n] = ctx[slot.cached : slot.cached + n]
+        cache, nxt = self._prefill(
+            self._cache_view(self._slot_table(slot)), self.params,
+            jnp.asarray(toks), jnp.int32(slot.cached), jnp.int32(n),
+        )
+        self._pages = cache.pages
+        return n, nxt
+
+    def run_decode_tick(self, dslots) -> np.ndarray:
+        """One batched decode tick over `dslots` (every other engine
+        row rides along dead). Returns the per-row sampled tokens
+        (index by slot.idx); cached/emit bookkeeping is the caller's."""
+        toks = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        live = np.zeros((self.slots,), bool)
+        table = np.zeros((self.slots, self._table_width), np.int32)
+        for s in dslots:
+            toks[s.idx] = s.req.out[-1]
+            pos[s.idx] = s.cached
+            live[s.idx] = True
+            table[s.idx, : len(s.pages)] = s.pages
+        cache, nxt = self._tick(
+            self._cache_view(table), self.params, jnp.asarray(toks),
+            jnp.asarray(pos), jnp.asarray(live),
+        )
+        self._pages = cache.pages
+        return np.asarray(nxt)
+
     def run(self, requests: list[Request], *, mode: str = "continuous",
             time_fn=time.perf_counter, faults=None, max_queue: int | None = None,
             watchdog_s: float = 0.0, sleep_fn=time.sleep,
@@ -345,17 +392,7 @@ class PagedEngine:
             # advance without starving in-flight decodes.
             slot = sched.prefill_slot()
             if slot is not None:
-                ctx = np.concatenate(
-                    [slot.req.prompt, np.asarray(slot.req.out, np.int32)]
-                )
-                n = min(self.prefill_chunk, slot.target - slot.cached)
-                toks = np.zeros((1, self.prefill_chunk), np.int32)
-                toks[0, :n] = ctx[slot.cached : slot.cached + n]
-                cache, nxt = self._prefill(
-                    self._cache_view(self._slot_table(slot)), self.params,
-                    jnp.asarray(toks), jnp.int32(slot.cached), jnp.int32(n),
-                )
-                self._pages = cache.pages
+                n, nxt = self.run_prefill_chunk(slot)
                 slot.cached += n
                 prefill_chunks += 1
                 prefill_rec = [slot.idx, slot.req.rid, n]
@@ -383,22 +420,8 @@ class PagedEngine:
                     events.append({"kind": "request_failed", "id": r.rid,
                                    "mode": mode, "reason": r.fail_reason})
             if dslots:
-                toks = np.zeros((self.slots,), np.int32)
-                pos = np.zeros((self.slots,), np.int32)
-                live = np.zeros((self.slots,), bool)
-                table = np.zeros((self.slots, self._table_width), np.int32)
-                for s in dslots:
-                    toks[s.idx] = s.req.out[-1]
-                    pos[s.idx] = s.cached
-                    live[s.idx] = True
-                    table[s.idx, : len(s.pages)] = s.pages
-                cache, nxt = self._tick(
-                    self._cache_view(table), self.params, jnp.asarray(toks),
-                    jnp.asarray(pos), jnp.asarray(live),
-                )
-                self._pages = cache.pages
+                nxt = self.run_decode_tick(dslots)
                 decode_ticks += 1
-                nxt = np.asarray(nxt)
                 now = time_fn() - t0
                 for s in dslots:
                     s.cached += 1
@@ -438,6 +461,8 @@ class PagedEngine:
                     sleep_fn(min(nxt_arrival - now, 0.05))
             if watchdog_s > 0 and busy_s > watchdog_s:
                 watchdog_slow += 1
+                if registry is not None:
+                    registry.inc("serve.watchdog_slow_ticks")
                 events.append({
                     "kind": "watchdog_slow_tick", "tick": tick_idx,
                     "mode": mode, "seconds": round(busy_s, 4),

@@ -1,0 +1,897 @@
+"""Failure-aware multi-replica serving fleet (ISSUE 7, ROADMAP item 4).
+
+One PagedEngine is one chip. This module puts N single-engine replicas
+behind serve/router.py's deterministic policy layer and makes replica
+DEATH a scheduled, tested event rather than an outage:
+
+- Each `Replica` wraps its own scheduler + PagePool (the PR-3 policy
+  machinery, unchanged) and a pluggable `compute`: `EngineCompute`
+  drives a real PagedEngine's jitted prefill/decode programs (each
+  replica its own page pools — the one-chip-per-replica model), while
+  `SimCompute` replaces the device math with a pure token function of
+  (request, position) so a 10^5-request storm runs on CPU in seconds
+  with the SCHEDULING — dispatch, paging, preemption, re-dispatch —
+  exercised for real. Both computes produce per-request outputs that
+  are a pure function of (prompt, params|salt), which is what makes
+  the crash-vs-crash-free output-equality proof meaningful.
+
+- `ReplicaCore.step` is the PagedEngine.run loop body restructured as
+  one scheduler iteration (sweep -> admit -> one prefill chunk -> one
+  decode tick) so the fleet can interleave N replicas on one clock.
+  The deadline sweep is skipped on ticks where no submitted request
+  carries a deadline and no cancel is pending — the O(queue) scan is
+  what would otherwise dominate a storm.
+
+- The `Fleet` loop advances a FakeClock by `tick_s` per tick; every
+  decision (router policy, failure detection, backoff, fencing) is
+  host-side and deterministic, so two identical-seed runs produce
+  bitwise-equal dispatch traces and per-status totals — the property
+  CI gates by running the seeded storm twice and `mctpu compare`-ing
+  the structural counts at exact equality.
+
+Failure semantics (the exactly-once contract):
+
+- A `replica_crash@fleet.tick:T?replica=K` fault stops replica K. The
+  router notices via heartbeat staleness (`heartbeat_miss` ticks), then
+  FAILS OVER: the dead replica's non-terminal requests have their
+  generation fence revoked, are harvested with their COMMITTED tokens,
+  and are re-dispatched exactly once each to surviving replicas —
+  `redispatch="resume"` re-prefills prompt + committed output (the
+  recompute-preemption path, now across replicas), `"discard"` drops
+  the partial output and restarts from the prompt.
+- Every token and terminal claim a replica makes passes the router's
+  generation-token fence. A crashed-but-partitioned replica
+  (``zombie_ticks=N``) keeps stepping after failover; every commit it
+  attempts is refused — zero double-generated tokens, pinned by test.
+- The crashed replica restarts after utils/retry.backoff_delay and
+  rejoins with empty pools; a replica that keeps flapping is
+  circuit-opened (permanently removed). `replica_join` scales the
+  fleet out elastically; `replica_leave` drains one gracefully.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import zlib
+from collections import deque
+
+from ..faults import FakeClock
+from ..obs.metrics import MetricsRegistry
+from .paged_cache import PagePool
+from .router import CircuitOpen, Router
+from .scheduler import ContinuousScheduler, Request, validate_request
+
+__all__ = [
+    "EngineCompute", "Fleet", "FleetResult", "Replica", "ReplicaCore",
+    "SimCompute",
+]
+
+
+class SimCompute:
+    """Device-free compute: the next token is a pure 32-bit mix of
+    (rid, output position, salt) mod vocab. Identical on every replica,
+    so a re-dispatched request regenerates exactly the tokens the dead
+    replica would have — the sim twin of greedy decode under shared
+    weights — while costing nothing, which is what lets the 10^5 storm
+    run on this box."""
+
+    def __init__(self, vocab: int = 512, chunk: int = 32, salt: int = 0):
+        self.vocab = vocab
+        self.chunk = chunk
+        self.salt = salt
+
+    def _tok(self, req: Request) -> int:
+        j = len(req.out)
+        h = (req.rid * 1000003 + j * 2654435761 + self.salt * 97
+             + int(req.prompt.size) * 8191) & 0xFFFFFFFF
+        return h % self.vocab
+
+    def prefill_chunk(self, slot) -> tuple[int, int]:
+        n = min(self.chunk, slot.target - slot.cached)
+        return n, self._tok(slot.req)
+
+    def decode(self, dslots) -> dict[int, int]:
+        return {s.idx: self._tok(s.req) for s in dslots}
+
+
+class EngineCompute:
+    """Model-backed compute: one PagedEngine (its own page pools) per
+    replica; prefill/decode go through the engine's two jitted
+    programs via the same run_prefill_chunk/run_decode_tick path
+    engine.run uses — one implementation, two drivers."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def prefill_chunk(self, slot) -> tuple[int, int]:
+        return self.engine.run_prefill_chunk(slot)
+
+    def decode(self, dslots):
+        return self.engine.run_decode_tick(dslots)
+
+
+class ReplicaCore:
+    """One replica's steppable engine loop over the PR-3 scheduler.
+
+    `on_emit(req, tok, now)` is the fleet's fenced commit hook, called
+    AFTER the token lands in the replica-local request (the local copy
+    always advances — a zombie replica keeps generating; only the
+    fence decides whether the authoritative output accepts it)."""
+
+    def __init__(self, compute, *, slots: int, num_pages: int,
+                 page_size: int, max_len: int, max_queue: int | None = None,
+                 on_emit=None, check_every: int = 1):
+        self.sched = ContinuousScheduler(
+            slots=slots, pool=PagePool(num_pages), page_size=page_size,
+            max_len=max_len, max_queue=max_queue,
+        )
+        self.compute = compute
+        self.on_emit = on_emit
+        self.check_every = check_every
+        self.steps = 0
+        self.decode_ticks = 0
+        self.prefill_chunks = 0
+        self._cancel_pending = False
+        self._n_fin = 0
+        self._n_drop = 0
+
+    def submit(self, req: Request) -> None:
+        self.sched.submit([req])
+
+    def flag_cancel(self) -> None:
+        """A cancel() landed on one of this core's requests: force the
+        sweep on the next step even with no deadlines in play."""
+        self._cancel_pending = True
+
+    @property
+    def unfinished(self) -> int:
+        return self.sched.unfinished
+
+    def _emit(self, req: Request, tok: int, now: float) -> None:
+        req.out.append(tok)
+        if req.first_token_at is None:
+            req.first_token_at = now
+        if self.on_emit is not None:
+            self.on_emit(req, tok, now)
+
+    def step(self, now: float):
+        """One scheduler iteration (the engine.run body, minus the
+        idle/fault/watchdog handling the fleet owns). Returns
+        (tick-record fields, newly finished locals, newly dropped
+        locals) — the fleet syncs terminal statuses from the tails."""
+        sched = self.sched
+        self.steps += 1
+        progressed = False
+        if sched.has_deadlines or self._cancel_pending:
+            progressed = bool(sched.sweep(now))
+            self._cancel_pending = False
+        admitted = [[s.idx, s.req.rid] for s in sched.admit(now)]
+        if sched.max_queue is not None:
+            progressed |= bool(sched.enforce_queue_bound(now))
+        prefill_rec = None
+        slot = sched.prefill_slot()
+        if slot is not None:
+            n, nxt = self.compute.prefill_chunk(slot)
+            slot.cached += n
+            self.prefill_chunks += 1
+            prefill_rec = [slot.idx, slot.req.rid, n]
+            progressed = True
+            if slot.cached >= slot.target:
+                # Prefill complete: the first generated token is due
+                # now (TTFT at prefill completion — engine.run's rule).
+                self._emit(slot.req, int(nxt), now)
+                prefill_rec.append("emit")
+                if slot.req.done:
+                    sched.finish(slot, now)
+        dslots = sched.grow_for_decode(now)
+        decoded = [[s.idx, s.req.rid] for s in dslots]
+        if dslots:
+            toks = self.compute.decode(dslots)
+            self.decode_ticks += 1
+            progressed = True
+            for s in dslots:
+                s.cached += 1
+                self._emit(s.req, int(toks[s.idx]), now)
+                if s.req.done:
+                    sched.finish(s, now)
+        preempted = sched.drain_preempted()
+        new_fin = sched.finished[self._n_fin:]
+        new_drop = sched.dropped[self._n_drop:]
+        self._n_fin, self._n_drop = len(sched.finished), len(sched.dropped)
+        if self.check_every and self.steps % self.check_every == 0:
+            sched.pool.check()
+        rec = {
+            "queue": len(sched.queue),
+            "running": sum(1 for s in sched.slots if not s.free),
+            "free_pages": sched.pool.free_pages,
+            "admitted": admitted, "prefill": prefill_rec,
+            "decoded": decoded, "preempted": preempted,
+            "finished": [r.rid for r in new_fin],
+            "aborted": [[r.rid, r.status] for r in new_drop],
+            "progressed": progressed or bool(admitted or new_fin or new_drop),
+        }
+        return rec, new_fin, new_drop
+
+
+class Replica:
+    """One fleet member: a named ReplicaCore plus the PR-6 registry its
+    step loop keeps current — `load()` (what least-loaded dispatch
+    reads) is queue depth + running slots FROM THE GAUGES, plus the
+    dispatches routed here since the last step (so a burst arriving
+    within one tick spreads instead of dog-piling the stalest gauge)."""
+
+    def __init__(self, name: str, compute, *, slots: int, num_pages: int,
+                 page_size: int, max_len: int, max_queue: int | None = None,
+                 check_every: int = 1, on_emit=None, clock=None):
+        self.name = name
+        self.registry = MetricsRegistry(clock=clock)
+        self.core = ReplicaCore(
+            compute, slots=slots, num_pages=num_pages, page_size=page_size,
+            max_len=max_len, max_queue=max_queue, check_every=check_every,
+            on_emit=on_emit,
+        )
+        self.alive = True
+        self.zombie_until = -1   # fleet tick a partitioned zombie stops at
+        self.pending_dispatches = 0
+
+    def _gauge(self, name: str) -> float:
+        g = self.registry.gauges.get(name)
+        return g.value if g is not None and g.value is not None else 0.0
+
+    def load(self) -> float:
+        return (self._gauge("serve.queue_depth")
+                + self._gauge("serve.running_slots")
+                + self.pending_dispatches)
+
+    def step(self, now: float):
+        rec, new_fin, new_drop = self.core.step(now)
+        r = self.registry
+        r.set("serve.queue_depth", rec["queue"])
+        r.set("serve.running_slots", rec["running"])
+        r.set("serve.free_pages", rec["free_pages"])
+        if rec["decoded"]:
+            r.inc("serve.decode_ticks")
+        if rec["prefill"] is not None:
+            r.inc("serve.prefill_chunks")
+        if rec["preempted"]:
+            r.inc("serve.preemptions", len(rec["preempted"]))
+        self.pending_dispatches = 0
+        return rec, new_fin, new_drop
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """One fleet run: every submitted request terminal, plus the
+    structural counts the determinism gate compares at exact equality
+    and the dispatch trace that IS the schedule (crc32-hashable)."""
+
+    requests: list[Request]
+    ticks: int
+    duration_s: float
+    dispatches: int
+    redispatches: int
+    fenced_discards: int
+    crashes: int
+    joins: int
+    leaves: int
+    restarts: int
+    circuit_opens: int
+    decode_ticks: int
+    prefill_chunks: int
+    preemptions: int
+    replicas_final: int
+    # (tick, rid, replica name, epoch, "dispatch" | "redispatch") —
+    # every routing decision in order; bitwise-equal across
+    # identical-seed runs (the determinism acceptance).
+    dispatch_trace: list[tuple] = dataclasses.field(default_factory=list)
+    events: list[dict] = dataclasses.field(default_factory=list)
+    replica_log: list[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def output_tokens(self) -> int:
+        return sum(len(r.out) for r in self.requests)
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.output_tokens / max(self.duration_s, 1e-9)
+
+    @functools.cached_property
+    def trace_crc(self) -> int:
+        """crc32 of the dispatch trace — one number `mctpu compare`
+        can gate at exact equality to pin the whole schedule. Cached:
+        the CI storm's trace holds ~10^5 tuples and the bench reads
+        this twice (the trace is complete once the result exists)."""
+        return zlib.crc32(json.dumps(self.dispatch_trace).encode())
+
+    def status_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for r in self.requests:
+            counts[r.status] = counts.get(r.status, 0) + 1
+        return counts
+
+    def outputs(self) -> dict[int, list[int]]:
+        """rid -> committed tokens (the authoritative, fenced output)."""
+        return {r.rid: list(r.out) for r in self.requests}
+
+    def finished_requests(self) -> list[Request]:
+        return [r for r in self.requests if r.status == "finished"]
+
+    def request_records(self) -> list[dict]:
+        """Per-request obs `request` field dicts, mode="fleet" — built
+        by engine.request_record, the ONE record shape report/trace
+        consume for engine and fleet runs alike."""
+        from .engine import request_record
+
+        return [request_record(r, "fleet")
+                for r in sorted(self.requests, key=lambda r: r.rid)]
+
+    def summary(self) -> dict:
+        from ..obs.report import pct_nearest
+
+        fin = self.finished_requests()
+        ttft = [1e3 * (r.first_token_at - r.arrival) for r in fin]
+        tpot = [1e3 * (r.finished_at - r.first_token_at)
+                / max(len(r.out) - 1, 1) for r in fin]
+        return {
+            "mode": "fleet",
+            "requests": len(self.requests),
+            "statuses": self.status_counts(),
+            "output_tokens": self.output_tokens,
+            "decode_ticks": self.decode_ticks,
+            "prefill_chunks": self.prefill_chunks,
+            "preemptions": self.preemptions,
+            "duration_s": round(self.duration_s, 4),
+            "tokens_per_s": round(self.tokens_per_s, 2),
+            "ttft_p50_ms": pct_nearest(ttft, 50),
+            "ttft_p99_ms": pct_nearest(ttft, 99),
+            "tpot_p50_ms": pct_nearest(tpot, 50),
+            "tpot_p99_ms": pct_nearest(tpot, 99),
+            "replicas": self.replicas_final,
+            "fleet_ticks": self.ticks,
+            "dispatches": self.dispatches,
+            "redispatches": self.redispatches,
+            "fenced_discards": self.fenced_discards,
+            "crashes": self.crashes,
+            "joins": self.joins,
+            "leaves": self.leaves,
+            "restarts": self.restarts,
+            "circuit_opens": self.circuit_opens,
+            "trace_crc": self.trace_crc,
+        }
+
+
+class Fleet:
+    """The router + N replicas on one deterministic clock (module doc).
+
+    `compute_factory(name)` builds each replica's compute (fresh state
+    per incarnation — a restarted replica comes back with empty pools).
+    `faults` injects replica_crash / replica_join / replica_leave at
+    the "fleet.tick" site. Telemetry is opt-in: `registry` aggregates
+    fleet-level counters/latency histograms, `fleet_sink` receives one
+    router record per tick, `replica_tick_sink` the per-replica tick
+    records (mode "fleet/<name>") `mctpu trace` reconstructs from.
+    """
+
+    def __init__(self, compute_factory, *, replicas: int = 2,
+                 slots: int = 4, num_pages: int = 64, page_size: int = 16,
+                 max_len: int = 256, max_queue: int | None = None,
+                 policy: str = "least_loaded", heartbeat_miss: int = 3,
+                 backoff_base: float = 0.0, max_flaps: int = 3,
+                 redispatch: str = "resume", tick_s: float = 1e-3,
+                 check_every: int = 1, faults=None, clock: FakeClock | None = None,
+                 registry: MetricsRegistry | None = None, fleet_sink=None,
+                 replica_tick_sink=None, jitter=None):
+        if replicas < 1:
+            raise ValueError(f"need at least one replica, got {replicas}")
+        if redispatch not in ("resume", "discard"):
+            raise ValueError(
+                f"redispatch {redispatch!r}: want 'resume' or 'discard'")
+        self.compute_factory = compute_factory
+        self.geometry = dict(slots=slots, num_pages=num_pages,
+                             page_size=page_size, max_len=max_len,
+                             max_queue=max_queue, check_every=check_every)
+        self.redispatch = redispatch
+        self.tick_s = tick_s
+        self.faults = faults
+        self.clock = clock if clock is not None else FakeClock()
+        self.registry = registry
+        self.fleet_sink = fleet_sink
+        self.replica_tick_sink = replica_tick_sink
+        self.router = Router(policy, heartbeat_miss=heartbeat_miss,
+                             backoff_base=backoff_base, max_flaps=max_flaps,
+                             jitter=jitter)
+        self.events: list[dict] = []       # obs `fault` field dicts
+        self.replica_log: list[dict] = []  # obs `replica` field dicts
+        self.dispatch_trace: list[tuple] = []
+        self.dispatches = 0
+        self.redispatches = 0
+        self.fenced_discards = 0
+        self.crashes = self.joins = self.leaves = 0
+        self.restarts = self.circuit_opens = 0
+        self._retired = [0, 0, 0]  # decode_ticks, prefill_chunks, preempts
+        self._auth: dict[int, Request] = {}
+        # rid -> (holding replica, live local copy): where a cancel()
+        # must land (the authoritative object the caller holds is a
+        # different Request than the replica-local one in flight).
+        self._holder: dict[int, tuple[Replica, Request]] = {}
+        self._zombies: list[Replica] = []
+        self._pending_restarts: list[tuple[float, str]] = []
+        self._next_idx = 0
+        self._tick = 0
+        for _ in range(replicas):
+            self._join(tick=0, now=0.0, log=False)
+
+    # -- membership ----------------------------------------------------
+
+    def _new_replica(self, name: str) -> Replica:
+        rep = Replica(name, self.compute_factory(name),
+                      clock=self.clock, **self.geometry)
+        rep.core.on_emit = self._make_emit(rep)
+        return rep
+
+    def _join(self, *, tick: int, now: float, log: bool = True) -> Replica:
+        name = f"r{self._next_idx}"
+        self._next_idx += 1
+        rep = self._new_replica(name)
+        self.router.register(rep, tick=tick)
+        self.joins += log
+        if log:
+            self._log_replica(name, "join", tick, now)
+        return rep
+
+    def _log_replica(self, name: str, kind: str, tick: int, now: float,
+                     **extra) -> None:
+        self.replica_log.append({
+            "name": name, "kind": kind, "tick": tick,
+            "now": round(now, 4), **extra,
+        })
+        if self.registry is not None:
+            self.registry.inc(f"fleet.replica_{kind}")
+
+    # -- fenced commits ------------------------------------------------
+
+    def _make_emit(self, replica: Replica):
+        name = replica.name
+
+        def emit(local: Request, tok: int, now: float) -> None:
+            if self.router.fence_ok(local.rid, name, local._fleet_epoch):
+                auth = self._auth[local.rid]
+                auth.out.append(tok)
+                if auth.first_token_at is None:
+                    auth.first_token_at = now
+            else:
+                self.fenced_discards += 1
+
+        return emit
+
+    def _sync_terminal(self, replica: Replica, locals_, now: float) -> int:
+        """Apply a replica's newly terminal local requests to the
+        authoritative records — through the fence, so a zombie's
+        terminal claims are refused like its tokens."""
+        done = 0
+        if self.registry is not None:
+            # Lazy: the sim path stays jax-free (engine imports jax).
+            from .engine import _observe_request
+        for local in locals_:
+            if not self.router.fence_ok(local.rid, replica.name,
+                                        local._fleet_epoch):
+                self.fenced_discards += 1
+                continue
+            auth = self._auth[local.rid]
+            auth.status = local.status
+            auth.fail_reason = local.fail_reason
+            auth.finished_at = local.finished_at
+            auth.preemptions += local.preemptions
+            if auth.admitted_at is None:
+                auth.admitted_at = local.admitted_at
+            if self.registry is not None:
+                _observe_request(self.registry, auth)
+            # A terminal rid holds no replica: dropping the holder entry
+            # releases the (Replica, local) pair — with EngineCompute a
+            # dead incarnation's whole PagedEngine cache would otherwise
+            # stay pinned for the rest of the run via finished rids.
+            self._holder.pop(local.rid, None)
+            done += 1
+        return done
+
+    # -- dispatch ------------------------------------------------------
+
+    def _dispatch(self, req: Request, *, tick: int, redispatch: bool) -> bool:
+        member = self.router.pick(req)
+        if member is None:
+            return False
+        epoch = self.router.grant(req.rid, member.name)
+        if redispatch and self.redispatch == "discard":
+            req.out.clear()
+            req.first_token_at = None
+        local = Request(rid=req.rid, prompt=req.prompt,
+                        max_new_tokens=req.max_new_tokens,
+                        arrival=req.arrival, deadline=req.deadline,
+                        session=req.session)
+        local.out = list(req.out)
+        # A request that was ever admitted keeps that mark across
+        # failover (even under discard, which regenerates the tokens):
+        # enforce_queue_bound exempts admitted_at-bearing requests, and
+        # a re-dispatch must never be backpressure-rejected as a fresh
+        # arrival when the fleet already served tokens for it.
+        local.admitted_at = req.admitted_at
+        local._fleet_epoch = epoch
+        member.replica.core.submit(local)
+        member.replica.pending_dispatches += 1
+        self._holder[req.rid] = (member.replica, local)
+        if req.cancel_requested:
+            # A cancel that landed while the rid awaited (re-)dispatch
+            # carries over to the new incarnation.
+            local.cancel()
+            member.replica.core.flag_cancel()
+        kind = "redispatch" if redispatch else "dispatch"
+        self.dispatch_trace.append((tick, req.rid, member.name, epoch, kind))
+        self.dispatches += not redispatch
+        self.redispatches += redispatch
+        if self.registry is not None:
+            self.registry.inc(f"fleet.{kind}es")
+        return True
+
+    def cancel(self, rid: int) -> None:
+        """Client-side abort of `rid`, fleet-wide: marks the
+        authoritative request AND the replica-local copy currently in
+        flight (they are distinct objects), and forces that replica's
+        sweep on its next step. Callable mid-run from a sink callback
+        (the loop invokes sinks every tick); a terminal or unknown rid
+        is a no-op, a rid awaiting re-dispatch picks the cancel up at
+        dispatch time."""
+        auth = self._auth.get(rid)
+        if auth is None or auth.terminal:
+            return
+        auth.cancel()
+        held = self._holder.get(rid)
+        if held is not None:
+            replica, local = held
+            local.cancel()
+            replica.core.flag_cancel()
+
+    # -- failure handling ----------------------------------------------
+
+    def _harvest(self, replica: Replica) -> list[Request]:
+        """Authoritative requests stranded on a dead/removed replica
+        (fence revoked here — a zombie loses commit rights the moment
+        failover begins, before the re-dispatch is even placed)."""
+        sched = replica.core.sched
+        locals_ = [s.req for s in sched.slots if s.req is not None]
+        locals_ += list(sched.queue)
+        stranded = []
+        for local in locals_:
+            auth = self._auth[local.rid]
+            if auth.terminal:
+                continue
+            self.router.revoke(local.rid)
+            auth.preemptions += local.preemptions
+            if auth.admitted_at is None:
+                auth.admitted_at = local.admitted_at
+            stranded.append(auth)
+        return sorted(stranded, key=lambda r: r.rid)
+
+    def _fail_over(self, member, *, tick: int, now: float,
+                   redispatch_q: deque) -> None:
+        name = member.name
+        self.router.deregister(name)
+        self._retire_counts(member.replica)
+        stranded = self._harvest(member.replica)
+        redispatch_q.extend(stranded)
+        self._log_replica(name, "dead", tick, now,
+                          stranded=[r.rid for r in stranded],
+                          **({"draining": True} if member.draining else {}))
+        if member.draining:
+            # The operator already asked this replica to leave; its
+            # crash completes the departure (in-flight work was just
+            # harvested for re-dispatch). Restarting it would override
+            # the drain intent with a fresh dispatch-taking member.
+            return
+        try:
+            delay = self.router.record_crash(name)
+            self._pending_restarts.append(((self.clock() - self._t0) + delay,
+                                           name))
+            self._pending_restarts.sort()
+            self._log_replica(name, "restart_scheduled", tick, now,
+                              delay_s=round(delay, 4))
+        except CircuitOpen as e:
+            self.circuit_opens += 1
+            self._log_replica(name, "circuit_open", tick, now, reason=str(e))
+
+    def _retire_counts(self, replica: Replica) -> None:
+        core = replica.core
+        self._retired[0] += core.decode_ticks
+        self._retired[1] += core.prefill_chunks
+        self._retired[2] += core.sched.preemptions
+        # A later zombie step must not re-bank these.
+        core.decode_ticks = core.prefill_chunks = 0
+        core.sched.preemptions = 0
+
+    def _resolve_fault_target(self, f) -> str:
+        """The rN name a crash/leave fault targets. A name that no
+        replica has EVER carried is a config error and raises — the
+        plan-validation contract (ISSUE 7 satellite) is that a fault
+        must never silently not fire. A name that existed but is
+        currently dead/absent is a legitimate plan/timing race and is
+        the caller's no-op."""
+        name = f.arg("replica", "r0")
+        name = name if isinstance(name, str) else f"r{name}"
+        ever = {f"r{i}" for i in range(self._next_idx)}
+        if name not in ever:
+            raise ValueError(
+                f"fault {f.kind}@{f.site}: replica {name!r} has never "
+                f"joined this fleet (members ever: r0..r{self._next_idx - 1})"
+                " — the fault would silently never fire"
+            )
+        return name
+
+    def _apply_fault(self, f, *, tick: int, now: float,
+                     redispatch_q: deque) -> None:
+        if f.kind == "replica_crash":
+            name = self._resolve_fault_target(f)
+            member = self.router.members.get(name)
+            if member is None or not member.replica.alive:
+                return
+            member.replica.alive = False
+            self.crashes += 1
+            zombie = int(f.arg("zombie_ticks", 0))
+            if zombie > 0:
+                member.replica.zombie_until = tick + zombie
+                self._zombies.append(member.replica)
+            self._log_replica(name, "crash", tick, now, zombie_ticks=zombie)
+        elif f.kind == "replica_join":
+            for _ in range(int(f.arg("replicas", 1))):
+                self._join(tick=tick, now=now)
+        elif f.kind == "replica_leave":
+            name = self._resolve_fault_target(f)
+            member = self.router.members.get(name)
+            if member is not None and not member.draining:
+                member.draining = True
+                self.leaves += 1
+                self._log_replica(name, "leave", tick, now)
+
+    # -- the loop ------------------------------------------------------
+
+    def _validate(self, requests) -> None:
+        """Fail a structurally impossible workload at run() entry,
+        before any replica sees it — the same shared check a replica's
+        submit() would apply, evaluated against the common geometry
+        (every replica owns an identical pool)."""
+        g = self.geometry
+        usable = PagePool(g["num_pages"]).usable
+        for r in requests:
+            validate_request(r, max_len=g["max_len"],
+                             page_size=g["page_size"], usable=usable)
+
+    def run(self, requests: list[Request]) -> FleetResult:
+        reqs = sorted(requests, key=lambda r: (r.arrival, r.rid))
+        self._validate(reqs)
+        self._auth = {r.rid: r for r in reqs}
+        if len(self._auth) != len(reqs):
+            raise ValueError("duplicate request ids in the workload")
+        pending = deque(reqs)
+        redispatch_q: deque[Request] = deque()
+        clock, tick_s = self.clock, self.tick_s
+        self._t0 = t0 = clock()
+        n_done = 0
+        n_total = len(reqs)
+        tick = self._tick
+        while n_done < n_total:
+            now = clock() - t0
+            if self.faults is not None:
+                for f in self.faults.fire("fleet.tick", tick):
+                    self._apply_fault(f, tick=tick, now=now,
+                                      redispatch_q=redispatch_q)
+                self.events.extend(self.faults.drain_events())
+            # Restarts whose backoff elapsed rejoin with fresh state.
+            while self._pending_restarts and self._pending_restarts[0][0] <= now:
+                _, name = self._pending_restarts.pop(0)
+                rep = self._new_replica(name)
+                self.router.register(rep, tick=tick)
+                # Counted HERE, not at scheduling: a run that ends
+                # before the backoff elapses had no restart, and the
+                # summary must agree with the replica_log's events.
+                self.restarts += 1
+                self._log_replica(name, "restart", tick, now)
+            # Failure detection: heartbeat staleness, then failover.
+            for member in self.router.stale(tick):
+                self._fail_over(member, tick=tick, now=now,
+                                redispatch_q=redispatch_q)
+            # Graceful leave completes when the drain empties.
+            for member in list(self.router.members.values()):
+                if member.draining and member.replica.core.unfinished == 0:
+                    self.router.deregister(member.name)
+                    self._retire_counts(member.replica)
+                    self._log_replica(member.name, "drain_complete", tick,
+                                      now)
+            # Dispatch: failovers first (they already waited), then due
+            # arrivals, FCFS. A re-dispatch happens EXACTLY once per
+            # failover — the queue is drained head-first and a request
+            # enters it only via _harvest.
+            dispatched, redispatched = [], []
+            while redispatch_q:
+                req = redispatch_q[0]
+                if not self._dispatch(req, tick=tick, redispatch=True):
+                    break
+                redispatch_q.popleft()
+                redispatched.append(req.rid)
+            while pending and pending[0].arrival <= now:
+                req = pending[0]
+                if not self._dispatch(req, tick=tick, redispatch=False):
+                    break
+                pending.popleft()
+                dispatched.append(req.rid)
+            # The fleet record goes out BEFORE the replicas step: the
+            # tick's routing decisions precede, in the JSONL, any token
+            # the target replica emits this same tick — which is what
+            # lets `mctpu trace` anchor a discard re-dispatch's token
+            # reset ahead of the new replica's first emission.
+            if self.fleet_sink is not None:
+                self.fleet_sink({
+                    "tick": tick, "now": round(now, 4),
+                    "replicas": len(self.router.members),
+                    "pending": len(pending) + len(redispatch_q),
+                    "dispatched": dispatched, "redispatched": redispatched,
+                    "redispatch": self.redispatch,
+                    "load": {m.name: [len(m.replica.core.sched.queue),
+                                      sum(1 for s in
+                                          m.replica.core.sched.slots
+                                          if not s.free),
+                                      m.replica.core.sched.pool.free_pages]
+                             for m in sorted(self.router.members.values(),
+                                             key=lambda m: m.name)},
+                })
+            # Step every live member (and any zombies — partitioned
+            # replicas the router no longer trusts); only live members
+            # heartbeat.
+            any_work = False
+            for member in sorted(self.router.members.values(),
+                                 key=lambda m: m.name):
+                rep = member.replica
+                if not rep.alive:
+                    continue
+                rec, new_fin, new_drop = rep.step(now)
+                self.router.beat(member.name, tick)
+                n_done += self._sync_terminal(rep, new_fin + new_drop, now)
+                any_work = any_work or rec["progressed"] or rep.core.unfinished
+                if self.replica_tick_sink is not None:
+                    self.replica_tick_sink({
+                        "tick": tick, "now": round(now, 4),
+                        "mode": f"fleet/{member.name}",
+                        **{k: rec[k] for k in
+                           ("queue", "running", "free_pages", "admitted",
+                            "prefill", "decoded", "preempted", "finished",
+                            "aborted")},
+                    })
+            for rep in list(self._zombies):
+                if tick >= rep.zombie_until:
+                    self._zombies.remove(rep)
+                    continue
+                rec, new_fin, new_drop = rep.step(now)
+                # Terminal claims from a zombie are fenced like tokens:
+                # before failover revokes its fences the zombie's
+                # completions are authoritative commits and must count
+                # toward n_done; after revocation they are discarded.
+                n_done += self._sync_terminal(rep, new_fin + new_drop, now)
+                # Pre-failover the zombie is still a member and its
+                # commits still land — its tick telemetry is part of
+                # the same in-flight drain, and `mctpu trace` needs it
+                # to account the committed tokens. Post-failover its
+                # commits are fence-refused, so the trail rightly
+                # excludes its records.
+                member = self.router.members.get(rep.name)
+                if (member is not None and member.replica is rep
+                        and self.replica_tick_sink is not None):
+                    self.replica_tick_sink({
+                        "tick": tick, "now": round(now, 4),
+                        "mode": f"fleet/{rep.name}",
+                        **{k: rec[k] for k in
+                           ("queue", "running", "free_pages", "admitted",
+                            "prefill", "decoded", "preempted", "finished",
+                            "aborted")},
+                    })
+            if self.registry is not None:
+                self.registry.set("fleet.replicas",
+                                  len(self.router.members))
+                self.registry.set("fleet.pending",
+                                  len(pending) + len(redispatch_q))
+            tick += 1
+            clock.advance(tick_s)
+            if n_done >= n_total:
+                break
+            if not any_work and not self._zombies:
+                # Fleet idle: nothing in flight on any LIVE replica. A
+                # dead-but-undetected member may still hold work — keep
+                # ticking until heartbeat staleness surfaces it. Else
+                # jump the clock to the next event, or — with no
+                # replicas and none restarting — fail what remains
+                # terminally (requests must always leave).
+                if any(not m.replica.alive
+                       for m in self.router.members.values()):
+                    continue
+                now = clock() - t0
+                if (not self.router.members and not self._pending_restarts
+                        and self.faults is not None
+                        and self.faults.pending("fleet.tick",
+                                                "replica_join")):
+                    # Empty fleet, but the plan still schedules a join:
+                    # capacity is in flight exactly like a pending
+                    # restart — keep ticking until its tick arrives.
+                    continue
+                if not self.router.members and not self._pending_restarts:
+                    # Nothing can ever serve again — future arrivals
+                    # included (waiting for one would spin forever: it
+                    # arrives, no member can take it, repeat).
+                    for req in list(pending) + list(redispatch_q):
+                        if req.terminal:
+                            continue
+                        req.status = "failed"
+                        req.fail_reason = "fleet has no replicas"
+                        # A future arrival fails AT its arrival moment,
+                        # never before it — finished_at < arrival would
+                        # put negative latencies in the obs records.
+                        req.finished_at = max(now, req.arrival)
+                        self._holder.pop(req.rid, None)
+                        n_done += 1
+                    pending.clear()
+                    redispatch_q.clear()
+                    continue
+                targets = [pending[0].arrival] if pending else []
+                if self._pending_restarts:
+                    targets.append(self._pending_restarts[0][0])
+                # Only a FUTURE event can be jumped to; a target <= now
+                # (work already here, capacity arriving via a restart
+                # that pops next iteration) just keeps ticking.
+                future = [t for t in targets if t > now]
+                if future:
+                    clock.advance(min(future) - now)
+                elif not targets and not (pending or redispatch_q):
+                    raise RuntimeError(
+                        "fleet stalled: replicas idle but "
+                        f"{n_total - n_done} request(s) unaccounted for"
+                    )
+        self._tick = tick
+        # Pool invariant at exit on every surviving replica: zero
+        # leaked, zero double-booked pages, fleet-wide.
+        for member in self.router.members.values():
+            member.replica.core.sched.pool.check()
+        decode_ticks = self._retired[0] + sum(
+            m.replica.core.decode_ticks for m in self.router.members.values())
+        prefills = self._retired[1] + sum(
+            m.replica.core.prefill_chunks
+            for m in self.router.members.values())
+        preempts = self._retired[2] + sum(
+            m.replica.core.sched.preemptions
+            for m in self.router.members.values())
+        return FleetResult(
+            requests=reqs, ticks=tick, duration_s=clock() - t0,
+            dispatches=self.dispatches, redispatches=self.redispatches,
+            fenced_discards=self.fenced_discards, crashes=self.crashes,
+            joins=self.joins, leaves=self.leaves, restarts=self.restarts,
+            circuit_opens=self.circuit_opens, decode_ticks=decode_ticks,
+            prefill_chunks=prefills, preemptions=preempts,
+            replicas_final=len(self.router.members),
+            dispatch_trace=self.dispatch_trace, events=self.events,
+            replica_log=self.replica_log,
+        )
+
+
+def make_fleet_workload(*, n: int, vocab: int, prompt_min: int,
+                        prompt_max: int, out_min: int, out_max: int,
+                        rate: float, seed: int, sessions: int = 0,
+                        deadline_s: float = 0.0) -> list[Request]:
+    """The serve-bench workload generator plus session keys: request i
+    belongs to session i % sessions (0 = sessionless), so the
+    session-affinity policy has stable keys to rendezvous-hash."""
+    from .bench import make_workload
+
+    reqs = make_workload(n=n, vocab=vocab, prompt_min=prompt_min,
+                         prompt_max=prompt_max, out_min=out_min,
+                         out_max=out_max, rate=rate, seed=seed,
+                         deadline_s=deadline_s)
+    if sessions > 0:
+        for r in reqs:
+            r.session = r.rid % sessions
+    return reqs

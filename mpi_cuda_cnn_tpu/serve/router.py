@@ -1,0 +1,204 @@
+"""Fleet router policy: dispatch, membership, health, and fences.
+
+One engine is one chip; the ROADMAP's north star needs N replicas
+behind a router that keeps serving when replicas die (ISSUE 7). This
+module is the fleet's POLICY half and is deliberately jax-free and
+engine-free: it decides WHERE a request goes and WHOSE outputs count,
+while serve/fleet.py owns the replicas that do the work. Everything
+here is deterministic — sorted membership, pure hash functions, an
+injectable jitter — so a seeded fleet storm produces a bitwise-equal
+dispatch trace run to run (the FakeClock contract from PRs 4-6).
+
+Three concerns, one per class group:
+
+- **Dispatch** (`Router.pick`): least-loaded reads each replica's
+  queue/slot/page telemetry (the PR-6 MetricsRegistry gauges the
+  replica's step loop maintains) and picks the smallest backlog;
+  session-affinity uses RENDEZVOUS (highest-random-weight) hashing on
+  (session, replica) so one session's requests land on one replica —
+  its prefix/KV locality survives other replicas joining or leaving,
+  because only keys owned by a departed replica move.
+
+- **Membership + health**: replicas heartbeat every tick they step; a
+  replica that misses `heartbeat_miss` consecutive ticks is declared
+  dead (crashed replicas simply stop beating — detection is the
+  router's, not the fault's). Restarts are paced by
+  utils/retry.backoff_delay and a replica that keeps flapping has its
+  circuit OPENED after `max_flaps` crashes: it is permanently removed
+  instead of bouncing the same failure through the fleet forever.
+
+- **Generation-token fences** (`Router.grant` / `fence_ok`): every
+  dispatch of a request carries a monotonically increasing epoch;
+  exactly ONE (replica, epoch) pair holds a request's fence at a time.
+  Re-dispatch bumps the epoch, so a partitioned "zombie" replica that
+  keeps generating after failover has every commit refused — no token
+  position can ever be generated twice into the authoritative output
+  (the exactly-once contract the fleet tests pin).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..utils.retry import backoff_delay
+
+POLICIES = ("least_loaded", "session")
+
+
+def stable_hash(*parts) -> int:
+    """32-bit FNV-1a over the parts' string forms — a process-stable,
+    seed-independent mixer (Python's str hash is randomized per
+    process, which would unseat every session on restart)."""
+    h = 2166136261
+    for part in parts:
+        for b in str(part).encode():
+            h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+        h = (h ^ 0x2E) & 0xFFFFFFFF  # field separator
+    return h
+
+
+@dataclasses.dataclass
+class Member:
+    """One replica as the router sees it: health bookkeeping. The
+    replica object itself (serve/fleet.py) hangs off `replica`; flap
+    counts live in Router._flap_history (one authority — they must
+    survive deregistration, so a per-Member copy could only go stale)."""
+
+    name: str
+    replica: object
+    joined_tick: int = 0
+    last_beat: int = 0
+    draining: bool = False
+
+
+class CircuitOpen(Exception):
+    """Raised by record_crash when a replica exhausts its flap budget."""
+
+
+class Router:
+    """Deterministic dispatch + membership + fencing (see module doc).
+
+    `jitter` has the random.random call shape and feeds
+    backoff_delay's de-synchronization term; every current surface
+    keeps the default 0.5 — restart pacing stays deterministic, the
+    FakeClock contract. The hook exists so a real multi-host deploy
+    can de-synchronize restarts without touching the pacing logic."""
+
+    def __init__(self, policy: str = "least_loaded", *,
+                 heartbeat_miss: int = 3, backoff_base: float = 0.0,
+                 max_flaps: int = 3, jitter=None):
+        if policy not in POLICIES:
+            raise ValueError(f"policy {policy!r}: want one of {POLICIES}")
+        if heartbeat_miss < 1:
+            raise ValueError(f"heartbeat_miss must be >= 1, got "
+                             f"{heartbeat_miss}")
+        self.policy = policy
+        self.heartbeat_miss = heartbeat_miss
+        self.backoff_base = backoff_base
+        self.max_flaps = max_flaps
+        self.jitter = jitter if jitter is not None else (lambda: 0.5)
+        self.members: dict[str, Member] = {}
+        # Flap counts survive deregistration: a restarted replica keeps
+        # its crash history, which is what makes the circuit breaker a
+        # breaker and not a per-incarnation counter.
+        self._flap_history: dict[str, int] = {}
+        self.circuit_open: set[str] = set()
+        # rid -> (replica name, epoch): the generation-token fence.
+        self._fence: dict[int, tuple[str, int]] = {}
+        self._epoch: dict[int, int] = {}
+
+    # -- membership ----------------------------------------------------
+
+    def register(self, replica, tick: int = 0) -> Member:
+        name = replica.name
+        if name in self.members:
+            raise ValueError(f"replica {name!r} already registered")
+        if name in self.circuit_open:
+            raise ValueError(f"replica {name!r} is circuit-open")
+        m = Member(name=name, replica=replica, joined_tick=tick,
+                   last_beat=tick)
+        self.members[name] = m
+        return m
+
+    def deregister(self, name: str) -> Member:
+        return self.members.pop(name)
+
+    def beat(self, name: str, tick: int) -> None:
+        self.members[name].last_beat = tick
+
+    def stale(self, tick: int) -> list[Member]:
+        """Members that have MISSED `heartbeat_miss` consecutive beats
+        — the router's failure detector (a crashed replica stops
+        beating; detection lags the crash by the miss budget). The
+        check runs BEFORE the current tick's beats land, so a healthy
+        member's lag is already 1: missed beats = lag - 1, hence the
+        strict comparison (a replica crashed at tick T is declared
+        dead at tick T + heartbeat_miss)."""
+        return [m for m in sorted(self.members.values(),
+                                  key=lambda m: m.name)
+                if tick - m.last_beat > self.heartbeat_miss]
+
+    def record_crash(self, name: str) -> float:
+        """Account one crash of `name`; returns the backoff delay (s)
+        before its restart may rejoin, or raises CircuitOpen once the
+        flap budget is exhausted (the replica never comes back)."""
+        flaps = self._flap_history.get(name, 0) + 1
+        self._flap_history[name] = flaps
+        if flaps > self.max_flaps:
+            self.circuit_open.add(name)
+            raise CircuitOpen(
+                f"replica {name} crashed {flaps} times "
+                f"(max_flaps={self.max_flaps}); circuit opened"
+            )
+        return backoff_delay(flaps - 1, self.backoff_base, self.jitter)
+
+    def dispatchable(self) -> list[Member]:
+        """Members that may receive NEW work, in deterministic order."""
+        return [m for m in sorted(self.members.values(),
+                                  key=lambda m: m.name)
+                if not m.draining]
+
+    # -- dispatch ------------------------------------------------------
+
+    def pick(self, req) -> Member | None:
+        """The replica `req` should run on, or None when nothing can
+        take work. Least-loaded reads each replica's load() (backed by
+        its PR-6 registry gauges); session requests rendezvous-hash
+        onto the surviving membership; ties break on name, so identical
+        fleets make identical choices."""
+        cands = self.dispatchable()
+        if not cands:
+            return None
+        if self.policy == "session" and req.session is not None:
+            return max(cands,
+                       key=lambda m: (stable_hash(req.session, m.name),
+                                      m.name))
+        return min(cands, key=lambda m: (m.replica.load(), m.name))
+
+    # -- generation-token fences ---------------------------------------
+
+    def grant(self, rid: int, name: str) -> int:
+        """Fence `rid`'s generation to replica `name`; returns the new
+        epoch. Every dispatch and re-dispatch goes through here —
+        epochs only ever move forward."""
+        epoch = self._epoch.get(rid, -1) + 1
+        self._epoch[rid] = epoch
+        self._fence[rid] = (name, epoch)
+        return epoch
+
+    def fence_ok(self, rid: int, name: str, epoch: int) -> bool:
+        """Whether (name, epoch) still holds `rid`'s fence — checked on
+        every token commit and terminal claim; a stale holder (zombie
+        or superseded dispatch) is refused."""
+        return self._fence.get(rid) == (name, epoch)
+
+    def revoke(self, rid: int) -> None:
+        """Invalidate `rid`'s fence IMMEDIATELY (failover harvest, rid
+        awaiting re-dispatch): nobody may commit until the next grant —
+        the window where a zombie could otherwise race the failover
+        shut. The epoch counter is untouched, so the next grant still
+        moves forward."""
+        self._fence.pop(rid, None)
+
+    def fence_of(self, rid: int) -> tuple[str, int] | None:
+        return self._fence.get(rid)

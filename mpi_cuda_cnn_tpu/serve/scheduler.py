@@ -47,6 +47,31 @@ import numpy as np
 
 from .paged_cache import PagePool, pages_for
 
+
+def validate_request(r: Request, *, max_len: int, page_size: int,
+                     usable: int) -> None:
+    """THE structural-admissibility check, shared by scheduler submit
+    and the fleet's up-front workload validation (one spelling, so the
+    fleet can never accept a request a replica's submit would then
+    raise on mid-run):
+
+    - prompt + max_new_tokens past max_len (block table can't hold it)
+    - a prompt alone needing more pages than the pool owns (it could
+      never be admitted, let alone decode)
+    """
+    if r.prompt.size + r.max_new_tokens > max_len:
+        raise ValueError(
+            f"request {r.rid}: prompt {r.prompt.size} + "
+            f"{r.max_new_tokens} new exceeds max_len {max_len}"
+        )
+    if pages_for(r.prompt.size + 1, page_size) > usable:
+        raise ValueError(
+            f"request {r.rid}: prompt of {r.prompt.size} tokens "
+            f"needs {pages_for(r.prompt.size + 1, page_size)} "
+            f"pages but the pool owns {usable} — it can "
+            "never be admitted (size the pool or shrink the prompt)"
+        )
+
 # A request leaves the system in exactly one of these states.
 TERMINAL_STATUSES = ("finished", "expired", "cancelled", "rejected", "failed")
 
@@ -58,13 +83,17 @@ class Request:
     preemption — recompute re-prefills prompt + out). `deadline` is an
     absolute time on the engine's clock (same timeline as `arrival`);
     past it the request is dropped/aborted with status "expired".
-    `cancel()` requests client-side abort at the next tick boundary."""
+    `cancel()` requests client-side abort at the next tick boundary.
+    `session` is an opaque affinity key (ISSUE 7): the fleet router's
+    session-affinity policy keeps one session's requests on one replica
+    so its prefix cache stays hot; None means no affinity."""
 
     rid: int
     prompt: np.ndarray
     max_new_tokens: int
     arrival: float = 0.0
     deadline: float | None = None
+    session: int | str | None = None
     out: list[int] = dataclasses.field(default_factory=list)
     status: str = "queued"
     fail_reason: str | None = None
@@ -153,6 +182,10 @@ class _SchedulerBase:
         # folds them into the tick record it emits for the timeline.
         self.preempted_log: list[int] = []
         self._admit_seq = 0
+        # True once any submitted request carried a deadline: lets a
+        # caller (the fleet's per-replica step loop) skip the O(queue)
+        # sweep() scan on ticks where nothing can possibly expire.
+        self.has_deadlines = False
 
     def submit(self, requests: Iterable[Request]) -> None:
         """Enqueue requests (FCFS by arrival). Structurally impossible
@@ -165,19 +198,11 @@ class _SchedulerBase:
         """
         reqs = sorted(requests, key=lambda r: (r.arrival, r.rid))
         for r in reqs:
-            total = r.prompt.size + r.max_new_tokens
-            if total > self.max_len:
-                raise ValueError(
-                    f"request {r.rid}: prompt {r.prompt.size} + "
-                    f"{r.max_new_tokens} new exceeds max_len {self.max_len}"
-                )
-            if pages_for(r.prompt.size + 1, self.page_size) > self.pool.usable:
-                raise ValueError(
-                    f"request {r.rid}: prompt of {r.prompt.size} tokens "
-                    f"needs {pages_for(r.prompt.size + 1, self.page_size)} "
-                    f"pages but the pool owns {self.pool.usable} — it can "
-                    "never be admitted (size the pool or shrink the prompt)"
-                )
+            validate_request(r, max_len=self.max_len,
+                             page_size=self.page_size,
+                             usable=self.pool.usable)
+            if r.deadline is not None:
+                self.has_deadlines = True
             self.queue.append(r)
 
     @property

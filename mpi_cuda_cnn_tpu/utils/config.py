@@ -258,16 +258,24 @@ class LMConfig(_JsonConfig):
 
 def _fault_plan_arg(spec: str) -> str:
     """argparse type for --fault-plan: parse NOW so a typo dies at the
-    command line with parse_plan's one-line message instead of as a
-    traceback from deep inside the trainer (ISSUE 5 satellite). The
+    command line with a one-line message instead of as a traceback from
+    deep inside the trainer (ISSUE 5 satellite); sites AND kinds are
+    checked against the CNN trainer's hook points (ISSUE 7 satellite)
+    via the shared faults.fault_plan_arg — `replica_crash@fleet.tick`
+    on `mctpu train` would silently never fire; it errors here. The
     original string is returned — the trainer re-parses it."""
-    from ..faults import parse_plan
+    from ..faults import fault_plan_arg
 
-    try:
-        parse_plan(spec)
-    except ValueError as e:
-        raise argparse.ArgumentTypeError(str(e)) from e
-    return spec
+    return fault_plan_arg("train")(spec)
+
+
+def _lm_fault_plan_arg(spec: str) -> str:
+    """The LM parser's --fault-plan type: same contract, "train-lm"
+    surface — the LM trainer has no train.batch hook, so nan@train.batch
+    (valid on the CNN trainer) must error here, not silently no-op."""
+    from ..faults import fault_plan_arg
+
+    return fault_plan_arg("train-lm")(spec)
 
 
 # Per-field argparse overrides shared by both auto-generated parsers:
@@ -278,15 +286,22 @@ _ARG_OVERRIDES: dict[str, dict] = {
     "fault_plan": {"type": _fault_plan_arg},
 }
 
+# The LM parser validates --fault-plan against ITS hook surface.
+_LM_ARG_OVERRIDES: dict[str, dict] = {
+    **_ARG_OVERRIDES,
+    "fault_plan": {"type": _lm_fault_plan_arg},
+}
 
-def _add_flag(p: argparse.ArgumentParser, name: str, default) -> None:
-    """One auto-generated dataclass flag, with any _ARG_OVERRIDES."""
+
+def _add_flag(p: argparse.ArgumentParser, name: str, default,
+              overrides: dict[str, dict] = _ARG_OVERRIDES) -> None:
+    """One auto-generated dataclass flag, with any per-parser overrides."""
     flag = "--" + name.replace("_", "-")
     if isinstance(default, bool):
         p.add_argument(flag, action=argparse.BooleanOptionalAction,
                        default=default)
         return
-    extra = dict(_ARG_OVERRIDES.get(name, ()))
+    extra = dict(overrides.get(name, ()))
     ftype = extra.pop("type", str if default is None else type(default))
     p.add_argument(flag, type=ftype, default=default, **extra)
 
@@ -299,7 +314,8 @@ def build_lm_parser() -> argparse.ArgumentParser:
     )
     defaults = LMConfig()
     for f in dataclasses.fields(LMConfig):
-        _add_flag(p, f.name, getattr(defaults, f.name))
+        _add_flag(p, f.name, getattr(defaults, f.name),
+                  overrides=_LM_ARG_OVERRIDES)
     return p
 
 

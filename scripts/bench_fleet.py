@@ -1,0 +1,15 @@
+"""Thin wrapper for the fleet bench (mpi_cuda_cnn_tpu.serve.bench) —
+`python scripts/bench_fleet.py ...` == `mctpu fleet-bench ...`: N
+single-engine replicas behind the failure-aware router under a seeded
+Poisson storm, with optional injected replica crashes/joins/leaves,
+deterministic under FakeClock (serve/fleet.py, ISSUE 7)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main
+
+if __name__ == "__main__":
+    sys.exit(fleet_bench_main())

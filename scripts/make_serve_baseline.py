@@ -1,0 +1,79 @@
+"""Regenerate the committed CI serving baseline, reproducibly.
+
+ci/serve_baseline.jsonl is DATA: the telemetry of one serve-bench run
+with pinned arguments, which CI re-runs fresh and compares against
+under ci/serve_gate.json's thresholds. Before this script the file was
+captured by hand, so "what arguments produced it?" lived only in the
+gate's _doc comment and drifted silently when the bench grew flags.
+Now there is exactly one spelling:
+
+    make serve-baseline            # or:
+    JAX_PLATFORMS=cpu python scripts/make_serve_baseline.py
+
+Refresh procedure (also in ci/serve_gate.json's _doc): rerun after any
+DELIBERATE scheduling change (admission order, chunking, preemption
+policy — anything that legitimately moves tick/chunk/token counts),
+commit the new ci/serve_baseline.jsonl with the change that moved it,
+and say so in the commit message. Never refresh to silence a red gate
+you can't explain — the 0%-tolerance structural counts exist to catch
+exactly that drift. The fleet gate (ci/fleet_gate.json) needs no
+baseline file: it compares two fresh identical-seed runs against each
+other, so there is nothing to regenerate.
+
+The arguments below MUST stay in lockstep with the CI candidate run in
+.github/workflows/ci.yml ("Perf-regression gate" step) — same seed,
+same shape, --device cpu so the schedule is a pure function of the
+seed; only then do the structural counts gate at 0%.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = Path(__file__).resolve().parents[1]
+BASELINE = REPO / "ci" / "serve_baseline.jsonl"
+
+# One flag list, shared verbatim with CI's candidate run (minus the
+# output path). Growing the bench must not change these silently: the
+# gate compares baseline vs fresh run, so both sides have to move
+# together — through this file and ci.yml in the same commit.
+BASELINE_ARGS = ["--requests", "12", "--seed", "0", "--device", "cpu"]
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        # No knobs on purpose: the whole point is ONE pinned spelling.
+        # A stray flag (even --help) must not silently overwrite the
+        # committed baseline with a default run.
+        print("usage: make_serve_baseline.py  (takes no arguments; "
+              "pinned args: " + " ".join(BASELINE_ARGS) + ")",
+              file=sys.stderr)
+        return 0 if sys.argv[1] in ("-h", "--help") else 2
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mpi_cuda_cnn_tpu.serve.bench import serve_bench_main
+
+    tmp = BASELINE.with_suffix(".jsonl.tmp")
+    # MetricsLogger appends: a stale tmp from an interrupted run would
+    # otherwise smuggle a second segment into the committed baseline.
+    tmp.unlink(missing_ok=True)
+    rc = serve_bench_main([*BASELINE_ARGS, "--metrics-jsonl", str(tmp)])
+    if rc != 0:
+        print(f"serve-bench failed (exit {rc}); baseline untouched",
+              file=sys.stderr)
+        tmp.unlink(missing_ok=True)
+        return rc
+    os.replace(tmp, BASELINE)  # atomic: never leave a torn baseline
+    print(f"wrote {BASELINE.relative_to(REPO)}")
+    print("Verify it gates green against itself, then commit it together "
+          "with the change that moved the schedule:")
+    print("  python -m mpi_cuda_cnn_tpu compare ci/serve_baseline.jsonl "
+          "ci/serve_baseline.jsonl --gate ci/serve_gate.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,6 +102,14 @@ step bench_serve 900 python scripts/bench_serve.py --requests 32 \
 step bench_serve_gqa_int8 900 python scripts/bench_serve.py \
     --requests 32 --rate 200 --kv-heads 1 --cache-dtype int8
 step profile_lm 900 python scripts/profile_lm.py
+# PR-7 (fleet): the engine-backed fleet on a real chip — N PagedEngine
+# replicas (shared weights) behind the failure-aware router, one crash
+# + re-dispatch mid-storm. Banks chip tokens/s for the PERF.md fleet
+# section (the sim-compute storm rows are chip-independent scheduling;
+# this step measures the device-backed replica path).
+step bench_fleet_engine 900 python scripts/bench_fleet.py \
+    --compute engine --replicas 2 --requests 32 --rate 200 \
+    --log summary --fault-plan "replica_crash@fleet.tick:30?replica=0"
 # PR-5 (elasticity): the width-invariant canonical-tree step on a real
 # chip mesh — banks the elastic-vs-plain step-time ratio for PERF.md
 # (CPU-banked 2x at the reference config; TPU fusion/collective costs

@@ -104,6 +104,13 @@ SLOW_TESTS = {
     "test_elastic.py::test_lm_preempt_resume_across_widths_bitwise",
     "test_elastic.py::test_elastic_step_is_width_invariant_and_pmean_is_not",
     "test_elastic.py::test_elastic_augment_keys_on_canonical_shard",
+    # Fleet (ISSUE 7): the tier-1-size storm + lifecycle/fencing tests
+    # stay fast; the 10^5-request acceptance storm and the engine-backed
+    # (jit-compiling) crash-parity twins run in the explicit CI fleet
+    # step (named ::-exactly, which overrides this skip) and --runslow.
+    "test_fleet.py::test_storm_100k_scale",
+    "test_fleet.py::test_engine_fleet_crash_outputs_match_crash_free[resume]",
+    "test_fleet.py::test_engine_fleet_crash_outputs_match_crash_free[discard]",
     "test_models.py::test_residual_unprojectable_shape_rejected",
     "test_pp.py::test_pp_grad_clip_matches_optax[mesh_axes1-1-False]",
     "test_tp_pp.py::test_tp_pp_eval_forward_matches_apply",

@@ -185,6 +185,100 @@ def test_scheduler_admit_finish_preempt_keep_pool_consistent():
     assert pool.free_pages == pool.usable - 0 - len(sched.slots[1].pages)
 
 
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_pagepool_randomized_op_sequence_invariant(dtype):
+    """Seeded randomized-sequence invariant (ISSUE 7 satellite): a few
+    hundred random admit / prefill-chunk / decode-growth / preempt /
+    cancel / expire operations against a real PagedEngine cache in each
+    storage dtype, with pool.check() after EVERY step — the no-leak /
+    no-double-book / scratch-never-circulates invariant must hold at
+    every intermediate state, not just the curated sequences above.
+    The fleet's re-dispatch path (serve/fleet.py) drives this exact
+    scheduler+pool pair per replica, so it inherits the guarantee."""
+    params = MODEL.init(jax.random.key(2))
+    engine = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                         prefill_chunk=4, max_len=32, cache_dtype=dtype)
+    # Host pool sized to the engine's device page arrays — the pairing
+    # ReplicaCore uses: page indices from this pool index those arrays.
+    pool = PagePool(10)
+    sched = ContinuousScheduler(slots=3, pool=pool, page_size=4, max_len=32)
+    rng = np.random.default_rng(11)
+    now = 0.0
+    next_rid = 0
+    submitted: list[Request] = []
+
+    def submit_one():
+        nonlocal next_rid
+        req = Request(
+            rid=next_rid,
+            prompt=rng.integers(0, 13, (int(rng.integers(2, 12)),)),
+            max_new_tokens=int(rng.integers(2, 14)), arrival=now,
+            # ~1 in 4 requests carries a deadline the clock will cross.
+            deadline=(now + float(rng.uniform(0.05, 0.6))
+                      if rng.random() < 0.25 else None),
+        )
+        next_rid += 1
+        submitted.append(req)
+        sched.submit([req])
+
+    def prefill_step():
+        slot = sched.prefill_slot()
+        if slot is None:
+            return
+        n, nxt = engine.run_prefill_chunk(slot)
+        slot.cached += n
+        if slot.cached >= slot.target:
+            slot.req.out.append(int(nxt))
+            if slot.req.done:
+                sched.finish(slot, now)
+
+    def decode_step_op():
+        dslots = sched.grow_for_decode(now)
+        if not dslots:
+            return
+        toks = engine.run_decode_tick(dslots)
+        for s in dslots:
+            s.cached += 1
+            s.req.out.append(int(toks[s.idx]))
+            if s.req.done:
+                sched.finish(s, now)
+
+    def preempt_op():
+        bound = [s for s in sched.slots if not s.free]
+        if bound:
+            sched.preempt(bound[int(rng.integers(len(bound)))])
+
+    def cancel_op():
+        live = [r for r in submitted if not r.terminal]
+        if live:
+            live[int(rng.integers(len(live)))].cancel()
+            sched.sweep(now)
+
+    ops = [submit_one, lambda: sched.admit(now), prefill_step,
+           decode_step_op, preempt_op, cancel_op,
+           lambda: sched.sweep(now)]
+    weights = np.array([0.22, 0.18, 0.2, 0.2, 0.08, 0.06, 0.06])
+    for _ in range(300):
+        now += float(rng.uniform(0.0, 0.02))  # deadlines really expire
+        ops[int(rng.choice(len(ops), p=weights))]()
+        pool.check()
+    # Drain: the surviving work must complete and hand every page back.
+    while sched.unfinished:
+        sched.sweep(now)
+        sched.admit(now)
+        prefill_step()
+        decode_step_op()
+        pool.check()
+        now += 0.01
+    assert all(r.terminal for r in submitted)
+    assert pool.free_pages == pool.usable
+    # The randomized walk must have exercised the interesting paths.
+    assert sched.preemptions > 0
+    statuses = {r.status for r in submitted}
+    assert "finished" in statuses
+    assert statuses & {"expired", "cancelled"}
+
+
 def test_engine_preemption_recovers_and_completes():
     """A pool far smaller than the workload's worst case forces
     preemptions; recompute must still finish every request with its
