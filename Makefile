@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative bench_serve bench_fleet serve-baseline profile_lm profile_moe report test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve bench_fleet serve-baseline profile_lm profile_moe report health test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -169,6 +169,12 @@ profile_moe:
 #   make report RUN=run.jsonl
 report:
 	$(PY) scripts/obs_report.py $(RUN)
+
+# Per-tenant SLO verdict table + alert replay for a finished run
+# (obs/health.py; exit 1 on violation — the CI health gate):
+#   make health RUN=run.jsonl SLO=ci/slo_gate.json
+health:
+	$(PY) -m mpi_cuda_cnn_tpu health $(RUN) $(if $(SLO),--slo $(SLO))
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

@@ -15,6 +15,7 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu trace run.jsonl --request 3     # lifecycle trace
     python -m mpi_cuda_cnn_tpu top run.jsonl                   # live dashboard
     python -m mpi_cuda_cnn_tpu compare base.jsonl new.jsonl    # regression gate
+    python -m mpi_cuda_cnn_tpu health run.jsonl --slo slo.json # SLO verdicts
 """
 
 from __future__ import annotations
@@ -265,6 +266,13 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.regress import compare_main
 
         return compare_main(argv[1:])
+    if argv and argv[0] == "health":
+        # SLO health gate: per-tenant verdict table + alert replay for
+        # a finished run, exit 1 on violation (obs.health, ISSUE 8) —
+        # jax-free.
+        from .obs.health import health_main
+
+        return health_main(argv[1:])
     if argv and argv[0] == "serve-bench":
         # Serving bench: paged-KV continuous batching vs static
         # batching under Poisson arrivals (serve/bench.py).

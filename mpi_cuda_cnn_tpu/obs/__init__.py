@@ -12,6 +12,12 @@ Four pillars, one record schema:
 - `schema`: the versioned JSONL record shape shared by MetricsLogger,
             bench.py, and `mctpu report`; `report` renders any run file
             into the markdown tables PERF.md used to assemble by hand.
+
+Plus the SLO layer on top of the schema (ISSUE 8): `slo` (declarative
+per-tenant objectives, error budgets, multi-window burn-rate math),
+`alerts` (the streaming rule engine whose live and replayed sequences
+are bitwise-identical), and `health` (`mctpu health` — per-tenant
+verdict tables with a CI exit code).
 """
 
 from .cost import (  # noqa: F401
@@ -38,7 +44,11 @@ from .metrics import (  # noqa: F401
     log_bucket_bounds,
     percentiles_from_record,
 )
+from .alerts import AlertEngine, alerts_crc  # noqa: F401
+from .health import evaluate as evaluate_health  # noqa: F401
+from .health import health_main  # noqa: F401
 from .report import render_markdown, report_main, summarize  # noqa: F401
+from .slo import Objective, SLOSpec  # noqa: F401
 from .schema import (  # noqa: F401
     RUN_MARKER,
     SCHEMA_VERSION,

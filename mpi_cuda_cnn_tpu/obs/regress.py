@@ -61,7 +61,7 @@ _HIGHER = ("tokens_per_s", "samples_per_s", "accuracy", "acc", "mfu",
 _LOWER = ("_ms", "ticks", "chunks", "preemptions", "restarts", "loss",
           "ppl", "bytes", "nonfinite", "wallclock", "seconds",
           "watchdog", "requests_failed", "requests_expired",
-          "requests_rejected")
+          "requests_rejected", "alerts_fired")
 
 
 def infer_direction(name: str) -> str | None:
@@ -95,7 +95,15 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                "tpot_p50_ms", "tpot_p99_ms", "duration_s",
                "fleet_ticks", "dispatches", "redispatches",
                "fenced_discards", "crashes", "joins", "leaves",
-               "restarts", "circuit_opens", "replicas", "trace_crc")
+               "restarts", "circuit_opens", "replicas", "trace_crc",
+               "alerts_fired", "alerts_crc")
+
+# Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
+# summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to
+# ...tenant.<name>.status.<k>), so an SLO-class gate can pin one
+# tenant's p99 or finished count without gating the rest.
+_TENANT_KEYS = ("requests", "output_tokens", "ttft_p50_ms", "ttft_p99_ms",
+                "tpot_p50_ms", "tpot_p99_ms")
 
 
 def metrics_from_records(records: list[dict]) -> dict[str, float]:
@@ -114,6 +122,15 @@ def metrics_from_records(records: list[dict]) -> dict[str, float]:
                 v = _num(v)
                 if v is not None:
                     out[f"serve.{mode}.status.{k}"] = v
+            for tname, block in (rec.get("tenants") or {}).items():
+                for k in _TENANT_KEYS:
+                    v = _num(block.get(k))
+                    if v is not None:
+                        out[f"serve.{mode}.tenant.{tname}.{k}"] = v
+                for k, v in (block.get("statuses") or {}).items():
+                    v = _num(v)
+                    if v is not None:
+                        out[f"serve.{mode}.tenant.{tname}.status.{k}"] = v
         elif ev == "train":
             v = _num(rec.get("loss"))
             if v is not None:

@@ -43,6 +43,37 @@ def _merge_hist_fields(a: dict, b: dict) -> dict:
     }
 
 
+def _request_group_row(rs: list[dict]) -> dict:
+    """Aggregate one group of `request` records (a mode, or a
+    (mode, tenant) pair) into the shared serving-row fields — ONE
+    implementation of the finished-only filter, the TPOT formula, and
+    the nearest-rank percentiles, so the per-mode and per-tenant tables
+    can never drift apart. Latency stats cover FINISHED requests only:
+    an aborted request carries null where the moment never happened
+    (pre-ISSUE-4 records have no status and count finished)."""
+    fin = [r for r in rs if r.get("status", "finished") == "finished"]
+    ttft = [r["ttft_ms"] for r in fin if r.get("ttft_ms") is not None]
+    # Per-output-token latency after the first token (TPOT).
+    tpot = [
+        (r["latency_ms"] - r["ttft_ms"]) / max(r["output_tokens"] - 1, 1)
+        for r in fin
+        if r.get("latency_ms") is not None and r.get("ttft_ms") is not None
+    ]
+    statuses: dict[str, int] = {}
+    for r in rs:
+        st = r.get("status", "finished")
+        statuses[st] = statuses.get(st, 0) + 1
+    return {
+        "requests": len(rs),
+        "statuses": statuses,
+        "output_tokens": sum(r["output_tokens"] for r in rs),
+        "ttft_p50_ms": _pct(ttft, 50),
+        "ttft_p99_ms": _pct(ttft, 99),
+        "tpot_p50_ms": _pct(tpot, 50),
+        "tpot_p99_ms": _pct(tpot, 99),
+    }
+
+
 def _by_event(records: Iterable[dict]) -> dict[str, list[dict]]:
     out: dict[str, list[dict]] = {}
     for r in records:
@@ -148,36 +179,40 @@ def summarize(records: Iterable[dict], *,
             by_mode.setdefault(r.get("mode", "?"), []).append(r)
         rows = []
         for mode, rs in sorted(by_mode.items()):
-            # Latency stats cover FINISHED requests only: an aborted
-            # request carries null where the moment never happened
-            # (pre-ISSUE-4 records have no status and count finished).
-            fin = [r for r in rs if r.get("status", "finished") == "finished"]
-            ttft = [r["ttft_ms"] for r in fin if r.get("ttft_ms") is not None]
-            # Per-output-token latency after the first token (TPOT).
-            tpot = [
-                (r["latency_ms"] - r["ttft_ms"])
-                / max(r["output_tokens"] - 1, 1)
-                for r in fin
-                if r.get("latency_ms") is not None
-                and r.get("ttft_ms") is not None
-            ]
-            statuses: dict[str, int] = {}
-            for r in rs:
-                st = r.get("status", "finished")
-                statuses[st] = statuses.get(st, 0) + 1
             rows.append({
                 "mode": mode,
-                "requests": len(rs),
-                "statuses": statuses,
+                **_request_group_row(rs),
                 "prompt_tokens": sum(r["prompt_tokens"] for r in rs),
-                "output_tokens": sum(r["output_tokens"] for r in rs),
                 "preemptions": sum(r.get("preemptions", 0) for r in rs),
-                "ttft_p50_ms": _pct(ttft, 50),
-                "ttft_p99_ms": _pct(ttft, 99),
-                "tpot_p50_ms": _pct(tpot, 50),
-                "tpot_p99_ms": _pct(tpot, 99),
             })
         summary["requests"] = rows
+        # Per-tenant serving table (ISSUE 8): only when any record is
+        # tenant-tagged — a single-tenant run must not grow a table
+        # that duplicates the per-mode rows above.
+        if any(r.get("tenant") not in (None, "default") for r in requests):
+            by_mt: dict[tuple[str, str], list[dict]] = {}
+            for r in requests:
+                key = (r.get("mode", "?"), r.get("tenant") or "default")
+                by_mt.setdefault(key, []).append(r)
+            summary["tenants"] = [
+                {"mode": mode, "tenant": tenant, **_request_group_row(rs)}
+                for (mode, tenant), rs in sorted(by_mt.items())
+            ]
+
+    alerts = ev.get("alert", [])
+    if alerts:
+        by_rule: dict[str, int] = {}
+        by_sev: dict[str, int] = {}
+        for r in alerts:
+            by_rule[r.get("rule", "?")] = by_rule.get(r.get("rule", "?"),
+                                                      0) + 1
+            by_sev[r.get("severity", "?")] = by_sev.get(
+                r.get("severity", "?"), 0) + 1
+        summary["alerts"] = {
+            "count": len(alerts),
+            "by_rule": dict(sorted(by_rule.items())),
+            "by_severity": dict(sorted(by_sev.items())),
+        }
 
     faults = ev.get("fault", [])
     ckpts = ev.get("ckpt", [])
@@ -402,6 +437,30 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 f"| {_fmt(r['tpot_p99_ms'])} |"
             )
         lines.append("")
+    if "tenants" in summary:
+        lines += [
+            "| tenant traffic | tenant | requests | statuses "
+            "| out tokens | TTFT p50 ms | TTFT p99 ms | tok p50 ms "
+            "| tok p99 ms |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for r in summary["tenants"]:
+            lines.append(
+                f"| {r['mode']} | {r['tenant']} | {r['requests']} "
+                f"| {_fmt(r['statuses'])} | {r['output_tokens']} "
+                f"| {_fmt(r['ttft_p50_ms'])} | {_fmt(r['ttft_p99_ms'])} "
+                f"| {_fmt(r['tpot_p50_ms'])} | {_fmt(r['tpot_p99_ms'])} |"
+            )
+        lines.append("")
+    if "alerts" in summary:
+        al = summary["alerts"]
+        lines += [
+            "| alerts | by severity | by rule |",
+            "|---|---|---|",
+            f"| {al['count']} | {_fmt(al['by_severity'])} "
+            f"| {_fmt(al['by_rule'])} |",
+            "",
+        ]
     if "robustness" in summary:
         rb = summary["robustness"]
         lines += [

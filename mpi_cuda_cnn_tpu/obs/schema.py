@@ -54,6 +54,8 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # requests carry null where the moment never happened; "status" is
     # the terminal status (finished/expired/cancelled/rejected/failed)
     # — absent in pre-ISSUE-4 records, treated as "finished".
+    # "tenant" (ISSUE 8) is the traffic-class identity SLO accounting
+    # buckets by — absent in pre-ISSUE-8 records, treated as "default".
     "request": ("id", "mode", "prompt_tokens", "output_tokens",
                 "ttft_ms", "latency_ms"),
     # One serving-bench run summary per scheduler mode (serve/bench.py).
@@ -94,8 +96,19 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # (admitted [[slot, rid]], prefill [slot, rid, n] | null, decoded
     # [[slot, rid]], finished/preempted/failed rids, aborted
     # [[rid, status]]). "now" is seconds since run start on the
-    # engine's (injectable) clock.
+    # engine's (injectable) clock. "terminal" (ISSUE 8) details each
+    # request reaching a terminal status this tick ({id, tenant,
+    # status, ttft_ms, tpot_ms, queue_wait_ms}) — the streaming
+    # good/bad events the SLO burn-rate rules fold.
     "tick": ("tick", "now", "queue", "free_pages"),
+    # One fired alert (obs/alerts.py, ISSUE 8): "rule" names the rule
+    # instance, "kind" its class (threshold / rate_of_change / absence
+    # / burn_rate), "seq" its position in the run's alert sequence
+    # (obs.alerts.alerts_crc pins the whole sequence as one number),
+    # "at" the triggering record's timeline stamp; context beyond that
+    # is free-form per kind (tenant/metric/burn for burn_rate,
+    # field/value/threshold for threshold, family/gap_s for absence).
+    "alert": ("seq", "rule", "kind", "severity", "at"),
 }
 
 

@@ -295,16 +295,18 @@ def render_gantt(records: list[dict], mode: str, *, width: int = 96,
 
 def render_request_table(lifecycles: dict[int, Lifecycle]) -> str:
     lines = [
-        "| rid | status | arrival s | queued ms | prefill ms | decode ms "
+        "| rid | status | tenant | arrival s | queued ms | prefill ms "
+        "| decode ms "
         "| preempt wait ms | preempts | chunks | dticks | tokens | ok |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for rid in sorted(lifecycles):
         lc = lifecycles[rid]
         b = lc.breakdown
         rec = lc.record or {}
         lines.append(
-            f"| {rid} | {_fmt(lc.derived_status)} | {_fmt(lc.arrival_s())} "
+            f"| {rid} | {_fmt(lc.derived_status)} "
+            f"| {rec.get('tenant', 'default')} | {_fmt(lc.arrival_s())} "
             f"| {_fmt(b.get('queued_ms'))} | {_fmt(b.get('prefill_ms'))} "
             f"| {_fmt(b.get('decode_ms'))} | {_fmt(b.get('preempted_ms'))} "
             f"| {lc.preemptions} | {lc.prefill_chunks} | {lc.decode_ticks} "
@@ -355,6 +357,12 @@ def trace_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--mode", default=None,
                     help="restrict to one scheduler mode "
                          "(default: every mode in the file)")
+    ap.add_argument("--tenant", default=None,
+                    help="restrict the request table and consistency "
+                         "check to one tenant's requests (ISSUE 8; "
+                         "untagged requests are tenant 'default'; the "
+                         "Gantt still draws the whole schedule — slots "
+                         "are shared)")
     ap.add_argument("--width", type=int, default=96,
                     help="Gantt width in columns (ticks are bucketed)")
     ap.add_argument("--format", choices=("md", "json"), default="md")
@@ -375,6 +383,14 @@ def trace_main(argv: list[str] | None = None) -> int:
         label = args.path if len(runs) == 1 \
             else f"{args.path} (run {i}/{len(runs)})"
         for mode, lifecycles in sorted(by_mode.items()):
+            if args.tenant is not None:
+                lifecycles = {
+                    rid: lc for rid, lc in lifecycles.items()
+                    if (lc.record or {}).get("tenant", "default")
+                    == args.tenant
+                }
+                if not lifecycles:
+                    continue
             bad = [rid for rid, lc in lifecycles.items() if not lc.consistent]
             if args.format == "json":
                 print(json.dumps({

@@ -8,9 +8,9 @@ latency-histogram percentiles from the newest `metrics` snapshot. This
 is the single-process precursor of the fleet router's replica view
 (ROADMAP item 4): the same records, one engine instead of N.
 
-Deliberately jax-free (imports only obs.schema/obs.metrics): `top` must
-run on any machine that can read the file, including while the training
-process owns every accelerator.
+Deliberately jax-free (imports only obs.schema/obs.metrics/obs.alerts):
+`top` must run on any machine that can read the file, including while
+the training process owns every accelerator.
 
 Modes:
 - default: follow — re-read appended records every --refresh seconds,
@@ -30,6 +30,7 @@ import time
 from collections import deque
 from pathlib import Path
 
+from .alerts import format_alert
 from .metrics import percentiles_from_record
 from .schema import RUN_MARKER, fmt_cell, validate_record
 
@@ -72,6 +73,12 @@ class TopState:
         self.fleet: dict | None = None       # newest fleet-router tick
         self.pending_hist: deque = deque(maxlen=history)
         self.replica_kinds: dict[str, int] = {}
+        # Alert stream (ISSUE 8): rolling recent window + per-rule and
+        # per-severity totals for the ALERTS panel.
+        self.alerts_recent: deque = deque(maxlen=6)
+        self.alerts_total = 0
+        self.alerts_by_rule: dict[str, int] = {}
+        self.alerts_by_sev: dict[str, int] = {}
         # Per-replica free-pages high-water (an empty replica's free
         # count = its pool size): the fixed scale its pressure bar
         # renders against.
@@ -114,6 +121,13 @@ class TopState:
         elif ev == "replica":
             kind = rec.get("kind", "?")
             self.replica_kinds[kind] = self.replica_kinds.get(kind, 0) + 1
+        elif ev == "alert":
+            self.alerts_total += 1
+            self.alerts_recent.append(rec)
+            rule = rec.get("rule", "?")
+            sev = rec.get("severity", "?")
+            self.alerts_by_rule[rule] = self.alerts_by_rule.get(rule, 0) + 1
+            self.alerts_by_sev[sev] = self.alerts_by_sev.get(sev, 0) + 1
 
 
 def _fmt(v) -> str:
@@ -259,6 +273,21 @@ def render(state: TopState, path: str, width: int = 96) -> str:
                 lines.append(
                     f"  step ms p50/p95/p99 {_pcts(snap, 'train.step_ms')}"
                 )
+    if state.alerts_total:
+        # ALERTS panel (ISSUE 8): totals plus the rolling tail — the
+        # live view of what the streaming rule engine fired so far.
+        lines.append("")
+        lines.append(
+            f"ALERTS  fired {state.alerts_total}  "
+            + "  ".join(f"{k}:{v}"
+                        for k, v in sorted(state.alerts_by_sev.items()))
+        )
+        lines.append("  rules: " + "  ".join(
+            f"{k}:{v}" for k, v in sorted(state.alerts_by_rule.items())))
+        for a in state.alerts_recent:
+            # ONE alert-line spelling, shared with `mctpu health`
+            # (obs.alerts.format_alert — jax-free like this module).
+            lines.append("  " + format_alert(a))
     if state.faults:
         lines.append("")
         lines.append("FAULTS  " + "  ".join(

@@ -48,16 +48,25 @@ def _fault_plan_arg(surface: str):
 
 def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
                   out_min: int, out_max: int, rate: float, seed: int,
-                  deadline_s: float = 0.0):
+                  deadline_s: float = 0.0, tenants: int = 0):
     """n seeded requests: uniform prompt/output lengths in the given
     ranges, Poisson arrivals at `rate` req/s (exponential gaps; rate 0
     = everything arrives at t=0). deadline_s > 0 gives every request an
     absolute deadline of arrival + deadline_s. Regenerating with the
     same seed gives an identical workload — the cross-mode comparison
-    contract."""
+    contract.
+
+    tenants > 0 tags each request with a seeded tenant draw over
+    "t0".."t{tenants-1}" (ISSUE 8's multi-tenant traffic mix). The
+    labels come from a SEPARATE generator ((seed, 1) spawn), so the
+    prompt/length/arrival stream is bitwise-identical with tagging on
+    or off — committed baselines and every pinned tick count stay
+    valid, and the same seed always maps request i to the same tenant.
+    """
     from .scheduler import Request
 
     rng = np.random.default_rng(seed)
+    trng = np.random.default_rng([seed, 1])
     t = 0.0
     reqs = []
     for i in range(n):
@@ -66,10 +75,12 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
         plen = int(rng.integers(prompt_min, prompt_max + 1))
         olen = int(rng.integers(out_min, out_max + 1))
         prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
+        tenant = (f"t{int(trng.integers(0, tenants))}" if tenants > 0
+                  else None)
         reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=olen,
                             arrival=t,
                             deadline=t + deadline_s if deadline_s > 0
-                            else None))
+                            else None, tenant=tenant))
     return reqs
 
 
@@ -127,6 +138,15 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "slow@serve.tick:9?s=0.2' (faults.parse_plan; "
                          "sites checked against serve-bench's hook "
                          "points at parse time)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="tag requests with a seeded tenant mix over "
+                         "t0..t{N-1} (0 = untagged single-tenant; the "
+                         "SLO layer buckets by tenant)")
+    ap.add_argument("--slo", default=None,
+                    help="SLO spec JSON (obs.slo grammar): run the "
+                         "streaming alert engine live on the record "
+                         "stream; fired alerts land in the JSONL as "
+                         "`alert` events")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-jsonl", default=None,
                     help="append per-request obs records here")
@@ -171,14 +191,29 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         n=args.requests, vocab=args.vocab, prompt_min=args.prompt_min,
         prompt_max=args.prompt_max, out_min=args.out_min,
         out_max=args.out_max, rate=args.rate, seed=args.seed,
-        deadline_s=args.deadline_ms / 1e3,
+        deadline_s=args.deadline_ms / 1e3, tenants=args.tenants,
     )
     run_kw = dict(
         max_queue=args.max_queue or None,
         watchdog_s=args.watchdog_ms / 1e3,
     )
+    alert_engine = None
+    if args.slo:
+        from ..obs.alerts import AlertEngine
+        from ..obs.slo import SLOSpec
+
+        try:
+            alert_engine = AlertEngine(slo=SLOSpec.load(args.slo))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     summaries = {}
     with MetricsLogger(path=args.metrics_jsonl, echo=False) as metrics:
+        if alert_engine is not None:
+            # Live alerting folds EXACTLY the records the file gets
+            # (MetricsLogger observer): replaying the finished JSONL
+            # reproduces the identical alert sequence, CRC-pinned.
+            alert_engine.attach(metrics)
         # Warm both compiled programs (engine-level: the same two serve
         # every mode) on one throwaway request, so no mode pays
         # compilation inside its latencies.
@@ -200,7 +235,10 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
             # reconstructs lifecycles from the same records afterwards.
             registry = MetricsRegistry()
             tick_sink = None
-            if metrics.jsonl_enabled:
+            if metrics.jsonl_enabled or alert_engine is not None:
+                # Tick records route through metrics.log either way:
+                # the JSONL sink and the alert observer both hang off
+                # it (with no file open, log() is observer-only).
                 def tick_sink(rec, _snap_every=64):
                     metrics.log("tick", **rec)
                     if (rec["tick"] + 1) % _snap_every == 0:
@@ -225,6 +263,10 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
             print(json.dumps({"bench": "serve", "backend":
                               jax.default_backend(),
                               "cache_dtype": args.cache_dtype, **s}))
+    if alert_engine is not None:
+        print(json.dumps({"metric": "serve_alerts_fired",
+                          "value": len(alert_engine.alerts),
+                          "alerts_crc": alert_engine.crc}))
     if len(summaries) == 2:
         st, ct = summaries["static"], summaries["continuous"]
         print(json.dumps({
@@ -305,6 +347,17 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--sessions", type=int, default=0,
                     help="session keys for the affinity policy: request "
                          "i belongs to session i %% N (0 = sessionless)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="tag requests with a seeded tenant mix over "
+                         "t0..t{N-1} (0 = untagged single-tenant; the "
+                         "SLO layer buckets by tenant)")
+    ap.add_argument("--slo", default=None,
+                    help="SLO spec JSON (obs.slo grammar): run the "
+                         "streaming alert engine live; with --log "
+                         "summary the engine taps the per-tick sinks "
+                         "directly (the records stay out of the JSONL, "
+                         "the alerts land in it). Summary gains "
+                         "alerts_fired/alerts_crc either way")
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     help="per-request fleet-clock deadline (0 = none)")
     ap.add_argument("--seed", type=int, default=0)
@@ -385,14 +438,31 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             prompt_max=args.prompt_max, out_min=args.out_min,
             out_max=args.out_max, rate=args.rate, seed=args.seed,
             sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
+            tenants=args.tenants,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    alert_engine = None
+    if args.slo:
+        from ..obs.alerts import AlertEngine
+        from ..obs.slo import SLOSpec
+
+        try:
+            alert_engine = AlertEngine(slo=SLOSpec.load(args.slo))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     clock = FakeClock()
     registry = MetricsRegistry(clock=clock)
     faults = FaultInjector(args.fault_plan) if args.fault_plan else None
     with MetricsLogger(path=args.metrics_jsonl, echo=False) as metrics:
+        if alert_engine is not None:
+            # Everything that goes through metrics.log (registry
+            # snapshots, replica/fault/request/serve records — and, at
+            # --log full, the tick/fleet stream) is folded live; the
+            # fired alerts are logged straight back as `alert` events.
+            alert_engine.attach(metrics)
         fleet_sink = replica_tick_sink = None
         if metrics.jsonl_enabled and args.log == "full":
             def fleet_sink(rec):
@@ -400,6 +470,20 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
 
             def replica_tick_sink(rec):
                 metrics.log("tick", **rec)
+        elif alert_engine is not None:
+            # Summary mode keeps per-tick records OUT of the JSONL (at
+            # 10^5 requests they would dominate the run) but the live
+            # rule engine still sees them: tap the sinks directly.
+            # Replay-from-file cannot reproduce these alerts — that
+            # contract needs --log full; the determinism CI instead
+            # pins alerts_crc across two identical-seed runs.
+            def fleet_sink(rec):
+                for a in alert_engine.ingest(rec, event="fleet"):
+                    metrics.log("alert", **a)
+
+            def replica_tick_sink(rec):
+                for a in alert_engine.ingest(rec, event="tick"):
+                    metrics.log("alert", **a)
         try:
             fleet = Fleet(
                 compute_factory, replicas=args.replicas, slots=args.slots,
@@ -435,6 +519,20 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
         if metrics.jsonl_enabled and args.log == "full":
             for rec in result.request_records():
                 metrics.log("request", **rec)
+        # Alert totals are ALWAYS stamped (zero/empty-CRC without
+        # --slo): the fleet determinism gate lists them, and a gated
+        # metric must exist in every fleet-bench run. The stamp covers
+        # every alert fired BEFORE the summary record itself — a rule
+        # matching the `serve` event would fire after the stamp is
+        # frozen (its record still lands in the JSONL, and `mctpu
+        # health` judges the file, not this stamp). Identical-seed
+        # runs freeze identically, so the determinism gate holds.
+        from ..obs.alerts import alerts_crc
+
+        s["alerts_fired"] = (len(alert_engine.alerts)
+                             if alert_engine is not None else 0)
+        s["alerts_crc"] = (alert_engine.crc if alert_engine is not None
+                           else alerts_crc([]))
         metrics.log("serve", **{
             "bench": "fleet", "policy": args.policy,
             "redispatch": args.redispatch,

@@ -54,7 +54,13 @@ from .paged_cache import (
     init_paged_cache,
     paged_forward,
 )
-from .scheduler import ContinuousScheduler, Request, StaticScheduler
+from .scheduler import (
+    ContinuousScheduler,
+    Request,
+    StaticScheduler,
+    tenant_block,
+    terminal_fields,
+)
 
 
 def request_record(r: Request, mode: str) -> dict:
@@ -67,6 +73,7 @@ def request_record(r: Request, mode: str) -> dict:
         "id": r.rid,
         "mode": mode,
         "status": r.status,
+        "tenant": r.tenant or "default",
         "prompt_tokens": int(r.prompt.size),
         "output_tokens": len(r.out),
         "ttft_ms": (None if r.first_token_at is None
@@ -161,6 +168,10 @@ class ServeResult:
             "ttft_p99_ms": pct_nearest(ttft, 99),
             "tpot_p50_ms": pct_nearest(tpot, 50),
             "tpot_p99_ms": pct_nearest(tpot, 99),
+            # Per-tenant status/latency counts (ISSUE 8): the summary
+            # keys `mctpu compare` flattens as serve.<mode>.tenant.<t>.*
+            # and `mctpu health` falls back to on summary-only logs.
+            "tenants": tenant_block(self.requests),
         }
 
 
@@ -170,18 +181,26 @@ def _observe_request(registry, r: Request) -> None:
     ServeResult.ttft_ms/tpot_ms, so the registry's percentiles and the
     summary's can never disagree on the same run). Null moments —
     aborted before admission or before the first token — are skipped,
-    the serving null convention."""
-    registry.inc(f"serve.requests_{r.status}")
-    if r.admitted_at is not None:
-        registry.observe("serve.queue_wait_ms",
-                         1e3 * (r.admitted_at - r.arrival))
-    if r.status != "finished":
-        return
-    registry.observe("serve.ttft_ms", 1e3 * (r.first_token_at - r.arrival))
-    registry.observe(
-        "serve.tpot_ms",
-        1e3 * (r.finished_at - r.first_token_at) / max(len(r.out) - 1, 1),
-    )
+    the serving null convention. A TAGGED tenant (ISSUE 8) additionally
+    lands in `serve.tenant.<name>.*` twins of every metric, which is
+    what `mctpu health` reads off a summary-only run; untagged requests
+    stay global-only (a single-tenant run must not pay double)."""
+    prefixes = ["serve."]
+    if r.tenant is not None:
+        prefixes.append(f"serve.tenant.{r.tenant}.")
+    for p in prefixes:
+        registry.inc(f"{p}requests_{r.status}")
+        if r.admitted_at is not None:
+            registry.observe(f"{p}queue_wait_ms",
+                             1e3 * (r.admitted_at - r.arrival))
+        if r.status != "finished":
+            continue
+        registry.observe(f"{p}ttft_ms",
+                         1e3 * (r.first_token_at - r.arrival))
+        registry.observe(
+            f"{p}tpot_ms",
+            1e3 * (r.finished_at - r.first_token_at) / max(len(r.out) - 1, 1),
+        )
 
 
 class PagedEngine:
@@ -500,6 +519,11 @@ class PagedEngine:
                 "finished": [r.rid for r in new_fin],
                 "aborted": [[r.rid, r.status] for r in new_drop],
                 "preempted": preempted,
+                # Terminal detail (ISSUE 8): tenant + latency per request
+                # reaching a terminal status THIS tick — the streaming
+                # good/bad events the SLO burn-rate rules fold, emitted
+                # when they happen instead of at end of run.
+                "terminal": [terminal_fields(r) for r in new_fin + new_drop],
             }
             if tick_sink is not None:
                 tick_sink(tick_rec)

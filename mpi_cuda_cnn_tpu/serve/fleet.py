@@ -61,7 +61,13 @@ from ..faults import FakeClock
 from ..obs.metrics import MetricsRegistry
 from .paged_cache import PagePool
 from .router import CircuitOpen, Router
-from .scheduler import ContinuousScheduler, Request, validate_request
+from .scheduler import (
+    ContinuousScheduler,
+    Request,
+    tenant_block,
+    terminal_fields,
+    validate_request,
+)
 
 __all__ = [
     "EngineCompute", "Fleet", "FleetResult", "Replica", "ReplicaCore",
@@ -359,6 +365,9 @@ class FleetResult:
             "restarts": self.restarts,
             "circuit_opens": self.circuit_opens,
             "trace_crc": self.trace_crc,
+            # Per-tenant status/latency counts (ISSUE 8) — same shape
+            # and flattening as ServeResult.summary's block.
+            "tenants": tenant_block(self.requests),
         }
 
 
@@ -466,11 +475,16 @@ class Fleet:
 
         return emit
 
-    def _sync_terminal(self, replica: Replica, locals_, now: float) -> int:
+    def _sync_terminal(self, replica: Replica, locals_,
+                       now: float) -> list[Request]:
         """Apply a replica's newly terminal local requests to the
         authoritative records — through the fence, so a zombie's
-        terminal claims are refused like its tokens."""
-        done = 0
+        terminal claims are refused like its tokens. Returns the
+        authoritative requests that became terminal by THIS call (the
+        fence-accepted set): the caller counts them toward run
+        completion and folds them into the tick's `terminal` entries
+        for the streaming SLO layer (ISSUE 8)."""
+        synced: list[Request] = []
         if self.registry is not None:
             # Lazy: the sim path stays jax-free (engine imports jax).
             from .engine import _observe_request
@@ -493,8 +507,8 @@ class Fleet:
             # dead incarnation's whole PagedEngine cache would otherwise
             # stay pinned for the rest of the run via finished rids.
             self._holder.pop(local.rid, None)
-            done += 1
-        return done
+            synced.append(auth)
+        return synced
 
     # -- dispatch ------------------------------------------------------
 
@@ -509,7 +523,7 @@ class Fleet:
         local = Request(rid=req.rid, prompt=req.prompt,
                         max_new_tokens=req.max_new_tokens,
                         arrival=req.arrival, deadline=req.deadline,
-                        session=req.session)
+                        session=req.session, tenant=req.tenant)
         local.out = list(req.out)
         # A request that was ever admitted keeps that mark across
         # failover (even under discard, which regenerates the tokens):
@@ -754,9 +768,15 @@ class Fleet:
                     continue
                 rec, new_fin, new_drop = rep.step(now)
                 self.router.beat(member.name, tick)
-                n_done += self._sync_terminal(rep, new_fin + new_drop, now)
+                synced = self._sync_terminal(rep, new_fin + new_drop, now)
+                n_done += len(synced)
                 any_work = any_work or rec["progressed"] or rep.core.unfinished
                 if self.replica_tick_sink is not None:
+                    # `terminal` carries the FENCE-ACCEPTED set (the
+                    # authoritative requests), not the replica-local
+                    # claims: a zombie's post-failover "finished" must
+                    # not count as a good SLO event when the commit was
+                    # refused (ISSUE 8).
                     self.replica_tick_sink({
                         "tick": tick, "now": round(now, 4),
                         "mode": f"fleet/{member.name}",
@@ -764,6 +784,7 @@ class Fleet:
                            ("queue", "running", "free_pages", "admitted",
                             "prefill", "decoded", "preempted", "finished",
                             "aborted")},
+                        "terminal": [terminal_fields(r) for r in synced],
                     })
             for rep in list(self._zombies):
                 if tick >= rep.zombie_until:
@@ -774,7 +795,8 @@ class Fleet:
                 # before failover revokes its fences the zombie's
                 # completions are authoritative commits and must count
                 # toward n_done; after revocation they are discarded.
-                n_done += self._sync_terminal(rep, new_fin + new_drop, now)
+                synced = self._sync_terminal(rep, new_fin + new_drop, now)
+                n_done += len(synced)
                 # Pre-failover the zombie is still a member and its
                 # commits still land — its tick telemetry is part of
                 # the same in-flight drain, and `mctpu trace` needs it
@@ -791,6 +813,7 @@ class Fleet:
                            ("queue", "running", "free_pages", "admitted",
                             "prefill", "decoded", "preempted", "finished",
                             "aborted")},
+                        "terminal": [terminal_fields(r) for r in synced],
                     })
             if self.registry is not None:
                 self.registry.set("fleet.replicas",
@@ -824,6 +847,7 @@ class Fleet:
                     # Nothing can ever serve again — future arrivals
                     # included (waiting for one would spin forever: it
                     # arrives, no member can take it, repeat).
+                    failed_now = []
                     for req in list(pending) + list(redispatch_q):
                         if req.terminal:
                             continue
@@ -835,8 +859,35 @@ class Fleet:
                         req.finished_at = max(now, req.arrival)
                         self._holder.pop(req.rid, None)
                         n_done += 1
+                        failed_now.append(req)
                     pending.clear()
                     redispatch_q.clear()
+                    if failed_now and self.registry is not None:
+                        # A total outage is the SLO event that matters
+                        # most: these terminals must reach the same
+                        # registry twins every fenced completion does.
+                        from .engine import _observe_request
+                        for req in failed_now:
+                            _observe_request(self.registry, req)
+                    if failed_now and self.replica_tick_sink is not None:
+                        # One router-attributed tick record carries the
+                        # mass failure into the trail: the burn-rate
+                        # rules fold its `terminal` entries (a fleet
+                        # that died with work outstanding must page),
+                        # and `mctpu trace` sees the aborted rids so
+                        # the lifecycles stay consistent with the
+                        # request records.
+                        self.replica_tick_sink({
+                            "tick": tick, "now": round(now, 4),
+                            "mode": "fleet/router",
+                            "queue": 0, "running": 0, "free_pages": 0,
+                            "admitted": [], "prefill": None,
+                            "decoded": [], "preempted": [], "finished": [],
+                            "aborted": [[r.rid, r.status]
+                                        for r in failed_now],
+                            "terminal": [terminal_fields(r)
+                                         for r in failed_now],
+                        })
                     continue
                 targets = [pending[0].arrival] if pending else []
                 if self._pending_restarts:
@@ -881,16 +932,18 @@ class Fleet:
 def make_fleet_workload(*, n: int, vocab: int, prompt_min: int,
                         prompt_max: int, out_min: int, out_max: int,
                         rate: float, seed: int, sessions: int = 0,
-                        deadline_s: float = 0.0) -> list[Request]:
+                        deadline_s: float = 0.0,
+                        tenants: int = 0) -> list[Request]:
     """The serve-bench workload generator plus session keys: request i
     belongs to session i % sessions (0 = sessionless), so the
-    session-affinity policy has stable keys to rendezvous-hash."""
+    session-affinity policy has stable keys to rendezvous-hash.
+    `tenants` passes through to make_workload's seeded tenant mix."""
     from .bench import make_workload
 
     reqs = make_workload(n=n, vocab=vocab, prompt_min=prompt_min,
                          prompt_max=prompt_max, out_min=out_min,
                          out_max=out_max, rate=rate, seed=seed,
-                         deadline_s=deadline_s)
+                         deadline_s=deadline_s, tenants=tenants)
     if sessions > 0:
         for r in reqs:
             r.session = r.rid % sessions

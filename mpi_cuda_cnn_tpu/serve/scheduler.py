@@ -76,6 +76,61 @@ def validate_request(r: Request, *, max_len: int, page_size: int,
 TERMINAL_STATUSES = ("finished", "expired", "cancelled", "rejected", "failed")
 
 
+def terminal_fields(r: Request) -> dict:
+    """One terminal request as the compact per-tick `terminal` entry
+    (ISSUE 8): what the streaming SLO/alert layer folds good/bad events
+    from, emitted INSIDE the run (the end-of-run `request` records are
+    too late for a burn-rate alert to be actionable). Latency formulas
+    match engine.request_record exactly — the two views of one request
+    can never disagree. jax-free on purpose: the fleet's sim path and
+    the alert engine consume this without importing the engine."""
+    return {
+        "id": r.rid,
+        "tenant": r.tenant or "default",
+        "status": r.status,
+        "ttft_ms": (None if r.first_token_at is None
+                    else round(1e3 * (r.first_token_at - r.arrival), 3)),
+        "tpot_ms": (None if r.status != "finished"
+                    else round(1e3 * (r.finished_at - r.first_token_at)
+                               / max(len(r.out) - 1, 1), 3)),
+        "queue_wait_ms": (None if r.admitted_at is None
+                          else round(1e3 * (r.admitted_at - r.arrival), 3)),
+    }
+
+
+def tenant_block(requests: Iterable[Request]) -> dict[str, dict]:
+    """Per-tenant status/latency counts for a run summary (ISSUE 8),
+    shared by ServeResult.summary and FleetResult.summary so the two
+    surfaces flatten identically in `mctpu compare`. Untagged requests
+    aggregate under "default". Percentiles follow the one serving
+    convention (obs.report.pct_nearest, imported lazily — this module
+    stays jax-free for the fleet's sim path)."""
+    from ..obs.report import pct_nearest
+
+    by_tenant: dict[str, list[Request]] = {}
+    for r in requests:
+        by_tenant.setdefault(r.tenant or "default", []).append(r)
+    out: dict[str, dict] = {}
+    for tenant, rs in sorted(by_tenant.items()):
+        statuses: dict[str, int] = {}
+        for r in rs:
+            statuses[r.status] = statuses.get(r.status, 0) + 1
+        fin = [r for r in rs if r.status == "finished"]
+        ttft = [1e3 * (r.first_token_at - r.arrival) for r in fin]
+        tpot = [1e3 * (r.finished_at - r.first_token_at)
+                / max(len(r.out) - 1, 1) for r in fin]
+        out[tenant] = {
+            "requests": len(rs),
+            "statuses": statuses,
+            "output_tokens": sum(len(r.out) for r in rs),
+            "ttft_p50_ms": pct_nearest(ttft, 50),
+            "ttft_p99_ms": pct_nearest(ttft, 99),
+            "tpot_p50_ms": pct_nearest(tpot, 50),
+            "tpot_p99_ms": pct_nearest(tpot, 99),
+        }
+    return out
+
+
 @dataclasses.dataclass
 class Request:
     """One serving request plus its runtime bookkeeping. `prompt` is a
@@ -86,7 +141,11 @@ class Request:
     `cancel()` requests client-side abort at the next tick boundary.
     `session` is an opaque affinity key (ISSUE 7): the fleet router's
     session-affinity policy keeps one session's requests on one replica
-    so its prefix cache stays hot; None means no affinity."""
+    so its prefix cache stays hot; None means no affinity. `tenant` is
+    the traffic-class identity (ISSUE 8): the SLO accounting layer
+    buckets good/bad events, latency histograms, and health verdicts by
+    it; None renders as "default" in every record and table — a
+    single-tenant run needs no tagging."""
 
     rid: int
     prompt: np.ndarray
@@ -94,6 +153,7 @@ class Request:
     arrival: float = 0.0
     deadline: float | None = None
     session: int | str | None = None
+    tenant: str | None = None
     out: list[int] = dataclasses.field(default_factory=list)
     status: str = "queued"
     fail_reason: str | None = None

@@ -856,6 +856,10 @@ class LMTrainer:
                                     "train.tokens_per_s",
                                     n * cfg.batch_size * cfg.seq_len / dt,
                                 )
+                                # Loss gauge (ISSUE 8): health/top read
+                                # it off `metrics` snapshots with its
+                                # min/max envelope.
+                                reg.set("train.loss", loss)
                                 reg.emit(self.metrics, step=step + 1)
                             last_t, last_step = now, step + 1
                             last_exc = timer.excluded_s

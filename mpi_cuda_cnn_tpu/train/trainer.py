@@ -687,6 +687,11 @@ class Trainer:
                         etotal=float(running["etotal"]) / ngood,
                         acc=float(running["acc"]) / ngood,
                     )
+                    # Loss as a registry gauge (ISSUE 8): health/top
+                    # read it off `metrics` snapshots, with the min/max
+                    # envelope the train record alone cannot carry.
+                    self.registry.set("train.loss",
+                                      float(running["loss"]) / ngood)
             with timer.phase("checkpoint"):
                 self._maybe_step_checkpoint(gstep + 1)
             self._step_boundary(gstep + 1)
@@ -888,6 +893,9 @@ class Trainer:
                     etotal=float(totals["etotal"]) / run,
                     acc=float(totals["acc"]) / run,
                 )
+                # Same gauge as the loop path (ISSUE 8).
+                self.registry.set("train.loss",
+                                  float(totals["loss"]) / run)
             with timer.phase("checkpoint"):
                 self._maybe_step_checkpoint(epoch * nsteps + done)
             # Scanned epochs advance chunk-by-chunk: crash/preempt

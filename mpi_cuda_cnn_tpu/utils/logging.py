@@ -70,6 +70,15 @@ class MetricsLogger:
         # In-memory record list, opt-in (unbounded — long-lived trainers
         # should leave it off and use the JSONL sink).
         self.rows: list[dict] | None = [] if capture else None
+        # Streaming observer (ISSUE 8): called with every record as it
+        # is logged — the obs.alerts.AlertEngine attaches here, so the
+        # live rule engine folds EXACTLY the records the file receives
+        # (which is what makes replaying the finished file reproduce
+        # the identical alert sequence). The observer may itself call
+        # log() (alerts are logged back through the same sink); a
+        # reentrant observer call sees the alert record and must ignore
+        # it, which AlertEngine.ingest does.
+        self.observer = None
 
     @property
     def jsonl_enabled(self) -> bool:
@@ -94,6 +103,8 @@ class MetricsLogger:
         if self._echo:
             body = " ".join(f"{k}={_fmt(v)}" for k, v in fields.items())
             self._log.info("%s %s", event, body)
+        if self.observer is not None:
+            self.observer(record)
 
     def close(self) -> None:
         if self._file:

@@ -7,13 +7,18 @@ the golden renderings tests/test_obs_runtime.py pins byte-for-byte:
 
     tests/data/golden_serve_report.md   (`mctpu report` output)
     tests/data/golden_serve_trace.md    (`mctpu trace` output)
+    tests/data/golden_serve_health.md   (`mctpu health` output, ISSUE 8)
 
 The workload is chosen for lifecycle diversity: a page pool far smaller
 than the worst case forces preemption/requeue cycles, an injected
 `slow` fault plus short deadlines expires one request mid-run, and
 Poisson arrivals stagger admissions — so the goldens exercise queued /
 prefill / decode / preempted / expired segments, not just the happy
-path. Rerun after any deliberate schema or rendering change:
+path. ISSUE 8 adds a two-tenant seeded mix and a live alert engine
+over a deliberately tight SLO spec (tests/data/sample_slo.json), so
+the sample carries `alert` events whose replay-equality and CRC the
+round-trip tests pin, and the health golden shows violated AND met
+objectives. Rerun after any deliberate schema or rendering change:
 
     JAX_PLATFORMS=cpu python scripts/make_obs_sample.py
 """
@@ -22,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import io
+import json
 import os
 import sys
 from pathlib import Path
@@ -31,14 +37,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REPO = Path(__file__).resolve().parents[1]
 DATA = REPO / "tests" / "data"
 
+# The sample's SLO spec: thresholds tight enough that the injected
+# slow faults push SOME events bad (burn-rate + staleness alerts and a
+# mixed health table), loose enough that others stay good.
+SAMPLE_SLO = {
+    "_doc": ["SLO spec for the checked-in sample run (make_obs_sample)."],
+    "tenants": {"*": {"availability": 0.9,
+                      "ttft_ms": {"target": 0.9, "threshold_ms": 200.0}}},
+    "burn": {"windows_s": [[0.5, 0.1]], "max_rate": 2.0},
+    "rules": [{"name": "tick-stale", "kind": "absence", "event": "tick",
+               "max_gap_s": 0.1}],
+    "max_alerts": 0,
+}
+
 
 def build_records():
     import jax
 
     from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
     from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.obs.alerts import AlertEngine
     from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
     from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
+    from mpi_cuda_cnn_tpu.obs.slo import SLOSpec
     from mpi_cuda_cnn_tpu.serve.bench import make_workload
     from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
 
@@ -47,19 +68,30 @@ def build_records():
     engine = PagedEngine(model, params, slots=3, num_pages=10, page_size=4,
                          prefill_chunk=8, max_len=40)
     records: list[dict] = []
+    # ONE alert engine across both modes, fed every record in file
+    # order — exactly what a replay of the finished file folds, so the
+    # golden's alert records satisfy the live==replay contract (the
+    # round-trip test re-derives them and compares CRCs).
+    alerts = AlertEngine(slo=SLOSpec.from_dict(SAMPLE_SLO))
+
+    def emit(rec: dict, clock) -> None:
+        records.append(validate_record(rec))
+        for a in alerts.ingest(rec):
+            records.append(validate_record(
+                make_record("alert", clock.now, **a)))
+
     for mode in ("static", "continuous"):
         clock = FakeClock()
         registry = MetricsRegistry(clock=clock)
 
         def sink(rec, clock=clock, registry=registry):
-            records.append(validate_record(
-                make_record("tick", clock.now, **rec)))
+            emit(make_record("tick", clock.now, **rec), clock)
             if (rec["tick"] + 1) % 32 == 0:
-                records.append(registry.snapshot(mode=rec["mode"]))
+                emit(registry.snapshot(mode=rec["mode"]), clock)
 
         reqs = make_workload(n=8, vocab=13, prompt_min=4, prompt_max=8,
                              out_min=6, out_max=18, rate=40.0, seed=5,
-                             deadline_s=0.35)
+                             deadline_s=0.3, tenants=2)
         # Under a FakeClock, in-engine service is instantaneous (the
         # clock only advances on idle waits), so deadlines would be
         # all-or-nothing; the staggered slow faults ratchet the clock
@@ -73,21 +105,21 @@ def build_records():
                          registry=registry, tick_sink=sink)
         s = res.summary()
         registry.set("serve.tokens_per_s", s["tokens_per_s"])
-        records.append(registry.snapshot(mode=mode, final=True))
+        emit(registry.snapshot(mode=mode, final=True), clock)
         for rec in res.request_records():
-            records.append(validate_record(
-                make_record("request", clock.now, **rec)))
+            emit(make_record("request", clock.now, **rec), clock)
         for ev in res.events:
-            records.append(validate_record(
-                make_record("fault", clock.now, **{"mode": mode, **ev})))
-        records.append(validate_record(
-            make_record("serve", clock.now, bench="serve", **s)))
+            emit(make_record("fault", clock.now, **{"mode": mode, **ev}),
+                 clock)
+        emit(make_record("serve", clock.now, bench="serve", **s), clock)
         print(f"{mode}: statuses={s['statuses']} "
               f"preemptions={s['preemptions']} ticks={s['decode_ticks']}")
+    print(f"alerts: {len(alerts.alerts)} fired, crc={alerts.crc}")
     return records
 
 
 def main() -> int:
+    from mpi_cuda_cnn_tpu.obs.health import health_main
     from mpi_cuda_cnn_tpu.obs.report import report_main
     from mpi_cuda_cnn_tpu.obs.schema import dump_records
     from mpi_cuda_cnn_tpu.obs.timeline import trace_main
@@ -96,22 +128,30 @@ def main() -> int:
     run = DATA / "sample_serve_run.jsonl"
     dump_records(build_records(), run)
     print(f"wrote {run}")
+    slo = DATA / "sample_slo.json"
+    slo.write_text(json.dumps(SAMPLE_SLO, indent=2) + "\n")
+    print(f"wrote {slo}")
 
     # Render with the repo-relative path (and from the repo root) so
     # the golden titles are machine-independent — the round-trip test
-    # invokes the renderers the same way.
+    # invokes the renderers the same way. `health` exits 1 BY DESIGN:
+    # the sample's tight SLO is violated (that is what makes the golden
+    # show both verdicts); the round-trip test pins that exit code too.
     os.chdir(REPO)
     rel = str(run.relative_to(REPO))
-    for golden, fn, argv in (
-        ("golden_serve_report.md", report_main, [rel]),
-        ("golden_serve_trace.md", trace_main, [rel, "--width", "80"]),
+    for golden, fn, argv, want_rc in (
+        ("golden_serve_report.md", report_main, [rel], 0),
+        ("golden_serve_trace.md", trace_main, [rel, "--width", "80"], 0),
+        ("golden_serve_health.md", health_main,
+         [rel, "--slo", str(slo.relative_to(REPO)), "--verify-alerts"], 1),
     ):
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
             rc = fn(argv)
-        if rc != 0:
-            print(f"error: {golden} renderer exited {rc}", file=sys.stderr)
-            return rc
+        if rc != want_rc:
+            print(f"error: {golden} renderer exited {rc} (want {want_rc})",
+                  file=sys.stderr)
+            return rc or 1
         (DATA / golden).write_text(buf.getvalue())
         print(f"wrote {DATA / golden}")
     return 0

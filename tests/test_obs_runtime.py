@@ -456,11 +456,18 @@ def test_sample_run_is_schema_pinned():
     schema/event-family drift fails here first, loudly."""
     records = load_records(DATA / "sample_serve_run.jsonl", strict=True)
     assert {r["event"] for r in records} == \
-        {"tick", "metrics", "request", "fault", "serve"}
+        {"tick", "metrics", "request", "fault", "serve", "alert"}
     # The diversity the goldens depend on: preemptions AND expiries.
     assert any(r["event"] == "tick" and r["preempted"] for r in records)
     assert any(r["event"] == "request" and r.get("status") == "expired"
                for r in records)
+    # ISSUE 8's additions: a tenant mix, per-tick terminal detail, and
+    # a live alert trail with both staleness and burn-rate kinds.
+    assert {r.get("tenant") for r in records
+            if r["event"] == "request"} == {"t0", "t1"}
+    assert any(r["event"] == "tick" and r.get("terminal") for r in records)
+    assert {r["kind"] for r in records if r["event"] == "alert"} == \
+        {"absence", "burn_rate"}
 
 
 def test_golden_report_roundtrip(monkeypatch, capsys):
@@ -482,6 +489,29 @@ def test_golden_trace_roundtrip(monkeypatch, capsys):
         (DATA / "golden_serve_trace.md").read_text()
 
 
+def test_golden_health_roundtrip(monkeypatch, capsys):
+    """`mctpu health` on the sample run is byte-for-byte the golden —
+    and exits 1: the sample's SLO spec is violated BY DESIGN (the
+    golden must show both ok and VIOLATED verdicts)."""
+    from mpi_cuda_cnn_tpu.obs.health import health_main
+
+    monkeypatch.chdir(REPO)
+    assert health_main(["tests/data/sample_serve_run.jsonl",
+                        "--slo", "tests/data/sample_slo.json",
+                        "--verify-alerts"]) == 1
+    assert capsys.readouterr().out == \
+        (DATA / "golden_serve_health.md").read_text()
+
+
+def test_trace_tenant_filter(monkeypatch, capsys):
+    """--tenant restricts the request table to one tenant's rows."""
+    monkeypatch.chdir(REPO)
+    assert trace_main(["tests/data/sample_serve_run.jsonl",
+                       "--tenant", "t1", "--mode", "continuous"]) == 0
+    out = capsys.readouterr().out
+    assert "| t1 |" in out and "| t0 |" not in out
+
+
 # ------------------------------------------------------- mctpu top
 
 
@@ -490,6 +520,8 @@ def test_top_once_frame_renders_engine_and_counts(capsys):
     out = capsys.readouterr().out
     assert "ENGINE [continuous]" in out and "ENGINE [static]" in out
     assert "ttft" in out and "tok/s" in out
+    # ALERTS panel (ISSUE 8): the sample's live alert trail renders.
+    assert "ALERTS" in out and "tick-stale" in out
     assert "\x1b" not in out  # --once is pipe/CI safe: no ANSI codes
 
 

@@ -1,0 +1,514 @@
+"""Multi-tenant SLO accounting + streaming alerts + health (ISSUE 8).
+
+THE acceptance tests live here:
+- running the alert engine LIVE (MetricsLogger observer / tick sinks)
+  during a seeded FakeClock serve run and replaying the finished JSONL
+  produce the bitwise-identical alert sequence (CRC-pinned);
+- two identical-seed fleet-storm runs produce identical `mctpu health`
+  verdict tables;
+- a seeded run with an injected slow / squeeze / replica_crash fault
+  plan fires the expected burn-rate / staleness alerts (pinned by kind
+  and tick) while the clean twin fires none.
+"""
+
+import json
+
+import pytest
+
+import jax
+
+from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.obs.alerts import AlertEngine, alerts_crc
+from mpi_cuda_cnn_tpu.obs.health import health_main
+from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
+from mpi_cuda_cnn_tpu.obs.regress import extract_metrics
+from mpi_cuda_cnn_tpu.obs.schema import load_records, make_record
+from mpi_cuda_cnn_tpu.obs.slo import (
+    Objective,
+    SLOSpec,
+    WindowedEvents,
+    budget_remaining,
+    collect_terminals,
+    verdicts_from_terminals,
+)
+from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main, make_workload
+from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+from mpi_cuda_cnn_tpu.utils.logging import MetricsLogger
+
+MODEL = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
+
+# The sample spec (tests/data/sample_slo.json's shape), inlined so unit
+# tests don't depend on the checked-in file.
+SPEC = {
+    "tenants": {"*": {"availability": 0.9,
+                      "ttft_ms": {"target": 0.9, "threshold_ms": 200.0}}},
+    "burn": {"windows_s": [[0.5, 0.1]], "max_rate": 2.0},
+    "rules": [{"name": "tick-stale", "kind": "absence", "event": "tick",
+               "max_gap_s": 0.1}],
+}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = MODEL.init(jax.random.key(0))
+    return PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                       prefill_chunk=8, max_len=40)
+
+
+def run_serve(engine, path, *, fault_plan=None, deadline_s=0.0,
+              spec=None, tenants=2):
+    """One seeded FakeClock serve run with the alert engine attached
+    live through the MetricsLogger observer; returns (engine, result)."""
+    clock = FakeClock()
+    ae = AlertEngine(slo=SLOSpec.from_dict(spec or SPEC))
+    with MetricsLogger(path=path, echo=False, clock=clock) as metrics:
+        ae.attach(metrics)
+        registry = MetricsRegistry(clock=clock)
+
+        def sink(rec):
+            metrics.log("tick", **rec)
+
+        reqs = make_workload(n=8, vocab=13, prompt_min=4, prompt_max=8,
+                             out_min=6, out_max=18, rate=40.0, seed=5,
+                             deadline_s=deadline_s, tenants=tenants)
+        faults = FaultInjector(fault_plan, clock=clock) if fault_plan \
+            else None
+        res = engine.run(reqs, mode="continuous", time_fn=clock,
+                         sleep_fn=clock.advance, faults=faults,
+                         registry=registry, tick_sink=sink)
+        for rec in res.request_records():
+            metrics.log("request", **rec)
+        metrics.log("serve", bench="serve", **res.summary())
+    return ae, res
+
+
+# ------------------------------------------------------ SLO math
+
+
+def test_objective_classify_and_budget_math():
+    avail = Objective("availability", 0.99)
+    lat = Objective("ttft_ms", 0.9, threshold_ms=100.0)
+    assert avail.classify({"status": "finished"}) is True
+    assert avail.classify({"status": "expired"}) is False
+    assert avail.classify({"status": "cancelled"}) is None  # client's call
+    assert lat.classify({"status": "finished", "ttft_ms": 99.0}) is True
+    assert lat.classify({"status": "finished", "ttft_ms": 101.0}) is False
+    # Failures are charged to availability, not double-charged here.
+    assert lat.classify({"status": "failed"}) is None
+    # Null-moment convention: a latency that was never measured (old
+    # record shapes) is not an event, never a bad one.
+    assert lat.classify({"status": "finished", "ttft_ms": None}) is None
+    # Budget: target 0.9 over 100 events allows 10 bad.
+    assert budget_remaining(95, 5, 0.9) == pytest.approx(0.5)
+    assert budget_remaining(90, 10, 0.9) == pytest.approx(0.0)
+    assert budget_remaining(80, 20, 0.9) == pytest.approx(-1.0)
+    assert budget_remaining(0, 0, 0.9) is None
+    with pytest.raises(ValueError):
+        Objective("availability", 1.0)  # target must leave a budget
+    with pytest.raises(ValueError):
+        Objective("ttft_ms", 0.9)  # latency objective needs a threshold
+    with pytest.raises(ValueError):
+        Objective("latency_p99", 0.9, threshold_ms=1.0)  # unknown metric
+
+
+def test_windowed_burn_rate_hand_computed():
+    we = WindowedEvents([[10.0, 2.0]])
+    target = 0.9  # budget = 10% bad
+    for i in range(8):
+        we.observe(float(i), True, target)
+    # 2 bad of 10 in the 10s window -> bad_frac 0.2 -> burn 2.0.
+    we.observe(8.0, False, target)
+    we.observe(9.0, False, target)
+    assert we.burn_rate(10.0, target) == pytest.approx(2.0)
+    # Short window (2s, events at t>7]: 2 bad of 2 -> burn 10.
+    assert we.burn_rate(2.0, target) == pytest.approx(10.0)
+    assert we.worst_burn() == pytest.approx(10.0)
+    # Time passes, bad events leave the short window.
+    we.observe(12.0, True, target)
+    assert we.burn_rate(2.0, target) == pytest.approx(0.0)
+    assert we.good == 9 and we.bad == 2
+
+
+def test_spec_parse_wildcard_and_errors():
+    spec = SLOSpec.from_dict(SPEC)
+    assert [o.metric for o in spec.objectives("anyone")] == \
+        ["availability", "ttft_ms"]
+    named = SLOSpec.from_dict({
+        "tenants": {"*": {"availability": 0.9},
+                    "vip": {"availability": 0.999}}})
+    assert named.objectives("vip")[0].target == 0.999
+    assert named.objectives("other")[0].target == 0.9
+    with pytest.raises(ValueError):
+        SLOSpec.from_dict({})  # no tenants
+    with pytest.raises(ValueError):
+        SLOSpec.from_dict({"tenants": {"*": {"availability": 0.9}},
+                           "burn": {"windows_s": [[5, 10]]}})  # short>long
+    with pytest.raises(ValueError):
+        AlertEngine(rules=[{"name": "x", "kind": "burn_rate"}])
+    with pytest.raises(ValueError):
+        AlertEngine(rules=[{"name": "x", "kind": "nope"}])
+
+
+# ------------------------------------------------------ rule engine
+
+
+def tick(t, n, **kw):
+    return make_record("tick", t, tick=n, now=t, queue=kw.pop("queue", 0),
+                       free_pages=9, **kw)
+
+
+def test_threshold_rule_edge_trigger_and_each():
+    ae = AlertEngine(rules=[{"name": "q", "kind": "threshold",
+                             "event": "tick", "field": "queue", "op": ">",
+                             "value": 3, "for_count": 2}])
+    assert ae.ingest(tick(0.0, 0, queue=5)) == []          # streak 1
+    assert len(ae.ingest(tick(0.1, 1, queue=6))) == 1      # streak 2: fire
+    assert ae.ingest(tick(0.2, 2, queue=7)) == []          # still firing
+    assert ae.ingest(tick(0.3, 3, queue=1)) == []          # re-arm
+    assert ae.ingest(tick(0.4, 4, queue=9)) == []
+    assert len(ae.ingest(tick(0.5, 5, queue=9))) == 1      # fires again
+    each = AlertEngine(rules=[{"name": "crash", "kind": "threshold",
+                               "event": "replica", "field": "kind",
+                               "op": "==", "value": "crash", "each": True}])
+    rec = make_record("replica", 1.0, name="r1", kind="crash")
+    assert len(each.ingest(rec)) == 1
+    assert len(each.ingest(rec)) == 1  # discrete events: every match
+
+
+def test_rate_of_change_rule():
+    ae = AlertEngine(rules=[{"name": "loss-spike",
+                             "kind": "rate_of_change", "event": "train",
+                             "field": "loss", "max_rise_pct": 50.0}])
+    assert ae.ingest(make_record("train", 1.0, step=1, loss=1.0)) == []
+    assert ae.ingest(make_record("train", 2.0, step=2, loss=1.2)) == []
+    fired = ae.ingest(make_record("train", 3.0, step=3, loss=2.0))
+    assert len(fired) == 1 and fired[0]["delta_pct"] == pytest.approx(66.667)
+
+
+def test_absence_rule_fires_on_gap_and_rearms():
+    ae = AlertEngine(rules=[{"name": "stale", "kind": "absence",
+                             "event": "tick", "max_gap_s": 0.1}])
+    assert ae.ingest(tick(0.00, 0)) == []
+    assert ae.ingest(tick(0.05, 1)) == []
+    fired = ae.ingest(tick(0.30, 2))  # late tick proves the gap it ends
+    assert len(fired) == 1 and fired[0]["gap_s"] == pytest.approx(0.25)
+    assert ae.ingest(tick(0.35, 3)) == []  # re-armed, no gap
+    # Records without "now" never advance the staleness clock: end-of-
+    # run records on the logger timeline cannot fabricate a gap.
+    assert ae.ingest(make_record("serve", 99.0, mode="x", requests=1,
+                                 tokens_per_s=1.0)) == []
+
+
+# --------------------------------------- live == replay (acceptance)
+
+
+def test_alert_engine_live_vs_replay_bitwise(engine, tmp_path):
+    path = tmp_path / "run.jsonl"
+    ae, _ = run_serve(engine, path, deadline_s=0.3,
+                      fault_plan="slow@serve.tick:10?s=0.15;"
+                                 "slow@serve.tick:20?s=0.15;"
+                                 "slow@serve.tick:30?s=0.15")
+    assert ae.alerts, "the faulted run must fire alerts"
+    replay = AlertEngine(slo=SLOSpec.from_dict(SPEC))
+    replay.replay(load_records(path))
+    assert [dict(a) for a in replay.alerts] == [dict(a) for a in ae.alerts]
+    assert replay.crc == ae.crc
+    # The file's logged alert records ARE the live sequence.
+    logged = [r for r in load_records(path) if r["event"] == "alert"]
+    assert alerts_crc(logged) == ae.crc
+
+
+def test_faulted_run_fires_pinned_alerts_clean_run_none(engine, tmp_path):
+    clean_ae, _ = run_serve(engine, tmp_path / "clean.jsonl")
+    assert clean_ae.alerts == []
+
+    slow_ae, _ = run_serve(engine, tmp_path / "slow.jsonl",
+                           deadline_s=0.3,
+                           fault_plan="slow@serve.tick:10?s=0.15;"
+                                      "slow@serve.tick:20?s=0.15;"
+                                      "slow@serve.tick:30?s=0.15")
+    # Pinned by kind and tick: each slow fault lands the next tick 0.15s
+    # late (staleness), and the expiry/late TTFTs it causes push the
+    # burn windows over max_rate.
+    assert [(a["kind"], a["tick"]) for a in slow_ae.alerts] == [
+        ("absence", 10), ("absence", 20), ("burn_rate", 29),
+        ("absence", 30), ("burn_rate", 30),
+    ]
+    assert {a["rule"] for a in slow_ae.alerts if a["kind"] == "burn_rate"} \
+        == {"burn:t1:availability", "burn:t1:ttft_ms"}
+
+    # A squeeze starves the pool: deadline expiries burn availability.
+    sq_ae, sq_res = run_serve(engine, tmp_path / "squeeze.jsonl",
+                              deadline_s=0.05,
+                              fault_plan="squeeze@serve.tick:2"
+                                         "?pages=9&ticks=120")
+    assert sq_res.status_counts().get("expired", 0) > 0
+    assert any(a["kind"] == "burn_rate" for a in sq_ae.alerts)
+
+
+# -------------------------------------------- fleet storm (acceptance)
+
+
+FLEET_SLO = {
+    "tenants": {"*": {"availability": 0.99,
+                      "ttft_ms": {"target": 0.9, "threshold_ms": 60000}}},
+    "burn": {"windows_s": [[2.0, 0.25]], "max_rate": 10.0},
+    "rules": [{"name": "replica-stale", "kind": "absence", "event": "tick",
+               "per": "mode", "max_gap_s": 0.01}],
+    "max_alerts": 0,
+}
+
+
+def run_fleet(tmp_path, tag, *, fault_plan=None, log="full", slo=True):
+    spec_path = tmp_path / "slo.json"
+    spec_path.write_text(json.dumps(FLEET_SLO))
+    out = tmp_path / f"fleet_{tag}.jsonl"
+    argv = ["--replicas", "3", "--requests", "200", "--rate", "300",
+            "--seed", "0", "--tenants", "2", "--log", log,
+            "--metrics-jsonl", str(out)]
+    if slo:
+        argv += ["--slo", str(spec_path)]
+    if fault_plan:
+        argv += ["--fault-plan", fault_plan]
+    assert fleet_bench_main(argv) == 0
+    return out, spec_path
+
+
+def test_fleet_crash_fires_staleness_and_health_tables_identical(
+        tmp_path, capsys):
+    """Two identical-seed crash storms: identical health verdict
+    tables, and the dead replica's tick silence fires the staleness
+    rule while the crash-free twin fires nothing."""
+    plan = "replica_crash@fleet.tick:40?replica=1"
+    out_a, spec_path = run_fleet(tmp_path, "a", fault_plan=plan)
+    out_b, _ = run_fleet(tmp_path, "b", fault_plan=plan)
+    capsys.readouterr()
+
+    alerts_a = [r for r in load_records(out_a) if r["event"] == "alert"]
+    assert any(a["kind"] == "absence" and a.get("group") == "fleet/r1"
+               for a in alerts_a), "dead replica must trip staleness"
+
+    rc_a = health_main([str(out_a), "--slo", str(spec_path)])
+    table_a = capsys.readouterr().out.split("\n", 2)[2]  # drop the path line
+    rc_b = health_main([str(out_b), "--slo", str(spec_path)])
+    table_b = capsys.readouterr().out.split("\n", 2)[2]
+    assert table_a == table_b
+    # max_alerts 0 + the staleness alert -> both runs unhealthy, alike.
+    assert rc_a == rc_b == 1
+
+    clean, _ = run_fleet(tmp_path, "clean")
+    capsys.readouterr()
+    assert [r for r in load_records(clean) if r["event"] == "alert"] == []
+    assert health_main([str(clean), "--slo", str(spec_path)]) == 0
+    capsys.readouterr()
+
+
+def test_fleet_summary_mode_health_fallback_and_tenant_keys(
+        tmp_path, capsys):
+    """--log summary: no tick records land in the file, yet the summary
+    carries per-tenant blocks + alert totals, health falls back to the
+    histogram estimate, and `mctpu compare` sees flattened per-tenant
+    metric names."""
+    out, spec_path = run_fleet(tmp_path, "sum", log="summary")
+    capsys.readouterr()
+    records = load_records(out)
+    assert not any(r["event"] == "tick" for r in records)
+    serve = next(r for r in records if r["event"] == "serve")
+    assert serve["alerts_fired"] == 0
+    assert set(serve["tenants"]) == {"t0", "t1"}
+
+    assert health_main([str(out), "--slo", str(spec_path)]) == 0
+    out_text = capsys.readouterr().out
+    assert "[summary]" in out_text and "(est)" in out_text
+
+    m = extract_metrics(out)
+    assert "serve.fleet.tenant.t0.requests" in m
+    assert "serve.fleet.tenant.t1.status.finished" in m
+    assert "serve.fleet.alerts_crc" in m
+    assert m["serve.fleet.alerts_fired"] == 0
+    # Without --slo the totals still exist (gated metrics must exist in
+    # EVERY fleet-bench run), as zero/empty-CRC.
+    out2, _ = run_fleet(tmp_path, "noslo", log="summary", slo=False)
+    capsys.readouterr()
+    m2 = extract_metrics(out2)
+    assert m2["serve.fleet.alerts_fired"] == 0
+    assert m2["serve.fleet.alerts_crc"] == alerts_crc([])
+
+
+def test_total_outage_reaches_slo_layer(tmp_path):
+    """Every replica dead with work outstanding: the mass-failed
+    requests land in the registry twins, in a router-attributed tick's
+    `terminal` entries (so burn-rate rules and health see the outage),
+    and the availability verdict is violated."""
+    from mpi_cuda_cnn_tpu.serve.fleet import Fleet, SimCompute
+    from mpi_cuda_cnn_tpu.faults import FaultInjector
+
+    reqs = make_workload(n=20, vocab=64, prompt_min=4, prompt_max=8,
+                         out_min=4, out_max=8, rate=500.0, seed=3,
+                         tenants=2)
+    ticks = []
+    registry = MetricsRegistry(clock=FakeClock())
+    ae = AlertEngine(slo=SLOSpec.from_dict({
+        "tenants": {"*": {"availability": 0.9}},
+        "burn": {"windows_s": [[1.0, 0.1]], "max_rate": 2.0},
+    }))
+
+    def sink(rec):
+        ticks.append(rec)
+        ae.ingest(rec, event="tick")
+
+    fleet = Fleet(lambda name: SimCompute(vocab=64), replicas=1,
+                  slots=2, num_pages=17, page_size=4, max_len=16,
+                  max_flaps=0, heartbeat_miss=1, registry=registry,
+                  replica_tick_sink=sink,
+                  faults=FaultInjector("replica_crash@fleet.tick:2"))
+    res = fleet.run(reqs)
+    failed = [r for r in res.requests if r.status == "failed"]
+    assert failed, "the circuit-opened fleet must fail the remainder"
+    # Registry twins observed the outage.
+    assert registry.counters["serve.requests_failed"].value == len(failed)
+    # The router tick carries every mass-failed rid as a terminal entry.
+    router_terms = [t for rec in ticks if rec["mode"] == "fleet/router"
+                    for t in rec["terminal"]]
+    assert sorted(t["id"] for t in router_terms) == \
+        sorted(r.rid for r in failed)
+    # The live burn rule paged on the outage.
+    assert any(a["kind"] == "burn_rate" for a in ae.alerts)
+
+
+# ----------------------------------------------------- health verdicts
+
+
+def test_health_verdicts_exact_path_and_exit_codes(engine, tmp_path,
+                                                   capsys):
+    path = tmp_path / "run.jsonl"
+    run_serve(engine, path, deadline_s=0.3,
+              fault_plan="slow@serve.tick:10?s=0.15;"
+                         "slow@serve.tick:20?s=0.15;"
+                         "slow@serve.tick:30?s=0.15")
+    spec_path = tmp_path / "slo.json"
+    spec_path.write_text(json.dumps(SPEC))
+    assert health_main([str(path), "--slo", str(spec_path),
+                        "--verify-alerts", "--format", "json"]) == 1
+    ev = json.loads(capsys.readouterr().out)
+    assert ev["source"] == "events"
+    assert ev["alert_crc_ok"] is True
+    t1_avail = next(v for v in ev["verdicts"]
+                    if v["tenant"] == "t1" and v["metric"] == "availability")
+    assert t1_avail["violated"] and t1_avail["budget_left"] < 0
+    # A generous spec over the same file is healthy (alerts replay under
+    # ITS rules, which fire nothing; without --verify-alerts the live
+    # records from the tight spec are not held against it).
+    loose = {"tenants": {"*": {"availability": 0.5}}, "max_alerts": 0}
+    spec_path.write_text(json.dumps(loose))
+    assert health_main([str(path), "--slo", str(spec_path)]) == 0
+    capsys.readouterr()
+    # Tamper proof: drop one live alert record and the verified replay
+    # catches the drift (the trace-style cross-check, alert flavored).
+    records = load_records(path)
+    tampered = [r for r in records if not (r["event"] == "alert"
+                                           and r.get("seq") == 0)]
+    from mpi_cuda_cnn_tpu.obs.schema import dump_records
+
+    p3 = tmp_path / "tampered.jsonl"
+    dump_records(tampered, p3)
+    spec_path.write_text(json.dumps(SPEC))
+    assert health_main([str(p3), "--slo", str(spec_path),
+                        "--verify-alerts", "--format", "json"]) == 1
+    ev = json.loads(capsys.readouterr().out)
+    assert ev["alert_crc_ok"] is False
+    assert "alert_crc_mismatch" in ev["violations"]
+    # Config errors are exit 2, not a verdict.
+    spec_path.write_text("{}")
+    assert health_main([str(path), "--slo", str(spec_path)]) == 2
+    assert health_main([str(tmp_path / "missing.jsonl")]) == 2
+    capsys.readouterr()
+
+
+def test_health_train_rules(tmp_path, capsys):
+    """Loss spikes, restarts, and non-finite steps judge a training
+    stream; a clean trajectory is healthy."""
+    from mpi_cuda_cnn_tpu.obs.schema import dump_records
+
+    good = [make_record("train", float(i), step=i, loss=2.0 - 0.1 * i)
+            for i in range(10)]
+    p = tmp_path / "train_ok.jsonl"
+    dump_records(good, p)
+    assert health_main([str(p)]) == 0
+    capsys.readouterr()
+
+    bad = list(good)
+    bad.insert(5, make_record("train", 4.5, step=45, loss=9.0))
+    bad.append(make_record("fault", 10.0, kind="restart"))
+    bad.append(make_record("fault", 11.0, kind="nonfinite_step"))
+    p2 = tmp_path / "train_bad.jsonl"
+    dump_records(bad, p2)
+    assert health_main([str(p2)]) == 1
+    out = capsys.readouterr().out
+    assert "loss_spike" in out and "VIOLATED" in out
+    assert "restarts" in out and "nonfinite_steps" in out
+
+
+# -------------------------------------------------- tenant plumbing
+
+
+def test_tenant_mix_is_seeded_and_leaves_rng_stream_untouched():
+    base = make_workload(n=6, vocab=13, prompt_min=4, prompt_max=8,
+                         out_min=6, out_max=18, rate=40.0, seed=5)
+    tagged = make_workload(n=6, vocab=13, prompt_min=4, prompt_max=8,
+                           out_min=6, out_max=18, rate=40.0, seed=5,
+                           tenants=3)
+    # Tenant labels come from a separate generator: prompts, lengths,
+    # and arrivals are bitwise-identical with tagging on/off —
+    # committed baselines and pinned tick counts stay valid.
+    for a, b in zip(base, tagged):
+        assert a.arrival == b.arrival and a.max_new_tokens == b.max_new_tokens
+        assert (a.prompt == b.prompt).all()
+        assert a.tenant is None
+        assert b.tenant in ("t0", "t1", "t2")
+    again = make_workload(n=6, vocab=13, prompt_min=4, prompt_max=8,
+                          out_min=6, out_max=18, rate=40.0, seed=5,
+                          tenants=3)
+    assert [r.tenant for r in again] == [r.tenant for r in tagged]
+
+
+def test_per_tenant_registry_and_terminal_entries(engine, tmp_path):
+    path = tmp_path / "run.jsonl"
+    clock = FakeClock()
+    registry = MetricsRegistry(clock=clock)
+    reqs = make_workload(n=8, vocab=13, prompt_min=4, prompt_max=8,
+                         out_min=6, out_max=18, rate=40.0, seed=5,
+                         tenants=2)
+    ticks = []
+    res = engine.run(reqs, mode="continuous", time_fn=clock,
+                     sleep_fn=clock.advance, registry=registry,
+                     tick_sink=ticks.append)
+    # Per-tenant histograms exist alongside the global twins, counts
+    # matching the per-tenant summary.
+    s = res.summary()
+    for tenant, block in s["tenants"].items():
+        n_fin = block["statuses"].get("finished", 0)
+        h = registry.histograms[f"serve.tenant.{tenant}.ttft_ms"]
+        assert h.count == n_fin
+        assert registry.counters[
+            f"serve.tenant.{tenant}.requests_finished"].value == n_fin
+    assert registry.histograms["serve.ttft_ms"].count == \
+        len(res.finished_requests)
+    # Tick terminal entries cover every request exactly once, with the
+    # same latency numbers as the request records.
+    terms = [t for rec in ticks for t in rec["terminal"]]
+    assert sorted(t["id"] for t in terms) == sorted(r.rid for r in reqs)
+    by_id = {t["id"]: t for t in terms}
+    for rec in res.request_records():
+        assert by_id[rec["id"]]["tenant"] == rec["tenant"]
+        assert by_id[rec["id"]]["ttft_ms"] == rec["ttft_ms"]
+    # collect_terminals prefers the tick trail and tags the mode.
+    recs = [make_record("tick", t["now"], **t) for t in ticks]
+    collected = collect_terminals(recs)
+    assert len(collected) == len(reqs)
+    assert {mode for _, mode, _ in collected} == {"continuous"}
+    verdicts = verdicts_from_terminals(
+        collected, SLOSpec.from_dict(
+            {"tenants": {"*": {"availability": 0.9}}}))
+    assert sum(v.events for v in verdicts) == len(reqs)
