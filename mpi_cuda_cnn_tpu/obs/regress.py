@@ -96,7 +96,10 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                "fleet_ticks", "dispatches", "redispatches",
                "fenced_discards", "crashes", "joins", "leaves",
                "restarts", "circuit_opens", "replicas", "trace_crc",
-               "alerts_fired", "alerts_crc")
+               "alerts_fired", "alerts_crc",
+               # Prefix-sharing structural counters (ISSUE 9).
+               "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+               "prefix_cow", "prefix_inserts", "prefix_evictions")
 
 # Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
 # summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to

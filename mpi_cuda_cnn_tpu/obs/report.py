@@ -271,7 +271,9 @@ def summarize(records: Iterable[dict], *,
              ("mode", "requests", "statuses", "output_tokens",
               "decode_ticks", "prefill_chunks", "preemptions",
               "watchdog_slow_ticks", "tokens_per_s",
-              "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms")}
+              "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms",
+              "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+              "prefix_cow", "prefix_evictions")}
             for r in serves
         ]
 
@@ -515,6 +517,26 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 f"| {_fmt(s['ttft_p99_ms'])} | {_fmt(s['tpot_p99_ms'])} |"
             )
         lines.append("")
+        # Prefix-cache table (ISSUE 9): only for runs that did any
+        # matching — an all-zero row on a sharing-off run is noise.
+        pruns = [s for s in summary["serve"]
+                 if (s.get("prefix_hits") or 0) + (s.get("prefix_misses")
+                                                   or 0) > 0]
+        if pruns:
+            lines += [
+                "| prefix cache | hits | misses | hit tokens | cow "
+                "| evictions |",
+                "|---|---|---|---|---|---|",
+            ]
+            for s in pruns:
+                lines.append(
+                    f"| {s['mode']} | {_fmt(s['prefix_hits'])} "
+                    f"| {_fmt(s['prefix_misses'])} "
+                    f"| {_fmt(s['prefix_hit_tokens'])} "
+                    f"| {_fmt(s['prefix_cow'])} "
+                    f"| {_fmt(s['prefix_evictions'])} |"
+                )
+            lines.append("")
     if "metrics" in summary:
         # Runtime-registry snapshots (ISSUE 6): the p50/p95/p99 tables
         # the serving sections of PERF.md are made from, produced by

@@ -99,7 +99,12 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # engine's (injectable) clock. "terminal" (ISSUE 8) details each
     # request reaching a terminal status this tick ({id, tenant,
     # status, ttft_ms, tpot_ms, queue_wait_ms}) — the streaming
-    # good/bad events the SLO burn-rate rules fold.
+    # good/bad events the SLO burn-rate rules fold. Prefix-sharing
+    # runs (ISSUE 9) additionally carry "prefix_hits"
+    # ([[rid, matched_tokens]] — the lifecycle marker `mctpu trace`
+    # renders) and "prefix" ({shared_pages, retained_pages, hits,
+    # misses, hit_tokens, cow_copies, inserts, evictions} — the
+    # `mctpu top` cache panel).
     "tick": ("tick", "now", "queue", "free_pages"),
     # One fired alert (obs/alerts.py, ISSUE 8): "rule" names the rule
     # instance, "kind" its class (threshold / rate_of_change / absence

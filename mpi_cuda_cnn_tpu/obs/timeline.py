@@ -56,6 +56,8 @@ class Lifecycle:
     prefill_chunks: int = 0
     decode_ticks: int = 0
     preemptions: int = 0
+    prefix_hits: int = 0
+    prefix_hit_tokens: int = 0
     derived_status: str | None = None
     terminal_now: float | None = None
     # Milliseconds spent per state, summed across segments.
@@ -139,6 +141,15 @@ def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
                 lc = life(mode, rid)
                 lc.admissions += 1
                 lc.events.append((tick, now, "admitted", slot))
+            for rid, matched in rec.get("prefix_hits") or []:
+                # Prefix-cache hit (ISSUE 9): this admission shared
+                # `matched` prompt tokens' pages and prefilled only the
+                # suffix — the marker that explains a short prefill
+                # segment in the breakdown.
+                lc = life(mode, rid)
+                lc.prefix_hits += 1
+                lc.prefix_hit_tokens += matched
+                lc.events.append((tick, now, "prefix_hit", matched))
             pf = rec.get("prefill")
             if pf:
                 lc = life(mode, pf[1])
@@ -297,8 +308,9 @@ def render_request_table(lifecycles: dict[int, Lifecycle]) -> str:
     lines = [
         "| rid | status | tenant | arrival s | queued ms | prefill ms "
         "| decode ms "
-        "| preempt wait ms | preempts | chunks | dticks | tokens | ok |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "| preempt wait ms | preempts | chunks | dticks | pfx tok "
+        "| tokens | ok |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for rid in sorted(lifecycles):
         lc = lifecycles[rid]
@@ -310,6 +322,7 @@ def render_request_table(lifecycles: dict[int, Lifecycle]) -> str:
             f"| {_fmt(b.get('queued_ms'))} | {_fmt(b.get('prefill_ms'))} "
             f"| {_fmt(b.get('decode_ms'))} | {_fmt(b.get('preempted_ms'))} "
             f"| {lc.preemptions} | {lc.prefill_chunks} | {lc.decode_ticks} "
+            f"| {lc.prefix_hit_tokens} "
             f"| {lc.tokens_accounted}/{_fmt(rec.get('output_tokens'))} "
             f"| {'yes' if lc.consistent else 'NO'} |"
         )
@@ -405,6 +418,8 @@ def trace_main(argv: list[str] | None = None) -> int:
                             "preemptions": lc.preemptions,
                             "prefill_chunks": lc.prefill_chunks,
                             "decode_ticks": lc.decode_ticks,
+                            "prefix_hits": lc.prefix_hits,
+                            "prefix_hit_tokens": lc.prefix_hit_tokens,
                             "tokens": lc.tokens_accounted,
                             "consistent": lc.consistent,
                         }

@@ -177,6 +177,25 @@ def render(state: TopState, path: str, width: int = 96) -> str:
             "watchdog-slow "
             f"{_fmt(counters.get('serve.watchdog_slow_ticks', 0))}"
         )
+        pfx = tk.get("prefix")
+        if pfx:
+            # Prefix-cache panel (ISSUE 9): hit/COW/evict totals plus
+            # shared / LRU-retained / free page bars — the residency
+            # picture behind the hit rate.
+            total = pfx.get("hits", 0) + pfx.get("misses", 0)
+            rate = pfx.get("hits", 0) / total if total else 0.0
+            pool_hi = (gauges.get("serve.free_pages") or {}).get("hi")
+            lines.append(
+                f"  prefix: hit rate {rate:.0%} "
+                f"({_fmt(pfx.get('hit_tokens'))} tok)  "
+                f"cow {_fmt(pfx.get('cow_copies'))}  "
+                f"evict {_fmt(pfx.get('evictions'))}  "
+                f"shared {_fmt(pfx.get('shared_pages'))} "
+                f"{bar(pfx.get('shared_pages'), pool_hi, width=8)} "
+                f"lru {_fmt(pfx.get('retained_pages'))} "
+                f"{bar(pfx.get('retained_pages'), pool_hi, width=8)} "
+                f"free {_fmt(free)} {bar(free, pool_hi, width=8)}"
+            )
         if counters:
             lines.append(
                 "  totals: "

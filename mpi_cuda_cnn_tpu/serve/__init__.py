@@ -5,7 +5,10 @@ paged_cache.py (the memory layout), scheduler.py (the admission /
 preemption policy), engine.py (the jitted ticks), bench.py (the
 `mctpu serve-bench` / `mctpu fleet-bench` harnesses), router.py (the
 fleet's dispatch/health/fencing policy), fleet.py (N replicas behind
-the router, failure-aware re-dispatch — ISSUE 7).
+the router, failure-aware re-dispatch — ISSUE 7), prefix_cache.py (the
+prefix-sharing tree: refcounted read-only pages, copy-on-write, LRU
+retention — ISSUE 9; scheduler.py's SLOScheduler is the matching
+SLO-aware admission/preemption policy).
 """
 
 from .engine import PagedEngine, ServeResult
@@ -17,10 +20,13 @@ from .fleet import (
     SimCompute,
 )
 from .paged_cache import PagedKVCache, PagePool, init_paged_cache
+from .prefix_cache import PrefixCache
 from .router import Router
 from .scheduler import (
     ContinuousScheduler,
     Request,
+    SLOPolicy,
+    SLOScheduler,
     StaticScheduler,
     pages_for,
 )
@@ -33,9 +39,12 @@ __all__ = [
     "PagedEngine",
     "PagedKVCache",
     "PagePool",
+    "PrefixCache",
     "Replica",
     "Request",
     "Router",
+    "SLOPolicy",
+    "SLOScheduler",
     "ServeResult",
     "SimCompute",
     "StaticScheduler",

@@ -48,7 +48,8 @@ def _fault_plan_arg(surface: str):
 
 def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
                   out_min: int, out_max: int, rate: float, seed: int,
-                  deadline_s: float = 0.0, tenants: int = 0):
+                  deadline_s: float = 0.0, tenants: int = 0,
+                  prefix_mix: float = 0.0, prefix_pool: int = 4):
     """n seeded requests: uniform prompt/output lengths in the given
     ranges, Poisson arrivals at `rate` req/s (exponential gaps; rate 0
     = everything arrives at t=0). deadline_s > 0 gives every request an
@@ -62,11 +63,22 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
     prompt/length/arrival stream is bitwise-identical with tagging on
     or off — committed baselines and every pinned tick count stay
     valid, and the same seed always maps request i to the same tenant.
-    """
+
+    prefix_mix > 0 (ISSUE 9) makes that fraction of requests share
+    template prefixes: each sharing request's prompt starts with one of
+    `prefix_pool` fixed seeded templates, keeping only its last ~1/4 as
+    a unique suffix — the system/template-prefix regime prefix sharing
+    exists for (varying lengths hit the tree at different depths, so
+    COW branching is exercised too). All prefix decisions come from a
+    (seed, 2) spawn and OVERWRITE an already-drawn prompt, so lengths,
+    arrivals, and tenant labels are bitwise-identical at any mix."""
     from .scheduler import Request
 
     rng = np.random.default_rng(seed)
     trng = np.random.default_rng([seed, 1])
+    prng = np.random.default_rng([seed, 2])
+    templates = [prng.integers(0, vocab, (prompt_max,)).astype(np.int32)
+                 for _ in range(prefix_pool)] if prefix_mix > 0 else []
     t = 0.0
     reqs = []
     for i in range(n):
@@ -77,11 +89,46 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
         prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
         tenant = (f"t{int(trng.integers(0, tenants))}" if tenants > 0
                   else None)
+        if templates and float(prng.random()) < prefix_mix:
+            k = int(prng.integers(0, prefix_pool))
+            shared = plen - max(1, plen // 4)
+            if shared > 0:
+                prompt = np.concatenate(
+                    [templates[k][:shared], prompt[shared:]])
         reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=olen,
                             arrival=t,
                             deadline=t + deadline_s if deadline_s > 0
                             else None, tenant=tenant))
     return reqs
+
+
+def build_sched_policy(args, slo_spec):
+    """The --scheduler/--tenant-priority/--tenant-quota surface, shared
+    by serve-bench and fleet-bench (one grammar, one error story).
+    Returns (rc, policy): rc nonzero means the error was printed and
+    the caller should exit with it; policy is None under fcfs."""
+    if args.scheduler != "slo":
+        if args.tenant_priority or args.tenant_quota:
+            print("error: --tenant-priority/--tenant-quota need "
+                  "--scheduler slo", file=sys.stderr)
+            return 2, None
+        return 0, None
+    from .scheduler import (
+        SLOPolicy,
+        parse_tenant_priorities,
+        parse_tenant_quotas,
+    )
+
+    try:
+        prios = (parse_tenant_priorities(args.tenant_priority)
+                 if args.tenant_priority else {})
+        slot_q, page_q = (parse_tenant_quotas(args.tenant_quota)
+                          if args.tenant_quota else ({}, {}))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2, None
+    return 0, SLOPolicy(priorities=prios, slot_quota=slot_q,
+                        page_quota=page_q, slo_spec=slo_spec)
 
 
 def serve_bench_main(argv: list[str] | None = None) -> int:
@@ -108,7 +155,10 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "ample; shrink it to exercise preemption)")
     ap.add_argument("--prefill-chunk", type=int, default=32)
     ap.add_argument("--cache-dtype", default="float32",
-                    choices=["float32", "bfloat16", "int8"])
+                    choices=["float32", "bfloat16", "int8", "auto"],
+                    help="auto routes from the banked int8 table "
+                         "(VERDICT 7): int8 for GQA/MQA, bfloat16 "
+                         "for MHA (models/generate.pick_cache_dtype)")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=96)
@@ -147,6 +197,30 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "streaming alert engine live on the record "
                          "stream; fired alerts land in the JSONL as "
                          "`alert` events")
+    ap.add_argument("--prefix-mix", type=float, default=0.0,
+                    help="fraction of requests sharing seeded template "
+                         "prompt prefixes (ISSUE 9 workload shape; "
+                         "0 = all-unique prompts, bitwise-identical "
+                         "lengths/arrivals either way)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable prefix-sharing KV cache on the "
+                         "continuous scheduler: hash-keyed prefix "
+                         "pages with refcounts + COW — cache-hit "
+                         "requests prefill only their suffix")
+    ap.add_argument("--scheduler", default="fcfs",
+                    choices=["fcfs", "slo"],
+                    help="continuous-batching policy: fcfs (default) "
+                         "or the SLO-aware scheduler (priority "
+                         "classes, per-tenant quotas, burn-driven "
+                         "preemption; implies --mode continuous)")
+    ap.add_argument("--tenant-priority", default=None,
+                    help="per-tenant priority classes, e.g. "
+                         "'t0=2,t1=0' (higher = more protected; "
+                         "needs --scheduler slo)")
+    ap.add_argument("--tenant-quota", default=None,
+                    help="per-tenant admission quotas, e.g. "
+                         "'t0=pages:8/slots:2,t1=slots:1' "
+                         "(needs --scheduler slo)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--metrics-jsonl", default=None,
                     help="append per-request obs records here")
@@ -173,6 +247,10 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         print(f"prompt {args.prompt_max} + out {args.out_max} exceeds "
               f"--max-seq {args.max_seq}", file=sys.stderr)
         return 1
+    from ..models.generate import pick_cache_dtype
+
+    cache_dtype = pick_cache_dtype(args.cache_dtype, heads=args.heads,
+                                   kv_heads=args.kv_heads or None)
     model = TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.heads, depth=args.depth,
         max_seq=args.max_seq, kv_heads=args.kv_heads,
@@ -183,8 +261,18 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
     engine = PagedEngine(
         model, params, slots=args.slots, num_pages=pages,
         page_size=args.page_size, prefill_chunk=args.prefill_chunk,
-        cache_dtype=args.cache_dtype, max_len=max_len,
+        cache_dtype=cache_dtype, max_len=max_len,
     )
+    if args.scheduler == "slo":
+        args.mode = "continuous"
+    if args.prefix_cache and args.mode == "static":
+        # Sharing is continuous-only (static is the reservation
+        # baseline); running it silently sharing-off would report a
+        # measurement the flags don't describe.
+        print("error: --prefix-cache needs continuous batching "
+              "(--mode continuous or both; static is the sharing-off "
+              "baseline)", file=sys.stderr)
+        return 2
     modes = (["static", "continuous"] if args.mode == "both"
              else [args.mode])
     workload_kw = dict(
@@ -192,21 +280,27 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         prompt_max=args.prompt_max, out_min=args.out_min,
         out_max=args.out_max, rate=args.rate, seed=args.seed,
         deadline_s=args.deadline_ms / 1e3, tenants=args.tenants,
+        prefix_mix=args.prefix_mix,
     )
     run_kw = dict(
         max_queue=args.max_queue or None,
         watchdog_s=args.watchdog_ms / 1e3,
     )
     alert_engine = None
+    slo_spec = None
     if args.slo:
         from ..obs.alerts import AlertEngine
         from ..obs.slo import SLOSpec
 
         try:
-            alert_engine = AlertEngine(slo=SLOSpec.load(args.slo))
+            slo_spec = SLOSpec.load(args.slo)
+            alert_engine = AlertEngine(slo=slo_spec)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    rc, sched_policy = build_sched_policy(args, slo_spec)
+    if rc:
+        return rc
     summaries = {}
     with MetricsLogger(path=args.metrics_jsonl, echo=False) as metrics:
         if alert_engine is not None:
@@ -214,12 +308,15 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
             # (MetricsLogger observer): replaying the finished JSONL
             # reproduces the identical alert sequence, CRC-pinned.
             alert_engine.attach(metrics)
-        # Warm both compiled programs (engine-level: the same two serve
+        # Warm the compiled programs (engine-level: the same ones serve
         # every mode) on one throwaway request, so no mode pays
-        # compilation inside its latencies.
+        # compilation inside its latencies. With sharing on, the COW
+        # copy program warms too (scratch onto itself — harmless).
         engine.run(make_workload(**{**workload_kw, "n": 1, "rate": 0.0,
                                     "deadline_s": 0.0}),
                    mode=modes[0])
+        if args.prefix_cache:
+            engine.copy_page(0, 0)
         for mode in modes:
             faults = None
             if args.fault_plan:
@@ -245,7 +342,12 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                         registry.emit(metrics, mode=rec["mode"])
             result = engine.run(make_workload(**workload_kw), mode=mode,
                                 faults=faults, registry=registry,
-                                tick_sink=tick_sink, **run_kw)
+                                tick_sink=tick_sink,
+                                prefix=(args.prefix_cache
+                                        and mode == "continuous"),
+                                policy=(sched_policy
+                                        if mode == "continuous" else None),
+                                **run_kw)
             s = result.summary()
             summaries[mode] = s
             registry.set("serve.tokens_per_s", s["tokens_per_s"])
@@ -256,13 +358,13 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                 metrics.log("fault", **{"mode": mode, **ev})
             metrics.log("serve", **{
                 "bench": "serve", "backend": jax.default_backend(),
-                "cache_dtype": args.cache_dtype, "rate": args.rate,
+                "cache_dtype": cache_dtype, "rate": args.rate,
                 "slots": args.slots, "page_size": args.page_size,
                 "pages": pages, **s,
             })
             print(json.dumps({"bench": "serve", "backend":
                               jax.default_backend(),
-                              "cache_dtype": args.cache_dtype, **s}))
+                              "cache_dtype": cache_dtype, **s}))
     if alert_engine is not None:
         print(json.dumps({"metric": "serve_alerts_fired",
                           "value": len(alert_engine.alerts),
@@ -358,6 +460,24 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                          "directly (the records stay out of the JSONL, "
                          "the alerts land in it). Summary gains "
                          "alerts_fired/alerts_crc either way")
+    ap.add_argument("--prefix-mix", type=float, default=0.0,
+                    help="fraction of requests sharing seeded template "
+                         "prompt prefixes (ISSUE 9; 0 = all-unique)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="per-replica prefix-sharing KV cache: "
+                         "cache-hit requests prefill only their suffix "
+                         "(restarted incarnations come back cold)")
+    ap.add_argument("--scheduler", default="fcfs",
+                    choices=["fcfs", "slo"],
+                    help="per-replica batching policy: fcfs or the "
+                         "SLO-aware scheduler (priorities, quotas, "
+                         "burn-driven preemption)")
+    ap.add_argument("--tenant-priority", default=None,
+                    help="per-tenant priority classes, e.g. 't0=2,t1=0'"
+                         " (higher = more protected; --scheduler slo)")
+    ap.add_argument("--tenant-quota", default=None,
+                    help="per-tenant admission quotas, e.g. "
+                         "'t0=pages:8/slots:2' (--scheduler slo)")
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     help="per-request fleet-clock deadline (0 = none)")
     ap.add_argument("--seed", type=int, default=0)
@@ -377,7 +497,9 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--kv-heads", type=int, default=0)
     ap.add_argument("--cache-dtype", default="float32",
-                    choices=["float32", "bfloat16", "int8"])
+                    choices=["float32", "bfloat16", "int8", "auto"],
+                    help="auto routes int8 for GQA/MQA, bfloat16 for "
+                         "MHA (models/generate.pick_cache_dtype)")
     ap.add_argument("--device", default="auto",
                     choices=["auto", "tpu", "cpu"])
     ap.add_argument("--metrics-jsonl", default=None,
@@ -438,21 +560,26 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             prompt_max=args.prompt_max, out_min=args.out_min,
             out_max=args.out_max, rate=args.rate, seed=args.seed,
             sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
-            tenants=args.tenants,
+            tenants=args.tenants, prefix_mix=args.prefix_mix,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     alert_engine = None
+    slo_spec = None
     if args.slo:
         from ..obs.alerts import AlertEngine
         from ..obs.slo import SLOSpec
 
         try:
-            alert_engine = AlertEngine(slo=SLOSpec.load(args.slo))
+            slo_spec = SLOSpec.load(args.slo)
+            alert_engine = AlertEngine(slo=slo_spec)
         except (OSError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    rc, sched_policy = build_sched_policy(args, slo_spec)
+    if rc:
+        return rc
     clock = FakeClock()
     registry = MetricsRegistry(clock=clock)
     faults = FaultInjector(args.fault_plan) if args.fault_plan else None
@@ -495,6 +622,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 check_every=args.check_every, faults=faults, clock=clock,
                 registry=registry, fleet_sink=fleet_sink,
                 replica_tick_sink=replica_tick_sink,
+                prefix=args.prefix_cache, sched_policy=sched_policy,
             )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)

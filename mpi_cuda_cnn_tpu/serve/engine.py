@@ -54,9 +54,12 @@ from .paged_cache import (
     init_paged_cache,
     paged_forward,
 )
+from .prefix_cache import PrefixCache, empty_prefix_fields
 from .scheduler import (
     ContinuousScheduler,
     Request,
+    SLOPolicy,
+    SLOScheduler,
     StaticScheduler,
     tenant_block,
     terminal_fields,
@@ -105,6 +108,9 @@ class ServeResult:
     duration_s: float
     events: list[dict] = dataclasses.field(default_factory=list)
     watchdog_slow_ticks: int = 0
+    # Prefix-cache structural counters (ISSUE 9): always present (zeros
+    # with sharing off) so gated metrics exist in every run.
+    prefix: dict = dataclasses.field(default_factory=empty_prefix_fields)
 
     @property
     def finished_requests(self) -> list[Request]:
@@ -168,6 +174,9 @@ class ServeResult:
             "ttft_p99_ms": pct_nearest(ttft, 99),
             "tpot_p50_ms": pct_nearest(tpot, 50),
             "tpot_p99_ms": pct_nearest(tpot, 99),
+            # Prefix-sharing counters (ISSUE 9), flat so `mctpu
+            # compare` gates them as serve.<mode>.prefix_hits etc.
+            **self.prefix,
             # Per-tenant status/latency counts (ISSUE 8): the summary
             # keys `mctpu compare` flattens as serve.<mode>.tenant.<t>.*
             # and `mctpu health` falls back to on summary-only logs.
@@ -223,6 +232,13 @@ class PagedEngine:
         self.page_size = page_size
         self.num_pages = num_pages
         self.prefill_chunk = prefill_chunk
+        if isinstance(cache_dtype, str) and cache_dtype == "auto":
+            # VERDICT item 7: route the storage dtype from the banked
+            # measurements — int8 for GQA/MQA, bfloat16 for MHA.
+            from ..models.generate import pick_cache_dtype
+
+            cache_dtype = pick_cache_dtype("auto", heads=model.heads,
+                                           kv_heads=model.n_kv)
         self.cache_dtype = jnp.dtype(cache_dtype)
         self.max_len = min(max_len or model.max_seq, model.max_seq)
         tmpl = init_paged_cache(model, slots=slots, num_pages=num_pages,
@@ -249,11 +265,21 @@ class PagedEngine:
             nxt = jnp.argmax(logits[0, jnp.maximum(n_valid - 1, 0)])
             return cache, nxt.astype(jnp.int32)
 
+        def copy(pages, src, dst):
+            # Copy-on-write (ISSUE 9): duplicate one physical page's
+            # rows across every layer's pools — the divergent request
+            # writes into the copy, the shared source stays read-only.
+            return [
+                {name: c[name].at[dst].set(c[name][src]) for name in c}
+                for c in pages
+            ]
+
         # Donate the cache: the page pools update in place tick-to-tick
         # (the engine always adopts the returned cache) instead of
         # allocating a second pool-sized buffer per dispatch.
         self._tick = jax.jit(tick, donate_argnums=(0,))
         self._prefill = jax.jit(prefill, donate_argnums=(0,))
+        self._copy = jax.jit(copy, donate_argnums=(0,))
 
     # -- host-side helpers ------------------------------------------------
 
@@ -272,6 +298,14 @@ class PagedEngine:
         req.out.append(tok)
         if req.first_token_at is None:
             req.first_token_at = now
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device-side COW: duplicate page `src` into page `dst` in
+        every layer's pools (keys and values, plus int8 scales). The
+        caller (engine.run / ReplicaCore.step) releases the shared
+        source's reference via scheduler.cow_complete afterwards."""
+        self._pages = self._copy(self._pages, jnp.int32(src),
+                                 jnp.int32(dst))
 
     def run_prefill_chunk(self, slot):
         """Advance `slot`'s prefill by one chunk on the device. Returns
@@ -319,7 +353,8 @@ class PagedEngine:
     def run(self, requests: list[Request], *, mode: str = "continuous",
             time_fn=time.perf_counter, faults=None, max_queue: int | None = None,
             watchdog_s: float = 0.0, sleep_fn=time.sleep,
-            registry=None, tick_sink=None) -> ServeResult:
+            registry=None, tick_sink=None, prefix: bool = False,
+            policy: SLOPolicy | None = None) -> ServeResult:
         """Serve `requests` to a terminal status each; return ServeResult.
 
         Requests are mutated in place (out/timestamps/status); arrivals
@@ -339,19 +374,33 @@ class PagedEngine:
         it happens (serve/bench.py points it at the metrics JSONL, which
         is what makes `mctpu top` live-tailable mid-run). Both default
         to off: the hot loop pays nothing unless asked.
+
+        Prefix sharing + SLO policy (ISSUE 9): `prefix=True` puts a
+        PrefixCache over the run's pool — a request whose prompt shares
+        cached prefix pages prefills only its suffix (TTFT drops
+        accordingly; outputs stay bitwise-identical in f32). `policy`
+        upgrades continuous batching to the SLOScheduler (priority
+        classes, per-tenant quotas, burn-driven preemption). Both apply
+        to iteration-level scheduling only — static batching is the
+        reservation baseline the comparison measures.
         """
+        pool = PagePool(self.num_pages)
+        pcache = PrefixCache(pool, self.page_size) if prefix else None
+        sched_kw = dict(slots=self.slots, pool=pool,
+                        page_size=self.page_size, max_len=self.max_len,
+                        max_queue=max_queue, prefix=pcache)
         if mode == "continuous":
-            sched = ContinuousScheduler(
-                slots=self.slots, pool=PagePool(self.num_pages),
-                page_size=self.page_size, max_len=self.max_len,
-                max_queue=max_queue,
-            )
+            if policy is not None:
+                sched = SLOScheduler(policy=policy, **sched_kw)
+            else:
+                sched = ContinuousScheduler(**sched_kw)
         elif mode == "static":
-            sched = StaticScheduler(
-                slots=self.slots, pool=PagePool(self.num_pages),
-                page_size=self.page_size, max_len=self.max_len,
-                max_queue=max_queue,
-            )
+            if prefix or policy is not None:
+                raise ValueError(
+                    "prefix sharing / SLO policy apply to continuous "
+                    "batching only — static is the reservation baseline"
+                )
+            sched = StaticScheduler(**{**sched_kw, "prefix": None})
         else:
             raise ValueError(f"mode {mode!r}: want 'continuous' or 'static'")
         sched.submit(requests)
@@ -411,18 +460,27 @@ class PagedEngine:
             # advance without starving in-flight decodes.
             slot = sched.prefill_slot()
             if slot is not None:
+                if slot.cow is not None:
+                    # Copy-on-write (ISSUE 9): duplicate the partially
+                    # matched shared page into the slot's private page
+                    # BEFORE its first write lands there.
+                    self.copy_page(*slot.cow)
+                    sched.cow_complete(slot)
                 n, nxt = self.run_prefill_chunk(slot)
                 slot.cached += n
                 prefill_chunks += 1
                 prefill_rec = [slot.idx, slot.req.rid, n]
                 progressed = True
                 if slot.cached >= slot.target:
-                    # Prefill complete: the chunk's last valid logits
-                    # give the first generated token right now. A
-                    # request done at its first token releases its slot
-                    # only under continuous batching — static holds
-                    # every reservation until the batch drains (the
-                    # occupancy discipline the comparison measures).
+                    # Prefill complete: the full prompt's pages are now
+                    # adoptable into the prefix tree (ISSUE 9), and the
+                    # chunk's last valid logits give the first generated
+                    # token right now. A request done at its first token
+                    # releases its slot only under continuous batching —
+                    # static holds every reservation until the batch
+                    # drains (the occupancy discipline the comparison
+                    # measures).
+                    sched.note_prefill_complete(slot)
                     self._emit(slot, int(nxt), time_fn() - t0)
                     prefill_rec.append("emit")  # first token at completion
                     if slot.req.done and isinstance(sched,
@@ -496,8 +554,9 @@ class PagedEngine:
             # is the tick store — an in-memory list would grow without
             # bound on a long-lived serve).
             preempted = sched.drain_preempted()
+            prefix_tick = pcache.drain_tick() if pcache is not None else None
             if not want_ticks:
-                sched.pool.check()
+                sched.check()
                 tick_idx += 1
                 continue
             new_fin = sched.finished[n_fin_seen:]
@@ -525,6 +584,17 @@ class PagedEngine:
                 # when they happen instead of at end of run.
                 "terminal": [terminal_fields(r) for r in new_fin + new_drop],
             }
+            if prefix_tick is not None:
+                # Prefix-cache panel fields (ISSUE 9): this tick's hit
+                # markers ([rid, matched_tokens] — the lifecycle event
+                # `mctpu trace` renders) + cumulative stats and
+                # residency gauges for the `mctpu top` cache panel.
+                tick_rec["prefix_hits"] = prefix_tick["hits"]
+                tick_rec["prefix"] = {
+                    "shared_pages": pcache.shared_pages,
+                    "retained_pages": pcache.retained_pages(),
+                    **pcache.stats,
+                }
             if tick_sink is not None:
                 tick_sink(tick_rec)
             if registry is not None:
@@ -544,18 +614,39 @@ class PagedEngine:
                     registry.inc("serve.tokens_emitted", emitted)
                 if preempted:
                     registry.inc("serve.preemptions", len(preempted))
+                if prefix_tick is not None:
+                    if prefix_tick["hits"]:
+                        registry.inc("serve.prefix.hits",
+                                     len(prefix_tick["hits"]))
+                        registry.inc("serve.prefix.hit_tokens",
+                                     sum(m for _, m in prefix_tick["hits"]))
+                    for key in ("cow", "evictions", "inserts"):
+                        if prefix_tick[key]:
+                            registry.inc(f"serve.prefix.{key}",
+                                         prefix_tick[key])
+                    registry.set("serve.prefix.shared_pages",
+                                 pcache.shared_pages)
+                    registry.set("serve.prefix.retained_pages",
+                                 pcache.retained_pages())
                 for r in new_fin + new_drop:
                     _observe_request(registry, r)
-            sched.pool.check()
+            sched.check()
             tick_idx += 1
 
-        # Release any squeeze that outlived the workload, then prove the
-        # pool clean: zero leaked, zero double-booked pages — with or
-        # without faults.
+        # Release any squeeze that outlived the workload, evict every
+        # retained prefix page (no slot holds a reference once all
+        # requests are terminal), then prove the pool clean: zero
+        # leaked, zero double-booked pages — with or without faults.
         for sq in squeezes:
             if sq["pages"]:
                 sched.pool.free(sq["pages"], sq["owner"])
-        sched.pool.check()
+        prefix_fields = empty_prefix_fields()
+        if pcache is not None:
+            prefix_fields = pcache.summary_fields()
+            pcache.clear()
+            # clear() evicts; freeze the counters at pre-flush values
+            # (end-of-run teardown is not cache pressure).
+        sched.check()
         terminal = sched.finished + sched.dropped
         if len(terminal) != n_reqs:
             raise RuntimeError(
@@ -567,5 +658,5 @@ class PagedEngine:
             mode=mode, requests=terminal, decode_ticks=decode_ticks,
             prefill_chunks=prefill_chunks, preemptions=sched.preemptions,
             duration_s=time_fn() - t0, events=events,
-            watchdog_slow_ticks=watchdog_slow,
+            watchdog_slow_ticks=watchdog_slow, prefix=prefix_fields,
         )

@@ -60,10 +60,12 @@ from collections import deque
 from ..faults import FakeClock
 from ..obs.metrics import MetricsRegistry
 from .paged_cache import PagePool
+from .prefix_cache import PrefixCache, empty_prefix_fields
 from .router import CircuitOpen, Router
 from .scheduler import (
     ContinuousScheduler,
     Request,
+    SLOScheduler,
     tenant_block,
     terminal_fields,
     validate_request,
@@ -101,6 +103,11 @@ class SimCompute:
     def decode(self, dslots) -> dict[int, int]:
         return {s.idx: self._tok(s.req) for s in dslots}
 
+    def copy_page(self, src: int, dst: int) -> None:
+        """Sim COW is pure bookkeeping: tokens are a function of
+        (rid, position), not of cache contents — the page accounting
+        is exercised for real, the device copy has nothing to copy."""
+
 
 class EngineCompute:
     """Model-backed compute: one PagedEngine (its own page pools) per
@@ -117,6 +124,9 @@ class EngineCompute:
     def decode(self, dslots):
         return self.engine.run_decode_tick(dslots)
 
+    def copy_page(self, src: int, dst: int) -> None:
+        self.engine.copy_page(src, dst)
+
 
 class ReplicaCore:
     """One replica's steppable engine loop over the PR-3 scheduler.
@@ -128,11 +138,17 @@ class ReplicaCore:
 
     def __init__(self, compute, *, slots: int, num_pages: int,
                  page_size: int, max_len: int, max_queue: int | None = None,
-                 on_emit=None, check_every: int = 1):
-        self.sched = ContinuousScheduler(
-            slots=slots, pool=PagePool(num_pages), page_size=page_size,
-            max_len=max_len, max_queue=max_queue,
-        )
+                 on_emit=None, check_every: int = 1, prefix: bool = False,
+                 policy=None):
+        pool = PagePool(num_pages)
+        self.prefix = PrefixCache(pool, page_size) if prefix else None
+        sched_kw = dict(slots=slots, pool=pool, page_size=page_size,
+                        max_len=max_len, max_queue=max_queue,
+                        prefix=self.prefix)
+        if policy is not None:
+            self.sched = SLOScheduler(policy=policy, **sched_kw)
+        else:
+            self.sched = ContinuousScheduler(**sched_kw)
         self.compute = compute
         self.on_emit = on_emit
         self.check_every = check_every
@@ -179,14 +195,23 @@ class ReplicaCore:
         prefill_rec = None
         slot = sched.prefill_slot()
         if slot is not None:
+            if slot.cow is not None:
+                # COW (ISSUE 9): duplicate the partially matched shared
+                # page before the slot's first write (engine.run's rule;
+                # SimCompute's copy is accounting-only).
+                self.compute.copy_page(*slot.cow)
+                sched.cow_complete(slot)
             n, nxt = self.compute.prefill_chunk(slot)
             slot.cached += n
             self.prefill_chunks += 1
             prefill_rec = [slot.idx, slot.req.rid, n]
             progressed = True
             if slot.cached >= slot.target:
-                # Prefill complete: the first generated token is due
-                # now (TTFT at prefill completion — engine.run's rule).
+                # Prefill complete: adopt the prompt's pages into the
+                # prefix tree (ISSUE 9); the first generated token is
+                # due now (TTFT at prefill completion — engine.run's
+                # rule).
+                sched.note_prefill_complete(slot)
                 self._emit(slot.req, int(nxt), now)
                 prefill_rec.append("emit")
                 if slot.req.done:
@@ -203,11 +228,13 @@ class ReplicaCore:
                 if s.req.done:
                     sched.finish(s, now)
         preempted = sched.drain_preempted()
+        prefix_tick = (self.prefix.drain_tick()
+                       if self.prefix is not None else None)
         new_fin = sched.finished[self._n_fin:]
         new_drop = sched.dropped[self._n_drop:]
         self._n_fin, self._n_drop = len(sched.finished), len(sched.dropped)
         if self.check_every and self.steps % self.check_every == 0:
-            sched.pool.check()
+            sched.check()
         rec = {
             "queue": len(sched.queue),
             "running": sum(1 for s in sched.slots if not s.free),
@@ -218,7 +245,23 @@ class ReplicaCore:
             "aborted": [[r.rid, r.status] for r in new_drop],
             "progressed": progressed or bool(admitted or new_fin or new_drop),
         }
+        if prefix_tick is not None:
+            rec["prefix_hits"] = prefix_tick["hits"]
         return rec, new_fin, new_drop
+
+    def prefix_stats(self) -> dict:
+        """Cumulative prefix counters in the flat fleet-summary shape
+        (zeros with sharing off — gated metrics exist in every run)."""
+        if self.prefix is None:
+            return empty_prefix_fields()
+        return self.prefix.summary_fields()
+
+    def reset_prefix_stats(self) -> None:
+        """Zero the counters after they were banked (retirement at
+        failover: a zombie's later activity must not re-bank)."""
+        if self.prefix is not None:
+            for k in self.prefix.stats:
+                self.prefix.stats[k] = 0
 
 
 class Replica:
@@ -230,13 +273,14 @@ class Replica:
 
     def __init__(self, name: str, compute, *, slots: int, num_pages: int,
                  page_size: int, max_len: int, max_queue: int | None = None,
-                 check_every: int = 1, on_emit=None, clock=None):
+                 check_every: int = 1, on_emit=None, clock=None,
+                 prefix: bool = False, policy=None):
         self.name = name
         self.registry = MetricsRegistry(clock=clock)
         self.core = ReplicaCore(
             compute, slots=slots, num_pages=num_pages, page_size=page_size,
             max_len=max_len, max_queue=max_queue, check_every=check_every,
-            on_emit=on_emit,
+            on_emit=on_emit, prefix=prefix, policy=policy,
         )
         self.alive = True
         self.zombie_until = -1   # fleet tick a partitioned zombie stops at
@@ -263,6 +307,10 @@ class Replica:
             r.inc("serve.prefill_chunks")
         if rec["preempted"]:
             r.inc("serve.preemptions", len(rec["preempted"]))
+        if rec.get("prefix_hits"):
+            r.inc("serve.prefix.hits", len(rec["prefix_hits"]))
+            r.inc("serve.prefix.hit_tokens",
+                  sum(m for _, m in rec["prefix_hits"]))
         self.pending_dispatches = 0
         return rec, new_fin, new_drop
 
@@ -294,6 +342,10 @@ class FleetResult:
     dispatch_trace: list[tuple] = dataclasses.field(default_factory=list)
     events: list[dict] = dataclasses.field(default_factory=list)
     replica_log: list[dict] = dataclasses.field(default_factory=list)
+    # Fleet-wide prefix-cache structural counters (ISSUE 9): summed
+    # across every replica incarnation; zeros with sharing off so the
+    # gated metrics exist in every fleet-bench run.
+    prefix: dict = dataclasses.field(default_factory=empty_prefix_fields)
 
     @property
     def output_tokens(self) -> int:
@@ -365,6 +417,9 @@ class FleetResult:
             "restarts": self.restarts,
             "circuit_opens": self.circuit_opens,
             "trace_crc": self.trace_crc,
+            # Prefix-sharing counters (ISSUE 9): flat keys the fleet
+            # determinism gate pins at exact equality.
+            **self.prefix,
             # Per-tenant status/latency counts (ISSUE 8) — same shape
             # and flattening as ServeResult.summary's block.
             "tenants": tenant_block(self.requests),
@@ -391,16 +446,22 @@ class Fleet:
                  redispatch: str = "resume", tick_s: float = 1e-3,
                  check_every: int = 1, faults=None, clock: FakeClock | None = None,
                  registry: MetricsRegistry | None = None, fleet_sink=None,
-                 replica_tick_sink=None, jitter=None):
+                 replica_tick_sink=None, jitter=None, prefix: bool = False,
+                 sched_policy=None):
         if replicas < 1:
             raise ValueError(f"need at least one replica, got {replicas}")
         if redispatch not in ("resume", "discard"):
             raise ValueError(
                 f"redispatch {redispatch!r}: want 'resume' or 'discard'")
         self.compute_factory = compute_factory
+        # prefix/sched_policy (ISSUE 9): each replica gets its own
+        # PrefixCache over its own pool (a restarted incarnation comes
+        # back cold) and, with sched_policy, an SLOScheduler instead of
+        # FCFS — the same upgrade engine.run applies single-engine.
         self.geometry = dict(slots=slots, num_pages=num_pages,
                              page_size=page_size, max_len=max_len,
-                             max_queue=max_queue, check_every=check_every)
+                             max_queue=max_queue, check_every=check_every,
+                             prefix=prefix, policy=sched_policy)
         self.redispatch = redispatch
         self.tick_s = tick_s
         self.faults = faults
@@ -420,6 +481,7 @@ class Fleet:
         self.crashes = self.joins = self.leaves = 0
         self.restarts = self.circuit_opens = 0
         self._retired = [0, 0, 0]  # decode_ticks, prefill_chunks, preempts
+        self._retired_prefix = empty_prefix_fields()
         self._auth: dict[int, Request] = {}
         # rid -> (holding replica, live local copy): where a cancel()
         # must land (the authoritative object the caller holds is a
@@ -619,9 +681,12 @@ class Fleet:
         self._retired[0] += core.decode_ticks
         self._retired[1] += core.prefill_chunks
         self._retired[2] += core.sched.preemptions
+        for k, v in core.prefix_stats().items():
+            self._retired_prefix[k] += v
         # A later zombie step must not re-bank these.
         core.decode_ticks = core.prefill_chunks = 0
         core.sched.preemptions = 0
+        core.reset_prefix_stats()
 
     def _resolve_fault_target(self, f) -> str:
         """The rN name a crash/leave fault targets. A name that no
@@ -784,6 +849,8 @@ class Fleet:
                            ("queue", "running", "free_pages", "admitted",
                             "prefill", "decoded", "preempted", "finished",
                             "aborted")},
+                        **({"prefix_hits": rec["prefix_hits"]}
+                           if "prefix_hits" in rec else {}),
                         "terminal": [terminal_fields(r) for r in synced],
                     })
             for rep in list(self._zombies):
@@ -813,6 +880,8 @@ class Fleet:
                            ("queue", "running", "free_pages", "admitted",
                             "prefill", "decoded", "preempted", "finished",
                             "aborted")},
+                        **({"prefix_hits": rec["prefix_hits"]}
+                           if "prefix_hits" in rec else {}),
                         "terminal": [terminal_fields(r) for r in synced],
                     })
             if self.registry is not None:
@@ -907,7 +976,7 @@ class Fleet:
         # Pool invariant at exit on every surviving replica: zero
         # leaked, zero double-booked pages, fleet-wide.
         for member in self.router.members.values():
-            member.replica.core.sched.pool.check()
+            member.replica.core.sched.check()
         decode_ticks = self._retired[0] + sum(
             m.replica.core.decode_ticks for m in self.router.members.values())
         prefills = self._retired[1] + sum(
@@ -916,6 +985,10 @@ class Fleet:
         preempts = self._retired[2] + sum(
             m.replica.core.sched.preemptions
             for m in self.router.members.values())
+        prefix_totals = dict(self._retired_prefix)
+        for m in self.router.members.values():
+            for k, v in m.replica.core.prefix_stats().items():
+                prefix_totals[k] += v
         return FleetResult(
             requests=reqs, ticks=tick, duration_s=clock() - t0,
             dispatches=self.dispatches, redispatches=self.redispatches,
@@ -925,25 +998,27 @@ class Fleet:
             prefill_chunks=prefills, preemptions=preempts,
             replicas_final=len(self.router.members),
             dispatch_trace=self.dispatch_trace, events=self.events,
-            replica_log=self.replica_log,
+            replica_log=self.replica_log, prefix=prefix_totals,
         )
 
 
 def make_fleet_workload(*, n: int, vocab: int, prompt_min: int,
                         prompt_max: int, out_min: int, out_max: int,
                         rate: float, seed: int, sessions: int = 0,
-                        deadline_s: float = 0.0,
-                        tenants: int = 0) -> list[Request]:
+                        deadline_s: float = 0.0, tenants: int = 0,
+                        prefix_mix: float = 0.0) -> list[Request]:
     """The serve-bench workload generator plus session keys: request i
     belongs to session i % sessions (0 = sessionless), so the
     session-affinity policy has stable keys to rendezvous-hash.
-    `tenants` passes through to make_workload's seeded tenant mix."""
+    `tenants`/`prefix_mix` pass through to make_workload's seeded
+    tenant mix and shared-template-prefix mix (ISSUE 9)."""
     from .bench import make_workload
 
     reqs = make_workload(n=n, vocab=vocab, prompt_min=prompt_min,
                          prompt_max=prompt_max, out_min=out_min,
                          out_max=out_max, rate=rate, seed=seed,
-                         deadline_s=deadline_s, tenants=tenants)
+                         deadline_s=deadline_s, tenants=tenants,
+                         prefix_mix=prefix_mix)
     if sessions > 0:
         for r in reqs:
             r.session = r.rid % sessions

@@ -46,6 +46,7 @@ from typing import Iterable
 import numpy as np
 
 from .paged_cache import PagePool, pages_for
+from .prefix_cache import PrefixCache
 
 
 def validate_request(r: Request, *, max_len: int, page_size: int,
@@ -145,7 +146,10 @@ class Request:
     the traffic-class identity (ISSUE 8): the SLO accounting layer
     buckets good/bad events, latency histograms, and health verdicts by
     it; None renders as "default" in every record and table — a
-    single-tenant run needs no tagging."""
+    single-tenant run needs no tagging. `priority` (ISSUE 9) is the
+    request's priority class for the SLO-aware scheduler: higher is
+    more protected (admitted first, preempted last); the FCFS
+    schedulers ignore it."""
 
     rid: int
     prompt: np.ndarray
@@ -154,6 +158,7 @@ class Request:
     deadline: float | None = None
     session: int | str | None = None
     tenant: str | None = None
+    priority: int = 0
     out: list[int] = dataclasses.field(default_factory=list)
     status: str = "queued"
     fail_reason: str | None = None
@@ -197,7 +202,16 @@ class Slot:
     written; while cached < target the slot is prefilling (target =
     the request's context length at admission), after that it decodes —
     the current token (last emitted, not yet cached) goes in at row
-    `cached` on the next tick."""
+    `cached` on the next tick.
+
+    Prefix sharing (ISSUE 9): `pages` stays THE ordered block-table
+    source; `refs` is the subset of those pages that are shared
+    read-only prefix pages this slot holds reader references on
+    (`prefix_nodes` the matching tree nodes), and a prefix hit binds
+    with cached = matched tokens so prefill covers only the suffix.
+    `cow` is a pending (src, dst) copy-on-write: the engine copies the
+    shared src page into the private dst page before the slot's first
+    write (`cow_node` holds the transient source reference)."""
 
     idx: int
     req: Request | None = None
@@ -205,6 +219,10 @@ class Slot:
     cached: int = 0
     target: int = 0
     admit_seq: int = -1
+    refs: list[int] = dataclasses.field(default_factory=list)
+    prefix_nodes: list = dataclasses.field(default_factory=list)
+    cow: tuple[int, int] | None = None
+    cow_node: object = None
 
     @property
     def free(self) -> bool:
@@ -221,7 +239,8 @@ class Slot:
 
 class _SchedulerBase:
     def __init__(self, *, slots: int, pool: PagePool, page_size: int,
-                 max_len: int, max_queue: int | None = None):
+                 max_len: int, max_queue: int | None = None,
+                 prefix: PrefixCache | None = None):
         if slots < 1:
             raise ValueError(f"need at least one slot, got {slots}")
         if max_queue is not None and max_queue < 1:
@@ -231,6 +250,7 @@ class _SchedulerBase:
         self.page_size = page_size
         self.max_len = max_len
         self.max_queue = max_queue
+        self.prefix = prefix
         self.queue: deque[Request] = deque()
         self.finished: list[Request] = []
         # Terminal non-finished requests (expired/cancelled/rejected/
@@ -298,11 +318,32 @@ class _SchedulerBase:
         return [s for s in self.slots if s.decoding]
 
     def _bind(self, slot: Slot, req: Request, pages: list[int],
-              now: float) -> None:
+              now: float, acq=None) -> None:
         slot.req = req
         slot.pages = pages
         slot.cached = 0
         slot.target = req.context_len
+        slot.refs = []
+        slot.prefix_nodes = []
+        slot.cow = None
+        slot.cow_node = None
+        if acq is not None:
+            # Prefix hit (ISSUE 9): shared pages lead the block table,
+            # cached starts at the matched depth — prefill covers only
+            # the suffix. A partial match copies-on-write into the
+            # slot's FIRST private page (the engine performs the device
+            # copy before the slot's first write). Stats count HERE
+            # (admission), not at acquire: a page-blocked head retried
+            # every tick must leave no phantom hit counts.
+            self.prefix.note_admitted(acq, req.rid)
+            if acq.matched > 0:
+                slot.pages = [n.page for n in acq.nodes] + pages
+                slot.refs = [n.page for n in acq.nodes]
+                slot.prefix_nodes = list(acq.nodes)
+                slot.cached = acq.matched
+                if acq.cow is not None:
+                    slot.cow = (acq.cow.page, pages[0])
+                    slot.cow_node = acq.cow
         slot.admit_seq = self._admit_seq
         self._admit_seq += 1
         req.status = "running"
@@ -310,18 +351,79 @@ class _SchedulerBase:
             req.admitted_at = now
 
     def _release(self, slot: Slot) -> None:
-        if slot.pages:
-            self.pool.free(slot.pages, slot.req.rid)
+        rid = slot.req.rid
+        if slot.cow_node is not None:
+            # Released before the first write: the pending copy never
+            # happened; just return the transient source reference.
+            self.prefix.cow_abandon(slot.cow_node, rid)
+            slot.cow = None
+            slot.cow_node = None
+        if slot.prefix_nodes:
+            self.prefix.release(slot.prefix_nodes, rid)
+        refset = set(slot.refs)
+        private = [p for p in slot.pages if p not in refset]
+        if private:
+            self.pool.free(private, rid)
         slot.req = None
         slot.pages = []
+        slot.refs = []
+        slot.prefix_nodes = []
         slot.cached = 0
         slot.target = 0
         slot.admit_seq = -1
+
+    def cow_complete(self, slot: Slot) -> None:
+        """The engine copied slot.cow's src page into its private dst:
+        release the transient source reference (the copy is counted by
+        the prefix cache)."""
+        self.prefix.cow_done(slot.cow_node, slot.req.rid)
+        slot.cow = None
+        slot.cow_node = None
+
+    def note_prefill_complete(self, slot: Slot) -> None:
+        """Prefill just reached target: adopt the slot's full prompt
+        pages into the prefix tree (ISSUE 9) so later same-prefix
+        requests hit. No-op without a prefix cache."""
+        if self.prefix is not None and slot.req is not None:
+            self.prefix.insert(slot.req.prompt, slot)
+
+    def check(self) -> None:
+        """Pool invariant + the slot-level sharing invariants: every
+        shared page a slot references sits strictly below its written
+        extent (no writable-shared page from the block table's point
+        of view), and any pending COW destination is private."""
+        self.pool.check()
+        ps = self.page_size
+        for s in self.slots:
+            if s.free:
+                assert not s.refs and s.cow is None
+                continue
+            refset = set(s.refs)
+            assert len(refset) == len(s.refs), "duplicate slot ref"
+            for i, p in enumerate(s.pages):
+                if p in refset:
+                    assert self.pool.is_shared(p), (
+                        f"slot ref page {p} is not a shared pool page"
+                    )
+                    assert (i + 1) * ps <= s.cached, (
+                        f"shared page {p} extends into slot {s.idx}'s "
+                        "writable region"
+                    )
+            if s.cow is not None:
+                assert s.cow[1] in s.pages and s.cow[1] not in refset, (
+                    "COW destination is not a private slot page"
+                )
+
+    def _on_terminal(self, req: Request, now: float) -> None:
+        """Hook: a request just reached a terminal status (finished or
+        dropped). The SLO-aware scheduler folds it into its live
+        per-tenant accountant; the FCFS schedulers do nothing."""
 
     def finish(self, slot: Slot, now: float) -> None:
         slot.req.status = "finished"
         slot.req.finished_at = now
         self.finished.append(slot.req)
+        self._on_terminal(slot.req, now)
         self._release(slot)
 
     def _drop(self, req: Request, status: str, now: float,
@@ -330,6 +432,7 @@ class _SchedulerBase:
         req.fail_reason = reason
         req.finished_at = now
         self.dropped.append(req)
+        self._on_terminal(req, now)
         return req
 
     # Whether sweep() releases an in-flight aborted request's slot and
@@ -402,6 +505,44 @@ class _SchedulerBase:
 class ContinuousScheduler(_SchedulerBase):
     """FCFS iteration-level scheduling with recompute preemption."""
 
+    _ACQUIRE = object()  # sentinel: _admit_one acquires for itself
+
+    def _admit_one(self, slot: Slot, req: Request, now: float,
+                   acq=_ACQUIRE) -> bool:
+        """Try to bind `req` into `slot`: prefix-match (ISSUE 9 — a
+        hit shares matched pages and starts cached at the matched
+        depth), cover the remaining extent + one decode row from the
+        pool (reclaiming LRU-retained prefix pages before giving up),
+        bind. Returns False (and leaves no trace) when the pool cannot
+        cover the request. A caller that already acquired (the SLO
+        scheduler's quota check needs the match depth first) passes
+        its acquisition in; on failure it is released either way."""
+        if acq is ContinuousScheduler._ACQUIRE:
+            acq = None
+            if self.prefix is not None:
+                acq = self.prefix.acquire(req.prompt, req.rid,
+                                          max_tokens=req.context_len - 1)
+        f = len(acq.nodes) if acq is not None else 0
+        need = pages_for(req.context_len + 1, self.page_size) - f
+        if need > self.pool.free_pages and self.prefix is not None:
+            self.prefix.reclaim(need - self.pool.free_pages)
+        if need > self.pool.free_pages:
+            if acq is not None:
+                self._release_acq(acq, req.rid)
+            return False
+        pages = self.pool.try_alloc(
+            pages_for(req.context_len, self.page_size) - f, req.rid
+        )
+        assert pages is not None
+        self._bind(slot, req, pages, now, acq=acq)
+        return True
+
+    def _release_acq(self, acq, rid) -> None:
+        """Undo an acquisition whose admission did not go through."""
+        if acq.cow is not None:
+            self.prefix.cow_abandon(acq.cow, rid)
+        self.prefix.release(acq.nodes, rid)
+
     def admit(self, now: float) -> list[Slot]:
         """Move arrived queue-head requests into free slots, bounded by
         free pages: a request is admitted only when the pool covers its
@@ -429,14 +570,9 @@ class ContinuousScheduler(_SchedulerBase):
                            f"context of {req.context_len} tokens needs "
                            f"{need} pages; pool owns {self.pool.usable}")
                 continue
-            if need > self.pool.free_pages:
+            if not self._admit_one(slot, req, now):
                 break
-            pages = self.pool.try_alloc(
-                pages_for(req.context_len, self.page_size), req.rid
-            )
-            assert pages is not None
             self.queue.popleft()
-            self._bind(slot, req, pages, now)
             bound.append(slot)
         return bound
 
@@ -452,14 +588,22 @@ class ContinuousScheduler(_SchedulerBase):
         self.queue.appendleft(req)
         self._release(slot)
 
+    def _choose_victim(self, victims: list[Slot]) -> Slot:
+        """FCFS preemption policy: evict the latest-admitted sequence.
+        The SLO-aware scheduler overrides this with priority + burn-
+        driven choice."""
+        return max(victims, key=lambda s: s.admit_seq)
+
     def grow_for_decode(self, now: float = 0.0) -> list[Slot]:
         """Give every decoding slot the page its next cache row needs,
-        preempting latest-admitted sequences while the pool is dry.
-        Returns the decoding slots that survived, oldest-first (the
-        engine's tick order). A slot that is dry and ALONE can never
-        grow — no victim remains — so its request is failed terminally
-        (the livelock guard's decode half) instead of raising: the
-        engine keeps serving everything else."""
+        reclaiming LRU-retained prefix pages first (ISSUE 9 — evicted
+        cache beats evicted work), then preempting victim sequences
+        while the pool is dry. Returns the decoding slots that
+        survived, oldest-first (the engine's tick order). A slot that
+        is dry and ALONE can never grow — no victim remains — so its
+        request is failed terminally (the livelock guard's decode
+        half) instead of raising: the engine keeps serving everything
+        else."""
         survivors = []
         for slot in sorted(self.decode_slots(), key=lambda s: s.admit_seq):
             if slot.free or not slot.decoding:
@@ -467,11 +611,14 @@ class ContinuousScheduler(_SchedulerBase):
             stalled = False
             while slot.pages and len(slot.pages) * self.page_size <= slot.cached:
                 got = self.pool.try_alloc(1, slot.req.rid)
+                if (got is None and self.prefix is not None
+                        and self.prefix.reclaim(1)):
+                    got = self.pool.try_alloc(1, slot.req.rid)
                 if got is not None:
                     slot.pages.extend(got)
                     continue
                 victims = [s for s in self.slots if not s.free]
-                victim = max(victims, key=lambda s: s.admit_seq)
+                victim = self._choose_victim(victims)
                 if victim is slot and len(victims) == 1:
                     req = slot.req
                     if pages_for(slot.cached + 1,
@@ -562,3 +709,219 @@ class StaticScheduler(_SchedulerBase):
                 self._release(slot)
             else:
                 self.finish(slot, now)
+
+
+# -- SLO-aware scheduling (ISSUE 9) -------------------------------------
+
+
+def parse_tenant_priorities(spec: str) -> dict[str, int]:
+    """The --tenant-priority grammar: 't0=2,t1=0' -> {'t0': 2,
+    't1': 0}. Higher is more protected."""
+    out: dict[str, int] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        try:
+            tenant, prio = part.split("=")
+            out[tenant.strip()] = int(prio)
+        except ValueError as e:
+            raise ValueError(
+                f"--tenant-priority entry {part!r}: want tenant=int "
+                "(e.g. 't0=2,t1=0')"
+            ) from e
+    return out
+
+
+def parse_tenant_quotas(spec: str) -> tuple[dict[str, int], dict[str, int]]:
+    """The --tenant-quota grammar: 't0=pages:8/slots:2,t1=slots:1' ->
+    (slot_quota, page_quota) dicts. A dimension left out of a tenant's
+    entry is unbounded for that tenant."""
+    slot_q: dict[str, int] = {}
+    page_q: dict[str, int] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        try:
+            tenant, dims = part.split("=")
+        except ValueError as e:
+            raise ValueError(
+                f"--tenant-quota entry {part!r}: want "
+                "tenant=dim:int[/dim:int] (e.g. 't0=pages:8/slots:2')"
+            ) from e
+        for dim in filter(None, (d.strip() for d in dims.split("/"))):
+            try:
+                kind, bound = dim.split(":")
+                bound = int(bound)
+            except ValueError as e:
+                raise ValueError(
+                    f"--tenant-quota {part!r}: bad dimension {dim!r}"
+                ) from e
+            if kind == "slots":
+                slot_q[tenant.strip()] = bound
+            elif kind == "pages":
+                page_q[tenant.strip()] = bound
+            else:
+                raise ValueError(
+                    f"--tenant-quota {part!r}: dimension {kind!r} must "
+                    "be 'slots' or 'pages'"
+                )
+    return slot_q, page_q
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """Configuration for SLOScheduler: per-tenant priority classes
+    (higher = more protected; a request's own nonzero `priority`
+    overrides its tenant's class), per-tenant admission quotas (slots
+    = concurrent engine slots; pages = PRIVATE pages reserved at
+    admission — shared prefix pages are free capacity and don't
+    count), and the SLO spec whose objectives drive the live burn
+    accounting (obs.slo grammar; None = the default availability-only
+    spec)."""
+
+    priorities: dict = dataclasses.field(default_factory=dict)
+    slot_quota: dict = dataclasses.field(default_factory=dict)
+    page_quota: dict = dataclasses.field(default_factory=dict)
+    slo_spec: object = None
+
+
+class SLOScheduler(ContinuousScheduler):
+    """SLO-aware admission and preemption over the continuous-batching
+    machinery (ISSUE 9, ROADMAP item 2).
+
+    FCFS treats every request identically; at production scale tenants
+    carry different objectives and an over-subscribed tenant can starve
+    everyone else's SLOs. This scheduler folds every terminal request
+    into a live obs.slo.Accountant (the PR-8 measurement layer) and
+    lets the numbers drive policy, all host-side and deterministic:
+
+    - ADMISSION reorders arrived requests by (priority class desc,
+      tenant burn-rate pressure desc, arrival, rid): protected classes
+      first, and within a class the tenant currently burning its error
+      budget fastest gets capacity first. Per-tenant quotas bound what
+      one tenant can hold (slots and admission-time private pages); a
+      quota-blocked tenant is SKIPPED — no head-of-line blocking — but
+      a page-blocked top candidate waits (lower-ranked work never
+      jumps the page queue).
+    - PREEMPTION victims are picked by (priority class asc, tenant
+      pressure asc, latest-admitted): the worst-burning tenant's work
+      is protected, and FCFS's replace-latest rule only breaks ties.
+
+    Burn pressure is a pure fold over event times the scheduler itself
+    stamped, so two identical-seed runs make bitwise-identical
+    decisions (the CI determinism gate covers the fleet form)."""
+
+    def __init__(self, *, policy: SLOPolicy | None = None, **kw):
+        super().__init__(**kw)
+        # Lazy obs import: this module stays light for the fleet's
+        # jax-free sim path (obs.slo is itself stdlib-only).
+        from ..obs.slo import Accountant, default_spec
+
+        self.policy = policy or SLOPolicy()
+        self.acct = Accountant(self.policy.slo_spec or default_spec())
+
+    def _on_terminal(self, req: Request, now: float) -> None:
+        for _ in self.acct.observe(terminal_fields(req), now):
+            pass
+
+    def _prio(self, req: Request) -> int:
+        if req.priority:
+            return req.priority
+        return self.policy.priorities.get(req.tenant or "default", 0)
+
+    def pressure(self, tenant: str) -> float:
+        """The tenant's worst CURRENT burn-rate multiple across its
+        objectives and windows — the live 'how close to paging' number
+        admission and victim choice read."""
+        worst = 0.0
+        for (t, metric), we in self.acct.events.items():
+            if t != tenant:
+                continue
+            obj = next(o for o in self.acct.spec.objectives(t)
+                       if o.metric == metric)
+            for w in we.windows_s:
+                worst = max(worst, we.burn_rate(w, obj.target))
+        return worst
+
+    def _choose_victim(self, victims: list[Slot]) -> Slot:
+        """Victims by (priority class asc, tenant burn pressure asc,
+        latest-admitted): the worst-burning tenant's work is protected;
+        FCFS's replace-latest rule only breaks ties."""
+        return min(victims, key=lambda s: (
+            self._prio(s.req),
+            self.pressure(s.req.tenant or "default"),
+            -s.admit_seq,
+        ))
+
+    def _usage(self, tenant: str) -> tuple[int, int]:
+        """(slots held, private pages held) by `tenant` right now.
+        Shared prefix pages don't count — they are deduplicated
+        capacity, not the tenant's reservation."""
+        slots_held = pages_held = 0
+        for s in self.slots:
+            if s.free or (s.req.tenant or "default") != tenant:
+                continue
+            slots_held += 1
+            pages_held += len(s.pages) - len(s.refs)
+        return slots_held, pages_held
+
+    def admit(self, now: float) -> list[Slot]:
+        bound: list[Slot] = []
+        free_slots = deque(s for s in self.slots if s.free)
+        if not free_slots or not self.queue:
+            return bound
+        arrived = [r for r in self.queue if r.arrival <= now]
+        if not arrived:
+            return bound
+        # One sort per tick: pressures are a pure fold over already-
+        # observed terminals, so neither the ordering key nor the
+        # priority changes mid-admit — only quota USAGE does, and that
+        # is updated incrementally below (O(n log n) per tick instead
+        # of a re-scan per admitted slot: the storm-scale requirement).
+        pressures = {t: self.pressure(t) for t in
+                     {r.tenant or "default" for r in arrived}}
+        order = sorted(arrived, key=lambda r: (
+            -self._prio(r), -pressures[r.tenant or "default"],
+            r.arrival, r.rid))
+        usage = {t: self._usage(t) for t in pressures}
+        taken: set[int] = set()
+        for req in order:
+            if not free_slots:
+                break
+            tenant = req.tenant or "default"
+            need = pages_for(req.context_len + 1, self.page_size)
+            if need > self.pool.usable:
+                # The livelock guard, verbatim from the FCFS form.
+                taken.add(id(req))
+                self._drop(req, "failed", now,
+                           f"context of {req.context_len} tokens needs "
+                           f"{need} pages; pool owns {self.pool.usable}")
+                continue
+            sq = self.policy.slot_quota.get(tenant)
+            pq = self.policy.page_quota.get(tenant)
+            held_slots, held_pages = usage[tenant]
+            if sq is not None and held_slots >= sq:
+                continue  # quota-blocked: skip, don't block others
+            # The page quota counts PRIVATE pages only (the SLOPolicy
+            # contract: shared prefix pages are deduplicated capacity)
+            # — so acquire first to learn the match depth, and release
+            # if the quota still blocks.
+            acq = (self.prefix.acquire(req.prompt, req.rid,
+                                       max_tokens=req.context_len - 1)
+                   if self.prefix is not None else None)
+            alloc_n = (pages_for(req.context_len, self.page_size)
+                       - (len(acq.nodes) if acq is not None else 0))
+            if pq is not None and held_pages + alloc_n > pq:
+                if acq is not None:
+                    self._release_acq(acq, req.rid)
+                continue
+            slot = free_slots[0]
+            if not self._admit_one(slot, req, now, acq=acq):
+                # Page-blocked: the top-ranked admissible request
+                # waits; nothing below it jumps the page queue.
+                break
+            free_slots.popleft()
+            taken.add(id(req))
+            bound.append(slot)
+            usage[tenant] = (held_slots + 1,
+                             held_pages + len(slot.pages) - len(slot.refs))
+        if taken:
+            self.queue = deque(r for r in self.queue
+                               if id(r) not in taken)
+        return bound

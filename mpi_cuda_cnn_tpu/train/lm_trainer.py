@@ -147,13 +147,16 @@ class LMTrainer:
                 f"[0, seq_len {cfg.seq_len}) — the prompt needs >= 1 "
                 f"position of the decode budget"
             )
-        if cfg.decode_cache_dtype not in ("float32", "bfloat16", "int8"):
+        if cfg.decode_cache_dtype not in ("float32", "bfloat16", "int8",
+                                          "auto"):
             # Same rationale: the auto-generated flag parser is type=str,
             # so a typo ('bf16') would otherwise surface only at
-            # sampling time, after the whole run.
+            # sampling time, after the whole run. "auto" (VERDICT 7)
+            # routes from the banked int8 table at sample time: int8
+            # for GQA/MQA, bfloat16 for MHA (generate.pick_cache_dtype).
             raise ValueError(
                 f"--decode-cache-dtype {cfg.decode_cache_dtype!r} must "
-                "be 'float32', 'bfloat16', or 'int8'"
+                "be 'float32', 'bfloat16', 'int8', or 'auto'"
             )
         if cfg.sample_top_k < 0 or not 0.0 <= cfg.sample_top_p <= 1.0:
             raise ValueError(
@@ -993,7 +996,7 @@ class LMTrainer:
             toks = lookup_speculative_generate(
                 self.model, params, prompt, num_tokens,
                 k=cfg.sample_speculative_k,
-                cache_dtype=cfg.decode_cache_dtype,
+                cache_dtype=self._cache_dtype(),
                 temperature=temperature,
                 key=jax.random.key(seed) if temperature > 0 else None,
                 top_k=cfg.sample_top_k, top_p=cfg.sample_top_p,
@@ -1003,10 +1006,19 @@ class LMTrainer:
                 self.model, params, prompt, num_tokens,
                 temperature=temperature,
                 key=jax.random.key(seed) if temperature > 0 else None,
-                cache_dtype=cfg.decode_cache_dtype,
+                cache_dtype=self._cache_dtype(),
                 top_k=cfg.sample_top_k, top_p=cfg.sample_top_p,
             )
         return np.asarray(prompt[0]), np.asarray(toks[0])
+
+    def _cache_dtype(self) -> str:
+        """--decode-cache-dtype with "auto" resolved against THIS
+        model's head geometry (generate.pick_cache_dtype, VERDICT 7)."""
+        from ..models.generate import pick_cache_dtype
+
+        return pick_cache_dtype(self.cfg.decode_cache_dtype,
+                                heads=self.model.heads,
+                                kv_heads=self.model.n_kv)
 
     def evaluate(self) -> float:
         """Mean next-token NLL over deterministic windows of the held-out

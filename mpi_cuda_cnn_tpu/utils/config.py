@@ -252,6 +252,10 @@ class LMConfig(_JsonConfig):
                                      # "int8" quarters them (absmax per
                                      # position x head, scales applied
                                      # outside the dots — generate.py);
+                                     # "auto" routes from the banked
+                                     # int8 table (VERDICT 7): int8 for
+                                     # GQA/MQA, bf16 for MHA
+                                     # (generate.pick_cache_dtype);
                                      # f32 = exactness default
 
 

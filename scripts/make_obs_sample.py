@@ -18,7 +18,11 @@ path. ISSUE 8 adds a two-tenant seeded mix and a live alert engine
 over a deliberately tight SLO spec (tests/data/sample_slo.json), so
 the sample carries `alert` events whose replay-equality and CRC the
 round-trip tests pin, and the health golden shows violated AND met
-objectives. Rerun after any deliberate schema or rendering change:
+objectives. ISSUE 9 turns on prefix sharing for the continuous run
+over a --prefix-mix workload (shared template prompts), so the sample
+carries `prefix_hits` tick markers and the `prefix` cache-panel
+fields the trace/top surfaces render. Rerun after any deliberate
+schema or rendering change:
 
     JAX_PLATFORMS=cpu python scripts/make_obs_sample.py
 """
@@ -91,7 +95,7 @@ def build_records():
 
         reqs = make_workload(n=8, vocab=13, prompt_min=4, prompt_max=8,
                              out_min=6, out_max=18, rate=40.0, seed=5,
-                             deadline_s=0.3, tenants=2)
+                             deadline_s=0.3, tenants=2, prefix_mix=0.6)
         # Under a FakeClock, in-engine service is instantaneous (the
         # clock only advances on idle waits), so deadlines would be
         # all-or-nothing; the staggered slow faults ratchet the clock
@@ -102,7 +106,12 @@ def build_records():
             "slow@serve.tick:30?s=0.15", clock=clock)
         res = engine.run(reqs, mode=mode, time_fn=clock,
                          sleep_fn=clock.advance, faults=faults,
-                         registry=registry, tick_sink=sink)
+                         registry=registry, tick_sink=sink,
+                         # Prefix sharing is continuous-only (static is
+                         # the reservation baseline): the continuous
+                         # half of the sample carries the ISSUE 9
+                         # prefix_hits/prefix tick fields.
+                         prefix=(mode == "continuous"))
         s = res.summary()
         registry.set("serve.tokens_per_s", s["tokens_per_s"])
         emit(registry.snapshot(mode=mode, final=True), clock)

@@ -101,6 +101,15 @@ step bench_serve 900 python scripts/bench_serve.py --requests 32 \
     --rate 200
 step bench_serve_gqa_int8 900 python scripts/bench_serve.py \
     --requests 32 --rate 200 --kv-heads 1 --cache-dtype int8
+# ISSUE 9: prefix sharing on-chip — the sharing-on/off pair at a high
+# shared-template mix banks hit rate vs tokens/s + TTFT percentiles
+# for the PERF.md "Prefix-sharing" table (skipped prefill FLOPs
+# meeting real HBM bandwidth; the CPU rows pin the schedule side).
+step bench_serve_prefix 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --prefix-cache
+step bench_serve_prefix_off 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9
 step profile_lm 900 python scripts/profile_lm.py
 # PR-7 (fleet): the engine-backed fleet on a real chip — N PagedEngine
 # replicas (shared weights) behind the failure-aware router, one crash

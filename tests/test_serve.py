@@ -187,31 +187,49 @@ def test_scheduler_admit_finish_preempt_keep_pool_consistent():
 
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
 def test_pagepool_randomized_op_sequence_invariant(dtype):
-    """Seeded randomized-sequence invariant (ISSUE 7 satellite): a few
-    hundred random admit / prefill-chunk / decode-growth / preempt /
-    cancel / expire operations against a real PagedEngine cache in each
-    storage dtype, with pool.check() after EVERY step — the no-leak /
-    no-double-book / scratch-never-circulates invariant must hold at
-    every intermediate state, not just the curated sequences above.
-    The fleet's re-dispatch path (serve/fleet.py) drives this exact
-    scheduler+pool pair per replica, so it inherits the guarantee."""
+    """Seeded randomized-sequence invariant (ISSUE 7 satellite,
+    extended for ISSUE 9): a few hundred random admit / prefill-chunk /
+    decode-growth / preempt / cancel / expire operations — now
+    interleaved with prefix-cache share / acquire / COW / insert /
+    LRU-evict / release traffic (half the prompts draw from a shared
+    template pool, a reclaim op squeezes retained pages out) — against
+    a real PagedEngine cache in each storage dtype, with the extended
+    sched.check() (pool no-leak / no-double-book / scratch-never-
+    circulates PLUS refcount conservation and no-writable-shared-page)
+    after EVERY step. The fleet's re-dispatch path (serve/fleet.py)
+    drives this exact scheduler+pool+prefix triple per replica, so it
+    inherits the guarantee."""
+    from mpi_cuda_cnn_tpu.serve.prefix_cache import PrefixCache
+
     params = MODEL.init(jax.random.key(2))
     engine = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
                          prefill_chunk=4, max_len=32, cache_dtype=dtype)
     # Host pool sized to the engine's device page arrays — the pairing
     # ReplicaCore uses: page indices from this pool index those arrays.
     pool = PagePool(10)
-    sched = ContinuousScheduler(slots=3, pool=pool, page_size=4, max_len=32)
+    prefix = PrefixCache(pool, page_size=4)
+    sched = ContinuousScheduler(slots=3, pool=pool, page_size=4, max_len=32,
+                                prefix=prefix)
     rng = np.random.default_rng(11)
+    # Shared template prompts: same-template requests exercise full-page
+    # acquire; divergent suffixes at non-page-aligned depths hit COW.
+    templates = [rng.integers(0, 13, (9,)).astype(np.int32)
+                 for _ in range(2)]
     now = 0.0
     next_rid = 0
     submitted: list[Request] = []
 
     def submit_one():
         nonlocal next_rid
+        if rng.random() < 0.5:
+            tmpl = templates[int(rng.integers(len(templates)))]
+            keep = int(rng.integers(4, 10))
+            tail = rng.integers(0, 13, (int(rng.integers(1, 4)),))
+            prompt = np.concatenate([tmpl[:keep], tail.astype(np.int32)])
+        else:
+            prompt = rng.integers(0, 13, (int(rng.integers(2, 12)),))
         req = Request(
-            rid=next_rid,
-            prompt=rng.integers(0, 13, (int(rng.integers(2, 12)),)),
+            rid=next_rid, prompt=prompt,
             max_new_tokens=int(rng.integers(2, 14)), arrival=now,
             # ~1 in 4 requests carries a deadline the clock will cross.
             deadline=(now + float(rng.uniform(0.05, 0.6))
@@ -225,9 +243,13 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
         slot = sched.prefill_slot()
         if slot is None:
             return
+        if slot.cow is not None:
+            engine.copy_page(*slot.cow)
+            sched.cow_complete(slot)
         n, nxt = engine.run_prefill_chunk(slot)
         slot.cached += n
         if slot.cached >= slot.target:
+            sched.note_prefill_complete(slot)
             slot.req.out.append(int(nxt))
             if slot.req.done:
                 sched.finish(slot, now)
@@ -254,29 +276,41 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
             live[int(rng.integers(len(live)))].cancel()
             sched.sweep(now)
 
+    def reclaim_op():
+        # The squeeze/pressure path: evict up to 2 LRU refcount-0
+        # prefix pages (never a referenced one — free() would raise).
+        prefix.reclaim(int(rng.integers(1, 3)))
+
     ops = [submit_one, lambda: sched.admit(now), prefill_step,
            decode_step_op, preempt_op, cancel_op,
-           lambda: sched.sweep(now)]
-    weights = np.array([0.22, 0.18, 0.2, 0.2, 0.08, 0.06, 0.06])
+           lambda: sched.sweep(now), reclaim_op]
+    weights = np.array([0.22, 0.18, 0.2, 0.18, 0.08, 0.05, 0.05, 0.04])
     for _ in range(300):
         now += float(rng.uniform(0.0, 0.02))  # deadlines really expire
         ops[int(rng.choice(len(ops), p=weights))]()
-        pool.check()
+        sched.check()
     # Drain: the surviving work must complete and hand every page back.
     while sched.unfinished:
         sched.sweep(now)
         sched.admit(now)
         prefill_step()
         decode_step_op()
-        pool.check()
+        sched.check()
         now += 0.01
     assert all(r.terminal for r in submitted)
+    prefix.clear()   # retained LRU pages hand back at teardown
+    sched.check()
     assert pool.free_pages == pool.usable
-    # The randomized walk must have exercised the interesting paths.
+    # The randomized walk must have exercised the interesting paths —
+    # including the whole ISSUE 9 surface.
     assert sched.preemptions > 0
     statuses = {r.status for r in submitted}
     assert "finished" in statuses
     assert statuses & {"expired", "cancelled"}
+    assert prefix.stats["hits"] > 0
+    assert prefix.stats["cow_copies"] > 0
+    assert prefix.stats["inserts"] > 0
+    assert prefix.stats["evictions"] > 0
 
 
 def test_engine_preemption_recovers_and_completes():
