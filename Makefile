@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative bench_serve bench_fleet serve-baseline profile_lm profile_moe report health test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve bench_fleet serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -175,6 +175,18 @@ report:
 #   make health RUN=run.jsonl SLO=ci/slo_gate.json
 health:
 	$(PY) -m mpi_cuda_cnn_tpu health $(RUN) $(if $(SLO),--slo $(SLO))
+
+# Style gate + the framework-invariant analyzer (ISSUE 10): ruff at
+# the pyproject scope, then `mctpu lint` (rules MCT001-MCT007 — jax
+# purity, clock/RNG/donation discipline, schema/fault-site
+# cross-checks, hot-loop host-sync) as JSON against the committed
+# zero-entry baseline. Exit nonzero on any finding — the same pair CI
+# runs. ruff is optional locally (skipped with a note if absent).
+lint:
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
+	  else echo "ruff not installed — skipping style half (CI runs it)"; fi
+	$(PY) -m mpi_cuda_cnn_tpu lint --format json \
+	  --baseline ci/lint_baseline.json
 
 # North-star recipe (BASELINE.json): LeNet-5(relu) to >=99% MNIST test
 # accuracy — he init, momentum, cosine decay, random-shift augmentation.

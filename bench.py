@@ -146,10 +146,15 @@ def main() -> None:
     # lesson: BENCH_r02 was rc=124 with parsed=null after a 25-minute hang).
     import subprocess
 
+    # Real OS clock on purpose: this bounds a subprocess that can HANG
+    # in C-level init, and the parent must never import the package
+    # (so utils/clock is unreachable).
+    # mctpu: disable=MCT002
     deadline = time.monotonic() + TOTAL_TIMEOUT_S
     errors = []
     for attempt in range(1, 4):
-        budget = min(ATTEMPT_TIMEOUT_S, deadline - time.monotonic())
+        budget = min(ATTEMPT_TIMEOUT_S,
+                     deadline - time.monotonic())  # mctpu: disable=MCT002
         if budget <= 10.0:
             errors.append("total wall-clock budget exhausted")
             break

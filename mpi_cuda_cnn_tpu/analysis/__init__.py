@@ -1,0 +1,74 @@
+"""analysis — the framework-invariant static analyzer behind `mctpu lint`.
+
+Nine PRs of review-hardening accumulated a set of contracts that lived
+only as prose in CHANGES.md — "the scheduler/router/slo/alerts layer is
+jax-free", "wall-clock goes through an injectable clock", "donation only
+via donate_jit", "every JSONL record uses a registered schema family",
+"every fault hook site is in faults.SITES" — and each was violated at
+least once before a reviewer caught it by hand. In the spirit of
+deviant-behavior inference (Engler et al., SOSP 2001: the codebase's own
+majority usage IS the specification) and always-on analyzer platforms
+(Sadowski et al., Tricorder, ICSE 2015: checks that run on every change,
+with precise findings and in-code suppressions, are the ones that stick),
+this package encodes those contracts as AST rules that run on every PR.
+
+Layout:
+- `core`        — Finding, the Rule protocol, the shared single-pass
+                  visitor, per-line `# mctpu: disable=MCTxxx` suppressions.
+- `manifest`    — the checked-in contract manifest (ci/lint_manifest.json):
+                  which modules are declared jax-free, the allowlisted
+                  clock/donation modules, the hot-loop sites.
+- `rules_purity`     — MCT001 jax-purity of manifested modules.
+- `rules_discipline` — MCT002 clock, MCT003 donation, MCT004 RNG.
+- `rules_crosscheck` — MCT005 schema families, MCT006 fault sites
+                  (semantic: the live registries are imported, not
+                  regexed, so the rule and the runtime cannot drift).
+- `rules_hotloop`    — MCT007 host-sync-in-hot-loop.
+- `baseline`    — the committed zero-entry baseline (ci/lint_baseline.json)
+                  that makes CI fail on any NEW finding.
+- `cli`         — `mctpu lint [PATHS] [--rule MCTxxx] [--format json]`.
+
+This package is itself declared jax-free in the manifest: `mctpu lint`
+must run on a machine with no accelerator stack warmed up.
+"""
+
+from __future__ import annotations
+
+from .baseline import load_baseline, write_baseline
+from .core import Finding, LintError, Rule, lint_paths
+from .manifest import Manifest, find_root, load_manifest
+from .rules_crosscheck import FaultSiteRule, SchemaFamilyRule
+from .rules_discipline import ClockRule, DonationRule, RngRule
+from .rules_hotloop import HostSyncRule
+from .rules_purity import JaxPurityRule
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "LintError",
+    "Manifest",
+    "Rule",
+    "all_rules",
+    "find_root",
+    "lint_paths",
+    "load_baseline",
+    "load_manifest",
+    "write_baseline",
+]
+
+# The shipped rule set, in rule-id order. A rule class is instantiated
+# per lint run (rules hold no cross-run state).
+ALL_RULES = (
+    JaxPurityRule,      # MCT001
+    ClockRule,          # MCT002
+    DonationRule,       # MCT003
+    RngRule,            # MCT004
+    SchemaFamilyRule,   # MCT005
+    FaultSiteRule,      # MCT006
+    HostSyncRule,       # MCT007
+)
+
+
+def all_rules() -> list[Rule]:
+    """Fresh instances of every shipped rule."""
+    return [cls() for cls in ALL_RULES]

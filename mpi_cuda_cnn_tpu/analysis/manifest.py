@@ -1,0 +1,110 @@
+"""The checked-in contract manifest the rules read (ci/lint_manifest.json).
+
+The analyzer encodes REPO contracts, not generic style, and a contract
+needs a declaration site: which modules claim jax-freedom (MCT001),
+which single module may read the wall clock (MCT002) or spell donation
+(MCT003), and which function bodies are serving hot loops with which
+device-value producers (MCT007). Keeping those declarations in one
+committed JSON file — instead of constants inside each rule — means a
+reviewer sees scope changes ("engine.py is no longer a hot loop") as a
+diff to the manifest, and tests can hand rules a synthetic manifest to
+point them at fixture files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+MANIFEST_REL = "ci/lint_manifest.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class HotLoop:
+    """One file's hot-loop declaration: `functions` are the def names
+    whose bodies are scanned, `producers` the dotted call targets whose
+    results are device values (jitted programs and the documented
+    device-array-returning helpers)."""
+
+    functions: frozenset[str]
+    producers: frozenset[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Manifest:
+    """Rule configuration. All paths are repo-root-relative POSIX."""
+
+    # Modules declared jax-free: no jax/jaxlib import anywhere in the
+    # module, and no direct first-party import of a module outside this
+    # set (it may pull jax transitively). Scope note: the package
+    # __init__ chain is deliberately NOT part of the contract — it
+    # imports the jax-heavy subsystems by design; jax-freedom here means
+    # the module's own code adds no jax dependency (offline consumers
+    # load these files directly, e.g. scripts/get_mnist.py's
+    # by-file-path bootstrap of utils/retry.py).
+    jax_free: frozenset[str] = frozenset()
+    # The one module allowed to read the wall clock (MCT002).
+    clock_modules: frozenset[str] = frozenset()
+    # The one module allowed to spell donate_argnums (MCT003).
+    donation_module: str = "mpi_cuda_cnn_tpu/utils/donation.py"
+    # file -> hot-loop declaration (MCT007).
+    hot_loops: dict[str, HotLoop] = dataclasses.field(default_factory=dict)
+    # Default scan scope for `mctpu lint` with no PATHS.
+    paths: tuple[str, ...] = ("mpi_cuda_cnn_tpu", "scripts", "bench.py")
+    # Import prefix that counts as first-party for MCT001.
+    first_party_root: str = "mpi_cuda_cnn_tpu"
+
+
+def load_manifest(path: str | Path) -> Manifest:
+    from .core import LintError  # local: core imports Manifest
+
+    p = Path(path)
+    if not p.is_file():
+        raise LintError(
+            f"lint manifest not found: {p} — the analyzer's contracts "
+            "(jax-free modules, clock/donation allowlists, hot loops) "
+            "live there; pass --manifest or run from the repo root"
+        )
+    try:
+        raw = json.loads(p.read_text())
+    except json.JSONDecodeError as e:
+        raise LintError(f"{p}: bad JSON: {e}") from e
+    known = {"_doc", "jax_free", "clock_modules", "donation_module",
+             "hot_loops", "paths", "first_party_root"}
+    unknown = sorted(set(raw) - known)
+    if unknown:
+        # A typo'd key would silently relax the contract it misspells.
+        raise LintError(f"{p}: unknown manifest keys {unknown}")
+    hot = {}
+    for rel, spec in raw.get("hot_loops", {}).items():
+        hot[rel] = HotLoop(functions=frozenset(spec.get("functions", ())),
+                           producers=frozenset(spec.get("producers", ())))
+    return Manifest(
+        jax_free=frozenset(raw.get("jax_free", ())),
+        clock_modules=frozenset(raw.get("clock_modules", ())),
+        donation_module=raw.get(
+            "donation_module", "mpi_cuda_cnn_tpu/utils/donation.py"),
+        hot_loops=hot,
+        paths=tuple(raw.get("paths",
+                            ("mpi_cuda_cnn_tpu", "scripts", "bench.py"))),
+        first_party_root=raw.get("first_party_root", "mpi_cuda_cnn_tpu"),
+    )
+
+
+def find_root(start: str | Path | None = None) -> Path:
+    """Walk up from `start` (default: cwd) to the directory holding
+    pyproject.toml — the repo root every manifest/baseline path is
+    relative to."""
+    from .core import LintError
+
+    p = Path(start or Path.cwd()).resolve()
+    if p.is_file():
+        p = p.parent
+    for candidate in (p, *p.parents):
+        if (candidate / "pyproject.toml").is_file():
+            return candidate
+    raise LintError(
+        f"no pyproject.toml above {p} — cannot locate the repo root "
+        "(run from inside the repo or pass explicit paths)"
+    )

@@ -1,0 +1,174 @@
+"""MCT002/MCT003/MCT004 — clock, donation, and RNG discipline.
+
+Three rules with one shape: a capability the framework routes through
+exactly one sanctioned spelling, and a banned raw form everywhere else.
+
+MCT002 (clock): every serving/fleet/elasticity proof in this repo is
+bitwise-deterministic because wall-clock only ever enters through an
+injectable `clock` parameter (FakeClock substitutes it in tests). A raw
+`time.time()` / `time.monotonic()` / `datetime.now()` read anywhere
+else is a nondeterminism leak that FakeClock cannot reach. The one
+sanctioned home for real wall-clock reads is the manifest's
+clock_modules (utils/clock.py). `time.perf_counter` is deliberately NOT
+banned: it is the injectable-clock *default value* convention
+("`clock` has the time.perf_counter call shape") — the discipline is
+about call sites, and a default argument is the injection point itself.
+
+MCT003 (donation): buffer donation is spelled ONCE, in
+utils/donation.donate_jit, and proven from the compiled HLO's alias
+table (obs.cost.assert_donation). A raw `donate_argnums=` at a call
+site reintroduces exactly the per-site drift PR 2 removed — and
+donation silently degrades to a copy on a shape mismatch, so a drifted
+site is invisible until the HBM bill arrives.
+
+MCT004 (RNG): every random draw threads a seeded generator
+(np.random.default_rng(seed) / jax PRNGKey); the global-state
+conveniences (np.random.rand, random.random, np.random.seed) make runs
+irreproducible and break the elastic "global batch is a pure function
+of (seed, step)" contract. Tests are exempt (they own their seeds);
+injectable jitter defaults (faults.supervise, utils/retry) carry
+commented suppressions — visible exceptions, not silent ones.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Rule, dotted_name
+
+# Canonical dotted names whose evaluation reads the wall clock. Call
+# sites are matched AFTER resolving import aliases through
+# ctx.canonical (`import time as t; t.monotonic()` and
+# `from datetime import datetime as dt; dt.now()` both resolve), and
+# `from time import monotonic`-style imports are flagged at the import
+# itself — a from-import is the evasion, not its later call sites.
+_BANNED_CLOCK = {
+    "time.time", "time.monotonic", "time.monotonic_ns", "time.time_ns",
+    "time.localtime", "time.gmtime",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# np.random attributes that are NOT the global-state API: seeded
+# construction stays legal everywhere.
+_NP_RANDOM_OK = {
+    "default_rng", "Generator", "SeedSequence", "BitGenerator",
+    "PCG64", "PCG64DXSM", "Philox", "SFC64", "MT19937", "RandomState",
+}
+# stdlib random attributes that construct an owned, seedable instance.
+_STDLIB_RANDOM_OK = {"Random", "SystemRandom"}
+
+
+class ClockRule(Rule):
+    rule_id = "MCT002"
+    title = "raw wall-clock read outside the allowlisted clock module"
+    node_types = (ast.Attribute, ast.ImportFrom)
+
+    def begin_file(self, ctx: FileContext) -> bool:
+        return (ctx.rel not in ctx.manifest.clock_modules
+                and not ctx.is_test)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.ImportFrom):
+            # `from time import monotonic` (any alias) binds a banned
+            # reader to a bare name no attribute match can see — flag
+            # the import. `from datetime import datetime` is fine: its
+            # .now() call sites canonicalize and match below.
+            for a in node.names:
+                full = f"{node.module}.{a.name}" if node.module else a.name
+                if node.level == 0 and full in _BANNED_CLOCK:
+                    self.report(
+                        ctx, node,
+                        f"`from {node.module} import {a.name}` binds a "
+                        "raw wall-clock reader — take an injectable "
+                        "clock or use utils/clock.py",
+                    )
+            return
+        name = dotted_name(node)
+        if name is None:
+            return
+        if ctx.canonical(name) in _BANNED_CLOCK:
+            self.report(
+                ctx, node,
+                f"wall-clock read {name!r} outside the clock module — "
+                "take an injectable clock (perf_counter call shape; "
+                "FakeClock substitutes it) or use utils/clock.py, the "
+                "one sanctioned wall-clock surface",
+            )
+
+
+class DonationRule(Rule):
+    rule_id = "MCT003"
+    title = "raw donate_argnums/donate_argnames outside utils/donation.py"
+    node_types = (ast.Call,)
+
+    def begin_file(self, ctx: FileContext) -> bool:
+        return ctx.rel != ctx.manifest.donation_module
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        assert isinstance(node, ast.Call)
+        for kw in node.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                self.report(
+                    ctx, node,
+                    f"{kw.arg}= spelled at a call site — donation goes "
+                    "through utils/donation.donate_jit (the ONE spelling "
+                    "obs.cost.assert_donation proves from the compiled "
+                    "HLO alias table)",
+                )
+
+
+class RngRule(Rule):
+    rule_id = "MCT004"
+    title = "global-state RNG outside tests"
+    node_types = (ast.Attribute, ast.ImportFrom)
+
+    def begin_file(self, ctx: FileContext) -> bool:
+        return not ctx.is_test
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level == 0 and mod == "random":
+                bad = [a.name for a in node.names
+                       if a.name not in _STDLIB_RANDOM_OK]
+                if bad:
+                    self.report(
+                        ctx, node,
+                        f"`from random import {', '.join(bad)}` pulls the "
+                        "process-global RNG — thread a seeded "
+                        "np.random.default_rng / random.Random instead",
+                    )
+            elif node.level == 0 and mod == "numpy.random":
+                bad = [a.name for a in node.names
+                       if a.name not in _NP_RANDOM_OK]
+                if bad:
+                    self.report(
+                        ctx, node,
+                        f"`from numpy.random import {', '.join(bad)}` is "
+                        "the global-state API — use default_rng(seed)",
+                    )
+            return
+        name = dotted_name(node)
+        if name is None:
+            return
+        # Resolve aliases through the file's own imports: `np.random.X`
+        # canonicalizes to numpy.random.X; a `random` bound by
+        # `from jax import random` canonicalizes to jax.random and is
+        # seeded-key threading, not a violation.
+        parts = ctx.canonical(name).split(".")
+        if (len(parts) == 3 and parts[0] == "numpy" and parts[1] == "random"
+                and parts[2] not in _NP_RANDOM_OK):
+            self.report(
+                ctx, node,
+                f"{name} draws from numpy's process-global RNG — "
+                "irreproducible; thread np.random.default_rng(seed)",
+            )
+        elif (len(parts) == 2 and parts[0] == "random"
+                and parts[1] not in _STDLIB_RANDOM_OK):
+            self.report(
+                ctx, node,
+                f"{name} draws from the process-global stdlib RNG — "
+                "irreproducible; thread a seeded random.Random or "
+                "np.random.default_rng",
+            )

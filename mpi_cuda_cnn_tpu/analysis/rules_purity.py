@@ -1,0 +1,110 @@
+"""MCT001 — jax-purity of modules declared jax-free in the manifest.
+
+The scheduler/router/slo/alerts/metrics/timeline/regress/faults/schema
+layer is the framework's POLICY half: it must run in offline tools
+(`mctpu report/trace/compare/health`), in the fleet's 10^5-request sim
+storms, and in bootstrap scripts, without importing jax — an accidental
+jax import turns a millisecond policy test into a device-init, and a
+traced op inside a policy decision breaks the FakeClock bitwise
+determinism every serving proof rests on.
+
+Two violation shapes:
+- importing jax/jaxlib (module level OR lazily inside a function — a
+  lazy import is still a jax dependency the first time the branch runs;
+  the two deliberate lazy sites in faults.py carry commented
+  suppressions, which is the point: exceptions are visible at the site);
+- directly importing a first-party module that is NOT itself declared
+  jax-free — the one-level closure check that caught
+  serve/scheduler.py's lazy `obs.report` import (report -> cost -> jax)
+  hiding inside the fleet sim path.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .core import FileContext, Rule
+
+_JAX_ROOTS = ("jax", "jaxlib")
+
+
+def _is_jax(module: str | None) -> bool:
+    if not module:
+        return False
+    top = module.split(".", 1)[0]
+    return top in _JAX_ROOTS
+
+
+class JaxPurityRule(Rule):
+    rule_id = "MCT001"
+    title = "jax-free module imports jax or a non-jax-free first-party module"
+    node_types = (ast.Import, ast.ImportFrom)
+
+    def begin_file(self, ctx: FileContext) -> bool:
+        return ctx.rel in ctx.manifest.jax_free
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _is_jax(alias.name):
+                    self.report(ctx, node,
+                                f"module is declared jax-free "
+                                f"(ci/lint_manifest.json) but imports "
+                                f"{alias.name!r}")
+                elif alias.name.split(".", 1)[0] == \
+                        ctx.manifest.first_party_root:
+                    self._check_first_party(
+                        node, ctx, alias.name.split("."), level=0)
+        elif isinstance(node, ast.ImportFrom):
+            if _is_jax(node.module):
+                self.report(ctx, node,
+                            f"module is declared jax-free but imports "
+                            f"from {node.module!r}")
+            elif node.level > 0 or (
+                    node.module or "").split(".", 1)[0] == \
+                    ctx.manifest.first_party_root:
+                parts = (node.module or "").split(".") if node.module else []
+                self._check_first_party(node, ctx, parts, level=node.level)
+
+    def _check_first_party(self, node: ast.AST, ctx: FileContext,
+                           parts: list[str], *, level: int) -> None:
+        target = _resolve(ctx.rel, parts, level)
+        if target is None or target in ctx.manifest.jax_free:
+            return
+        self.report(
+            ctx, node,
+            f"jax-free module imports first-party {target!r}, which is "
+            "not declared jax-free — it may pull jax transitively "
+            "(declare it in ci/lint_manifest.json once it is, or move "
+            "the needed helper into a jax-free module)",
+        )
+
+
+def _resolve(rel: str, parts: list[str], level: int) -> str | None:
+    """Map an import in file `rel` to the repo-relative .py path of the
+    imported module. The manifest lists concrete module files, so the
+    .py form is the membership key; a PACKAGE import (`from . import
+    obs`, which executes an __init__ chain the jax-free contract can
+    never hold for) resolves to a path not in the manifest and is
+    reported as a violation — which it is."""
+    if level == 0:
+        base: list[str] = []
+        # Absolute: parts already start at the first-party root, which
+        # is a directory at the repo root.
+    else:
+        parent = Path(rel).parent
+        base = [] if parent == Path(".") else list(parent.parts)
+        for _ in range(level - 1):
+            if not base:
+                return None
+            base.pop()
+    full = [*base, *parts]
+    if not full:
+        return None
+    if not parts:
+        # `from . import x`: the import target is the package __init__
+        # (the submodules bind as attributes after their own import —
+        # a jax-free package like analysis/ declares its __init__).
+        return "/".join(full) + "/__init__.py"
+    return "/".join(full) + ".py"

@@ -16,6 +16,7 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu top run.jsonl                   # live dashboard
     python -m mpi_cuda_cnn_tpu compare base.jsonl new.jsonl    # regression gate
     python -m mpi_cuda_cnn_tpu health run.jsonl --slo slo.json # SLO verdicts
+    python -m mpi_cuda_cnn_tpu lint --format json              # invariant lint
 """
 
 from __future__ import annotations
@@ -273,6 +274,13 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.health import health_main
 
         return health_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # Static analyzer: the framework-invariant rules MCT001-MCT007
+        # over the repo's own contracts (analysis/, ISSUE 10) —
+        # jax-free, gates CI on exit code.
+        from .analysis.cli import lint_main
+
+        return lint_main(argv[1:])
     if argv and argv[0] == "serve-bench":
         # Serving bench: paged-KV continuous batching vs static
         # batching under Poisson arrivals (serve/bench.py).

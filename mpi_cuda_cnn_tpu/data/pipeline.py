@@ -12,7 +12,7 @@ step (or once per epoch for small datasets) as full batches.
 
 from __future__ import annotations
 
-from typing import Iterator
+from collections.abc import Iterator
 
 import numpy as np
 

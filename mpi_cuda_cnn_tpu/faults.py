@@ -58,7 +58,7 @@ import random
 import signal as _signal
 import threading
 import time
-from typing import Callable
+from collections.abc import Callable
 
 import numpy as np
 
@@ -352,7 +352,7 @@ class PreemptionGuard:
             return
         self.request(signum)
 
-    def install(self, signals=(_signal.SIGTERM, _signal.SIGINT)) -> "PreemptionGuard":
+    def install(self, signals=(_signal.SIGTERM, _signal.SIGINT)) -> PreemptionGuard:
         for s in signals:
             try:
                 self._prev[s] = _signal.signal(s, self._handle)
@@ -368,7 +368,7 @@ class PreemptionGuard:
             _signal.signal(s, prev)
         self._prev.clear()
 
-    def __enter__(self) -> "PreemptionGuard":
+    def __enter__(self) -> PreemptionGuard:
         return self.install()
 
     def __exit__(self, *exc) -> None:
@@ -550,7 +550,10 @@ def step_is_finite(m, finite_fn, state) -> bool:
     state (params, optimizer moments — a NaN gradient with a finite
     loss lands there) must be finite. `finite_fn` is the trainer's
     jitted all_finite; the check costs one scalar sync."""
-    import jax
+    # Lazy on purpose: only the jax-entangled trainers call this;
+    # importing faults.py itself must stay jax-free (bootstrap and
+    # offline consumers load it directly).
+    import jax  # mctpu: disable=MCT001
 
     vals = jax.device_get(m)
     for v in jax.tree.leaves(vals):
@@ -564,8 +567,9 @@ def all_finite(tree):
     step counters — are always finite and are skipped). Trainers jit
     this once and call it per guarded step: ONE boolean comes back, so
     the guard costs a scalar sync, not a state download."""
-    import jax
-    import jax.numpy as jnp
+    # Lazy on purpose — same contract as step_is_finite above.
+    import jax  # mctpu: disable=MCT001
+    import jax.numpy as jnp  # mctpu: disable=MCT001
 
     ok = jnp.asarray(True)
     for leaf in jax.tree.leaves(tree):
@@ -577,6 +581,8 @@ def all_finite(tree):
 def supervise(attempt_fn: Callable[[int], object], *, max_restarts: int,
               logger=None, metrics=None, registry=None,
               backoff_base: float = 0.5,
+              # injectable U[0,1) default: tests pass a constant
+              # mctpu: disable=MCT004
               sleep=time.sleep, jitter=random.random) -> object:
     """The crash-safe training supervisor: run `attempt_fn(attempt)` and,
     on a crash, rerun it up to `max_restarts` more times.

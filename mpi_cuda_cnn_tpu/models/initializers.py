@@ -12,7 +12,7 @@ cnnmpi.c:423, bug SURVEY.md 2.6c).
 from __future__ import annotations
 
 import math
-from typing import Callable
+from collections.abc import Callable
 
 import jax
 import jax.numpy as jnp

@@ -42,6 +42,21 @@ DEFAULT_HI = 1e5
 BUCKETS_PER_DECADE = 10
 
 
+def pct_nearest(vals: list[float], q: float) -> float | None:
+    """Nearest-rank percentile (no interpolation): conservative at the
+    tail on small request counts. THE serving percentile convention —
+    serve/engine.ServeResult.summary(), the fleet summary, and `mctpu
+    report`'s per-request table all use this one function, so they can
+    never disagree on identical data. Lives here (not obs/report.py)
+    so the jax-free scheduler/fleet layer can import it without
+    pulling report's cost-analysis stack (`mctpu lint` MCT001)."""
+    s = sorted(vals)
+    if not s:
+        return None
+    i = min(len(s) - 1, max(0, -(-int(q) * len(s) // 100) - 1))
+    return round(s[i], 3)
+
+
 def log_bucket_bounds(lo: float = DEFAULT_LO, hi: float = DEFAULT_HI,
                       per_decade: int = BUCKETS_PER_DECADE) -> list[float]:
     """Upper bounds of log-spaced buckets covering [lo, hi]. The bounds
@@ -158,7 +173,7 @@ class Histogram:
 
     @classmethod
     def from_fields(cls, fields: dict,
-                    bounds: list[float] | None = None) -> "Histogram":
+                    bounds: list[float] | None = None) -> Histogram:
         """Rebuild from to_fields() output — the consumer half used by
         `mctpu top`/report to compute percentiles from a record."""
         h = cls(bounds)

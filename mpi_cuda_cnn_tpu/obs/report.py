@@ -16,10 +16,10 @@ import argparse
 import json
 import statistics
 import sys
-from typing import Iterable
+from collections.abc import Iterable
 
 from .cost import mfu, peak_flops
-from .metrics import Histogram
+from .metrics import Histogram, pct_nearest
 from .schema import fmt_cell as _fmt
 from .schema import iter_runs
 
@@ -333,19 +333,6 @@ def summarize(records: Iterable[dict], *,
             for name, ms in sorted(agg.items())
         }
     return summary
-
-
-def pct_nearest(vals: list[float], q: float) -> float | None:
-    """Nearest-rank percentile (no interpolation): conservative at the
-    tail on small request counts. THE serving percentile convention —
-    serve/engine.ServeResult.summary() uses this same function, so the
-    per-request table here and the engine's own `serve` summary agree
-    on identical data."""
-    s = sorted(vals)
-    if not s:
-        return None
-    i = min(len(s) - 1, max(0, -(-int(q) * len(s) // 100) - 1))
-    return round(s[i], 3)
 
 
 _pct = pct_nearest

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import json
 from pathlib import Path
-from typing import Iterable, Iterator
+from collections.abc import Iterable, Iterator
 
 SCHEMA_VERSION = 1
 
@@ -106,6 +106,14 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # misses, hit_tokens, cow_copies, inserts, evictions} — the
     # `mctpu top` cache panel).
     "tick": ("tick", "now", "queue", "free_pages"),
+    # One benchmark headline (bench.py, scripts/bench_decode.py,
+    # scripts/bench_speculative.py): "metric" names the measured
+    # quantity, "value" its number (null when the capture failed —
+    # bench.py's error line still stamps the family), "unit" its unit.
+    # `mctpu compare` reads these as dotted `bench.*` metrics. This
+    # family was emitted unregistered for three PRs — the exact drift
+    # class `mctpu lint` MCT005 now catches at the call site.
+    "bench": ("metric", "value", "unit"),
     # One fired alert (obs/alerts.py, ISSUE 8): "rule" names the rule
     # instance, "kind" its class (threshold / rate_of_change / absence
     # / burn_rate), "seq" its position in the run's alert sequence

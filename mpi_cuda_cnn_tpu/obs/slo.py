@@ -239,7 +239,7 @@ class SLOSpec:
         return self.tenants.get(tenant, self.tenants.get("*", []))
 
     @classmethod
-    def from_dict(cls, spec: dict) -> "SLOSpec":
+    def from_dict(cls, spec: dict) -> SLOSpec:
         tenants: dict[str, list[Objective]] = {}
         raw = spec.get("tenants")
         if not isinstance(raw, dict) or not raw:
@@ -278,7 +278,7 @@ class SLOSpec:
         )
 
     @classmethod
-    def load(cls, path: str | Path) -> "SLOSpec":
+    def load(cls, path: str | Path) -> SLOSpec:
         try:
             return cls.from_dict(json.loads(Path(path).read_text()))
         except (KeyError, TypeError, json.JSONDecodeError) as e:

@@ -21,7 +21,8 @@ axis extensible ('model' axis for TP slots into the same specs).
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from collections.abc import Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -49,7 +49,7 @@ price of the bitwise contract; runs that don't need elasticity leave
 
 from __future__ import annotations
 
-from typing import Callable
+from collections.abc import Callable
 
 import jax
 import jax.numpy as jnp

@@ -41,7 +41,8 @@ correct (same input, same weights) and costs only the unsliced FLOPs.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable
+from collections.abc import Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -279,9 +280,11 @@ def unpack_params(plan: PipelinePlan, packed) -> list:
             for li, i in enumerate(idxs):
                 f = getattr(plan.model.layers[i], "features", None)
                 merged = jax.tree.map(
-                    lambda *ls: (
+                    # Loop vars bound as defaults (ruff B023): the map
+                    # runs immediately, but the binding makes it obvious.
+                    lambda *ls, sliced=plan.layer_sliced[i], f=f: (
                         jnp.concatenate(ls, axis=-1)
-                        if plan.layer_sliced[i]
+                        if sliced
                         and ls[0].shape and ls[0].shape[-1] * plan.n_model == f
                         else ls[0]
                     ),

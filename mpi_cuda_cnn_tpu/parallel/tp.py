@@ -26,7 +26,8 @@ explicit shard_map/psum formulation as the readable SPMD reference).
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from collections.abc import Callable
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -43,11 +43,11 @@ from __future__ import annotations
 import dataclasses
 import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import TransformerLM
+from ..utils.donation import donate_jit
 from .paged_cache import (
     PagedKVCache,
     PagePool,
@@ -153,10 +153,10 @@ class ServeResult:
                 for r in sorted(self.requests, key=lambda r: r.rid)]
 
     def summary(self) -> dict:
-        # Nearest-rank percentiles (obs.report.pct_nearest) — the ONE
+        # Nearest-rank percentiles (obs.metrics.pct_nearest) — the ONE
         # serving convention, so `mctpu report`'s per-request table and
         # this summary can never disagree on the same run.
-        from ..obs.report import pct_nearest
+        from ..obs.metrics import pct_nearest
 
         ttft, tpot = self.ttft_ms(), self.tpot_ms()
         return {
@@ -276,10 +276,11 @@ class PagedEngine:
 
         # Donate the cache: the page pools update in place tick-to-tick
         # (the engine always adopts the returned cache) instead of
-        # allocating a second pool-sized buffer per dispatch.
-        self._tick = jax.jit(tick, donate_argnums=(0,))
-        self._prefill = jax.jit(prefill, donate_argnums=(0,))
-        self._copy = jax.jit(copy, donate_argnums=(0,))
+        # allocating a second pool-sized buffer per dispatch. donate_jit
+        # is the repo's ONE donation spelling (`mctpu lint` MCT003).
+        self._tick = donate_jit(tick)
+        self._prefill = donate_jit(prefill)
+        self._copy = donate_jit(copy)
 
     # -- host-side helpers ------------------------------------------------
 
@@ -348,6 +349,9 @@ class PagedEngine:
             jnp.asarray(pos), jnp.asarray(live),
         )
         self._pages = cache.pages
+        # THE sanctioned sync: one host transfer per BATCHED tick
+        # (every live slot's token in one array), not per sequence.
+        # mctpu: disable=MCT007
         return np.asarray(nxt)
 
     def run(self, requests: list[Request], *, mode: str = "continuous",
@@ -481,6 +485,10 @@ class PagedEngine:
                     # drains (the occupancy discipline the comparison
                     # measures).
                     sched.note_prefill_complete(slot)
+                    # Sanctioned sync: int() ONLY on the completing
+                    # chunk, where the token is emitted — mid-prompt
+                    # chunks pipeline the device array untouched.
+                    # mctpu: disable=MCT007
                     self._emit(slot, int(nxt), time_fn() - t0)
                     prefill_rec.append("emit")  # first token at completion
                     if slot.req.done and isinstance(sched,

@@ -59,7 +59,7 @@ from collections import deque
 
 from ..faults import FakeClock
 from ..obs.metrics import MetricsRegistry
-from .paged_cache import PagePool
+from .pool import PagePool
 from .prefix_cache import PrefixCache, empty_prefix_fields
 from .router import CircuitOpen, Router
 from .scheduler import (
@@ -212,6 +212,9 @@ class ReplicaCore:
                 # due now (TTFT at prefill completion — engine.run's
                 # rule).
                 sched.note_prefill_complete(slot)
+                # Sanctioned sync (engine.run's rule): int() only on
+                # the completing chunk, where the token is emitted.
+                # mctpu: disable=MCT007
                 self._emit(slot.req, int(nxt), now)
                 prefill_rec.append("emit")
                 if slot.req.done:
@@ -386,7 +389,7 @@ class FleetResult:
                 for r in sorted(self.requests, key=lambda r: r.rid)]
 
     def summary(self) -> dict:
-        from ..obs.report import pct_nearest
+        from ..obs.metrics import pct_nearest
 
         fin = self.finished_requests()
         ttft = [1e3 * (r.first_token_at - r.arrival) for r in fin]

@@ -46,7 +46,7 @@ import dataclasses
 
 import numpy as np
 
-from .paged_cache import PagePool
+from .pool import PagePool
 
 PREFIX_OWNER = "__prefix__"
 

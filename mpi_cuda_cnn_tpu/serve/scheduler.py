@@ -41,11 +41,11 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Iterable
+from collections.abc import Iterable
 
 import numpy as np
 
-from .paged_cache import PagePool, pages_for
+from .pool import PagePool, pages_for
 from .prefix_cache import PrefixCache
 
 
@@ -104,9 +104,9 @@ def tenant_block(requests: Iterable[Request]) -> dict[str, dict]:
     shared by ServeResult.summary and FleetResult.summary so the two
     surfaces flatten identically in `mctpu compare`. Untagged requests
     aggregate under "default". Percentiles follow the one serving
-    convention (obs.report.pct_nearest, imported lazily — this module
-    stays jax-free for the fleet's sim path)."""
-    from ..obs.report import pct_nearest
+    convention (obs.metrics.pct_nearest — jax-free, so this module's
+    fleet sim path stays jax-free; `mctpu lint` MCT001 pins it)."""
+    from ..obs.metrics import pct_nearest
 
     by_tenant: dict[str, list[Request]] = {}
     for r in requests:

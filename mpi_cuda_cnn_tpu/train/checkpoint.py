@@ -299,7 +299,7 @@ class AsyncCheckpointer:
     # (chained) even when train() itself raises between saves; __del__ is
     # the last-resort net for a dropped object — it cannot raise, so it
     # logs the lost error and releases the worker thread.
-    def __enter__(self) -> "AsyncCheckpointer":
+    def __enter__(self) -> AsyncCheckpointer:
         return self
 
     def __exit__(self, *exc) -> None:

@@ -323,7 +323,7 @@ def build_lm_parser() -> argparse.ArgumentParser:
     return p
 
 
-def parse_lm_args(argv: list[str] | None = None) -> "LMConfig":
+def parse_lm_args(argv: list[str] | None = None) -> LMConfig:
     return LMConfig(**vars(build_lm_parser().parse_args(argv)))
 
 

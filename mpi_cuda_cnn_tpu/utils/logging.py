@@ -17,6 +17,7 @@ import time
 from pathlib import Path
 
 from ..obs.schema import RUN_MARKER, make_record
+from .clock import utc_stamp
 
 _LOGGER_NAME = "mpi_cuda_cnn_tpu"
 
@@ -60,9 +61,9 @@ class MetricsLogger:
             # same path accumulates runs in one file — the comment line
             # (obs.schema.RUN_MARKER) is where iter_runs/`mctpu report`
             # split, so aggregates never blend unrelated runs.
-            self._file.write(time.strftime(
-                RUN_MARKER + " %Y-%m-%dT%H:%M:%SZ\n", time.gmtime()
-            ))
+            # Absolute stamp via the one sanctioned wall-clock surface
+            # (utils/clock, MCT002) — record "t" fields stay relative.
+            self._file.write(f"{RUN_MARKER} {utc_stamp()}\n")
             self._file.flush()
         self._echo = echo
         self._log = get_logger()
@@ -87,7 +88,7 @@ class MetricsLogger:
         analysis, per-epoch memory snapshots)."""
         return self._file is not None
 
-    def sink_or_none(self) -> "MetricsLogger | None":
+    def sink_or_none(self) -> MetricsLogger | None:
         """self when the JSONL sink is open, else None — the form
         obs.trace.span's `metrics=` argument wants (emit span records
         only when a run file is collecting them)."""
@@ -111,7 +112,7 @@ class MetricsLogger:
             self._file.close()
             self._file = None
 
-    def __enter__(self) -> "MetricsLogger":
+    def __enter__(self) -> MetricsLogger:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:

@@ -17,6 +17,8 @@ import random
 
 
 def backoff_delay(attempt: int, base: float,
+                  # injectable U[0,1) default: tests pass a constant
+                  # mctpu: disable=MCT004
                   jitter=random.random) -> float:
     """Delay in seconds before retry number `attempt` (0-based: the
     delay AFTER the first failure is attempt 0). `jitter` is an

@@ -195,7 +195,7 @@ def main():
         )
         exact = bool(np.array_equal(np.asarray(got), want))
         t_spec, sus = try_timed(
-            lambda m: speculative_generate(
+            lambda m, k=k: speculative_generate(
                 target, t_params, draft, d_params, prompt, m, k=k
             ),
             args.tokens, t_plain / (k * 4.0),
@@ -238,7 +238,7 @@ def main():
         )
         lk_got = np.asarray(lk_toks)
         t_lk, sus = try_timed(
-            lambda m: lookup_speculative_generate(
+            lambda m, k=k: lookup_speculative_generate(
                 target, t_params, lk_prompt, m, k=k
             ),
             args.tokens, lk_plain / (k * 4.0),
@@ -285,7 +285,7 @@ def main():
             k=k, temperature=temp, key=skey, return_stats=True,
         )
         t_sT, susT = try_timed(
-            lambda m: speculative_generate(
+            lambda m, k=k: speculative_generate(
                 target, t_params, draft, d_params, prompt, m, k=k,
                 temperature=temp, key=skey,
             ),
@@ -316,7 +316,7 @@ def main():
             temperature=temp, key=skey, return_stats=True,
         )
         t_lkT, susLT = try_timed(
-            lambda m: lookup_speculative_generate(
+            lambda m, k=k: lookup_speculative_generate(
                 target, t_params, lk_prompt, m, k=k, temperature=temp,
                 key=skey,
             ),

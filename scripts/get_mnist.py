@@ -85,6 +85,8 @@ SYNTHETIC_SHA256S = {
 
 def fetch_with_retry(url: str, *, opener=None,
                      tries: int = 3, base_delay: float = 0.5,
+                     # injectable U[0,1) default: tests pass a constant
+                     # mctpu: disable=MCT004
                      sleep=time.sleep, jitter=random.random,
                      timeout: float = 30.0) -> bytes:
     """Fetch `url`, retrying transient failures with exponential backoff

@@ -254,7 +254,8 @@ def pytest_collection_modifyitems(config, items):
         if stale:
             import warnings
 
-            warnings.warn(f"SLOW_TESTS entries match no test: {sorted(stale)}")
+            warnings.warn(f"SLOW_TESTS entries match no test: {sorted(stale)}",
+                          stacklevel=2)
 
 
 @pytest.fixture(scope="session")

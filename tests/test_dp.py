@@ -151,5 +151,9 @@ def test_uneven_batch_rejected(eight_devices):
     _, state, step, *_ = _setup(mesh)
     x = jnp.zeros((12, 28, 28, 1))
     y = jnp.zeros((12, 10))
-    with pytest.raises(Exception):
+    # XLA surfaces the shape mismatch differently across versions
+    # (ValueError vs XlaRuntimeError, sometimes with an empty message)
+    # — the broad catch is deliberate (noqa'd), the behavior under test
+    # is that the mis-sharded step REFUSES, whatever the lineage.
+    with pytest.raises(Exception):  # noqa: B017
         jax.block_until_ready(step(state, *dp_shard_batch((x, y), mesh)))

@@ -377,7 +377,7 @@ def test_session_affinity_keeps_sessions_on_one_replica():
                         plan="replica_crash@fleet.tick:40?replica=1",
                         max_flaps=0).run(workload(n=200, sessions=12))
     home = {s: next(iter(n)) for s, n in by_session.items()}
-    for (tick, rid, name, _, kind) in crashed.dispatch_trace:
+    for (_tick, rid, name, _, _kind) in crashed.dispatch_trace:
         s = rid_session[rid]
         if home[s] != "r1":
             # Sessions not homed on the dead replica never move.

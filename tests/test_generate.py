@@ -260,7 +260,7 @@ def test_trained_model_generates_the_cycle():
         updates, opt_state = opt.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    for i in range(250):
+    for _ in range(250):
         start = rng.integers(0, 13, (16, 1))
         toks = jnp.asarray((start + np.arange(33)) % 13, jnp.int32)
         params, opt_state, loss = step(params, opt_state, toks)

@@ -1,0 +1,398 @@
+"""`mctpu lint` (mpi_cuda_cnn_tpu/analysis, ISSUE 10).
+
+Per rule MCT001-MCT007: a fixture snippet that MUST fire (pinned rule
+id AND line — deleting a rule's implementation fails its fixture test)
+and a clean twin that MUST stay silent. Plus: the self-lint acceptance
+(the shipped tree is finding-free under the checked-in manifest), the
+suppression mechanics, the baseline round-trip, and the CLI contract
+(exit codes 0/1/2, JSON format).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from mpi_cuda_cnn_tpu.analysis import (
+    ALL_RULES,
+    LintError,
+    all_rules,
+    lint_paths,
+    load_manifest,
+    write_baseline,
+)
+from mpi_cuda_cnn_tpu.analysis.baseline import apply_baseline, load_baseline
+from mpi_cuda_cnn_tpu.analysis.cli import lint_main
+from mpi_cuda_cnn_tpu.analysis.manifest import HotLoop, Manifest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def run_lint(tmp_path, files: dict[str, str], *, manifest=None,
+             rules=None, paths=None):
+    for rel, source in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(source)
+    return lint_paths(paths or list(files), root=tmp_path,
+                      manifest=manifest or Manifest(), rules=rules)
+
+
+def keys(findings):
+    return [(f.rule, f.path, f.line) for f in findings]
+
+
+# -- rule registry ------------------------------------------------------
+
+
+def test_all_seven_rules_registered():
+    assert [cls.rule_id for cls in ALL_RULES] == [
+        "MCT001", "MCT002", "MCT003", "MCT004", "MCT005", "MCT006",
+        "MCT007",
+    ]
+
+
+# -- MCT001 jax-purity --------------------------------------------------
+
+
+def test_mct001_fires_on_jax_import_and_unfree_first_party(tmp_path):
+    src = ("import jax\n"
+           "from .helper import thing\n"
+           "def f():\n"
+           "    import jax.numpy as jnp\n"
+           "    return jnp, thing\n")
+    found = run_lint(tmp_path, {"mod.py": src},
+                     manifest=Manifest(jax_free=frozenset({"mod.py"})))
+    assert keys(found) == [
+        ("MCT001", "mod.py", 1),   # import jax
+        ("MCT001", "mod.py", 2),   # first-party helper.py not declared
+        ("MCT001", "mod.py", 4),   # lazy jax import is still a finding
+    ]
+
+
+def test_mct001_clean_twin(tmp_path):
+    src = ("import dataclasses\n"
+           "import numpy as np\n"
+           "from .helper import thing\n")
+    manifest = Manifest(jax_free=frozenset({"mod.py", "helper.py"}))
+    assert run_lint(tmp_path, {"mod.py": src}, manifest=manifest) == []
+    # And an UNDECLARED module may import jax freely.
+    assert run_lint(tmp_path, {"other.py": "import jax\n"},
+                    manifest=manifest) == []
+
+
+# -- MCT002 clock discipline --------------------------------------------
+
+
+def test_mct002_fires_on_wall_clock_read(tmp_path):
+    src = ("import time\n"
+           "deadline = time.monotonic() + 5\n"
+           "t0 = time.time()\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert keys(found) == [("MCT002", "mod.py", 2), ("MCT002", "mod.py", 3)]
+
+
+def test_mct002_catches_alias_and_from_import_evasion(tmp_path):
+    """Aliased modules and from-imports resolve through the file's own
+    import bindings — the spellings that used to slip past a literal
+    dotted-chain match."""
+    src = ("import time as t\n"
+           "from datetime import datetime as dt\n"
+           "from time import monotonic\n"
+           "a = t.monotonic()\n"
+           "b = dt.now()\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert keys(found) == [
+        ("MCT002", "mod.py", 3),   # the from-import IS the evasion
+        ("MCT002", "mod.py", 4),   # t.monotonic -> time.monotonic
+        ("MCT002", "mod.py", 5),   # dt.now -> datetime.datetime.now
+    ]
+
+
+def test_mct002_clean_twin(tmp_path):
+    # perf_counter is the injectable-clock default convention, and the
+    # allowlisted clock module may read the real clock.
+    src = ("import time\n"
+           "def f(clock=time.perf_counter):\n"
+           "    return clock()\n")
+    assert run_lint(tmp_path, {"mod.py": src}) == []
+    clock_src = "import time\nnow = time.monotonic()\n"
+    manifest = Manifest(clock_modules=frozenset({"clock.py"}))
+    assert run_lint(tmp_path, {"clock.py": clock_src},
+                    manifest=manifest) == []
+
+
+# -- MCT003 donation discipline -----------------------------------------
+
+
+def test_mct003_fires_on_raw_donate_argnums(tmp_path):
+    src = ("import jax\n"
+           "step = jax.jit(lambda s: s, donate_argnums=(0,))\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert keys(found) == [("MCT003", "mod.py", 2)]
+    # donate_argnames is the same violation.
+    src2 = "f = g(donate_argnames=('state',))\n"
+    assert keys(run_lint(tmp_path, {"m2.py": src2})) == \
+        [("MCT003", "m2.py", 1)]
+
+
+def test_mct003_clean_twin(tmp_path):
+    # The donation module itself holds the one sanctioned spelling.
+    src = ("import jax\n"
+           "def donate_jit(fn, argnums=(0,), **kw):\n"
+           "    return jax.jit(fn, donate_argnums=argnums, **kw)\n")
+    manifest = Manifest(donation_module="donation.py")
+    assert run_lint(tmp_path, {"donation.py": src},
+                    manifest=manifest) == []
+    # Callers using donate_jit are clean.
+    assert run_lint(tmp_path, {"user.py": "step = donate_jit(f)\n"},
+                    manifest=manifest) == []
+
+
+# -- MCT004 RNG discipline ----------------------------------------------
+
+
+def test_mct004_fires_on_global_rng(tmp_path):
+    src = ("import random\n"
+           "import numpy as np\n"
+           "x = random.random()\n"
+           "y = np.random.rand(3)\n"
+           "np.random.seed(0)\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert keys(found) == [
+        ("MCT004", "mod.py", 3),
+        ("MCT004", "mod.py", 4),
+        ("MCT004", "mod.py", 5),
+    ]
+
+
+def test_mct004_clean_twin(tmp_path):
+    # Seeded generators everywhere; `from jax import random` binds the
+    # SAME spelling to seeded-key threading and must not fire; tests
+    # are exempt wholesale.
+    src = ("import numpy as np\n"
+           "rng = np.random.default_rng(0)\n"
+           "g = np.random.Generator(np.random.PCG64(1))\n")
+    assert run_lint(tmp_path, {"mod.py": src}) == []
+    jax_src = ("from jax import random\n"
+               "k = random.split(random.PRNGKey(0))\n")
+    assert run_lint(tmp_path, {"m2.py": jax_src}) == []
+    test_src = "import random\nx = random.random()\n"
+    assert run_lint(tmp_path, {"test_m.py": test_src}) == []
+
+
+# -- MCT005 schema-family cross-check -----------------------------------
+
+
+def test_mct005_fires_on_unregistered_family(tmp_path):
+    src = ("metrics.log(\"not_a_family\", step=1)\n"
+           "rec = make_record(\"bogus_event\", 0.0, x=1)\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert keys(found) == [("MCT005", "mod.py", 1), ("MCT005", "mod.py", 2)]
+
+
+def test_mct005_clean_twin(tmp_path):
+    # Registered families (the LIVE obs.schema registry) are silent,
+    # as are non-literal first args and unrelated .log call shapes.
+    src = ("metrics.log(\"train\", step=1, loss=0.5)\n"
+           "rec = make_record(\"bench\", 0.0, metric=\"m\", value=1)\n"
+           "metrics.log(event, step=2)\n"
+           "import math\n"
+           "y = math.log(2.0)\n")
+    assert run_lint(tmp_path, {"mod.py": src}) == []
+
+
+# -- MCT006 fault-site cross-check --------------------------------------
+
+
+def test_mct006_fires_on_unknown_site(tmp_path):
+    src = ("for f in faults.fire(\"serve.tock\", i):\n"
+           "    pass\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert keys(found) == [("MCT006", "mod.py", 1)]
+
+
+def test_mct006_clean_twin(tmp_path):
+    src = ("faults.fire(\"serve.tick\", i)\n"
+           "faults.fire(\"fleet.tick\", t)\n"
+           "faults.fire(site, t)\n")
+    assert run_lint(tmp_path, {"mod.py": src}) == []
+
+
+# -- MCT007 host-sync-in-hot-loop ---------------------------------------
+
+HOT = Manifest(hot_loops={
+    "mod.py": HotLoop(functions=frozenset({"run"}),
+                      producers=frozenset({"self._tick"})),
+})
+
+
+def test_mct007_fires_on_device_value_sync(tmp_path):
+    src = ("import numpy as np\n"
+           "class E:\n"
+           "    def run(self):\n"
+           "        cache, nxt = self._tick(1)\n"
+           "        a = int(nxt)\n"
+           "        b = np.asarray(nxt)\n"
+           "        c = nxt.item()\n"
+           "        return a, b, c\n")
+    found = run_lint(tmp_path, {"mod.py": src}, manifest=HOT)
+    assert keys(found) == [
+        ("MCT007", "mod.py", 5),
+        ("MCT007", "mod.py", 6),
+        ("MCT007", "mod.py", 7),
+    ]
+
+
+def test_mct007_clean_twin(tmp_path):
+    # Reassignment from a non-producer clears taint (the engine.run
+    # decode path: nxt is rebound to an already-host array); functions
+    # outside the manifest's hot set are not scanned; host values
+    # convert freely.
+    src = ("class E:\n"
+           "    def run(self):\n"
+           "        cache, nxt = self._tick(1)\n"
+           "        self.stash(nxt)\n"
+           "        nxt = self.decode_host(2)\n"
+           "        n = int(nxt)\n"
+           "        return n, int(self.counter)\n"
+           "    def cold(self):\n"
+           "        _, nxt = self._tick(1)\n"
+           "        return int(nxt)\n")
+    assert run_lint(tmp_path, {"mod.py": src}, manifest=HOT) == []
+
+
+# -- suppressions -------------------------------------------------------
+
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    src = ("import time\n"
+           "a = time.monotonic()  # mctpu: disable=MCT002\n"
+           "# mctpu: disable=MCT002\n"
+           "b = time.monotonic()\n"
+           "c = time.monotonic()\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    # Only the unsuppressed line fires; a pragma covers ITS line and
+    # the next code line, never further.
+    assert keys(found) == [("MCT002", "mod.py", 5)]
+
+
+def test_suppression_tolerates_trailing_prose(tmp_path):
+    """A reason after the rule id — the natural spelling the README
+    encourages — must not be swallowed into the token (a pragma that
+    visibly exists but suppresses nothing is worse than none)."""
+    src = ("import time\n"
+           "a = time.monotonic()  # mctpu: disable=MCT002 injectable\n"
+           "# mctpu: disable=MCT002, MCT004 both deliberate here\n"
+           "b = time.monotonic()\n")
+    assert run_lint(tmp_path, {"mod.py": src}) == []
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    src = ("import time\n"
+           "a = time.monotonic()  # mctpu: disable=MCT004\n")
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert keys(found) == [("MCT002", "mod.py", 2)]
+
+
+# -- baseline round-trip ------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    src = "import time\nx = time.time()\n"
+    found = run_lint(tmp_path, {"mod.py": src})
+    assert len(found) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(found, bl)
+    known = load_baseline(bl)
+    assert apply_baseline(found, known) == []
+    # A NEW finding on another line is not absorbed by the baseline.
+    src2 = "import time\nx = time.time()\ny = time.monotonic()\n"
+    found2 = run_lint(tmp_path, {"mod.py": src2})
+    left = apply_baseline(found2, known)
+    assert keys(left) == [("MCT002", "mod.py", 3)]
+
+
+def test_out_of_root_path_is_config_error(tmp_path):
+    """A scanned path outside the root cannot key findings root-
+    relatively — LintError (the CLI's exit-2 contract), never a raw
+    ValueError traceback from relative_to."""
+    outside = tmp_path / "elsewhere" / "mod.py"
+    outside.parent.mkdir()
+    outside.write_text("import time\n")
+    root = tmp_path / "repo"
+    root.mkdir()
+    with pytest.raises(LintError, match="outside the repo root"):
+        lint_paths([str(outside)], root=root, manifest=Manifest())
+
+
+def test_baseline_rejects_bad_files(tmp_path):
+    bad = tmp_path / "b.json"
+    bad.write_text("{\"version\": 99, \"findings\": []}")
+    with pytest.raises(LintError):
+        load_baseline(bad)
+    with pytest.raises(LintError):
+        load_baseline(tmp_path / "missing.json")
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def _write_cli_fixture(tmp_path, source: str) -> Path:
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    ci = tmp_path / "ci"
+    ci.mkdir()
+    manifest = ci / "lint_manifest.json"
+    manifest.write_text(json.dumps({"paths": ["mod.py"], "jax_free": []}))
+    (tmp_path / "mod.py").write_text(source)
+    return manifest
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    manifest = _write_cli_fixture(tmp_path, "import time\nt = time.time()\n")
+    rc = lint_main(["--manifest", str(manifest), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [(f["rule"], f["path"], f["line"]) for f in out["findings"]] == \
+        [("MCT002", "mod.py", 2)]
+    # --rule filters; a rule that cannot fire here exits clean.
+    assert lint_main(["--manifest", str(manifest), "--rule", "MCT004"]) == 0
+    # Unknown rule / missing manifest are config errors (exit 2).
+    assert lint_main(["--manifest", str(manifest), "--rule", "MCT999"]) == 2
+    assert lint_main(["--manifest", str(tmp_path / "nope.json")]) == 2
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_round_trip(tmp_path, capsys):
+    manifest = _write_cli_fixture(tmp_path, "import time\nt = time.time()\n")
+    bl = tmp_path / "ci" / "lint_baseline.json"
+    assert lint_main(["--manifest", str(manifest),
+                      "--write-baseline", str(bl)]) == 0
+    assert lint_main(["--manifest", str(manifest),
+                      "--baseline", str(bl)]) == 0
+    # Without the baseline the finding still gates.
+    assert lint_main(["--manifest", str(manifest)]) == 1
+    capsys.readouterr()
+
+
+# -- self-lint acceptance -----------------------------------------------
+
+
+def test_shipped_tree_is_finding_free():
+    """ISSUE 10 acceptance: `mctpu lint` reports ZERO findings on the
+    shipped tree under the checked-in manifest — violations are fixed
+    or carry a commented suppression at the site, never debt."""
+    manifest = load_manifest(REPO / "ci" / "lint_manifest.json")
+    findings = lint_paths(list(manifest.paths), root=REPO,
+                          manifest=manifest, rules=all_rules())
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_checked_in_baseline_is_empty():
+    known = load_baseline(REPO / "ci" / "lint_baseline.json")
+    assert known == set(), (
+        "ci/lint_baseline.json must stay a zero-entry baseline — fix "
+        "or suppress new findings at the site instead"
+    )
