@@ -13,6 +13,7 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu serve-bench --requests 32       # serving bench
     python -m mpi_cuda_cnn_tpu fleet-bench --replicas 4        # fleet storm
     python -m mpi_cuda_cnn_tpu trace run.jsonl --request 3     # lifecycle trace
+    python -m mpi_cuda_cnn_tpu explain run.jsonl --worst ttft  # causal blame
     python -m mpi_cuda_cnn_tpu top run.jsonl                   # live dashboard
     python -m mpi_cuda_cnn_tpu compare base.jsonl new.jsonl    # regression gate
     python -m mpi_cuda_cnn_tpu health run.jsonl --slo slo.json # SLO verdicts
@@ -255,6 +256,13 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.timeline import trace_main
 
         return trace_main(argv[1:])
+    if argv and argv[0] == "explain":
+        # Offline: causal critical-path attribution — per-request blame
+        # trees that sum exactly to end-to-end latency, aggregate blame
+        # and top-blocker tables (obs.causal, ISSUE 11) — jax-free.
+        from .obs.causal import explain_main
+
+        return explain_main(argv[1:])
     if argv and argv[0] == "top":
         # Live dashboard: tail (or replay) a metrics JSONL and render
         # the engine/trainer gauges in place (obs.top) — jax-free.

@@ -99,7 +99,13 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                "alerts_fired", "alerts_crc",
                # Prefix-sharing structural counters (ISSUE 9).
                "prefix_hits", "prefix_misses", "prefix_hit_tokens",
-               "prefix_cow", "prefix_inserts", "prefix_evictions")
+               "prefix_cow", "prefix_inserts", "prefix_evictions",
+               # Causal-blame attribution (ISSUE 11): the canonical
+               # per-request blame CRC plus per-category tick totals —
+               # the fleet determinism gate pins them at exact equality.
+               "blame_crc", "blame_self_compute", "blame_queued_behind",
+               "blame_preempted_by", "blame_redispatch_replay",
+               "blame_router_wait", "blame_quota_ticks")
 
 # Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
 # summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to

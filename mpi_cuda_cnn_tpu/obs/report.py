@@ -63,6 +63,11 @@ def _request_group_row(rs: list[dict]) -> dict:
     for r in rs:
         st = r.get("status", "finished")
         statuses[st] = statuses.get(st, 0) + 1
+    # Quota skip-over wait (ISSUE 11 satellite): the SLOScheduler policy
+    # share of queue wait, split from capacity waits. Absent in
+    # pre-ISSUE-11 records -> no column data (renders as an em-dash).
+    quota = [r["queue_wait_quota_ms"] for r in rs
+             if r.get("queue_wait_quota_ms") is not None]
     return {
         "requests": len(rs),
         "statuses": statuses,
@@ -71,6 +76,7 @@ def _request_group_row(rs: list[dict]) -> dict:
         "ttft_p99_ms": _pct(ttft, 99),
         "tpot_p50_ms": _pct(tpot, 50),
         "tpot_p99_ms": _pct(tpot, 99),
+        "quota_wait_p99_ms": _pct(quota, 99),
     }
 
 
@@ -198,6 +204,17 @@ def summarize(records: Iterable[dict], *,
                 {"mode": mode, "tenant": tenant, **_request_group_row(rs)}
                 for (mode, tenant), rs in sorted(by_mt.items())
             ]
+
+    blames = ev.get("blame", [])
+    if blames:
+        # Causal blame summaries (obs/causal.py, ISSUE 11): one row per
+        # `blame` record (per mode, per segment under --merge).
+        summary["blame"] = [
+            {k: r.get(k) for k in
+             ("mode", "requests", "categories", "quota_ticks",
+              "tenants", "conserved", "crc")}
+            for r in blames
+        ]
 
     alerts = ev.get("alert", [])
     if alerts:
@@ -414,8 +431,8 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
         lines += [
             "| serving (per-request) | requests | statuses | out tokens "
             "| preempt | TTFT p50 ms | TTFT p99 ms | tok p50 ms "
-            "| tok p99 ms |",
-            "|---|---|---|---|---|---|---|---|---|",
+            "| tok p99 ms | quota wait p99 ms |",
+            "|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in summary["requests"]:
             lines.append(
@@ -423,22 +440,46 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 f"| {_fmt(r.get('statuses'))} | {r['output_tokens']} "
                 f"| {r['preemptions']} | {_fmt(r['ttft_p50_ms'])} "
                 f"| {_fmt(r['ttft_p99_ms'])} | {_fmt(r['tpot_p50_ms'])} "
-                f"| {_fmt(r['tpot_p99_ms'])} |"
+                f"| {_fmt(r['tpot_p99_ms'])} "
+                f"| {_fmt(r.get('quota_wait_p99_ms'))} |"
             )
         lines.append("")
     if "tenants" in summary:
         lines += [
             "| tenant traffic | tenant | requests | statuses "
             "| out tokens | TTFT p50 ms | TTFT p99 ms | tok p50 ms "
-            "| tok p99 ms |",
-            "|---|---|---|---|---|---|---|---|---|",
+            "| tok p99 ms | quota wait p99 ms |",
+            "|---|---|---|---|---|---|---|---|---|---|",
         ]
         for r in summary["tenants"]:
             lines.append(
                 f"| {r['mode']} | {r['tenant']} | {r['requests']} "
                 f"| {_fmt(r['statuses'])} | {r['output_tokens']} "
                 f"| {_fmt(r['ttft_p50_ms'])} | {_fmt(r['ttft_p99_ms'])} "
-                f"| {_fmt(r['tpot_p50_ms'])} | {_fmt(r['tpot_p99_ms'])} |"
+                f"| {_fmt(r['tpot_p50_ms'])} | {_fmt(r['tpot_p99_ms'])} "
+                f"| {_fmt(r.get('quota_wait_p99_ms'))} |"
+            )
+        lines.append("")
+    if "blame" in summary:
+        # Causal blame (ISSUE 11): aggregate critical-path attribution
+        # per mode — where the run's request-latency ticks actually
+        # went, with the quota skip-over share split out.
+        from .causal import CATEGORIES as _BLAME_CATS
+
+        lines += [
+            "| blame (ticks) | requests | "
+            + " | ".join(c.replace("_", " ") for c in _BLAME_CATS)
+            + " | quota skip | conserved | crc |",
+            "|---|" + "---|" * (len(_BLAME_CATS) + 4),
+        ]
+        for r in summary["blame"]:
+            cats = r.get("categories") or {}
+            lines.append(
+                f"| {r['mode']} | {_fmt(r.get('requests'))} | "
+                + " | ".join(_fmt(cats.get(c)) for c in _BLAME_CATS)
+                + f" | {_fmt(r.get('quota_ticks'))} "
+                f"| {'yes' if r.get('conserved') else 'NO'} "
+                f"| {_fmt(r.get('crc'))} |"
             )
         lines.append("")
     if "alerts" in summary:

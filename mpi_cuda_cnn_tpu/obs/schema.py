@@ -84,6 +84,9 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # replica count, undispatched backlog, this tick's routing moments
     # (dispatched/redispatched rids) and the per-replica load map
     # {name: [queue, running, free_pages]} the dispatch policy reads.
+    # Causality (ISSUE 11): "arrived" (rids whose arrival fell due this
+    # tick) and "failed_over" ([[rid, replica]] — requests a failover
+    # stranded, ending their active blame segment at the crash).
     "fleet": ("tick", "now", "replicas"),
     # One replica lifecycle moment (serve/fleet.py, ISSUE 7): kind is
     # join / crash / dead / restart_scheduled / restart / circuit_open
@@ -104,7 +107,13 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # ([[rid, matched_tokens]] — the lifecycle marker `mctpu trace`
     # renders) and "prefix" ({shared_pages, retained_pages, hits,
     # misses, hit_tokens, cow_copies, inserts, evictions} — the
-    # `mctpu top` cache panel).
+    # `mctpu top` cache panel). Causality (ISSUE 11): "arrived" (rids
+    # whose arrival fell due this tick — the blame span's anchor),
+    # "blocked" ([[rid, reason, holders]] — admission attempts that
+    # failed, reason "pages"/"slots"/"quota", holders the occupying
+    # rids: the blocker edges `mctpu explain` blames queue waits on),
+    # and "preempted_for" ([[victim, beneficiary]] — whose page need
+    # forced each eviction).
     "tick": ("tick", "now", "queue", "free_pages"),
     # One benchmark headline (bench.py, scripts/bench_decode.py,
     # scripts/bench_speculative.py): "metric" names the measured
@@ -114,6 +123,14 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # family was emitted unregistered for three PRs — the exact drift
     # class `mctpu lint` MCT005 now catches at the call site.
     "bench": ("metric", "value", "unit"),
+    # One causal-blame summary per mode (obs/causal.py, ISSUE 11):
+    # aggregate per-category tick totals ("categories": self_compute /
+    # queued_behind / preempted_by / redispatch_replay / router_wait —
+    # each request's categories sum bitwise to its end-to-end tick
+    # span), per-tenant breakdown ("tenants"), the quota skip-over
+    # share ("quota_ticks"), and "crc" — the canonical per-request
+    # blame CRC the fleet determinism gate pins at exact equality.
+    "blame": ("mode", "requests", "categories"),
     # One fired alert (obs/alerts.py, ISSUE 8): "rule" names the rule
     # instance, "kind" its class (threshold / rate_of_change / absence
     # / burn_rate), "seq" its position in the run's alert sequence

@@ -367,6 +367,13 @@ def trace_main(argv: list[str] | None = None) -> int:
     ap.add_argument("path", help="metrics JSONL with tick + request records")
     ap.add_argument("--request", type=int, default=None,
                     help="detail one request id instead of the summary")
+    ap.add_argument("--slowest", type=int, default=None,
+                    help="show only the N slowest requests, keyed on "
+                         "recorded latency_ms (ttft_ms for requests "
+                         "that never finished) — the same worst-k "
+                         "selector `mctpu explain --worst` uses, with "
+                         "latency as the key (explain --worst ttft/"
+                         "tpot keys on those metrics) (ISSUE 11)")
     ap.add_argument("--mode", default=None,
                     help="restrict to one scheduler mode "
                          "(default: every mode in the file)")
@@ -405,6 +412,26 @@ def trace_main(argv: list[str] | None = None) -> int:
                 if not lifecycles:
                     continue
             bad = [rid for rid, lc in lifecycles.items() if not lc.consistent]
+            if args.slowest is not None and args.request is None:
+                # Worst-k drill-down (ISSUE 11 satellite): the shared
+                # selector, keyed on the request record's latency (ttft
+                # as the fallback for aborted requests that emitted but
+                # never finished). The consistency check above already
+                # ran over EVERY lifecycle — drift is never hidden by
+                # the display filter.
+                from .causal import worst_k
+
+                def _lat(lc):
+                    rec = lc.record or {}
+                    if rec.get("latency_ms") is not None:
+                        return rec["latency_ms"]
+                    return rec.get("ttft_ms")  # FakeClock latencies can be 0
+
+                keep = worst_k(list(lifecycles.values()), _lat,
+                               args.slowest)
+                lifecycles = {lc.rid: lc for lc in keep}
+                if not lifecycles:
+                    continue
             if args.format == "json":
                 print(json.dumps({
                     "path": args.path, "run": i, "mode": mode,

@@ -83,6 +83,11 @@ class TopState:
         # count = its pool size): the fixed scale its pressure bar
         # renders against.
         self.free_hi: dict[str, float] = {}
+        # TOP-BLOCKERS (ISSUE 11): ticks each holder rid kept a blocked
+        # admission waiting (joint attribution over the tick records'
+        # `blocked` entries), plus the block-reason mix.
+        self.blockers: dict[int, int] = {}
+        self.block_reasons: dict[str, int] = {}
         self._history = history
 
     def reset(self) -> None:
@@ -100,6 +105,12 @@ class TopState:
             self.queue_hist.setdefault(
                 mode, deque(maxlen=self._history)
             ).append(rec.get("queue", 0))
+            for entry in rec.get("blocked") or []:
+                rid, reason, holders = entry[0], entry[1], entry[2]
+                self.block_reasons[reason] = \
+                    self.block_reasons.get(reason, 0) + 1
+                for h in holders:
+                    self.blockers[h] = self.blockers.get(h, 0) + 1
         elif ev == "train":
             self.train = rec
         elif ev == "epoch":
@@ -292,6 +303,18 @@ def render(state: TopState, path: str, width: int = 96) -> str:
                 lines.append(
                     f"  step ms p50/p95/p99 {_pcts(snap, 'train.step_ms')}"
                 )
+    if state.blockers:
+        # TOP-BLOCKERS (ISSUE 11): who is holding admissions up RIGHT
+        # NOW — the live twin of `mctpu explain`'s blocker table.
+        top = sorted(state.blockers.items(),
+                     key=lambda kv: (-kv[1], kv[0]))[:8]
+        lines.append("")
+        lines.append(
+            "TOP BLOCKERS  blocked-attempt ticks by holder — "
+            + "  ".join(f"rid {rid}:{n}" for rid, n in top)
+        )
+        lines.append("  reasons: " + "  ".join(
+            f"{k}:{v}" for k, v in sorted(state.block_reasons.items())))
     if state.alerts_total:
         # ALERTS panel (ISSUE 8): totals plus the rolling tail — the
         # live view of what the streaming rule engine fired so far.

@@ -238,6 +238,7 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         return 1
 
     from ..models.transformer import TransformerLM
+    from ..obs.causal import CATEGORIES, BlameAccumulator
     from ..obs.metrics import MetricsRegistry
     from ..utils.logging import MetricsLogger
     from .engine import PagedEngine
@@ -331,15 +332,25 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
             # `mctpu top run.jsonl` tails the file live; `mctpu trace`
             # reconstructs lifecycles from the same records afterwards.
             registry = MetricsRegistry()
-            tick_sink = None
+            base_sink = None
             if metrics.jsonl_enabled or alert_engine is not None:
                 # Tick records route through metrics.log either way:
                 # the JSONL sink and the alert observer both hang off
                 # it (with no file open, log() is observer-only).
-                def tick_sink(rec, _snap_every=64):
+                def base_sink(rec, _snap_every=64):
                     metrics.log("tick", **rec)
                     if (rec["tick"] + 1) % _snap_every == 0:
                         registry.emit(metrics, mode=rec["mode"])
+            # Causal blame (ISSUE 11) folds the live tick stream the
+            # way the alert engine does — always on, so every serve
+            # summary carries blame_crc + per-category totals whether
+            # or not the ticks reach a file.
+            blame = BlameAccumulator()
+
+            def tick_sink(rec, _base=base_sink):
+                blame.ingest_tick(rec)
+                if _base is not None:
+                    _base(rec)
             result = engine.run(make_workload(**workload_kw), mode=mode,
                                 faults=faults, registry=registry,
                                 tick_sink=tick_sink,
@@ -349,6 +360,15 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                                         if mode == "continuous" else None),
                                 **run_kw)
             s = result.summary()
+            # Blame stamp (ISSUE 11): the crc + per-category totals
+            # `mctpu compare` flattens as serve.<mode>.blame_*, plus
+            # the full `blame` summary record for `mctpu report`.
+            bf = blame.summary_fields(mode)
+            s["blame_crc"] = bf["crc"]
+            s["blame_quota_ticks"] = bf["quota_ticks"]
+            for cat in CATEGORIES:
+                s[f"blame_{cat}"] = bf["categories"][cat]
+            metrics.log("blame", **bf)
             summaries[mode] = s
             registry.set("serve.tokens_per_s", s["tokens_per_s"])
             registry.emit(metrics, mode=mode, final=True)
@@ -514,6 +534,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     from ..faults import FakeClock, FaultInjector
+    from ..obs.causal import CATEGORIES, BlameAccumulator
     from ..obs.metrics import MetricsRegistry
     from ..utils.logging import MetricsLogger
     from .fleet import EngineCompute, Fleet, SimCompute, make_fleet_workload
@@ -590,12 +611,12 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             # --log full, the tick/fleet stream) is folded live; the
             # fired alerts are logged straight back as `alert` events.
             alert_engine.attach(metrics)
-        fleet_sink = replica_tick_sink = None
+        base_fleet = base_replica = None
         if metrics.jsonl_enabled and args.log == "full":
-            def fleet_sink(rec):
+            def base_fleet(rec):
                 metrics.log("fleet", **rec)
 
-            def replica_tick_sink(rec):
+            def base_replica(rec):
                 metrics.log("tick", **rec)
         elif alert_engine is not None:
             # Summary mode keeps per-tick records OUT of the JSONL (at
@@ -604,13 +625,29 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             # Replay-from-file cannot reproduce these alerts — that
             # contract needs --log full; the determinism CI instead
             # pins alerts_crc across two identical-seed runs.
-            def fleet_sink(rec):
+            def base_fleet(rec):
                 for a in alert_engine.ingest(rec, event="fleet"):
                     metrics.log("alert", **a)
 
-            def replica_tick_sink(rec):
+            def base_replica(rec):
                 for a in alert_engine.ingest(rec, event="tick"):
                     metrics.log("alert", **a)
+        # Causal blame (ISSUE 11): ALWAYS folded live off the sinks,
+        # like the alert engine under --log summary — the determinism
+        # gate pins blame_crc + per-category totals on every fleet-
+        # bench run, including the 10^5 storm whose per-tick records
+        # never reach the JSONL.
+        blame = BlameAccumulator()
+
+        def fleet_sink(rec, _base=base_fleet):
+            blame.ingest_fleet(rec)
+            if _base is not None:
+                _base(rec)
+
+        def replica_tick_sink(rec, _base=base_replica):
+            blame.ingest_tick(rec)
+            if _base is not None:
+                _base(rec)
         try:
             fleet = Fleet(
                 compute_factory, replicas=args.replicas, slots=args.slots,
@@ -635,6 +672,14 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             return 1
         wall_s = time.perf_counter() - t_wall
         s = result.summary()
+        # Blame stamp (ISSUE 11): flat keys the fleet determinism gate
+        # pins at exact equality, plus the `blame` summary record.
+        bf = blame.summary_fields("fleet")
+        s["blame_crc"] = bf["crc"]
+        s["blame_quota_ticks"] = bf["quota_ticks"]
+        for cat in CATEGORIES:
+            s[f"blame_{cat}"] = bf["categories"][cat]
+        metrics.log("blame", **bf)
         s["wall_s"] = round(wall_s, 3)
         s["wall_tokens_per_s"] = round(
             result.output_tokens / max(wall_s, 1e-9), 1)

@@ -88,6 +88,10 @@ def request_record(r: Request, mode: str) -> dict:
         "arrival_s": round(r.arrival, 4),
         "queue_wait_ms": (None if r.admitted_at is None
                           else round(1e3 * (r.admitted_at - r.arrival), 3)),
+        # Quota skip-over share of the queue wait (ISSUE 11): time an
+        # SLOScheduler spent skipping this request over for its own
+        # tenant's quota — zero under FCFS/capacity waits.
+        "queue_wait_quota_ms": round(1e3 * r.quota_wait_s, 3),
         "preemptions": r.preemptions,
         **({"reason": r.fail_reason} if r.fail_reason else {}),
     }
@@ -202,6 +206,13 @@ def _observe_request(registry, r: Request) -> None:
         if r.admitted_at is not None:
             registry.observe(f"{p}queue_wait_ms",
                              1e3 * (r.admitted_at - r.arrival))
+        if r.quota_wait_s > 0:
+            # The SLOScheduler skip-over share of the wait (ISSUE 11),
+            # split out so a quota-throttled tenant's policy wait can't
+            # masquerade as a capacity shortage. Observed only when
+            # nonzero: FCFS runs must not bury the histogram in zeros.
+            registry.observe(f"{p}queue_wait_quota_ms",
+                             1e3 * r.quota_wait_s)
         if r.status != "finished":
             continue
         registry.observe(f"{p}ttft_ms",
@@ -416,6 +427,12 @@ class PagedEngine:
         squeezes: list[dict] = []  # {"pages": [...], "until": tick}
         tick_idx = 0
         want_ticks = registry is not None or tick_sink is not None
+        # Arrival announcements (ISSUE 11): each tick record names the
+        # rids whose arrival fell due since the last one, so `mctpu
+        # explain` can anchor every request's blame span on the tick
+        # axis without needing the end-of-run request records.
+        arrivals = sorted((r.arrival, r.rid) for r in requests)
+        arr_cursor = 0
         # Terminal-request watermarks: sched.finished / sched.dropped
         # are append-only, so the new tail since last iteration IS this
         # tick's terminal set — no instrumentation at the call sites.
@@ -561,7 +578,11 @@ class PagedEngine:
             # record itself is streamed, never retained (the JSONL sink
             # is the tick store — an in-memory list would grow without
             # bound on a long-lived serve).
-            preempted = sched.drain_preempted()
+            # (victim, beneficiary) pairs: the rid list keeps the
+            # pre-ISSUE-11 tick shape, the pairs are the causal edges.
+            preempted_pairs = sched.drain_preempted()
+            preempted = [v for v, _ in preempted_pairs]
+            blocked = sched.drain_blocked()
             prefix_tick = pcache.drain_tick() if pcache is not None else None
             if not want_ticks:
                 sched.check()
@@ -571,6 +592,11 @@ class PagedEngine:
             new_drop = sched.dropped[n_drop_seen:]
             n_fin_seen, n_drop_seen = len(sched.finished), len(sched.dropped)
             now = time_fn() - t0
+            arrived_now = []
+            while arr_cursor < len(arrivals) and \
+                    arrivals[arr_cursor][0] <= now:
+                arrived_now.append(arrivals[arr_cursor][1])
+                arr_cursor += 1
             arrived_waiting = sum(1 for r in sched.queue if r.arrival <= now)
             running = sum(1 for s in sched.slots if not s.free)
             prefilling = sum(1 for s in sched.slots
@@ -581,11 +607,20 @@ class PagedEngine:
                 "queue": arrived_waiting, "running": running,
                 "prefilling": prefilling,
                 "free_pages": sched.pool.free_pages, "backlog": backlog,
+                "arrived": arrived_now,
                 "admitted": admitted, "prefill": prefill_rec,
                 "decoded": decoded,
                 "finished": [r.rid for r in new_fin],
                 "aborted": [[r.rid, r.status] for r in new_drop],
                 "preempted": preempted,
+                # Causality (ISSUE 11): blocked admission attempts
+                # ([rid, reason, holders]) and preemption beneficiaries
+                # ([victim, for_rid]) — the blocker edges of the blame
+                # DAG `mctpu explain` reconstructs.
+                "blocked": [[rid, reason, holders]
+                            for rid, reason, holders in blocked],
+                "preempted_for": [[v, b] for v, b in preempted_pairs
+                                  if b is not None],
                 # Terminal detail (ISSUE 8): tenant + latency per request
                 # reaching a terminal status THIS tick — the streaming
                 # good/bad events the SLO burn-rate rules fold, emitted

@@ -230,7 +230,8 @@ class ReplicaCore:
                 self._emit(s.req, int(toks[s.idx]), now)
                 if s.req.done:
                     sched.finish(s, now)
-        preempted = sched.drain_preempted()
+        preempted_pairs = sched.drain_preempted()
+        blocked = sched.drain_blocked()
         prefix_tick = (self.prefix.drain_tick()
                        if self.prefix is not None else None)
         new_fin = sched.finished[self._n_fin:]
@@ -243,7 +244,15 @@ class ReplicaCore:
             "running": sum(1 for s in sched.slots if not s.free),
             "free_pages": sched.pool.free_pages,
             "admitted": admitted, "prefill": prefill_rec,
-            "decoded": decoded, "preempted": preempted,
+            "decoded": decoded,
+            "preempted": [v for v, _ in preempted_pairs],
+            # Causal edges (ISSUE 11): blocked admission attempts and
+            # preemption beneficiaries, same shape as engine.run's tick
+            # record so `mctpu explain` folds both trails identically.
+            "blocked": [[rid, reason, holders]
+                        for rid, reason, holders in blocked],
+            "preempted_for": [[v, b] for v, b in preempted_pairs
+                              if b is not None],
             "finished": [r.rid for r in new_fin],
             "aborted": [[r.rid, r.status] for r in new_drop],
             "progressed": progressed or bool(admitted or new_fin or new_drop),
@@ -485,6 +494,7 @@ class Fleet:
         self.restarts = self.circuit_opens = 0
         self._retired = [0, 0, 0]  # decode_ticks, prefill_chunks, preempts
         self._retired_prefix = empty_prefix_fields()
+        self._failed_over_tick: list[tuple[int, str]] = []
         self._auth: dict[int, Request] = {}
         # rid -> (holding replica, live local copy): where a cancel()
         # must land (the authoritative object the caller holds is a
@@ -563,6 +573,7 @@ class Fleet:
             auth.fail_reason = local.fail_reason
             auth.finished_at = local.finished_at
             auth.preemptions += local.preemptions
+            auth.quota_wait_s += local.quota_wait_s
             if auth.admitted_at is None:
                 auth.admitted_at = local.admitted_at
             if self.registry is not None:
@@ -647,6 +658,7 @@ class Fleet:
                 continue
             self.router.revoke(local.rid)
             auth.preemptions += local.preemptions
+            auth.quota_wait_s += local.quota_wait_s
             if auth.admitted_at is None:
                 auth.admitted_at = local.admitted_at
             stranded.append(auth)
@@ -659,6 +671,11 @@ class Fleet:
         self._retire_counts(member.replica)
         stranded = self._harvest(member.replica)
         redispatch_q.extend(stranded)
+        # Causal marker (ISSUE 11): this tick's fleet record names the
+        # rids the failover stranded, so `mctpu explain` can end their
+        # active segments at the failover and bill the re-dispatch wait
+        # + re-prefill to redispatch_replay instead of self-compute.
+        self._failed_over_tick.extend((r.rid, name) for r in stranded)
         self._log_replica(name, "dead", tick, now,
                           stranded=[r.rid for r in stranded],
                           **({"draining": True} if member.draining else {}))
@@ -755,6 +772,10 @@ class Fleet:
             raise ValueError("duplicate request ids in the workload")
         pending = deque(reqs)
         redispatch_q: deque[Request] = deque()
+        # Arrival announcements (ISSUE 11): each fleet record names the
+        # rids whose arrival fell due since the last one — the tick
+        # anchor `mctpu explain` starts every blame span at.
+        announce = deque((r.arrival, r.rid) for r in reqs)
         clock, tick_s = self.clock, self.tick_s
         self._t0 = t0 = clock()
         n_done = 0
@@ -810,12 +831,19 @@ class Fleet:
             # the target replica emits this same tick — which is what
             # lets `mctpu trace` anchor a discard re-dispatch's token
             # reset ahead of the new replica's first emission.
+            failed_over, self._failed_over_tick = self._failed_over_tick, []
             if self.fleet_sink is not None:
+                arrived_now = []
+                while announce and announce[0][0] <= now:
+                    arrived_now.append(announce.popleft()[1])
                 self.fleet_sink({
                     "tick": tick, "now": round(now, 4),
                     "replicas": len(self.router.members),
                     "pending": len(pending) + len(redispatch_q),
+                    "arrived": arrived_now,
                     "dispatched": dispatched, "redispatched": redispatched,
+                    "failed_over": [[rid, name]
+                                    for rid, name in failed_over],
                     "redispatch": self.redispatch,
                     "load": {m.name: [len(m.replica.core.sched.queue),
                                       sum(1 for s in
@@ -850,7 +878,8 @@ class Fleet:
                         "mode": f"fleet/{member.name}",
                         **{k: rec[k] for k in
                            ("queue", "running", "free_pages", "admitted",
-                            "prefill", "decoded", "preempted", "finished",
+                            "prefill", "decoded", "preempted",
+                            "blocked", "preempted_for", "finished",
                             "aborted")},
                         **({"prefix_hits": rec["prefix_hits"]}
                            if "prefix_hits" in rec else {}),
@@ -881,7 +910,8 @@ class Fleet:
                         "mode": f"fleet/{rep.name}",
                         **{k: rec[k] for k in
                            ("queue", "running", "free_pages", "admitted",
-                            "prefill", "decoded", "preempted", "finished",
+                            "prefill", "decoded", "preempted",
+                            "blocked", "preempted_for", "finished",
                             "aborted")},
                         **({"prefix_hits": rec["prefix_hits"]}
                            if "prefix_hits" in rec else {}),
@@ -954,7 +984,9 @@ class Fleet:
                             "mode": "fleet/router",
                             "queue": 0, "running": 0, "free_pages": 0,
                             "admitted": [], "prefill": None,
-                            "decoded": [], "preempted": [], "finished": [],
+                            "decoded": [], "preempted": [],
+                            "blocked": [], "preempted_for": [],
+                            "finished": [],
                             "aborted": [[r.rid, r.status]
                                         for r in failed_now],
                             "terminal": [terminal_fields(r)

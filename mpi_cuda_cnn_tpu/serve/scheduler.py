@@ -167,6 +167,10 @@ class Request:
     first_token_at: float | None = None
     finished_at: float | None = None
     preemptions: int = 0
+    # Queue-wait seconds spent quota-blocked under SLOScheduler
+    # (ISSUE 11): the skip-over share of queue_wait, so the split
+    # registry metric can tell policy waits from capacity waits.
+    quota_wait_s: float = 0.0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -258,9 +262,19 @@ class _SchedulerBase:
         # exactly one of the two lists.
         self.dropped: list[Request] = []
         self.preemptions = 0
-        # rids preempted since the last drain_preempted() — the engine
-        # folds them into the tick record it emits for the timeline.
-        self.preempted_log: list[int] = []
+        # (victim rid, beneficiary rid | None) pairs preempted since the
+        # last drain_preempted() — the engine folds them into the tick
+        # record it emits for the timeline, and the beneficiary is the
+        # causal edge `mctpu explain` blames the wait on (ISSUE 11).
+        self.preempted_log: list[tuple[int, int | None]] = []
+        # (blocked rid, reason, holder rids) admission attempts that
+        # failed since the last drain_blocked() (ISSUE 11): reason is
+        # "pages" / "slots" / "quota", holders the rids occupying the
+        # resource the candidate waited on — the blocker edges of the
+        # causal DAG. Appended only for candidates actually TRIED this
+        # tick (the head under FCFS; every skipped candidate under the
+        # SLO scheduler, whose quota skip-overs are their own edge kind).
+        self.blocked_log: list[tuple[int, str, list[int]]] = []
         self._admit_seq = 0
         # True once any submitted request carried a deadline: lets a
         # caller (the fleet's per-replica step loop) skip the O(queue)
@@ -292,10 +306,32 @@ class _SchedulerBase:
     def next_arrival(self) -> float | None:
         return min((r.arrival for r in self.queue), default=None)
 
-    def drain_preempted(self) -> list[int]:
-        """rids preempted since the last call (tick-record bookkeeping)."""
+    def drain_preempted(self) -> list[tuple[int, int | None]]:
+        """(victim, beneficiary) pairs preempted since the last call
+        (tick-record bookkeeping; beneficiary None when the eviction
+        had no single requesting slot)."""
         out, self.preempted_log = self.preempted_log, []
         return out
+
+    def drain_blocked(self) -> list[tuple[int, str, list[int]]]:
+        """(rid, reason, holders) admission blocks since the last call
+        — the tick record's `blocked` field (ISSUE 11)."""
+        out, self.blocked_log = self.blocked_log, []
+        return out
+
+    def _occupants(self, tenant: str | None = None) -> list[int]:
+        """rids currently holding slots (and therefore pages), sorted —
+        the holder set a blocked admission queued behind. With `tenant`,
+        only that tenant's occupants (the quota-block holder set)."""
+        return sorted(
+            s.req.rid for s in self.slots
+            if not s.free
+            and (tenant is None or (s.req.tenant or "default") == tenant)
+        )
+
+    def _note_blocked(self, req: Request, reason: str,
+                      holders: list[int]) -> None:
+        self.blocked_log.append((req.rid, reason, holders))
 
     def prefill_backlog(self) -> int:
         """Prompt tokens admitted but not yet cached — the chunked-
@@ -571,19 +607,30 @@ class ContinuousScheduler(_SchedulerBase):
                            f"{need} pages; pool owns {self.pool.usable}")
                 continue
             if not self._admit_one(slot, req, now):
+                # Page-blocked head: record whom it queued behind — the
+                # occupants holding the pages whose release will unblock
+                # it (the ISSUE 11 blocker edge).
+                self._note_blocked(req, "pages", self._occupants())
                 break
             self.queue.popleft()
             bound.append(slot)
+        if (self.queue and self.queue[0].arrival <= now
+                and not any(s.free for s in self.slots)):
+            # Slot-blocked head: every engine slot is occupied — the
+            # head waits on a slot release, not on pages.
+            self._note_blocked(self.queue[0], "slots", self._occupants())
         return bound
 
-    def preempt(self, slot: Slot) -> None:
+    def preempt(self, slot: Slot, for_rid: int | None = None) -> None:
         """Evict `slot`: free its pages, requeue its request at the
         HEAD (it keeps FCFS priority and its emitted tokens; the grown
-        context recomputes via chunked prefill on readmission)."""
+        context recomputes via chunked prefill on readmission).
+        `for_rid` names the beneficiary — the decoding request whose
+        page need forced the eviction (the preempted-by causal edge)."""
         req = slot.req
         req.preemptions += 1
         self.preemptions += 1
-        self.preempted_log.append(req.rid)
+        self.preempted_log.append((req.rid, for_rid))
         req.status = "queued"
         self.queue.appendleft(req)
         self._release(slot)
@@ -639,7 +686,7 @@ class ContinuousScheduler(_SchedulerBase):
                         # the scratch page and corrupt the read mask.
                         stalled = True
                     break
-                self.preempt(victim)
+                self.preempt(victim, for_rid=slot.req.rid)
             if not stalled and not slot.free and slot.decoding:
                 survivors.append(slot)
         return survivors
@@ -659,6 +706,11 @@ class StaticScheduler(_SchedulerBase):
 
     def admit(self, now: float) -> list[Slot]:
         if any(not s.free for s in self.slots):
+            if self.queue and self.queue[0].arrival <= now:
+                # The in-flight batch holds every slot until it drains:
+                # the arrived head queues behind ALL of it (ISSUE 11).
+                self._note_blocked(self.queue[0], "slots",
+                                   self._occupants())
             return []
         bound = []
         for slot in self.slots:
@@ -679,6 +731,11 @@ class StaticScheduler(_SchedulerBase):
                 continue
             pages = self.pool.try_alloc(need, req.rid)
             if pages is None:
+                # Reservation-blocked behind the rows already bound into
+                # THIS batch (static reserves worst case up front); an
+                # empty holder list means no request holds the pages —
+                # an injected squeeze does.
+                self._note_blocked(req, "pages", self._occupants())
                 break
             self.queue.popleft()
             self._bind(slot, req, pages, now)
@@ -815,6 +872,10 @@ class SLOScheduler(ContinuousScheduler):
 
         self.policy = policy or SLOPolicy()
         self.acct = Accountant(self.policy.slo_spec or default_spec())
+        # Previous admit() moment: the inter-attempt gap is what a
+        # quota-blocked candidate's quota_wait_s accrues per skipped
+        # attempt (ISSUE 11 — the skip-over share of queue wait).
+        self._prev_admit_now: float | None = None
 
     def _on_terminal(self, req: Request, now: float) -> None:
         for _ in self.acct.observe(terminal_fields(req), now):
@@ -863,11 +924,23 @@ class SLOScheduler(ContinuousScheduler):
 
     def admit(self, now: float) -> list[Slot]:
         bound: list[Slot] = []
+        prev, self._prev_admit_now = self._prev_admit_now, now
+        delta = max(now - prev, 0.0) if prev is not None else 0.0
         free_slots = deque(s for s in self.slots if s.free)
-        if not free_slots or not self.queue:
+        if not self.queue:
             return bound
         arrived = [r for r in self.queue if r.arrival <= now]
         if not arrived:
+            return bound
+        if not free_slots:
+            # Slot-blocked: every arrived candidate waits on a slot
+            # release. One representative blocked entry (the highest-
+            # priority earliest arrival — pressure left out: computing
+            # it on every saturated tick is the cost the early return
+            # exists to skip) keeps the record volume bounded.
+            head = min(arrived, key=lambda r: (-self._prio(r),
+                                               r.arrival, r.rid))
+            self._note_blocked(head, "slots", self._occupants())
             return bound
         # One sort per tick: pressures are a pure fold over already-
         # observed terminals, so neither the ordering key nor the
@@ -883,6 +956,9 @@ class SLOScheduler(ContinuousScheduler):
         taken: set[int] = set()
         for req in order:
             if not free_slots:
+                # Ran out of slots mid-order: the next-ranked candidate
+                # is slot-blocked behind everything now running.
+                self._note_blocked(req, "slots", self._occupants())
                 break
             tenant = req.tenant or "default"
             need = pages_for(req.context_len + 1, self.page_size)
@@ -897,6 +973,15 @@ class SLOScheduler(ContinuousScheduler):
             pq = self.policy.page_quota.get(tenant)
             held_slots, held_pages = usage[tenant]
             if sq is not None and held_slots >= sq:
+                # Quota skip-over (ISSUE 11): its own causal edge kind —
+                # the candidate waits on ITS OWN tenant's occupancy, not
+                # on fleet capacity — and its own queue-wait split (the
+                # inter-attempt gap accrues as quota_wait_s, clamped to
+                # the request's own presence so a late arrival never
+                # inherits the whole gap and the quota share stays a
+                # subset of its queue wait).
+                req.quota_wait_s += min(delta, max(now - req.arrival, 0.0))
+                self._note_blocked(req, "quota", self._occupants(tenant))
                 continue  # quota-blocked: skip, don't block others
             # The page quota counts PRIVATE pages only (the SLOPolicy
             # contract: shared prefix pages are deduplicated capacity)
@@ -910,11 +995,14 @@ class SLOScheduler(ContinuousScheduler):
             if pq is not None and held_pages + alloc_n > pq:
                 if acq is not None:
                     self._release_acq(acq, req.rid)
+                req.quota_wait_s += min(delta, max(now - req.arrival, 0.0))
+                self._note_blocked(req, "quota", self._occupants(tenant))
                 continue
             slot = free_slots[0]
             if not self._admit_one(slot, req, now, acq=acq):
                 # Page-blocked: the top-ranked admissible request
                 # waits; nothing below it jumps the page queue.
+                self._note_blocked(req, "pages", self._occupants())
                 break
             free_slots.popleft()
             taken.add(id(req))

@@ -8,6 +8,7 @@ the golden renderings tests/test_obs_runtime.py pins byte-for-byte:
     tests/data/golden_serve_report.md   (`mctpu report` output)
     tests/data/golden_serve_trace.md    (`mctpu trace` output)
     tests/data/golden_serve_health.md   (`mctpu health` output, ISSUE 8)
+    tests/data/golden_serve_explain.md  (`mctpu explain` output, ISSUE 11)
 
 The workload is chosen for lifecycle diversity: a page pool far smaller
 than the worst case forces preemption/requeue cycles, an injected
@@ -61,6 +62,7 @@ def build_records():
     from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
     from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
     from mpi_cuda_cnn_tpu.obs.alerts import AlertEngine
+    from mpi_cuda_cnn_tpu.obs.causal import BlameAccumulator
     from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
     from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
     from mpi_cuda_cnn_tpu.obs.slo import SLOSpec
@@ -87,8 +89,13 @@ def build_records():
     for mode in ("static", "continuous"):
         clock = FakeClock()
         registry = MetricsRegistry(clock=clock)
+        # Causal blame (ISSUE 11): folded off the same tick stream the
+        # file gets, then stamped as the `blame` summary record the
+        # report golden renders and the explain golden drills into.
+        blame = BlameAccumulator()
 
-        def sink(rec, clock=clock, registry=registry):
+        def sink(rec, clock=clock, registry=registry, blame=blame):
+            blame.ingest_tick(rec)
             emit(make_record("tick", clock.now, **rec), clock)
             if (rec["tick"] + 1) % 32 == 0:
                 emit(registry.snapshot(mode=rec["mode"]), clock)
@@ -113,6 +120,8 @@ def build_records():
                          # prefix_hits/prefix tick fields.
                          prefix=(mode == "continuous"))
         s = res.summary()
+        emit(make_record("blame", clock.now, **blame.summary_fields(mode)),
+             clock)
         registry.set("serve.tokens_per_s", s["tokens_per_s"])
         emit(registry.snapshot(mode=mode, final=True), clock)
         for rec in res.request_records():
@@ -128,6 +137,7 @@ def build_records():
 
 
 def main() -> int:
+    from mpi_cuda_cnn_tpu.obs.causal import explain_main
     from mpi_cuda_cnn_tpu.obs.health import health_main
     from mpi_cuda_cnn_tpu.obs.report import report_main
     from mpi_cuda_cnn_tpu.obs.schema import dump_records
@@ -153,6 +163,11 @@ def main() -> int:
         ("golden_serve_trace.md", trace_main, [rel, "--width", "80"], 0),
         ("golden_serve_health.md", health_main,
          [rel, "--slo", str(slo.relative_to(REPO)), "--verify-alerts"], 1),
+        # ISSUE 11: aggregate blame + top blockers + the two worst-TTFT
+        # blame trees — exits 0 because the sample conserves (the
+        # round-trip test pins bytes AND exit code).
+        ("golden_serve_explain.md", explain_main,
+         [rel, "--worst", "ttft", "-k", "2"], 0),
     ):
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):

@@ -1,0 +1,378 @@
+"""Causal critical-path attribution (ISSUE 11): `mctpu explain`.
+
+THE acceptance tests live here:
+- blame conservation: for every terminal request of a seeded fleet
+  storm (crashes + zombie + preemptions + prefix sharing on), the blame
+  categories sum BITWISE to the request's end-to-end tick span, and two
+  identical-seed storms produce CRC-identical blame;
+- `mctpu explain` exits 1 on any drift vs the engine's own records
+  (tampered trail), 0 on a clean one, byte-pinned against the
+  checked-in golden;
+- the SLOScheduler quota skip-over wait is split out of the conflated
+  queue-wait histogram (its own registry metric + report column).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+import jax
+
+from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.obs.causal import (
+    CATEGORIES,
+    BlameAccumulator,
+    explain_main,
+    worst_k,
+)
+from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
+from mpi_cuda_cnn_tpu.obs.schema import (
+    dump_records,
+    make_record,
+    validate_record,
+)
+from mpi_cuda_cnn_tpu.obs.timeline import trace_main
+from mpi_cuda_cnn_tpu.serve.bench import make_workload
+from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+from mpi_cuda_cnn_tpu.serve.fleet import Fleet, SimCompute, \
+    make_fleet_workload
+from mpi_cuda_cnn_tpu.serve.scheduler import SLOPolicy
+
+REPO = Path(__file__).resolve().parents[1]
+DATA = REPO / "tests" / "data"
+
+MODEL = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    params = MODEL.init(jax.random.key(0))
+    # Pool far below the worst case: preemption lifecycles (and their
+    # preempted-by blame edges) appear, not just the happy path.
+    return PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                       prefill_chunk=8, max_len=40)
+
+
+def _storm(seed=3, crash=True, detail=False):
+    """A seeded sim-fleet storm with a zombie crash, an elastic join,
+    preemption pressure (tight per-replica pools) and prefix sharing —
+    every blame category except router_wait exercised. Returns
+    (FleetResult, BlameAccumulator)."""
+    acc = BlameAccumulator(detail=detail)
+    plan = ("replica_crash@fleet.tick:40?replica=1&zombie_ticks=3;"
+            "replica_join@fleet.tick:120") if crash else None
+    fleet = Fleet(
+        lambda name: SimCompute(vocab=64, chunk=8, salt=0),
+        replicas=3, slots=2, num_pages=12, page_size=4, max_len=64,
+        faults=FaultInjector(plan) if plan else None, clock=FakeClock(),
+        tick_s=1e-3, prefix=True,
+        fleet_sink=acc.ingest_fleet, replica_tick_sink=acc.ingest_tick,
+    )
+    reqs = make_fleet_workload(n=300, vocab=64, prompt_min=4,
+                               prompt_max=24, out_min=4, out_max=24,
+                               rate=500.0, seed=seed)
+    return fleet.run(reqs), acc
+
+
+# --------------------------------------------- conservation acceptance
+
+
+def test_fleet_storm_blame_conserves_and_covers_every_category():
+    """THE ISSUE 11 acceptance: every terminal request's categories sum
+    bitwise to its end-to-end tick span through crashes, a zombie,
+    preemptions, and prefix sharing — and the storm exercises self /
+    queued-behind / preempted-by / redispatch-replay blame."""
+    res, acc = _storm(detail=True)
+    assert res.crashes == 1 and res.redispatches > 0
+    assert res.preemptions > 0
+    assert acc.check("fleet") == []
+    blames = acc.blames()["fleet"]
+    assert len(blames) == len(res.requests)
+    for b in blames.values():
+        assert b.terminal and b.conserved
+        assert sum(b.cats.values()) == b.terminal_tick - b.start_tick
+    totals = acc.summary_fields("fleet")["categories"]
+    assert totals["self_compute"] > 0
+    assert totals["queued_behind"] > 0
+    assert totals["preempted_by"] > 0
+    assert totals["redispatch_replay"] > 0
+    # Preemption blame names the beneficiary; queue blame the holders.
+    assert any(b.preemptors for b in blames.values())
+    assert any(b.blockers for b in blames.values())
+    # Replay blame lands exactly on requests the failover stranded.
+    replayed = {b.rid for b in blames.values()
+                if b.cats["redispatch_replay"]}
+    redispatched = {t[1] for t in res.dispatch_trace
+                    if t[4] == "redispatch"}
+    assert replayed <= redispatched and replayed
+
+
+def test_identical_seed_storms_blame_crc_identical():
+    """Attribution is deterministic: two identical-seed storms fold to
+    bitwise-identical blame (the CI gate's run-vs-run property), and a
+    different seed does not."""
+    _, a = _storm(seed=3)
+    _, b = _storm(seed=3)
+    assert a.crc("fleet") == b.crc("fleet")
+    assert a.summary_fields("fleet") == b.summary_fields("fleet")
+    _, c = _storm(seed=4)
+    assert a.crc("fleet") != c.crc("fleet")
+
+
+def test_engine_blame_conservation_and_blocker_edges(engine):
+    """Single-engine form: a constrained pool forces page/slot blocks
+    and preemptions; blame conserves per request and the blocker edges
+    name real co-resident holders."""
+    acc = BlameAccumulator(detail=True)
+    clock = FakeClock()
+    reqs = make_workload(n=8, vocab=13, prompt_min=4, prompt_max=8,
+                         out_min=6, out_max=18, rate=40.0, seed=5)
+    res = engine.run(reqs, mode="continuous", time_fn=clock,
+                     sleep_fn=clock.advance, tick_sink=acc.ingest_tick)
+    assert acc.check("continuous") == []
+    blames = acc.blames()["continuous"]
+    assert len(blames) == len(res.requests)
+    rids = set(blames)
+    for b in blames.values():
+        assert b.conserved
+        # A blocker/beneficiary is always another request of this run.
+        assert set(b.blockers) <= rids
+        assert set(b.preemptors) <= rids
+
+
+def test_blame_record_is_schema_valid():
+    _, acc = _storm()
+    rec = make_record("blame", 0.0, **acc.summary_fields("fleet"))
+    validate_record(rec)
+    assert set(rec["categories"]) == set(CATEGORIES)
+    assert rec["conserved"] is True
+
+
+def test_blocked_note_change_splits_attribution():
+    """A queued wait whose block note changes mid-wait bills each
+    holder set (and reason) for the ticks it actually blocked — the
+    newest note must not absorb the whole segment."""
+    acc = BlameAccumulator(detail=True)
+
+    def tick(i, **kw):
+        acc.ingest_tick({"mode": "m", "tick": i, "now": float(i), **kw})
+
+    tick(0, arrived=[1])
+    tick(0, blocked=[[1, "quota", [2]]])
+    for i in range(1, 6):  # 5 ticks quota-blocked behind rid 2
+        tick(i, blocked=[[1, "quota", [2]]])
+    tick(6, blocked=[[1, "pages", [3]]])  # then 1 tick behind rid 3
+    tick(7, admitted=[[0, 1]])
+    tick(9, finished=[1],
+         terminal=[{"id": 1, "tenant": "default", "status": "finished",
+                    "ttft_ms": 1.0, "tpot_ms": 1.0}])
+    b = acc.blames()["m"][1]
+    assert b.conserved and b.span_ticks == 9
+    # rid 2 blocked ticks 0..6 (quota), rid 3 ticks 6..7 (pages).
+    assert b.blockers == {2: 6, 3: 1}
+    assert b.quota_ticks == 6
+    assert b.cats["queued_behind"] == 7 and b.cats["self_compute"] == 2
+
+
+# ------------------------------------------------------- worst-k selector
+
+
+def test_worst_k_selector_orders_desc_and_drops_none():
+    rows = [{"v": 3}, {"v": None}, {"v": 9}, {"v": 0}, {"v": 9}]
+    got = worst_k(rows, lambda r: r["v"], 3)
+    assert [r["v"] for r in got] == [9, 9, 3]
+    assert worst_k(rows, lambda r: r["v"], 0) == []
+
+
+# --------------------------------------------------- explain CLI + drift
+
+
+def _engine_trail(engine, tmp_path, name="run.jsonl"):
+    """A serve-bench-shaped JSONL (tick + request + serve + blame) from
+    one FakeClock engine run. Everything arrives at t=0 (rate 0 — a
+    FakeClock only advances on idle waits, so Poisson arrivals would
+    serialize) and output lengths overflow the pool: blocked
+    admissions and preemptions appear in the trail."""
+    acc = BlameAccumulator()
+    ticks = []
+    clock = FakeClock()
+    reqs = make_workload(n=8, vocab=13, prompt_min=4, prompt_max=8,
+                         out_min=12, out_max=28, rate=0.0, seed=5)
+    res = engine.run(reqs, mode="continuous", time_fn=clock,
+                     sleep_fn=clock.advance,
+                     tick_sink=lambda r: (acc.ingest_tick(r),
+                                          ticks.append(dict(r))))
+    records = [make_record("tick", t["now"], **t) for t in ticks]
+    records += [make_record("request", clock.now, **r)
+                for r in res.request_records()]
+    records.append(make_record("serve", clock.now, **res.summary()))
+    records.append(make_record("blame", clock.now,
+                               **acc.summary_fields("continuous")))
+    path = tmp_path / name
+    dump_records(records, path)
+    return records, path, res
+
+
+def test_explain_cli_clean_run_exits_zero(engine, tmp_path, capsys):
+    records, path, res = _engine_trail(engine, tmp_path)
+    assert explain_main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "| blame (ticks) |" in out and "top blockers" in out
+    assert explain_main([str(path), "--worst", "ttft", "-k", "3"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("request ") == 3 and "conserved yes" in out
+    assert explain_main([str(path), "--request", res.requests[0].rid,
+                         "--format", "md"]) == 0
+    assert explain_main([str(path), "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert payload["problems"] == [] and payload["inconsistent"] == []
+    assert set(payload["aggregate"]["categories"]) == set(CATEGORIES)
+    # Live == replay: the blame record stamped by the live fold and the
+    # file-replay recomputation agree bitwise (the alerts_crc
+    # discipline, ISSUE 8 -> 11).
+    stamped = next(r for r in records if r["event"] == "blame")
+    assert payload["aggregate"]["crc"] == stamped["crc"]
+    assert payload["aggregate"]["categories"] == stamped["categories"]
+
+
+def test_explain_exits_1_on_drift_vs_engine_records(engine, tmp_path):
+    """Tampering with the trail must exit 1 — both halves: a request
+    record disagreeing with the reconstruction (the trace-style drift)
+    and a trail whose blame cannot conserve (a vanished terminal)."""
+    records, _, _ = _engine_trail(engine, tmp_path)
+    # Half 1: inflate one request record's output_tokens.
+    tampered = [({**r, "output_tokens": r["output_tokens"] + 1}
+                 if r["event"] == "request" else r) for r in records]
+    p1 = tmp_path / "drift.jsonl"
+    dump_records(tampered, p1)
+    assert explain_main([str(p1)]) == 1
+    # Half 2: drop one tick's finished entry — that rid never reaches a
+    # terminal status in the trail, so its blame account is incomplete.
+    dropped = False
+    tampered2 = []
+    for r in records:
+        if not dropped and r["event"] == "tick" and r.get("finished"):
+            r = {**r, "finished": r["finished"][1:],
+                 "terminal": (r.get("terminal") or [])[1:]}
+            dropped = True
+        tampered2.append(r)
+    assert dropped
+    p2 = tmp_path / "lost.jsonl"
+    dump_records(tampered2, p2)
+    assert explain_main([str(p2)]) == 1
+
+
+def test_explain_rejects_legacy_trail_without_causal_fields(engine,
+                                                            tmp_path):
+    """A pre-ISSUE-11 trail (tick records without arrived/blocked) is a
+    config error (exit 2), not silently-wrong blame."""
+    records, _, _ = _engine_trail(engine, tmp_path)
+    legacy = [{k: v for k, v in r.items()
+               if k not in ("arrived", "blocked", "preempted_for")}
+              for r in records]
+    path = tmp_path / "legacy.jsonl"
+    dump_records(legacy, path)
+    assert explain_main([str(path)]) == 2
+
+
+def test_golden_explain_roundtrip(monkeypatch, capsys):
+    """`mctpu explain` on the sample run is byte-for-byte the
+    checked-in golden (regenerate via scripts/make_obs_sample.py)."""
+    monkeypatch.chdir(REPO)
+    rc = explain_main(["tests/data/sample_serve_run.jsonl",
+                       "--worst", "ttft", "-k", "2"])
+    assert rc == 0
+    assert capsys.readouterr().out == \
+        (DATA / "golden_serve_explain.md").read_text()
+
+
+# ------------------------------------------------- trace --slowest N
+
+
+def test_trace_slowest_selects_worst_by_latency(engine, tmp_path,
+                                                capsys):
+    records, path, res = _engine_trail(engine, tmp_path)
+    assert trace_main([str(path), "--slowest", "2"]) == 0
+    out = capsys.readouterr().out
+    table = [ln for ln in out.splitlines()
+             if ln.startswith("| ") and ln.split("|")[1].strip().isdigit()]
+    assert len(table) == 2
+    lat = {r["id"]: r["latency_ms"]
+           for r in (rec for rec in records if rec["event"] == "request")}
+    want = sorted(lat, key=lambda rid: -lat[rid])[:2]
+    got = [int(ln.split("|")[1]) for ln in table]
+    assert sorted(got) == sorted(want)
+
+
+# ------------------------------------------- quota skip-over wait split
+
+
+def test_quota_wait_split_from_capacity_wait(engine):
+    """Satellite: under the SLOScheduler a quota-limited tenant's
+    skip-over time lands in quota_wait_s (its own registry histogram
+    and blame edge kind), while the unlimited tenant's stays zero."""
+    registry = MetricsRegistry(clock=FakeClock())
+    acc = BlameAccumulator()
+    clock = FakeClock()
+    reqs = make_workload(n=12, vocab=13, prompt_min=4, prompt_max=8,
+                         out_min=6, out_max=14, rate=40.0, seed=7,
+                         tenants=2)
+    # Under a FakeClock busy ticks are instantaneous, so quota seconds
+    # only accrue while something advances the clock — the staggered
+    # slow faults ratchet it mid-run (the make_obs_sample recipe).
+    faults = FaultInjector(
+        ";".join(f"slow@serve.tick:{t}?s=0.05" for t in range(2, 40, 3)),
+        clock=clock)
+    res = engine.run(reqs, mode="continuous", time_fn=clock,
+                     sleep_fn=clock.advance, registry=registry,
+                     faults=faults, tick_sink=acc.ingest_tick,
+                     policy=SLOPolicy(slot_quota={"t0": 1}))
+    assert acc.check("continuous") == []
+    quota = {r.rid: r.quota_wait_s for r in res.requests}
+    t0 = [r for r in res.requests if r.tenant == "t0"]
+    t1 = [r for r in res.requests if r.tenant == "t1"]
+    assert len(t0) > 1 and t1
+    assert any(quota[r.rid] > 0 for r in t0)  # skip-overs accrued
+    assert all(quota[r.rid] == 0 for r in t1)  # unlimited tenant clean
+    # The split registry metric exists, tenant-twinned, and only for
+    # requests that actually waited on quota.
+    h = registry.histograms.get("serve.queue_wait_quota_ms")
+    assert h is not None and h.count == sum(1 for r in res.requests
+                                            if r.quota_wait_s > 0)
+    assert "serve.tenant.t0.queue_wait_quota_ms" in registry.histograms
+    assert "serve.tenant.t1.queue_wait_quota_ms" not in registry.histograms
+    # Blame sees the same skip-overs as the "quota" edge kind.
+    assert acc.summary_fields("continuous")["quota_ticks"] > 0
+    # The split is a SUBSET of the total queue wait, never extra time.
+    for r in res.requests:
+        if r.admitted_at is not None:
+            assert r.quota_wait_s <= (r.admitted_at - r.arrival) + 1e-9
+    # And the request records carry the column report renders.
+    rec = res.request_records()[0]
+    assert "queue_wait_quota_ms" in rec
+
+
+def test_quota_wait_clamped_to_requests_own_presence():
+    """A late arrival skipped right after a long admit gap must accrue
+    only the time it actually existed, not the whole inter-admit gap —
+    otherwise quota wait could exceed the total queue wait."""
+    import numpy as np
+
+    from mpi_cuda_cnn_tpu.serve.pool import PagePool
+    from mpi_cuda_cnn_tpu.serve.scheduler import Request, SLOScheduler
+
+    sched = SLOScheduler(
+        policy=SLOPolicy(slot_quota={"t0": 1}),
+        slots=2, pool=PagePool(16), page_size=4, max_len=32,
+    )
+    occupant = Request(rid=0, prompt=np.arange(4), max_new_tokens=4,
+                       arrival=0.0, tenant="t0")
+    sched.submit([occupant])
+    sched.admit(0.0)  # t0 holds its one slot; _prev_admit_now = 0
+    late = Request(rid=1, prompt=np.arange(4), max_new_tokens=4,
+                   arrival=9.9, tenant="t0")
+    sched.submit([late])
+    sched.admit(10.0)  # gap = 10 s, but the request existed for 0.1 s
+    assert late.quota_wait_s == pytest.approx(0.1)

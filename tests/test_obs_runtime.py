@@ -456,9 +456,18 @@ def test_sample_run_is_schema_pinned():
     schema/event-family drift fails here first, loudly."""
     records = load_records(DATA / "sample_serve_run.jsonl", strict=True)
     assert {r["event"] for r in records} == \
-        {"tick", "metrics", "request", "fault", "serve", "alert"}
+        {"tick", "metrics", "request", "fault", "serve", "alert", "blame"}
     # The diversity the goldens depend on: preemptions AND expiries.
     assert any(r["event"] == "tick" and r["preempted"] for r in records)
+    # ISSUE 11's additions: causal tick fields (arrival announcements,
+    # blocker edges, preemption beneficiaries) and a conserved `blame`
+    # summary per mode.
+    assert any(r["event"] == "tick" and r.get("blocked") for r in records)
+    assert any(r["event"] == "tick" and r.get("preempted_for")
+               for r in records)
+    assert all("arrived" in r for r in records if r["event"] == "tick")
+    assert all(r.get("conserved") for r in records
+               if r["event"] == "blame")
     assert any(r["event"] == "request" and r.get("status") == "expired"
                for r in records)
     # ISSUE 8's additions: a tenant mix, per-tick terminal detail, and
