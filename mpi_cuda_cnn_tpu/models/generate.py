@@ -40,24 +40,52 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import NEG_INF, attention
+from ..ops.pallas_gemv import qmatmul
 from .transformer import TransformerLM, _layernorm
+
+# THE auto-dtype routing table (ISSUE 12 satellite: one place for every
+# "auto" storage-dtype decision), keyed by surface -> (GQA/MQA pick,
+# MHA pick). Cache row: measurement-driven (PERF.md int8 decode table,
+# one v5e) — int8 wins +27-32% under GQA/MQA and LOSES MHA by ~9%,
+# where bfloat16 wins outright. Weights row: under GQA/MQA the weight
+# stream is the dominant byte mover once the cache is int8-shrunk, so
+# int8 follows the same byte-dominance argument (chip rows banked by
+# tpu_capture's bench_decode --weights-dtype steps); at MHA the cache
+# dominates and the measured bf16-weights cast was NOT a win
+# (PERF.md round-5 note), so weights stay f32 there.
+_AUTO_DTYPE_ROUTING: dict[str, tuple[str, str]] = {
+    "cache": ("int8", "bfloat16"),
+    "weights": ("int8", "float32"),
+}
+
+
+def _route_auto(surface: str, dtype: str, heads: int,
+                kv_heads: int | None) -> str:
+    if dtype != "auto":
+        return dtype
+    gqa_pick, mha_pick = _AUTO_DTYPE_ROUTING[surface]
+    kv = kv_heads or heads
+    return gqa_pick if kv < heads else mha_pick
 
 
 def pick_cache_dtype(dtype: str, *, heads: int,
                      kv_heads: int | None = None) -> str:
     """Resolve --decode-cache-dtype "auto" to a concrete storage dtype
-    (VERDICT item 7), the pick_attn_impl pattern applied to the cache.
+    (VERDICT item 7), the pick_attn_impl pattern applied to the cache:
+    int8 for GQA/MQA, bfloat16 for MHA (_AUTO_DTYPE_ROUTING "cache"
+    row). Explicit dtypes pass through untouched — "auto" is a router,
+    not a cap, exactly like pick_attn_impl's contract."""
+    return _route_auto("cache", dtype, heads, kv_heads)
 
-    Measurement-driven (PERF.md int8 decode table, one v5e): int8 wins
-    under GQA/MQA (the cache is already small, so the absmax math is
-    paid back by the 4x byte cut) and LOSES MHA by ~9%, where bfloat16
-    wins outright. So: kv_heads < heads -> int8, MHA -> bfloat16.
-    Explicit dtypes pass through untouched — "auto" is a router, not a
-    cap, exactly like pick_attn_impl's contract."""
-    if dtype != "auto":
-        return dtype
-    kv = kv_heads or heads
-    return "int8" if kv < heads else "bfloat16"
+
+def pick_weights_dtype(dtype: str, *, heads: int,
+                       kv_heads: int | None = None) -> str:
+    """Resolve --decode-weights-dtype "auto" (ISSUE 12): int8 for
+    GQA/MQA — where the weight stream dominates the decode bytes once
+    the cache is int8 — float32 for MHA, where the cache dominates and
+    the measured bf16 weights cast was not a win (_AUTO_DTYPE_ROUTING
+    "weights" row; same pass-through contract as pick_cache_dtype)."""
+    return _route_auto("weights", dtype, heads, kv_heads)
 
 
 def init_cache(model: TransformerLM, batch: int,
@@ -195,6 +223,11 @@ def token_forward(model: TransformerLM, params, toks, positions, attend):
     layer i's cache update + masked attention read (closing over its
     cache; layers are traced in order, so append-style capture works —
     the same idiom as prefill's attn_fn).
+
+    Every weight matmul routes through ops.pallas_gemv.qmatmul, so
+    params may carry int8 QuantW leaves (quantize_decode_params,
+    --decode-weights-dtype int8) — the decode-weight bandwidth lever
+    rides the SAME forward, not a second one.
     Returns (B, k, vocab) f32 logits.
     """
     b, kk = toks.shape
@@ -206,7 +239,7 @@ def token_forward(model: TransformerLM, params, toks, positions, attend):
         y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
         q, k, v = model.project_qkv(blk, y, positions=positions)
         o = attend(i, q, k, v)
-        x = x + o.astype(x.dtype) @ blk["wo"]
+        x = x + qmatmul(o.astype(x.dtype), blk["wo"])
         y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
         if model.moe_experts:
             from ..parallel.ep import moe_mlp_inference
@@ -217,9 +250,10 @@ def token_forward(model: TransformerLM, params, toks, positions, attend):
             )
             x = x + m.reshape(b, kk, model.dim)
         else:
-            x = x + jax.nn.gelu(y @ blk["w1"]) @ blk["w2"]
+            x = x + qmatmul(jax.nn.gelu(qmatmul(y, blk["w1"])),
+                            blk["w2"])
     x = _layernorm(x, params["ln_f"]["g"], params["ln_f"]["b"])
-    return (x @ params["head"]).astype(jnp.float32)
+    return qmatmul(x, params["head"]).astype(jnp.float32)
 
 
 def attend_kv(q, ck, cv, mask, cks=None, cvs=None):
@@ -242,11 +276,36 @@ def attend_kv(q, ck, cv, mask, cks=None, cvs=None):
     g = h // hkv
     qg = q.reshape(b, kk, hkv, g, hd)
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    logits = jnp.einsum(
-        "bqhgd,bkhd->bhgqk", qg,
-        ck.astype(jnp.float32) if int8 else ck,
-        preferred_element_type=jnp.float32,
-    ) * scale                                 # (B, Hkv, g, k, L)
+    # The single-query gemv cell (g*kk == 1: MHA one-token decode) uses
+    # sum-product contractions instead of einsums when accumulating in
+    # f32 OFF-TPU: XLA CPU's batched-gemv emitter orders its
+    # accumulation differently from any per-(b,h) dot a fused kernel
+    # can express, so einsums there are unreproducible to the bit. The
+    # sum-product is the one formulation XLA CPU emits identically
+    # inside and outside a Pallas kernel — ops/pallas_paged_attention
+    # mirrors it (same backend switch), which is what makes the paged
+    # kernel's f32 parity gate BITWISE across MHA too, exactly where it
+    # is tested (interpret mode on CPU). On TPU both sides keep the
+    # batched einsum/dot — the MXU path the banked MHA decode rows
+    # measure; the kernel-vs-gather contract there is the bf16/int8
+    # band, not bitwise f32 (nothing serving-shaped runs f32 MHA on
+    # chip, and the CPU gate pins the kernel's indexing either way).
+    # bf16 keeps the einsums everywhere (the kernel's bf16 dots already
+    # land bitwise inside bf16 rounding).
+    sumprod = (kk * g == 1 and (int8 or ck.dtype == jnp.float32)
+               and jax.default_backend() != "tpu")
+    if sumprod:
+        qv = qg[:, 0, :, 0, :]                # (B, Hkv, hd)
+        ckf = ck.astype(jnp.float32) if int8 else ck
+        logits = (jnp.sum(
+            qv[:, :, :, None] * jnp.transpose(ckf, (0, 2, 3, 1)), axis=2,
+        ) * scale)[:, :, None, None, :]       # (B, Hkv, 1, 1, L)
+    else:
+        logits = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg,
+            ck.astype(jnp.float32) if int8 else ck,
+            preferred_element_type=jnp.float32,
+        ) * scale                             # (B, Hkv, g, k, L)
     if int8:
         logits = logits * jnp.transpose(cks, (0, 2, 3, 1))[:, :, None, :, :]
     if mask.ndim == 2:
@@ -254,11 +313,24 @@ def attend_kv(q, ck, cv, mask, cks=None, cvs=None):
     logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     if int8:
-        pv = probs * jnp.transpose(cvs, (0, 2, 3, 1))[:, :, None, :, :]
-        o = jnp.einsum(
-            "bhgqk,bkhd->bqhgd", pv, cv.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
+        if sumprod:
+            pq = probs[:, :, 0, 0, :] * cvs[:, :, :, 0].transpose(0, 2, 1)
+            o = jnp.sum(
+                pq[:, :, :, None]
+                * jnp.transpose(cv.astype(jnp.float32), (0, 2, 1, 3)),
+                axis=2,
+            )[:, None]                          # (B, 1, Hkv, hd)
+        else:
+            pv = probs * jnp.transpose(cvs, (0, 2, 3, 1))[:, :, None, :, :]
+            o = jnp.einsum(
+                "bhgqk,bkhd->bqhgd", pv, cv.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+    elif sumprod:
+        o = jnp.sum(
+            probs[:, :, 0, 0, :, None] * jnp.transpose(cv, (0, 2, 1, 3)),
+            axis=2,
+        )[:, None]                              # (B, 1, Hkv, hd)
     else:
         o = jnp.einsum(
             "bhgqk,bkhd->bqhgd", probs.astype(cv.dtype), cv,

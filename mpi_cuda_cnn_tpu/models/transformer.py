@@ -30,6 +30,16 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import attention, rope
+from ..ops.pallas_gemv import QuantW, qmatmul
+
+
+def _weight_cast(cd):
+    """The compute-dtype weight cast, QuantW-aware: quantized decode
+    weights (ops/pallas_gemv) carry their own storage dtype and must
+    not be astype'd — qmatmul dequantizes them inside its kernel."""
+    if cd is None:
+        return lambda t: t
+    return lambda t: t if isinstance(t, QuantW) else t.astype(cd)
 
 
 def _layernorm(x, g, b, eps=1e-5):
@@ -156,17 +166,20 @@ class TransformerLM:
         Before the serve/ refactor the decode path re-implemented these
         lines and only a parity test bound the two; now they cannot
         drift. Per-row (B, S) positions are the continuous-batching
-        decode form — each serving slot sits at its own depth.
+        decode form — each serving slot sits at its own depth. Weight
+        matmuls route through qmatmul, so serving params may carry int8
+        QuantW leaves (quantize_decode_params) — the decode-weight
+        bandwidth lever, same forward.
         Returns q: (B, S, H, hd); k, v: (B, S, Hkv, hd)."""
         b, s, _ = y.shape
         h, hd, hkv = self.heads, self.head_dim, self.n_kv
-        w = (lambda t: t.astype(compute_dtype)) if compute_dtype else (lambda t: t)
+        w = _weight_cast(compute_dtype)
         if hkv == h:
-            qkv = y @ w(blk["wqkv"])                # (B, S, 3*dim)
+            qkv = qmatmul(y, w(blk["wqkv"]))        # (B, S, 3*dim)
             q, k, v = jnp.split(qkv, 3, axis=-1)
         else:
-            q = y @ w(blk["wq"])                    # (B, S, dim)
-            kv = y @ w(blk["wkv"])                  # (B, S, 2*hkv*hd)
+            q = qmatmul(y, w(blk["wq"]))            # (B, S, dim)
+            kv = qmatmul(y, w(blk["wkv"]))          # (B, S, 2*hkv*hd)
             k, v = jnp.split(kv, 2, axis=-1)
         q = q.reshape(b, s, h, hd)
         k = k.reshape(b, s, hkv, hd)
@@ -199,12 +212,12 @@ class TransformerLM:
         b, s, _ = x.shape
         h, hd = self.heads, self.head_dim
         cd = compute_dtype
-        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+        w = _weight_cast(cd)
 
         y = _layernorm(x, blk["ln1"]["g"], blk["ln1"]["b"])
         q, k, v = self.project_qkv(blk, y, positions=pos, compute_dtype=cd)
         o = attn(q, k, v).reshape(b, s, h * hd)
-        x = x + (o.astype(x.dtype) @ w(blk["wo"]))
+        x = x + qmatmul(o.astype(x.dtype), w(blk["wo"]))
         y = _layernorm(x, blk["ln2"]["g"], blk["ln2"]["b"])
         if self.moe_experts:
             # Expert weights go through the same compute-dtype cast
@@ -232,7 +245,8 @@ class TransformerLM:
                 )
             return x + m.reshape(b, s, self.dim).astype(x.dtype), aux
         return (
-            x + jax.nn.gelu(y @ w(blk["w1"])) @ w(blk["w2"]),
+            x + qmatmul(jax.nn.gelu(qmatmul(y, w(blk["w1"]))),
+                        w(blk["w2"])),
             jnp.zeros(()),
         )
 
@@ -272,7 +286,7 @@ class TransformerLM:
         b, s = tokens.shape
         h, hd = self.heads, self.head_dim
         cd = compute_dtype
-        w = (lambda t: t.astype(cd)) if cd else (lambda t: t)
+        w = _weight_cast(cd)
         if s > self.max_seq:
             # XLA's gather would silently clamp out-of-range positions to
             # pos_emb[max_seq-1]; fail loudly instead. (Sharded callers
@@ -309,5 +323,5 @@ class TransformerLM:
             return (x, aux_total) if return_aux else x
         # Head matmul in compute dtype (it is the single largest matmul);
         # logits come back in f32 — the loss softmax must not run in bf16.
-        logits = (x @ w(params["head"])).astype(jnp.float32)
+        logits = qmatmul(x, w(params["head"])).astype(jnp.float32)
         return (logits, aux_total) if return_aux else logits

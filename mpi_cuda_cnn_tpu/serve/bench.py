@@ -159,6 +159,21 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                     help="auto routes from the banked int8 table "
                          "(VERDICT 7): int8 for GQA/MQA, bfloat16 "
                          "for MHA (models/generate.pick_cache_dtype)")
+    ap.add_argument("--attn-kernel", default="gather",
+                    choices=["gather", "pallas"],
+                    help="paged-attention read (ISSUE 12): gather = "
+                         "the XLA formulation; pallas = the fused "
+                         "ops/pallas_paged_attention kernel (pages "
+                         "stream HBM->VMEM; bitwise vs gather in f32, "
+                         "<=1e-5 in bf16/int8; interpret mode on CPU)")
+    ap.add_argument("--decode-weights-dtype", default="float32",
+                    choices=["float32", "bfloat16", "int8", "auto"],
+                    help="decode GEMV weights storage (ISSUE 12): int8 "
+                         "= per-channel absmax QuantW via the fused "
+                         "GEMV (ops/pallas_gemv), quantized ONCE at "
+                         "engine construction; auto routes int8 for "
+                         "GQA/MQA, float32 for MHA "
+                         "(generate.pick_weights_dtype)")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--prompt-min", type=int, default=8)
     ap.add_argument("--prompt-max", type=int, default=96)
@@ -263,6 +278,8 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         model, params, slots=args.slots, num_pages=pages,
         page_size=args.page_size, prefill_chunk=args.prefill_chunk,
         cache_dtype=cache_dtype, max_len=max_len,
+        attn_kernel=args.attn_kernel,
+        weights_dtype=args.decode_weights_dtype,
     )
     if args.scheduler == "slo":
         args.mode = "continuous"
@@ -379,12 +396,17 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
             metrics.log("serve", **{
                 "bench": "serve", "backend": jax.default_backend(),
                 "cache_dtype": cache_dtype, "rate": args.rate,
+                "attn_kernel": args.attn_kernel,
+                "weights_dtype": engine.weights_dtype,
                 "slots": args.slots, "page_size": args.page_size,
                 "pages": pages, **s,
             })
             print(json.dumps({"bench": "serve", "backend":
                               jax.default_backend(),
-                              "cache_dtype": cache_dtype, **s}))
+                              "cache_dtype": cache_dtype,
+                              "attn_kernel": args.attn_kernel,
+                              "weights_dtype": engine.weights_dtype,
+                              **s}))
     if alert_engine is not None:
         print(json.dumps({"metric": "serve_alerts_fired",
                           "value": len(alert_engine.alerts),
@@ -520,6 +542,16 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                     choices=["float32", "bfloat16", "int8", "auto"],
                     help="auto routes int8 for GQA/MQA, bfloat16 for "
                          "MHA (models/generate.pick_cache_dtype)")
+    ap.add_argument("--attn-kernel", default="gather",
+                    choices=["gather", "pallas"],
+                    help="paged-attention read per engine replica "
+                         "(ISSUE 12; engine compute only): gather = "
+                         "XLA, pallas = the fused kernel")
+    ap.add_argument("--decode-weights-dtype", default="float32",
+                    choices=["float32", "bfloat16", "int8", "auto"],
+                    help="decode GEMV weights per engine replica "
+                         "(ISSUE 12; engine compute only; auto = int8 "
+                         "for GQA/MQA, float32 for MHA)")
     ap.add_argument("--device", default="auto",
                     choices=["auto", "tpu", "cpu"])
     ap.add_argument("--metrics-jsonl", default=None,
@@ -569,6 +601,8 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 model, params, slots=args.slots, num_pages=pages,
                 page_size=args.page_size, prefill_chunk=args.prefill_chunk,
                 cache_dtype=args.cache_dtype, max_len=max_len,
+                attn_kernel=args.attn_kernel,
+                weights_dtype=args.decode_weights_dtype,
             ))
     else:
         def compute_factory(name):

@@ -231,30 +231,46 @@ class PagedEngine:
     scratch); max_len bounds any one sequence (prompt + new tokens) and
     sizes the block table. cache_dtype composes with the shipped
     --decode-cache-dtype forms (float32 / bfloat16 / int8).
+
+    ISSUE 12 levers, both behind the ONE shared decode implementation:
+    `attn_kernel` picks the paged read — "gather" (XLA) or "pallas"
+    (the fused ops/pallas_paged_attention kernel; bitwise in f32,
+    <= 1e-5 in bf16/int8) — carried as PagedKVCache metadata so both
+    jitted programs (run_prefill_chunk / run_decode_tick) compile the
+    same choice; `weights_dtype` quantizes the decode GEMV weights ONCE
+    at construction (ops/pallas_gemv.quantize_decode_params — int8
+    per-channel absmax, bf16 cast, or f32 pass-through; "auto" routes
+    via generate.pick_weights_dtype, the pick_cache_dtype twin).
     """
 
     def __init__(self, model: TransformerLM, params, *, slots: int = 4,
                  num_pages: int = 64, page_size: int = 16,
                  prefill_chunk: int = 32, cache_dtype="float32",
-                 max_len: int | None = None):
+                 max_len: int | None = None, attn_kernel: str = "gather",
+                 weights_dtype: str = "float32"):
+        from ..models.generate import pick_cache_dtype, pick_weights_dtype
+        from ..ops.pallas_gemv import quantize_decode_params
+
         self.model = model
-        self.params = params
         self.slots = slots
         self.page_size = page_size
         self.num_pages = num_pages
         self.prefill_chunk = prefill_chunk
+        self.weights_dtype = pick_weights_dtype(
+            weights_dtype, heads=model.heads, kv_heads=model.n_kv)
+        # One-time conversion: the hot loop only ever reads this form.
+        self.params = quantize_decode_params(params, self.weights_dtype)
+        self.attn_kernel = attn_kernel
         if isinstance(cache_dtype, str) and cache_dtype == "auto":
             # VERDICT item 7: route the storage dtype from the banked
             # measurements — int8 for GQA/MQA, bfloat16 for MHA.
-            from ..models.generate import pick_cache_dtype
-
             cache_dtype = pick_cache_dtype("auto", heads=model.heads,
                                            kv_heads=model.n_kv)
         self.cache_dtype = jnp.dtype(cache_dtype)
         self.max_len = min(max_len or model.max_seq, model.max_seq)
         tmpl = init_paged_cache(model, slots=slots, num_pages=num_pages,
                                 page_size=page_size, dtype=self.cache_dtype,
-                                max_len=self.max_len)
+                                max_len=self.max_len, kernel=attn_kernel)
         self._pages = tmpl.pages
         self._table_width = tmpl.block_table.shape[1]
 
@@ -298,7 +314,8 @@ class PagedEngine:
     def _cache_view(self, table: np.ndarray) -> PagedKVCache:
         return PagedKVCache(pages=self._pages,
                             block_table=jnp.asarray(table),
-                            page_size=self.page_size)
+                            page_size=self.page_size,
+                            kernel=self.attn_kernel)
 
     def _slot_table(self, slot) -> np.ndarray:
         row = np.zeros((1, self._table_width), np.int32)

@@ -33,10 +33,15 @@ Host-side page accounting (alloc/free/ownership) is `PagePool`; policy
 (who gets pages when) lives in scheduler.py.
 
 TPU note: the gather materializes (B, L, Hkv, hd) rows per layer — the
-XLA formulation of the paged read. The fused form (per-page async DMA
-into VMEM, double-buffered — the Pallas paged-attention kernel) is a
-drop-in replacement behind attend_kv when decode batch sizes outgrow
-the gather; the layout above matches that kernel's contract.
+XLA formulation of the paged read. The fused form SHIPPED as
+ops/pallas_paged_attention.paged_attend (ISSUE 12): pages stream
+HBM -> VMEM behind scalar-prefetched block tables with the Pallas
+pipeline double-buffering the per-page copies, and the gathered rows
+never exist outside VMEM. `paged_update_attend(kernel="pallas")`
+dispatches to it (the write stays shared); PagedKVCache carries the
+choice as static metadata so one engine never mixes layouts. Parity is
+bitwise vs this gather in f32, <= 1e-5 in bf16/int8
+(tests/test_paged_kernel.py, interpret mode on CPU).
 """
 
 from __future__ import annotations
@@ -60,11 +65,16 @@ from .pool import PagePool, pages_for  # noqa: F401
 class PagedKVCache:
     """Device-side paged cache state: per-layer page pools + the block
     table mapping each slot's logical positions to physical pages.
-    `page_size` is static metadata (it shapes the compiled program)."""
+    `page_size` is static metadata (it shapes the compiled program), as
+    is `kernel` — "gather" (the XLA formulation) or "pallas" (the fused
+    ops/pallas_paged_attention read); carrying the choice on the cache
+    keeps ONE decode implementation with a leaf-level dispatch, the
+    QuantW pattern applied to the attention read."""
 
     pages: list[dict]
     block_table: jnp.ndarray      # (slots, pages_per_slot) int32
     page_size: int
+    kernel: str = "gather"
 
     @property
     def num_pages(self) -> int:
@@ -77,13 +87,16 @@ class PagedKVCache:
 
 jax.tree_util.register_dataclass(
     PagedKVCache, data_fields=["pages", "block_table"],
-    meta_fields=["page_size"],
+    meta_fields=["page_size", "kernel"],
 )
+
+_KERNELS = ("gather", "pallas")
 
 
 def init_paged_cache(model: TransformerLM, *, slots: int, num_pages: int,
                      page_size: int, dtype=jnp.float32,
-                     max_len: int | None = None) -> PagedKVCache:
+                     max_len: int | None = None,
+                     kernel: str = "gather") -> PagedKVCache:
     """Empty page pools + an all-scratch block table.
 
     num_pages INCLUDES the reserved scratch page 0, so num_pages - 1
@@ -97,6 +110,8 @@ def init_paged_cache(model: TransformerLM, *, slots: int, num_pages: int,
         raise ValueError(f"num_pages {num_pages} < 2 (page 0 is scratch)")
     if page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if kernel not in _KERNELS:
+        raise ValueError(f"kernel {kernel!r}: want one of {_KERNELS}")
     max_len = max_len or model.max_seq
     shape = (num_pages, page_size, model.n_kv, model.head_dim)
     int8 = jnp.dtype(dtype) == jnp.int8
@@ -114,23 +129,27 @@ def init_paged_cache(model: TransformerLM, *, slots: int, num_pages: int,
             pages.append({"k": jnp.zeros(shape, dtype),
                           "v": jnp.zeros(shape, dtype)})
     table = jnp.zeros((slots, pages_for(max_len, page_size)), jnp.int32)
-    return PagedKVCache(pages=pages, block_table=table, page_size=page_size)
+    return PagedKVCache(pages=pages, block_table=table,
+                        page_size=page_size, kernel=kernel)
 
 
 def paged_update_attend(c: dict, q, k, v, positions, valid, block_table,
-                        page_size: int):
-    """One layer's paged write + gathered attention read.
+                        page_size: int, kernel: str = "gather"):
+    """One layer's paged write + attention read.
 
     q: (B, kk, H, hd); k/v: (B, kk, Hkv, hd); positions: (B, kk)
     absolute positions; valid: (B, kk) bool — invalid tokens (padding
     beyond a prompt's length, dead slots) write to scratch page 0 at
     offset 0 instead, so they can never touch a page owned by a live
     sequence. Writes land FIRST (in-chunk causality: row i then reads
-    rows <= i through the gather), then the block table gathers each
-    slot's pages into (B, L, Hkv, hd) rows for the shared attend_kv
-    read, masked to key positions <= the row's own position. Positions
-    beyond a slot's written extent read whatever the gathered (possibly
-    scratch/stale) rows hold — the mask keeps them out of the softmax.
+    rows <= i through the read), then the read runs per `kernel`:
+    "gather" materializes each slot's pages into (B, L, Hkv, hd) rows
+    for the shared attend_kv read; "pallas" streams the same pages
+    HBM -> VMEM inside ops/pallas_paged_attention.paged_attend (bitwise
+    vs the gather in f32, <= 1e-5 in bf16/int8). Either way the read is
+    masked to key positions <= the row's own position; positions beyond
+    a slot's written extent read whatever the (possibly scratch/stale)
+    rows hold — the mask keeps them out of the softmax.
     Returns (o: (B, kk, H*hd) f32, new_c).
     """
     b, kk = positions.shape
@@ -159,6 +178,11 @@ def paged_update_attend(c: dict, q, k, v, positions, valid, block_table,
             "v": c["v"].at[pi, of].set(
                 v.astype(cdt).reshape(b * kk, hkv, hd)),
         }
+    if kernel == "pallas":
+        from ..ops.pallas_paged_attention import paged_attend
+
+        o = paged_attend(q, new_c, positions, block_table, page_size)
+        return o, new_c
     # Gather this slot's pages into contiguous logical rows. L =
     # pages_per_slot * page_size — the engine sizes the table to the
     # serving max_len, not to the pool (reads scale with the SEQUENCE
@@ -188,7 +212,7 @@ def paged_forward(model: TransformerLM, params, toks, positions, valid,
     def attend(i, q, k, v):
         o, new_c = paged_update_attend(
             cache.pages[i], q, k, v, positions, valid,
-            cache.block_table, cache.page_size,
+            cache.block_table, cache.page_size, kernel=cache.kernel,
         )
         new_pages.append(new_c)
         return o

@@ -158,6 +158,17 @@ class LMTrainer:
                 f"--decode-cache-dtype {cfg.decode_cache_dtype!r} must "
                 "be 'float32', 'bfloat16', 'int8', or 'auto'"
             )
+        if cfg.decode_weights_dtype not in ("float32", "bfloat16",
+                                            "int8", "auto"):
+            # Same early-validation contract as decode_cache_dtype: the
+            # auto-generated parser is type=str, so a typo would
+            # otherwise surface only at sampling time. "auto" routes
+            # int8 for GQA/MQA, f32 for MHA (pick_weights_dtype — one
+            # routing table with the cache's).
+            raise ValueError(
+                f"--decode-weights-dtype {cfg.decode_weights_dtype!r} "
+                "must be 'float32', 'bfloat16', 'int8', or 'auto'"
+            )
         if cfg.sample_top_k < 0 or not 0.0 <= cfg.sample_top_p <= 1.0:
             raise ValueError(
                 f"--sample-top-k {cfg.sample_top_k} must be >= 0 and "
@@ -977,6 +988,22 @@ class LMTrainer:
                 from ..parallel.tp import shard_lm_params
 
                 params = shard_lm_params(self.model, params, self.mesh)
+        wdt = self._weights_dtype()
+        if wdt != "float32":
+            # One-time serving-weights conversion (ISSUE 12): int8
+            # per-channel QuantW / bf16 cast through the SAME forward
+            # (qmatmul dispatch). Single-placement paths only — the
+            # QuantW leaves don't carry Megatron shardings, and a
+            # sample-time lever must not silently unshard the decode.
+            if self.n_model > 1:
+                raise ValueError(
+                    "--decode-weights-dtype requires an unsharded "
+                    "sample path (model-parallel decode keeps f32 "
+                    "weights; set --decode-weights-dtype float32)"
+                )
+            from ..ops.pallas_gemv import quantize_decode_params
+
+            params = quantize_decode_params(params, wdt)
         if cfg.sample_speculative_k:
             # Draft-free prompt-lookup speculation. Greedy at
             # temperature 0 (bitwise-exact contract); temperature > 0
@@ -1019,6 +1046,16 @@ class LMTrainer:
         return pick_cache_dtype(self.cfg.decode_cache_dtype,
                                 heads=self.model.heads,
                                 kv_heads=self.model.n_kv)
+
+    def _weights_dtype(self) -> str:
+        """--decode-weights-dtype with "auto" resolved against THIS
+        model's head geometry (generate.pick_weights_dtype — one
+        routing table with the cache's)."""
+        from ..models.generate import pick_weights_dtype
+
+        return pick_weights_dtype(self.cfg.decode_weights_dtype,
+                                  heads=self.model.heads,
+                                  kv_heads=self.model.n_kv)
 
     def evaluate(self) -> float:
         """Mean next-token NLL over deterministic windows of the held-out

@@ -257,6 +257,14 @@ class LMConfig(_JsonConfig):
                                      # GQA/MQA, bf16 for MHA
                                      # (generate.pick_cache_dtype);
                                      # f32 = exactness default
+    decode_weights_dtype: str = "float32"  # decode GEMV weights at
+                                     # sample time (ISSUE 12): "int8" =
+                                     # per-channel absmax QuantW via
+                                     # the fused GEMV (ops/pallas_gemv,
+                                     # quantized once per sample call);
+                                     # "auto" routes int8 for GQA/MQA,
+                                     # f32 for MHA
+                                     # (generate.pick_weights_dtype)
 
 
 

@@ -89,6 +89,20 @@ step bench_decode_bf16 900 python scripts/bench_decode.py \
 # Round-5: int8 KV cache (quarter bytes; absmax scales outside the dots).
 step bench_decode_int8 900 python scripts/bench_decode.py \
     --cache-dtype int8
+# ISSUE 12: the fused paged-attention kernel A/B on chip — same seeded
+# workload, gather vs pallas read at the serving dtype (GQA + int8
+# cache). The greedy CRCs must match (f32 parity is bitwise; int8 is
+# compared on the CPU interpret gate) and the tokens/s pair is the
+# FIRST real measurement of the gather's materialization cost.
+step bench_decode_paged_gather 900 python scripts/bench_decode.py \
+    --paged --kernel gather --kv-heads 2 --cache-dtype int8
+step bench_decode_paged_pallas 900 python scripts/bench_decode.py \
+    --paged --kernel pallas --kv-heads 2 --cache-dtype int8
+# ISSUE 12: int8 decode-weight GEMVs — with the cache already int8 at
+# MQA the weight stream dominates; this row banks the quartered-bytes
+# effect (f32 weights twin = the bench_decode_int8 step above).
+step bench_decode_w8 900 python scripts/bench_decode.py \
+    --kv-heads 1 --cache-dtype int8 --weights-dtype int8
 # Round-5: stabilized five-config rows (two-point; tunnel-independent).
 step bench_configs 1200 python scripts/bench_configs.py
 step profile_moe 900 python scripts/profile_moe.py
@@ -110,6 +124,23 @@ step bench_serve_prefix 900 python scripts/bench_serve.py \
     --prefix-cache
 step bench_serve_prefix_off 900 python scripts/bench_serve.py \
     --mode continuous --requests 32 --rate 200 --prefix-mix 0.9
+# ISSUE 12: the engine-serve capture — the FIRST real serving rows with
+# the fused levers on: tokens/s + TTFT/TPOT percentiles at the serving
+# configuration (GQA, auto-routed int8 cache + int8 weights, Pallas
+# paged read), its kernel-off twin on the identical seeded workload,
+# and the prefix-sharing hit-rate pair with the kernel on — the rows
+# PERF.md's "Paged decode kernel" table holds open next to the CPU
+# tick counts.
+step bench_serve_kernel 900 python scripts/bench_serve.py \
+    --requests 32 --rate 200 --kv-heads 2 --cache-dtype auto \
+    --attn-kernel pallas --decode-weights-dtype auto
+step bench_serve_kernel_off 900 python scripts/bench_serve.py \
+    --requests 32 --rate 200 --kv-heads 2 --cache-dtype auto \
+    --attn-kernel gather --decode-weights-dtype auto
+step bench_serve_prefix_kernel 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --prefix-cache --kv-heads 2 --cache-dtype auto \
+    --attn-kernel pallas --decode-weights-dtype auto
 step profile_lm 900 python scripts/profile_lm.py
 # PR-7 (fleet): the engine-backed fleet on a real chip — N PagedEngine
 # replicas (shared weights) behind the failure-aware router, one crash

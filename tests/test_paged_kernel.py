@@ -1,0 +1,428 @@
+"""Fused Pallas paged-attention kernel + int8 decode-weight GEMVs
+(ISSUE 12): parity with the gather path — BITWISE in f32, within the
+1e-5 band in bf16/int8 — across MHA/GQA/MQA and decode/prefill query
+widths, the paged-layout edge cases the gather hides, and the
+quantized-weight error bound. Everything runs the real kernels in
+Pallas interpret mode on CPU (tier-1 scope)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.models.generate import (
+    _quant_kv,
+    decode_step,
+    generate,
+    init_cache,
+    pick_cache_dtype,
+    pick_weights_dtype,
+)
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.ops.pallas_gemv import (
+    QuantW,
+    dequantize_weight,
+    int8_gemv,
+    qmatmul,
+    quantize_decode_params,
+    quantize_weight,
+)
+from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+from mpi_cuda_cnn_tpu.serve.paged_cache import (
+    init_paged_cache,
+    paged_update_attend,
+    pages_for,
+)
+from mpi_cuda_cnn_tpu.serve.scheduler import Request
+
+MODEL = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
+GQA = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48,
+                    kv_heads=2, pos="rope")
+MQA = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48,
+                    kv_heads=1, pos="rope")
+
+HEAD_CONFIGS = {"mha": 4, "gqa": 2, "mqa": 1}
+
+
+def _rand_case(dtype, hkv, kk, seed, *, b=3, h=4, hd=8, ps=4, per=5,
+               pool=16):
+    """One random paged-attention call: q/k/v for the incoming tokens,
+    a populated page pool, per-slot block tables of distinct non-scratch
+    pages, and in-range positions. Returns (inputs..., call kwargs)."""
+    rng = np.random.default_rng(seed)
+    L = per * ps
+    q = jnp.asarray(rng.normal(size=(b, kk, h, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, kk, hkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, kk, hkv, hd)), jnp.float32)
+    kv = rng.normal(size=(2, pool, ps, hkv, hd)).astype(np.float32)
+    if dtype == "int8":
+        qk, sk = _quant_kv(jnp.asarray(kv[0]).reshape(1, pool * ps, hkv, hd))
+        qv, sv = _quant_kv(jnp.asarray(kv[1]).reshape(1, pool * ps, hkv, hd))
+        c = {"k": qk.reshape(pool, ps, hkv, hd),
+             "ks": sk.reshape(pool, ps, hkv, 1),
+             "v": qv.reshape(pool, ps, hkv, hd),
+             "vs": sv.reshape(pool, ps, hkv, 1)}
+    else:
+        dt = jnp.dtype(dtype)
+        c = {"k": jnp.asarray(kv[0], dt), "v": jnp.asarray(kv[1], dt)}
+    table = np.zeros((b, per), np.int32)
+    for i in range(b):
+        table[i] = rng.choice(np.arange(1, pool), per, replace=False)
+    pos0 = rng.integers(0, L - kk, (b, 1))
+    positions = jnp.asarray(pos0 + np.arange(kk)[None, :], jnp.int32)
+    return q, k, v, c, jnp.asarray(table), positions, ps
+
+
+def _both(q, k, v, c, table, positions, ps):
+    valid = jnp.ones(positions.shape, bool)
+    og, _ = paged_update_attend(dict(c), q, k, v, positions, valid,
+                                table, ps, kernel="gather")
+    op, _ = paged_update_attend(dict(c), q, k, v, positions, valid,
+                                table, ps, kernel="pallas")
+    return np.asarray(og), np.asarray(op)
+
+
+@pytest.mark.parametrize("kk", [1, 4], ids=["decode", "chunk"])
+@pytest.mark.parametrize("head", ["mha", "gqa", "mqa"])
+def test_kernel_matches_gather_f32_bitwise(head, kk):
+    """THE f32 gate: the fused kernel's output equals the gather path's
+    BITWISE — every contraction mirrors attend_kv's formulation, so any
+    drift is a layout/indexing bug, not rounding. Covers the decode
+    tick (kk=1) and the chunked-prefill query width (kk=4) at every
+    head mapping."""
+    for seed in range(3):
+        want, got = _both(*_rand_case("float32", HEAD_CONFIGS[head], kk,
+                                      seed))
+        np.testing.assert_array_equal(got, want,
+                                      err_msg=f"{head} kk={kk} seed={seed}")
+
+
+@pytest.mark.parametrize("kk", [1, 4], ids=["decode", "chunk"])
+@pytest.mark.parametrize("head", ["mha", "gqa", "mqa"])
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_kernel_matches_gather_quantized(dtype, head, kk):
+    """bf16/int8 pages: identical elementwise math (same absmax
+    contract, scales applied outside the dots), reduction order differs
+    by at most the page split — the 1e-5 band of the existing
+    quantized paged-vs-contiguous parity."""
+    for seed in range(3):
+        want, got = _both(*_rand_case(dtype, HEAD_CONFIGS[head], kk, seed))
+        np.testing.assert_allclose(
+            got, want, rtol=1e-5, atol=1e-5,
+            err_msg=f"{dtype} {head} kk={kk} seed={seed}")
+
+
+def _identity_paged_cache(model, batch, page_size, dtype=jnp.float32,
+                          kernel="gather"):
+    per = pages_for(model.max_seq, page_size)
+    cache = init_paged_cache(model, slots=batch,
+                             num_pages=batch * per + 1,
+                             page_size=page_size, dtype=dtype,
+                             kernel=kernel)
+    table = 1 + np.arange(batch * per, dtype=np.int32).reshape(batch, per)
+    return dataclasses.replace(cache, block_table=jnp.asarray(table))
+
+
+@pytest.mark.parametrize("model", [MODEL, GQA], ids=["mha", "gqa_rope"])
+def test_paged_kernel_decode_step_matches_contiguous_f32(model):
+    """Transitivity of the layout contracts: kernel == gather (this
+    file's bitwise gate) and gather == contiguous (test_serve's), so
+    decode_step over a kernel="pallas" cache must equal the contiguous
+    cache BITWISE through a 20-step decode, page boundaries crossed
+    mid-sequence."""
+    params = model.init(jax.random.key(0))
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(0, 13, (3, 20)), jnp.int32
+    )
+    cc = init_cache(model, 3)
+    pc = _identity_paged_cache(model, 3, page_size=8, kernel="pallas")
+    for i in range(20):
+        want, cc = decode_step(model, params, toks[:, i], i, cc)
+        got, pc = decode_step(model, params, toks[:, i],
+                              jnp.full((3,), i, jnp.int32), pc)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"step {i}")
+
+
+def test_slot_extent_ending_mid_page():
+    """A slot whose extent ends mid-page must mask the page's written
+    tail out of the softmax: corrupting rows BEYOND the slot's position
+    (same page, later offsets) changes nothing; corrupting the position
+    row itself does. The gather hides this case behind XLA's masked
+    reads — the kernel's VMEM strip must reproduce it."""
+    q, k, v, c, table, _, ps = _rand_case("float32", 2, 1, 7)
+    # DISJOINT tables for this test: the poison targets one slot's page
+    # tail, so no other slot may share that physical page.
+    table = jnp.asarray(
+        1 + np.arange(3 * 5, dtype=np.int32).reshape(3, 5) % 15)
+    positions = jnp.asarray([[ps + 1], [2 * ps + 2], [1]], jnp.int32)
+    want, got = _both(q, k, v, c, table, positions, ps)
+    np.testing.assert_array_equal(got, want)
+    # Poison the offsets just past each slot's position, inside the
+    # same (mid-extent) page — outputs must not move.
+    poisoned = dict(c)
+    tab = np.asarray(table)
+    for s, pos in enumerate(np.asarray(positions)[:, 0]):
+        page = tab[s, pos // ps]
+        off = pos % ps
+        if off + 1 < ps:
+            poisoned = {
+                n: poisoned[n].at[page, off + 1:].set(1e30)
+                if n in ("k", "v") else poisoned[n]
+                for n in poisoned
+            }
+    want2, got2 = _both(q, k, v, poisoned, table, positions, ps)
+    np.testing.assert_array_equal(got2, got)
+    np.testing.assert_array_equal(want2, want)
+
+
+def test_scratch_page_never_read():
+    """Block-table columns beyond a slot's live pages hold 0 — the
+    scratch page. Its contents are masked out of every softmax, so
+    poisoning page 0 with huge finite values must not move any output
+    (kernel and gather alike). This is the page-0 contract the pool
+    invariants assume."""
+    q, k, v, c, table, _, ps = _rand_case("float32", 2, 1, 11)
+    # Short extents: positions inside page 1 of 5, so table columns
+    # 2..4 are dead weight — point them at scratch like the engine does.
+    tab = np.asarray(table).copy()
+    tab[:, 2:] = 0
+    positions = jnp.asarray([[ps - 1], [2], [ps + 1]], jnp.int32)
+    want, got = _both(q, k, v, c, jnp.asarray(tab), positions, ps)
+    poisoned = {n: (c[n].at[0].set(1e30) if n in ("k", "v") else c[n])
+                for n in c}
+    want2, got2 = _both(q, k, v, poisoned, jnp.asarray(tab), positions, ps)
+    np.testing.assert_array_equal(got2, got)
+    np.testing.assert_array_equal(want2, want)
+
+
+def test_cow_private_page_read_after_copy():
+    """The COW discipline (ISSUE 9) on the kernel path: after a page is
+    copied src -> dst and the slot's table repointed at dst, the kernel
+    must read the COPY — later writes to the shared source must not
+    leak into the reader. Mirrors engine.copy_page's per-layer
+    .at[dst].set(c[src]) exactly."""
+    q, k, v, c, table, positions, ps = _rand_case("float32", 2, 1, 13)
+    tab = np.asarray(table).copy()
+    src = int(tab[0, 0])
+    dst = 15  # a free pool page outside every table
+    assert not (tab == dst).any()
+    copied = {n: c[n].at[dst].set(c[n][src]) for n in c}
+    tab2 = tab.copy()
+    tab2[0, 0] = dst
+    want_before, got_before = _both(q, k, v, copied, jnp.asarray(tab2),
+                                    positions, ps)
+    np.testing.assert_array_equal(got_before, want_before)
+    # Diverge the source AFTER the copy: the dst reader sees nothing.
+    diverged = {n: (copied[n].at[src].set(-7.0) if n in ("k", "v")
+                    else copied[n]) for n in copied}
+    want_after, got_after = _both(q, k, v, diverged, jnp.asarray(tab2),
+                                  positions, ps)
+    np.testing.assert_array_equal(got_after, got_before)
+    np.testing.assert_array_equal(want_after, want_before)
+
+
+def test_preempted_then_resumed_slot_kernel_on():
+    """Recompute preemption under a starved pool, with the fused kernel
+    serving every read: the resumed slot re-prefills into DIFFERENT
+    physical pages, and its greedy stream must still equal generate()'s
+    — the block-table indirection is the only thing that changed."""
+    params = MODEL.init(jax.random.key(1))
+    rng = np.random.default_rng(5)
+    engine = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                         prefill_chunk=8, max_len=40, attn_kernel="pallas")
+    prompts = [rng.integers(0, 13, (6,)).astype(np.int32) for _ in range(5)]
+    want = [np.asarray(generate(MODEL, params, jnp.asarray(p[None, :]),
+                                18))[0] for p in prompts]
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=18)
+            for i, p in enumerate(prompts)]
+    res = engine.run(reqs, mode="continuous")
+    assert res.preemptions > 0
+    for r in res.requests:
+        np.testing.assert_array_equal(np.asarray(r.out), want[r.rid],
+                                      err_msg=f"request {r.rid}")
+
+
+def test_randomized_block_table_fuzz_kernel_equals_gather():
+    """Seeded fuzz over the block-table space: random pool sizes, page
+    sizes, table permutations (slots may SHARE pages — the prefix-
+    sharing read pattern), ragged per-slot depths, MHA/GQA/MQA — kernel
+    == gather bitwise in f32, every draw."""
+    rng = np.random.default_rng(1234)
+    for trial in range(12):
+        hkv = int(rng.choice([1, 2, 4]))
+        ps = int(rng.choice([2, 4, 8]))
+        per = int(rng.integers(2, 6))
+        pool = per * 3 + 2
+        b = int(rng.integers(1, 4))
+        kk = int(rng.choice([1, 2]))
+        L = per * ps
+        q = jnp.asarray(rng.normal(size=(b, kk, 4, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, kk, hkv, 8)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, kk, hkv, 8)), jnp.float32)
+        c = {"k": jnp.asarray(rng.normal(size=(pool, ps, hkv, 8)),
+                              jnp.float32),
+             "v": jnp.asarray(rng.normal(size=(pool, ps, hkv, 8)),
+                              jnp.float32)}
+        # Pages drawn WITH replacement across slots: shared pages are
+        # legal reads (refcounted prefix pages).
+        table = jnp.asarray(
+            rng.integers(1, pool, (b, per)), jnp.int32)
+        positions = jnp.asarray(
+            rng.integers(0, L - kk + 1, (b, 1))
+            + np.arange(kk)[None, :], jnp.int32)
+        want, got = _both(q, k, v, c, table, positions, ps)
+        np.testing.assert_array_equal(
+            got, want, err_msg=f"trial {trial}: hkv={hkv} ps={ps} "
+                               f"per={per} b={b} kk={kk}")
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_engine_greedy_matches_generate_kernel_on(dtype):
+    """End-to-end engine-vs-generate greedy equality with the fused
+    kernel serving both jitted programs (prefill chunks AND decode
+    ticks), across cache dtypes and both scheduler modes — the same
+    acceptance the gather path holds in test_serve.py."""
+    params = MODEL.init(jax.random.key(0))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 13, (n,)).astype(np.int32)
+               for n in (3, 7, 11, 5)]
+    new = [9, 4, 12, 7]
+    want = [
+        np.asarray(generate(MODEL, params, jnp.asarray(p[None, :]), n,
+                            cache_dtype=dtype))[0]
+        for p, n in zip(prompts, new)
+    ]
+    engine = PagedEngine(MODEL, params, slots=2, num_pages=4 * 6 + 1,
+                         page_size=8, prefill_chunk=4, cache_dtype=dtype,
+                         attn_kernel="pallas")
+    for mode in ("continuous", "static"):
+        reqs = [Request(rid=i, prompt=p, max_new_tokens=n)
+                for i, (p, n) in enumerate(zip(prompts, new))]
+        res = engine.run(reqs, mode=mode)
+        for r in res.requests:
+            np.testing.assert_array_equal(
+                np.asarray(r.out), want[r.rid],
+                err_msg=f"{mode} request {r.rid} ({dtype})")
+
+
+def test_engine_vs_generate_with_both_levers_on():
+    """THE both-levers acceptance: Pallas paged read + int8 decode
+    weights in the engine, against generate() running the SAME
+    quantized params over the contiguous cache — greedy streams equal
+    per request (one forward implementation, two storage formats)."""
+    params = GQA.init(jax.random.key(3))
+    qparams = quantize_decode_params(params, "int8")
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 13, (n,)).astype(np.int32)
+               for n in (4, 9, 6)]
+    new = [8, 5, 11]
+    want = [np.asarray(generate(GQA, qparams, jnp.asarray(p[None, :]), n,
+                                cache_dtype="int8"))[0]
+            for p, n in zip(prompts, new)]
+    engine = PagedEngine(GQA, params, slots=2, num_pages=4 * 6 + 1,
+                         page_size=8, prefill_chunk=4, cache_dtype="int8",
+                         attn_kernel="pallas", weights_dtype="int8")
+    assert engine.weights_dtype == "int8"
+    assert isinstance(engine.params["head"], QuantW)
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=n)
+            for i, (p, n) in enumerate(zip(prompts, new))]
+    res = engine.run(reqs, mode="continuous")
+    for r in res.requests:
+        np.testing.assert_array_equal(np.asarray(r.out), want[r.rid],
+                                      err_msg=f"request {r.rid}")
+
+
+def test_int8_weights_logit_error_bound():
+    """int8 decode weights hold the same error discipline as the int8
+    KV cache (test_generate's 5e-2 pin): per-channel absmax bounds each
+    weight's relative error by 1/254 and the scales are exact f32
+    multiplies outside the dots, so cached decode logits stay within
+    the quantization band of the f32-weight path at every step."""
+    params = MODEL.init(jax.random.key(0))
+    qparams = quantize_decode_params(params, "int8")
+    assert isinstance(qparams["blocks"][0]["wqkv"], QuantW)
+    assert qparams["blocks"][0]["wqkv"].q.dtype == jnp.int8
+    # Non-GEMV leaves stay untouched (gathers/layernorms).
+    assert qparams["tok_emb"].dtype == jnp.float32
+    assert qparams["blocks"][0]["ln1"]["g"].dtype == jnp.float32
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(0, 13, (2, 12)), jnp.int32
+    )
+    c32 = init_cache(MODEL, 2)
+    c8 = init_cache(MODEL, 2)
+    for i in range(12):
+        l32, c32 = decode_step(MODEL, params, toks[:, i], i, c32)
+        l8, c8 = decode_step(MODEL, qparams, toks[:, i], i, c8)
+        np.testing.assert_allclose(np.asarray(l8), np.asarray(l32),
+                                   rtol=5e-2, atol=5e-2,
+                                   err_msg=f"step {i}")
+
+
+def test_int8_gemv_matches_dequantized_matmul():
+    """The fused GEMV's contract is (x @ q) * s — the scale stays
+    OUTSIDE the contraction (the absmax discipline; it is constant
+    along the contracted din). Pin it against the same jnp formulation
+    to float rounding, and against the scale-inside dequantized matmul
+    within the reassociation band, across tile counts (dout both
+    128-divisible and not)."""
+    rng = np.random.default_rng(0)
+    for n, din, dout in ((8, 64, 256), (3, 32, 48), (1, 128, 128)):
+        x = jnp.asarray(rng.normal(size=(n, din)), jnp.float32)
+        w = quantize_weight(jnp.asarray(rng.normal(size=(din, dout)),
+                                        jnp.float32))
+        got = np.asarray(int8_gemv(x, w))
+        want = np.asarray((x @ w.q.astype(jnp.float32)) * w.s)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+        # Scale-inside (x @ dequant) reassociates one multiply — same
+        # value to ~1 ulp of the accumulated dot.
+        np.testing.assert_allclose(got,
+                                   np.asarray(x @ dequantize_weight(w)),
+                                   rtol=1e-5, atol=1e-5)
+        # qmatmul dispatch: QuantW routes to the kernel, arrays to @.
+        np.testing.assert_allclose(np.asarray(qmatmul(x, w)), got,
+                                   rtol=0, atol=0)
+        plain = jnp.asarray(rng.normal(size=(din, dout)), jnp.float32)
+        np.testing.assert_array_equal(np.asarray(qmatmul(x, plain)),
+                                      np.asarray(x @ plain))
+
+
+def test_quantize_weight_error_bound():
+    """Per-channel absmax: every dequantized weight within
+    max|w_col|/254 of the original, per column."""
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(64, 96)), jnp.float32)
+    qw = quantize_weight(w)
+    err = np.abs(np.asarray(dequantize_weight(qw)) - np.asarray(w))
+    bound = np.max(np.abs(np.asarray(w)), axis=0) / 254.0 + 1e-7
+    assert (err <= bound[None, :]).all()
+
+
+def test_pick_weights_dtype_routing_shares_table_with_cache():
+    """The two auto routers live on ONE table (_AUTO_DTYPE_ROUTING):
+    weights route int8 under GQA/MQA (weight stream dominates once the
+    cache is int8) and float32 at MHA (measured bf16-weights non-win);
+    cache routes int8/bfloat16 as banked. Explicit dtypes pass through
+    both."""
+    from mpi_cuda_cnn_tpu.models.generate import _AUTO_DTYPE_ROUTING
+
+    assert set(_AUTO_DTYPE_ROUTING) == {"cache", "weights"}
+    assert pick_weights_dtype("auto", heads=8, kv_heads=2) == "int8"
+    assert pick_weights_dtype("auto", heads=8, kv_heads=1) == "int8"
+    assert pick_weights_dtype("auto", heads=8, kv_heads=None) == "float32"
+    assert pick_weights_dtype("auto", heads=8, kv_heads=8) == "float32"
+    assert pick_weights_dtype("bfloat16", heads=8, kv_heads=1) == "bfloat16"
+    assert pick_cache_dtype("auto", heads=8, kv_heads=2) == "int8"
+    assert pick_cache_dtype("auto", heads=8, kv_heads=None) == "bfloat16"
+
+
+def test_bad_kernel_and_weights_dtype_rejected():
+    params = MODEL.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="kernel"):
+        init_paged_cache(MODEL, slots=1, num_pages=4, page_size=4,
+                         kernel="fused")
+    with pytest.raises(ValueError, match="decode weights dtype"):
+        PagedEngine(MODEL, params, slots=1, num_pages=4, page_size=4,
+                    weights_dtype="fp8")
