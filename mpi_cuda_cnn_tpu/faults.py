@@ -38,6 +38,19 @@ kinds:
 - ``replica_leave`` — graceful drain: replica ``replica=K`` stops
                 taking dispatches, finishes its in-flight work, then
                 deregisters
+- ``pool_crash``    — kill every live replica of pool ``pool=prefill``
+                or ``pool=decode`` at a fleet tick (ISSUE 13: the
+                pool-collapse degradation driver — the fleet flips to
+                unified serving for affected requests)
+- ``handoff_drop``  — drop the Nth prefill->decode KV handoff in
+                flight (ISSUE 13; trigger value = handoff sequence
+                number): both ends release their pages and the
+                request re-prefills exactly once
+- ``kv_corrupt``    — corrupt one page's integrity stamp of the Nth
+                handoff (``page=K``, site fleet.handoff) or the Nth
+                resume re-dispatch's committed context (site
+                fleet.resume): verification refuses the transfer and
+                the request re-prefills — garbage is never decoded
 
 Recovery — `supervise()` is the `--max-restarts N` loop: it runs one
 training attempt, and on a crash rebuilds the trainer and resumes from
@@ -94,16 +107,19 @@ class Fault:
 
 
 KINDS = ("crash", "io", "nan", "squeeze", "slow", "preempt",
-         "replica_crash", "replica_join", "replica_leave")
+         "replica_crash", "replica_join", "replica_leave",
+         "pool_crash", "handoff_drop", "kv_corrupt")
 
 # Hook sites each CLI surface actually registers, and the kinds each
 # site's consumer APPLIES (ISSUE 7 satellite): a plan naming a site the
 # chosen subcommand never reaches would silently never fire, and a kind
 # the site's consumer ignores (e.g. replica_crash@train.step) would
 # fire and silently do nothing — `validate_plan_sites` turns both into
-# argparse-time errors. crash/io are legal everywhere a site exists:
-# FaultInjector.fire raises them unconditionally, so they are always
-# observable. The trainers are two surfaces: both thread the injector
+# argparse-time errors. crash/io are legal everywhere a FIRED site
+# exists: FaultInjector.fire raises them unconditionally, so they are
+# always observable (the POLLED fleet.handoff/fleet.resume sites
+# exclude them — poll never raises, so they would be inert there).
+# The trainers are two surfaces: both thread the injector
 # through train.step and the checkpoint hooks, but only the CNN
 # trainer fires train.batch (the nan-poisoning site) — nan@train.batch
 # on an LM run would validate and then silently never fire.
@@ -124,7 +140,17 @@ SITES: dict[str, dict[str, frozenset[str]]] = {
     },
     "fleet-bench": {
         "fleet.tick": frozenset({"crash", "io", "replica_crash",
-                                 "replica_join", "replica_leave"}),
+                                 "replica_join", "replica_leave",
+                                 "pool_crash"}),
+        # Disaggregated-serving faults (ISSUE 13). These sites are
+        # polled, not fired, so the always-raising crash/io kinds are
+        # deliberately NOT registered here — they would be inert.
+        # fleet.handoff triggers on the HANDOFF sequence number (the
+        # Nth prefill->decode transfer), fleet.resume on the resume
+        # re-dispatch sequence number (the Nth committed-context
+        # transfer across a failover).
+        "fleet.handoff": frozenset({"handoff_drop", "kv_corrupt"}),
+        "fleet.resume": frozenset({"kv_corrupt"}),
     },
 }
 

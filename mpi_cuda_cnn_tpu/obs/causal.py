@@ -27,7 +27,12 @@ causal account whose critical path is blamed category by category:
                       NEW tokens (re-dispatch wait + re-prefill of the
                       already-committed context);
 - router_wait:        fleet arrival -> first dispatch (no replica
-                      would take it yet).
+                      would take it yet);
+- handoff_wait:       disaggregated prefill->decode KV transfer
+                      (ISSUE 13) — sealed on the prefill replica until
+                      bound decode-ready on the receiver; an aborted
+                      transfer transitions to redispatch_replay at the
+                      abort marker.
 
 Attribution is in integer TICKS on the producer's own tick axis, so
 the decomposition is exact: for every terminal request the category
@@ -58,13 +63,17 @@ from .schema import fmt_cell as _fmt
 from .schema import iter_runs
 
 # Category order is part of the CRC contract — append only.
+# handoff_wait (ISSUE 13): disaggregated serving's prefill->decode KV
+# transfer — from the sealed detach on the prefill replica to the
+# decode-ready bind on the receiver (or to the abort that sends the
+# request back through redispatch_replay).
 CATEGORIES = ("self_compute", "queued_behind", "preempted_by",
-              "redispatch_replay", "router_wait")
+              "redispatch_replay", "router_wait", "handoff_wait")
 
 # Internal wait states -> blame category.
 _STATE_CAT = {"active": "self_compute", "queued": "queued_behind",
               "preempt_wait": "preempted_by", "replay": "redispatch_replay",
-              "router": "router_wait"}
+              "router": "router_wait", "handoff": "handoff_wait"}
 
 
 def worst_k(rows, key, k: int):
@@ -314,6 +323,22 @@ class BlameAccumulator:
             if st.state != "replay":
                 st.close(tick, now, "replay")
             st.replica = name
+        # Disaggregated handoff markers (ISSUE 13), processed BEFORE
+        # redispatched: an aborted handoff's re-dispatch can land in
+        # the same fleet record, and the replay segment must start at
+        # the abort, not absorb the handoff wait.
+        for rid, _src in rec.get("handoff_started") or []:
+            st = self._st("fleet", rid, tick, now, "handoff")
+            if st.state != "handoff":
+                st.close(tick, now, "handoff")
+        for rid, _dst in rec.get("handoff_done") or []:
+            st = self._st("fleet", rid, tick, now, "handoff")
+            if st.state == "handoff":
+                st.close(tick, now, "active")
+        for rid, _why in rec.get("handoff_aborted") or []:
+            st = self._st("fleet", rid, tick, now, "handoff")
+            if st.state == "handoff":
+                st.close(tick, now, "replay")
         for rid in rec.get("redispatched") or []:
             st = self._st("fleet", rid, tick, now, "replay")
             if st.state != "replay":

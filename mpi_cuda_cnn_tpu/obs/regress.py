@@ -105,7 +105,13 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                # the fleet determinism gate pins them at exact equality.
                "blame_crc", "blame_self_compute", "blame_queued_behind",
                "blame_preempted_by", "blame_redispatch_replay",
-               "blame_router_wait", "blame_quota_ticks")
+               "blame_router_wait", "blame_quota_ticks",
+               # Disaggregated serving (ISSUE 13): handoff / integrity
+               # / degradation counters plus the handoff-wait blame
+               # category — the disagg determinism gate pins them at
+               # exact equality (zeros on a unified fleet).
+               "blame_handoff_wait", "handoffs", "handoff_pages",
+               "handoffs_aborted", "kv_refusals", "degraded_unified")
 
 # Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
 # summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to

@@ -281,6 +281,26 @@ def summarize(records: Iterable[dict], *,
             "pending_last": last.get("pending"),
         }
 
+    handoffs = ev.get("handoff", [])
+    if handoffs:
+        # Disaggregated KV handoffs (ISSUE 13): lifecycle counts by
+        # state, aborts broken down by reason.
+        by_state: dict[str, int] = {}
+        by_reason: dict[str, int] = {}
+        for r in handoffs:
+            st = r.get("state", "?")
+            by_state[st] = by_state.get(st, 0) + 1
+            if st == "aborted":
+                why = r.get("reason", "?")
+                by_reason[why] = by_reason.get(why, 0) + 1
+        summary["handoffs"] = {
+            "events": len(handoffs),
+            "by_state": dict(sorted(by_state.items())),
+            "aborts_by_reason": dict(sorted(by_reason.items())),
+            "pages": sum(r.get("pages", 0) for r in handoffs
+                         if r.get("state") == "done"),
+        }
+
     serves = ev.get("serve", [])
     if serves:
         summary["serve"] = [
@@ -531,6 +551,19 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
             for name, per in fl["by_replica"].items():
                 lines.append(f"| {name} | {_fmt(per)} |")
             lines.append("")
+    if "handoffs" in summary:
+        # Disaggregated KV handoffs (ISSUE 13).
+        ho = summary["handoffs"]
+        st = ho["by_state"]
+        lines += [
+            "| handoffs | started | done | aborted | pages moved "
+            "| aborts by reason |",
+            "|---|---|---|---|---|---|",
+            f"| | {st.get('started', 0)} | {st.get('done', 0)} "
+            f"| {st.get('aborted', 0)} | {ho['pages']} "
+            f"| {_fmt(ho['aborts_by_reason'])} |",
+            "",
+        ]
     if "serve" in summary:
         lines += [
             "| serve run | requests | tokens/s | decode ticks "

@@ -87,11 +87,25 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # Causality (ISSUE 11): "arrived" (rids whose arrival fell due this
     # tick) and "failed_over" ([[rid, replica]] — requests a failover
     # stranded, ending their active blame segment at the crash).
+    # Disaggregation (ISSUE 13): "handoff_started" ([[rid, src]]),
+    # "handoff_done" ([[rid, dst]]), "handoff_aborted" ([[rid, reason]])
+    # and "handoffs_inflight" — the prefill->decode KV transfer markers,
+    # ordered in the JSONL before any replica record of the same tick.
     "fleet": ("tick", "now", "replicas"),
+    # One prefill->decode KV handoff lifecycle moment (serve/fleet.py,
+    # ISSUE 13): "state" is started / done / aborted (aborted carries
+    # "reason": sender_dead / receiver_dead / dropped / kv_corrupt /
+    # decode_pool_empty / cancelled); "src"/"dst" the replica names,
+    # "pages" the transfer size, "hid" the handoff sequence number the
+    # fleet.handoff fault site triggers on.
+    "handoff": ("rid", "state"),
     # One replica lifecycle moment (serve/fleet.py, ISSUE 7): kind is
     # join / crash / dead / restart_scheduled / restart / circuit_open
-    # / leave / drain_complete; free-form beyond (name, kind) — the
-    # fleet report table aggregates by kind per replica.
+    # / leave / drain_complete — plus, for disaggregated fleets
+    # (ISSUE 13), degraded / restored, whose "name" is the POOL
+    # ("prefill"/"decode"), not a replica. Free-form beyond
+    # (name, kind) — the fleet report table aggregates by kind per
+    # name.
     "replica": ("name", "kind"),
     # One serving-engine scheduler iteration (serve/engine.py, ISSUE 6):
     # the per-tick state `mctpu trace` reconstructs request lifecycles

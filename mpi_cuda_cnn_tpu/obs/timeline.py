@@ -56,6 +56,7 @@ class Lifecycle:
     prefill_chunks: int = 0
     decode_ticks: int = 0
     preemptions: int = 0
+    handoffs: int = 0
     prefix_hits: int = 0
     prefix_hit_tokens: int = 0
     derived_status: str | None = None
@@ -129,6 +130,20 @@ def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
                 lc = life("fleet", rid)
                 lc.events.append((tick, now, "redispatched",
                                   rec.get("redispatch", "resume")))
+            # Disaggregated handoff markers (ISSUE 13): the fleet emits
+            # its record before stepping replicas, so the phase
+            # transition (handoff/handoff_done) is ordered BEFORE the
+            # decode pool's first emission for the rid.
+            for rid, src in rec.get("handoff_started") or []:
+                lc = life("fleet", rid)
+                lc.handoffs += 1
+                lc.events.append((tick, now, "handoff", src))
+            for rid, dst in rec.get("handoff_done") or []:
+                life("fleet", rid).events.append(
+                    (tick, now, "handoff_done", dst))
+            for rid, why in rec.get("handoff_aborted") or []:
+                life("fleet", rid).events.append(
+                    (tick, now, "handoff_aborted", why))
         elif ev == "tick":
             mode = rec.get("mode", "?")
             if mode.startswith("fleet/"):
@@ -191,10 +206,11 @@ def _compute_breakdown(lc: Lifecycle) -> None:
     if arrival is None or lc.terminal_now is None:
         return
     acc = {"queued_ms": 0.0, "prefill_ms": 0.0, "decode_ms": 0.0,
-           "preempted_ms": 0.0}
+           "preempted_ms": 0.0, "handoff_ms": 0.0}
     state, since = "queued", arrival
     state_key = {"queued": "queued_ms", "prefill": "prefill_ms",
-                 "decode": "decode_ms", "preempted": "preempted_ms"}
+                 "decode": "decode_ms", "preempted": "preempted_ms",
+                 "handoff": "handoff_ms"}
     for _tick, now, kind, _detail in lc.events:
         if kind == "admitted":
             acc[state_key[state]] += now - since
@@ -202,12 +218,21 @@ def _compute_breakdown(lc: Lifecycle) -> None:
         elif kind == "first_token":
             acc[state_key[state]] += now - since
             state, since = "decode", now
-        elif kind in ("preempted", "redispatched"):
+        elif kind in ("preempted", "redispatched", "handoff_aborted"):
             # Crash failover is accounted like a preemption wait: the
             # request holds no slot between losing a replica and
-            # readmission elsewhere.
+            # readmission elsewhere. An aborted handoff enters the
+            # same wait (its re-dispatch re-prefills).
             acc[state_key[state]] += now - since
             state, since = "preempted", now
+        elif kind == "handoff":
+            # Disaggregated phase transition (ISSUE 13): sealed in
+            # flight between the pools.
+            acc[state_key[state]] += now - since
+            state, since = "handoff", now
+        elif kind == "handoff_done":
+            acc[state_key[state]] += now - since
+            state, since = "decode", now
         elif kind in ("finished", "aborted"):
             acc[state_key[state]] += now - since
             since = now
@@ -308,9 +333,10 @@ def render_request_table(lifecycles: dict[int, Lifecycle]) -> str:
     lines = [
         "| rid | status | tenant | arrival s | queued ms | prefill ms "
         "| decode ms "
-        "| preempt wait ms | preempts | chunks | dticks | pfx tok "
+        "| preempt wait ms | handoff ms | preempts | chunks | dticks "
+        "| pfx tok "
         "| tokens | ok |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for rid in sorted(lifecycles):
         lc = lifecycles[rid]
@@ -321,6 +347,7 @@ def render_request_table(lifecycles: dict[int, Lifecycle]) -> str:
             f"| {rec.get('tenant', 'default')} | {_fmt(lc.arrival_s())} "
             f"| {_fmt(b.get('queued_ms'))} | {_fmt(b.get('prefill_ms'))} "
             f"| {_fmt(b.get('decode_ms'))} | {_fmt(b.get('preempted_ms'))} "
+            f"| {_fmt(b.get('handoff_ms'))} "
             f"| {lc.preemptions} | {lc.prefill_chunks} | {lc.decode_ticks} "
             f"| {lc.prefix_hit_tokens} "
             f"| {lc.tokens_accounted}/{_fmt(rec.get('output_tokens'))} "
@@ -443,6 +470,7 @@ def trace_main(argv: list[str] | None = None) -> int:
                             "status": lc.derived_status,
                             "breakdown": lc.breakdown,
                             "preemptions": lc.preemptions,
+                            "handoffs": lc.handoffs,
                             "prefill_chunks": lc.prefill_chunks,
                             "decode_ticks": lc.decode_ticks,
                             "prefix_hits": lc.prefix_hits,

@@ -239,6 +239,9 @@ def render(state: TopState, path: str, width: int = 96) -> str:
             f"replicas {_fmt(fl.get('replicas'))}  "
             f"pending {_fmt(fl.get('pending')):>5} "
             f"{sparkline(state.pending_hist)}"
+            # Disaggregated serving (ISSUE 13): KV transfers in flight.
+            + (f"  handoffs-inflight {fl['handoffs_inflight']}"
+               if fl.get("handoffs_inflight") is not None else "")
         )
         # Per-replica load rows: what least-loaded dispatch reads —
         # queue depth, occupied slots, free pages — plus each replica's

@@ -8,7 +8,9 @@ fleet's dispatch/health/fencing policy), fleet.py (N replicas behind
 the router, failure-aware re-dispatch — ISSUE 7), prefix_cache.py (the
 prefix-sharing tree: refcounted read-only pages, copy-on-write, LRU
 retention — ISSUE 9; scheduler.py's SLOScheduler is the matching
-SLO-aware admission/preemption policy).
+SLO-aware admission/preemption policy), handoff.py (the disaggregated
+prefill/decode pools' crash-safe page-granular KV transfer protocol —
+ISSUE 13; fleet.py drives it, engine.adopt_pages is the device copy).
 """
 
 from .engine import PagedEngine, ServeResult
@@ -19,6 +21,7 @@ from .fleet import (
     Replica,
     SimCompute,
 )
+from .handoff import Handoff, parse_pools
 from .paged_cache import PagedKVCache, PagePool, init_paged_cache
 from .prefix_cache import PrefixCache
 from .router import Router
@@ -36,6 +39,7 @@ __all__ = [
     "EngineCompute",
     "Fleet",
     "FleetResult",
+    "Handoff",
     "PagedEngine",
     "PagedKVCache",
     "PagePool",
@@ -50,4 +54,5 @@ __all__ = [
     "StaticScheduler",
     "init_paged_cache",
     "pages_for",
+    "parse_pools",
 ]

@@ -448,6 +448,21 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                     "joins / leaves (exactly-once re-dispatch).",
     )
     ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--pools", default=None,
+                    help="disaggregated prefill/decode serving "
+                         "(ISSUE 13): 'prefill:N,decode:M' splits the "
+                         "fleet by phase — arrivals dispatch to the "
+                         "prefill pool, completed prefills hand their "
+                         "KV page sets to decode replicas through the "
+                         "crash-safe page-granular transfer protocol "
+                         "(per-page CRCs, per-handoff fences); "
+                         "overrides --replicas. An emptied pool "
+                         "degrades affected requests to unified "
+                         "serving instead of stalling")
+    ap.add_argument("--handoff-ticks", type=int, default=1,
+                    help="fleet ticks one KV handoff's copy is in "
+                         "flight (the mid-handoff crash window; "
+                         "needs --pools)")
     ap.add_argument("--policy", default="least_loaded",
                     choices=["least_loaded", "session"])
     ap.add_argument("--redispatch", default="resume",
@@ -569,8 +584,29 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     from ..obs.causal import CATEGORIES, BlameAccumulator
     from ..obs.metrics import MetricsRegistry
     from ..utils.logging import MetricsLogger
-    from .fleet import EngineCompute, Fleet, SimCompute, make_fleet_workload
+    from .fleet import (
+        EngineCompute,
+        Fleet,
+        SimCompute,
+        make_fleet_workload,
+        parse_pools,
+    )
     from .paged_cache import pages_for
+
+    pools = None
+    if args.pools:
+        try:
+            pools = parse_pools(args.pools)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    elif args.handoff_ticks != 1:
+        # The loud-config-error convention: a unified fleet has no
+        # handoffs, so a swept --handoff-ticks would be silently
+        # ignored and every run would measure the same thing.
+        print("error: --handoff-ticks needs --pools (a unified fleet "
+              "performs no KV handoffs)", file=sys.stderr)
+        return 2
 
     max_len = args.prompt_max + args.out_max
     pages = args.pages or args.slots * pages_for(max_len, args.page_size) + 1
@@ -694,6 +730,11 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 registry=registry, fleet_sink=fleet_sink,
                 replica_tick_sink=replica_tick_sink,
                 prefix=args.prefix_cache, sched_policy=sched_policy,
+                pools=pools, handoff_ticks=args.handoff_ticks,
+                # The per-transfer lifecycle log is only ever emitted at
+                # --log full; at summary-mode storm scale retaining it
+                # would be pure GC ballast (the counters still stamp).
+                log_handoffs=(args.log == "full"),
             )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -724,6 +765,12 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
         for ev in result.events:
             metrics.log("fault", **{"mode": "fleet", **ev})
         if metrics.jsonl_enabled and args.log == "full":
+            # Handoff lifecycle records (ISSUE 13): full-log only —
+            # at 10^5-storm scale one record per transfer state would
+            # rival the tick volume the summary mode exists to avoid
+            # (the gated summary counters cover the totals either way).
+            for rec in result.handoff_log:
+                metrics.log("handoff", **rec)
             for rec in result.request_records():
                 metrics.log("request", **rec)
         # Alert totals are ALWAYS stamped (zero/empty-CRC without
@@ -743,7 +790,9 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
         metrics.log("serve", **{
             "bench": "fleet", "policy": args.policy,
             "redispatch": args.redispatch,
-            "replicas_initial": args.replicas, "rate": args.rate,
+            "replicas_initial": (sum(pools.values()) if pools
+                                 else args.replicas),
+            "rate": args.rate,
             "slots": args.slots, "page_size": args.page_size,
             "pages": pages, "compute": args.compute, **s,
         })

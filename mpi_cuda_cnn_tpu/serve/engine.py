@@ -301,6 +301,16 @@ class PagedEngine:
                 for c in pages
             ]
 
+        def adopt(pages, src_pool, src, dst):
+            # Cross-engine KV transfer (ISSUE 13): scatter the sender
+            # pool's page rows (keys, values, int8 scales alike) into
+            # this engine's pools at the destination indices — the
+            # device half of the prefill->decode handoff.
+            return [
+                {name: c[name].at[dst].set(s[name][src]) for name in c}
+                for c, s in zip(pages, src_pool)
+            ]
+
         # Donate the cache: the page pools update in place tick-to-tick
         # (the engine always adopts the returned cache) instead of
         # allocating a second pool-sized buffer per dispatch. donate_jit
@@ -308,6 +318,7 @@ class PagedEngine:
         self._tick = donate_jit(tick)
         self._prefill = donate_jit(prefill)
         self._copy = donate_jit(copy)
+        self._adopt = donate_jit(adopt)
 
     # -- host-side helpers ------------------------------------------------
 
@@ -335,6 +346,44 @@ class PagedEngine:
         source's reference via scheduler.cow_complete afterwards."""
         self._pages = self._copy(self._pages, jnp.int32(src),
                                  jnp.int32(dst))
+
+    def adopt_pages(self, src_engine: "PagedEngine", src_pages,
+                    dst_pages) -> None:
+        """Adopt KV page content from another engine's pools (the
+        disaggregated prefill->decode handoff, ISSUE 13): the sender's
+        rows at `src_pages` land at this engine's `dst_pages`, every
+        layer's keys/values (and int8 scales) together. Both engines
+        must share the cache geometry — the fleet builds every replica
+        from one model/config, which is also what makes the handed-off
+        decode bitwise-equal to the unified one."""
+        if (src_engine.page_size != self.page_size
+                or src_engine.cache_dtype != self.cache_dtype
+                or len(src_engine._pages) != len(self._pages)):
+            raise ValueError(
+                "adopt_pages across mismatched cache geometries "
+                f"(page_size {src_engine.page_size} vs {self.page_size}, "
+                f"dtype {src_engine.cache_dtype} vs {self.cache_dtype})"
+            )
+        if len(src_pages) != len(dst_pages):
+            raise ValueError(
+                f"adopt_pages: {len(src_pages)} source pages vs "
+                f"{len(dst_pages)} destinations"
+            )
+        # Pad the index arrays to the next power of two so the jitted
+        # scatter compiles O(log num_pages) shapes, not one per handoff
+        # page count. Pad entries copy the sender's scratch page onto
+        # THIS pool's scratch page (page 0 on both ends) — scratch is
+        # the sanctioned garbage sink, never read as live data.
+        n = len(src_pages)
+        width = 1 << max(n - 1, 0).bit_length()
+        src = np.zeros(width, np.int32)
+        dst = np.zeros(width, np.int32)
+        src[:n] = src_pages
+        dst[:n] = dst_pages
+        self._pages = self._adopt(
+            self._pages, src_engine._pages,
+            jnp.asarray(src), jnp.asarray(dst),
+        )
 
     def run_prefill_chunk(self, slot):
         """Advance `slot`'s prefill by one chunk on the device. Returns

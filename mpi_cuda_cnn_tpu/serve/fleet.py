@@ -47,6 +47,19 @@ Failure semantics (the exactly-once contract):
   rejoins with empty pools; a replica that keeps flapping is
   circuit-opened (permanently removed). `replica_join` scales the
   fleet out elastically; `replica_leave` drains one gracefully.
+
+Disaggregated prefill/decode serving (ISSUE 13, serve/handoff.py):
+`pools={"prefill": N, "decode": M}` splits the fleet by phase — the
+router dispatches arrivals to the prefill pool, and a completed
+prefill's page set moves to a decode replica through a page-granular
+handoff (sealed pages under a per-handoff ownership token, per-page
+content CRCs verified at adoption, the rid's generation fence revoked
+in flight and re-granted to the receiver). A crash of either end
+mid-handoff resolves to exactly-once via the same re-dispatch path a
+replica crash uses; a pool that EMPTIES (crashes, circuit breaker,
+leave, `pool_crash`) degrades affected requests to unified serving on
+whatever can take work — with a `degraded` obs event — instead of
+stalling, and a repopulated pool logs `restored`.
 """
 
 from __future__ import annotations
@@ -59,6 +72,15 @@ from collections import deque
 
 from ..faults import FakeClock
 from ..obs.metrics import MetricsRegistry
+from .handoff import (
+    Handoff,
+    context_crc,
+    context_tokens,
+    handoff_owner,
+    page_crcs,
+    parse_pools,
+    verify_page_crcs,
+)
 from .pool import PagePool
 from .prefix_cache import PrefixCache, empty_prefix_fields
 from .router import CircuitOpen, Router
@@ -73,7 +95,7 @@ from .scheduler import (
 
 __all__ = [
     "EngineCompute", "Fleet", "FleetResult", "Replica", "ReplicaCore",
-    "SimCompute",
+    "SimCompute", "parse_pools",
 ]
 
 
@@ -108,6 +130,12 @@ class SimCompute:
         (rid, position), not of cache contents — the page accounting
         is exercised for real, the device copy has nothing to copy."""
 
+    def adopt_pages(self, src_compute, src_pages, dst_pages) -> None:
+        """Sim cross-pool KV transfer (ISSUE 13): accounting-only, like
+        COW — tokens are a pure function of (rid, position), so the
+        protocol (seal, CRC, adopt, release) is exercised for real
+        while the content copy has nothing to move."""
+
 
 class EngineCompute:
     """Model-backed compute: one PagedEngine (its own page pools) per
@@ -126,6 +154,12 @@ class EngineCompute:
 
     def copy_page(self, src: int, dst: int) -> None:
         self.engine.copy_page(src, dst)
+
+    def adopt_pages(self, src_compute, src_pages, dst_pages) -> None:
+        """Cross-engine KV page transfer (ISSUE 13): copy the sender
+        engine's page rows into this engine's pools at the destination
+        indices — the device half of the prefill->decode handoff."""
+        self.engine.adopt_pages(src_compute.engine, src_pages, dst_pages)
 
 
 class ReplicaCore:
@@ -151,6 +185,11 @@ class ReplicaCore:
             self.sched = ContinuousScheduler(**sched_kw)
         self.compute = compute
         self.on_emit = on_emit
+        # Disaggregated serving hook (ISSUE 13): called when a slot's
+        # prefill completes with decode work remaining; returning True
+        # means the fleet DETACHED the slot for a cross-pool handoff
+        # (prefill was this replica's whole job for the rid).
+        self.on_prefill_done = None
         self.check_every = check_every
         self.steps = 0
         self.decode_ticks = 0
@@ -219,6 +258,12 @@ class ReplicaCore:
                 prefill_rec.append("emit")
                 if slot.req.done:
                     sched.finish(slot, now)
+                elif (self.on_prefill_done is not None
+                        and self.on_prefill_done(self, slot, now)):
+                    # Handed off (ISSUE 13): the fleet sealed the page
+                    # set and detached the slot — decode happens on the
+                    # receiving pool's replica.
+                    pass
         dslots = sched.grow_for_decode(now)
         decoded = [[s.idx, s.req.rid] for s in dslots]
         if dslots:
@@ -286,8 +331,12 @@ class Replica:
     def __init__(self, name: str, compute, *, slots: int, num_pages: int,
                  page_size: int, max_len: int, max_queue: int | None = None,
                  check_every: int = 1, on_emit=None, clock=None,
-                 prefix: bool = False, policy=None):
+                 prefix: bool = False, policy=None, phase: str | None = None):
         self.name = name
+        # Pool membership of a disaggregated fleet (ISSUE 13):
+        # "prefill" | "decode" | None (unified). A restarted
+        # incarnation keeps its name's phase.
+        self.phase = phase
         self.registry = MetricsRegistry(clock=clock)
         self.core = ReplicaCore(
             compute, slots=slots, num_pages=num_pages, page_size=page_size,
@@ -348,6 +397,19 @@ class FleetResult:
     prefill_chunks: int
     preemptions: int
     replicas_final: int
+    # Disaggregated serving (ISSUE 13): completed prefill->decode KV
+    # handoffs (+ pages moved), aborted transfers (either end died, the
+    # transfer dropped, or a CRC refused adoption), integrity refusals
+    # (corrupted handoff pages or resume contexts — never decoded), and
+    # requests served unified because a pool was empty. All stamped in
+    # every run (zeros on a unified fleet) so the gates can pin them.
+    handoffs: int = 0
+    handoff_pages: int = 0
+    handoffs_aborted: int = 0
+    kv_refusals: int = 0
+    degraded_unified: int = 0
+    pools: dict | None = None
+    handoff_log: list[dict] = dataclasses.field(default_factory=list)
     # (tick, rid, replica name, epoch, "dispatch" | "redispatch") —
     # every routing decision in order; bitwise-equal across
     # identical-seed runs (the determinism acceptance).
@@ -429,6 +491,15 @@ class FleetResult:
             "restarts": self.restarts,
             "circuit_opens": self.circuit_opens,
             "trace_crc": self.trace_crc,
+            # Disaggregated-serving counters (ISSUE 13): flat keys the
+            # disagg determinism gate pins at exact equality; zeros on
+            # a unified fleet so they exist in every fleet-bench run.
+            "handoffs": self.handoffs,
+            "handoff_pages": self.handoff_pages,
+            "handoffs_aborted": self.handoffs_aborted,
+            "kv_refusals": self.kv_refusals,
+            "degraded_unified": self.degraded_unified,
+            **({"pools": dict(self.pools)} if self.pools else {}),
             # Prefix-sharing counters (ISSUE 9): flat keys the fleet
             # determinism gate pins at exact equality.
             **self.prefix,
@@ -459,12 +530,52 @@ class Fleet:
                  check_every: int = 1, faults=None, clock: FakeClock | None = None,
                  registry: MetricsRegistry | None = None, fleet_sink=None,
                  replica_tick_sink=None, jitter=None, prefix: bool = False,
-                 sched_policy=None):
+                 sched_policy=None, pools: dict[str, int] | str | None = None,
+                 handoff_ticks: int = 1, log_handoffs: bool = True):
+        if isinstance(pools, str):
+            pools = parse_pools(pools)
+        if pools is not None:
+            bad = [k for k, v in pools.items()
+                   if k not in ("prefill", "decode") or v < 1]
+            if bad or set(pools) != {"prefill", "decode"}:
+                raise ValueError(
+                    f"pools {pools!r}: want {{'prefill': N>=1, "
+                    "'decode': M>=1}}"
+                )
+            replicas = pools["prefill"] + pools["decode"]
         if replicas < 1:
             raise ValueError(f"need at least one replica, got {replicas}")
+        if handoff_ticks < 1:
+            raise ValueError(f"handoff_ticks must be >= 1, got "
+                             f"{handoff_ticks}")
         if redispatch not in ("resume", "discard"):
             raise ValueError(
                 f"redispatch {redispatch!r}: want 'resume' or 'discard'")
+        if pools is None and faults is not None:
+            # The inert-fault contract (ISSUE 7 satellite), extended to
+            # the handoff site: fleet.handoff is only polled on a
+            # pooled fleet — a unified run would validate the plan and
+            # then silently never fire it. (fleet.resume stays legal
+            # everywhere: failover resume re-dispatches exist on
+            # unified fleets too.)
+            inert = [f"{f.kind}@{f.site}"
+                     for f in faults.pending("fleet.handoff")]
+            if inert:
+                raise ValueError(
+                    f"fault(s) {', '.join(sorted(set(inert)))} need a "
+                    "disaggregated fleet (--pools) — on a unified fleet "
+                    "they would silently never fire"
+                )
+        if redispatch == "discard" and faults is not None \
+                and faults.pending("fleet.resume"):
+            # Same contract, resume leg: discard re-dispatches never
+            # verify a committed context (there is none to verify), so
+            # a fleet.resume fault would silently never fire.
+            raise ValueError(
+                "kv_corrupt@fleet.resume needs --redispatch resume — "
+                "discard re-dispatches carry no committed context, so "
+                "the fault would silently never fire"
+            )
         self.compute_factory = compute_factory
         # prefix/sched_policy (ISSUE 9): each replica gets its own
         # PrefixCache over its own pool (a restarted incarnation comes
@@ -492,6 +603,32 @@ class Fleet:
         self.fenced_discards = 0
         self.crashes = self.joins = self.leaves = 0
         self.restarts = self.circuit_opens = 0
+        # Disaggregated serving (ISSUE 13): pool membership plan, the
+        # in-flight handoff table, and the degradation latches.
+        self.pools = pools
+        self.handoff_ticks = handoff_ticks
+        self._phase_of: dict[str, str | None] = {}
+        self._handoffs: dict[int, Handoff] = {}
+        self._handoff_seq = 0
+        self._resume_seq = 0
+        self.handoffs = self.handoff_pages = 0
+        self.handoffs_aborted = self.kv_refusals = 0
+        # Unique rids served unified because a pool was empty — a SET,
+        # so a request that degrades repeatedly (handoff abort, then
+        # again at its re-prefill's completion) counts once, matching
+        # the summary key's "requests served unified" semantics.
+        self._degraded_rids: set[int] = set()
+        self._degraded = {"prefill": False, "decode": False}
+        # obs `handoff` field dicts. log_handoffs=False keeps the list
+        # EMPTY (summary-mode storms: ~2 retained dicts per transfer
+        # would be the PR-11 retained-container GC cost all over again
+        # for a log nothing reads); the summary counters and registry
+        # increments are unaffected.
+        self.log_handoffs = log_handoffs
+        self.handoff_log: list[dict] = []
+        self._handoff_started_tick: list[tuple[int, str]] = []
+        self._handoff_done_tick: list[tuple[int, str]] = []
+        self._handoff_aborted_tick: list[tuple[int, str]] = []
         self._retired = [0, 0, 0]  # decode_ticks, prefill_chunks, preempts
         self._retired_prefix = empty_prefix_fields()
         self._failed_over_tick: list[tuple[int, str]] = []
@@ -504,25 +641,38 @@ class Fleet:
         self._pending_restarts: list[tuple[float, str]] = []
         self._next_idx = 0
         self._tick = 0
-        for _ in range(replicas):
-            self._join(tick=0, now=0.0, log=False)
+        if pools is None:
+            phases: list[str | None] = [None] * replicas
+        else:
+            # Deterministic initial membership: r0..r{P-1} prefill,
+            # then the decode pool — names keep their phase across
+            # restarts (self._phase_of).
+            phases = (["prefill"] * pools["prefill"]
+                      + ["decode"] * pools["decode"])
+        for phase in phases:
+            self._join(tick=0, now=0.0, log=False, phase=phase)
 
     # -- membership ----------------------------------------------------
 
     def _new_replica(self, name: str) -> Replica:
         rep = Replica(name, self.compute_factory(name),
-                      clock=self.clock, **self.geometry)
+                      clock=self.clock, phase=self._phase_of.get(name),
+                      **self.geometry)
         rep.core.on_emit = self._make_emit(rep)
+        rep.core.on_prefill_done = self._make_prefill_done(rep)
         return rep
 
-    def _join(self, *, tick: int, now: float, log: bool = True) -> Replica:
+    def _join(self, *, tick: int, now: float, log: bool = True,
+              phase: str | None = None) -> Replica:
         name = f"r{self._next_idx}"
         self._next_idx += 1
+        self._phase_of[name] = phase
         rep = self._new_replica(name)
         self.router.register(rep, tick=tick)
         self.joins += log
         if log:
-            self._log_replica(name, "join", tick, now)
+            self._log_replica(name, "join", tick, now,
+                              **({"pool": phase} if phase else {}))
         return rep
 
     def _log_replica(self, name: str, kind: str, tick: int, now: float,
@@ -586,12 +736,336 @@ class Fleet:
             synced.append(auth)
         return synced
 
+    # -- prefill->decode KV handoff (ISSUE 13) -------------------------
+
+    def _log_handoff(self, ho: Handoff, state: str, tick: int, now: float,
+                     **extra) -> None:
+        if self.log_handoffs:
+            self.handoff_log.append({
+                "rid": ho.rid, "hid": ho.hid, "state": state,
+                "src": ho.src, "dst": ho.dst, "pages": len(ho.pages),
+                "tick": tick, "now": round(now, 4), **extra,
+            })
+        if self.registry is not None:
+            self.registry.inc(f"fleet.handoff_{state}")
+
+    def _note_degraded(self, pool: str, tick: int, now: float) -> None:
+        """Latch + log a pool-collapse degradation exactly once per
+        episode: the fleet serves affected requests unified instead of
+        stalling; `_check_restored` clears the latch when the pool
+        repopulates (restart / join)."""
+        if not self._degraded[pool]:
+            self._degraded[pool] = True
+            self._log_replica(pool, "degraded", tick, now, pool=pool)
+            if self.registry is not None:
+                self.registry.inc("fleet.degraded")
+
+    def _check_restored(self, tick: int, now: float) -> None:
+        if self.pools is None:
+            return
+        for pool in ("prefill", "decode"):
+            if self._degraded[pool] and self.router.dispatchable(pool):
+                self._degraded[pool] = False
+                self._log_replica(pool, "restored", tick, now, pool=pool)
+
+    def _make_prefill_done(self, replica: Replica):
+        def on_done(core: ReplicaCore, slot, now: float) -> bool:
+            return self._begin_handoff(replica, core, slot, now)
+        return on_done
+
+    def _begin_handoff(self, replica: Replica, core: ReplicaCore, slot,
+                       now: float) -> bool:
+        """A prefill-pool slot just completed its prefill with decode
+        work remaining: seal its page set and open a handoff, or — with
+        the decode pool EMPTY — degrade this request to unified serving
+        on the prefill replica (return False: the slot keeps decoding
+        locally instead of stalling behind a pool that may never come
+        back)."""
+        if self.pools is None or replica.phase != "prefill":
+            return False
+        member = self.router.members.get(replica.name)
+        if (member is None or member.replica is not replica
+                or not replica.alive):
+            # A ZOMBIE (or already-failed-over) incarnation completing
+            # a prefill must not open a handoff: the failover already
+            # re-dispatched its requests, and a zombie-initiated
+            # transfer would double-dispatch the rid the moment it
+            # aborted (sender_dead) — the exactly-once violation the
+            # blame-conservation acceptance caught. The zombie decodes
+            # locally instead; every commit it attempts is fenced off.
+            return False
+        rid0 = slot.req.rid
+        if rid0 in self._handoffs or self._auth[rid0].terminal:
+            # Defensive: one in-flight transfer per rid, never one for
+            # a request that already left the system.
+            return False
+        tick = self._tick
+        if not self.router.dispatchable("decode"):
+            self._note_degraded("decode", tick, now)
+            self._degraded_rids.add(slot.req.rid)
+            return False
+        local = slot.req
+        rid = local.rid
+        hid = self._handoff_seq
+        self._handoff_seq += 1
+        cached = slot.cached
+        owner = handoff_owner(rid, hid)
+        # The seal-time integrity stamps, from the SENDER's view of the
+        # context (rows 0..cached-1; the just-emitted token is not yet
+        # a cache row).
+        crcs = page_crcs(context_tokens(local.prompt, local.out), cached,
+                         self.geometry["page_size"])
+        drop = False
+        if self.faults is not None:
+            for f in self.faults.poll("fleet.handoff", hid):
+                if f.kind == "handoff_drop":
+                    drop = True
+                elif f.kind == "kv_corrupt":
+                    page = min(int(f.arg("page", 0)), len(crcs) - 1)
+                    crcs[page] ^= 0x5A5A5A5A
+                else:
+                    raise ValueError(
+                        f"fault kind {f.kind!r} is inert at fleet.handoff"
+                    )
+        pages, private, nodes = core.sched.detach_for_handoff(slot, owner)
+        # Nobody may commit for this rid while its KV is in flight: the
+        # per-handoff fence. The receiver gets a fresh epoch at
+        # completion; an abort re-grants via the re-dispatch path.
+        self.router.revoke(rid)
+        auth = self._auth[rid]
+        auth.preemptions += local.preemptions
+        auth.quota_wait_s += local.quota_wait_s
+        if auth.admitted_at is None:
+            auth.admitted_at = local.admitted_at
+        self._holder.pop(rid, None)
+        ho = Handoff(hid=hid, rid=rid, src=replica.name, src_rep=replica,
+                     pages=pages, private=private, nodes=nodes,
+                     cached=cached, crcs=crcs, owner=owner, drop=drop)
+        self._handoffs[rid] = ho
+        self._handoff_started_tick.append((rid, replica.name))
+        self._log_handoff(ho, "started", tick, now)
+        return True
+
+    @staticmethod
+    def _can_take(member, ho: Handoff, req: Request) -> bool:
+        """THE receiver-capability predicate, shared by handoff
+        placement and the bind-time re-target so the two can never
+        disagree: a receiver must be able to TAKE the transfer — page
+        capacity for the whole set, a free slot, and its own pool's
+        admission quota — not merely hold its pages."""
+        sched = member.replica.core.sched
+        return (member.replica.alive
+                and sched.pool.free_pages >= len(ho.pages)
+                and any(s.free for s in sched.slots)
+                and sched.transfer_quota_ok(req))
+
+    def _src_live(self, ho: Handoff) -> bool:
+        m = self.router.members.get(ho.src)
+        return (m is not None and m.replica is ho.src_rep
+                and m.replica.alive)
+
+    def _dst_live(self, ho: Handoff) -> bool:
+        m = self.router.members.get(ho.dst)
+        return (m is not None and m.replica is ho.dst_rep
+                and m.replica.alive)
+
+    def _abort_handoff(self, ho: Handoff, reason: str, tick: int,
+                       now: float, redispatch_q: deque) -> None:
+        """Resolve a failed transfer to exactly-once: release whichever
+        ends still live (a dead incarnation's pool died with it — the
+        receiver's partial adoption is revoked, the sender's sealed
+        pages freed), then re-enter the fleet's re-dispatch queue —
+        the request re-prefills elsewhere under a fresh fence epoch.
+        A corrupted or dropped page set is never decoded."""
+        if self._src_live(ho):
+            ho.src_rep.core.sched.release_handoff(ho.private, ho.nodes,
+                                                  ho.owner)
+        if ho.dst_pages and self._dst_live(ho):
+            ho.dst_rep.core.sched.pool.free(list(ho.dst_pages), ho.owner)
+        ho.state = "aborted"
+        self.handoffs_aborted += 1
+        auth = self._auth[ho.rid]
+        # Resume-path integrity stamp (the handoff abort IS a failover
+        # for this rid): the committed context is verified before the
+        # re-dispatch re-prefills it.
+        auth._ctx_crc = context_crc(auth.prompt, auth.out)
+        redispatch_q.append(auth)
+        del self._handoffs[ho.rid]
+        self._handoff_aborted_tick.append((ho.rid, reason))
+        self._log_handoff(ho, "aborted", tick, now, reason=reason)
+
+    def _process_handoffs(self, tick: int, now: float,
+                          redispatch_q: deque) -> None:
+        """Advance every in-flight handoff one fleet tick (rid order —
+        deterministic). Runs BEFORE dispatch, so an abort's re-dispatch
+        and a completion's first decode can land this same tick, and
+        the tick's fleet record (emitted after) carries the markers
+        ordered ahead of any replica emission."""
+        for rid in sorted(self._handoffs):
+            ho = self._handoffs[rid]
+            if not self._src_live(ho):
+                # Sender died mid-handoff: the receiver's partial
+                # adoption is revoked and the request re-prefills
+                # elsewhere (the PR-7 fence + re-dispatch path,
+                # extended to the handoff site).
+                self._abort_handoff(ho, "sender_dead", tick, now,
+                                    redispatch_q)
+                continue
+            if ho.cancelled:
+                self._abort_handoff(ho, "cancelled", tick, now,
+                                    redispatch_q)
+                continue
+            if ho.state == "pending":
+                auth = self._auth[rid]
+                pool_members = self.router.dispatchable("decode")
+                cands = [m for m in pool_members
+                         if self._can_take(m, ho, auth)]
+                if not pool_members:
+                    # Decode pool collapsed while the transfer waited:
+                    # degrade — re-prefill lands unified via dispatch.
+                    self._note_degraded("decode", tick, now)
+                    self._degraded_rids.add(rid)
+                    self._abort_handoff(ho, "decode_pool_empty", tick,
+                                        now, redispatch_q)
+                    continue
+                if not cands:
+                    continue  # capacity in flight — retry next tick
+                member = min(cands,
+                             key=lambda m: (m.replica.load(), m.name))
+                dst_pages = member.replica.core.sched.pool.try_alloc(
+                    len(ho.pages), ho.owner)
+                assert dst_pages is not None
+                # Counts toward same-tick load like a dispatch: several
+                # placements in one tick spread instead of dog-piling
+                # the stalest gauge.
+                member.replica.pending_dispatches += 1
+                ho.dst = member.name
+                ho.dst_rep = member.replica
+                ho.dst_pages = dst_pages
+                ho.state = "copying"
+                ho.ticks_left = self.handoff_ticks
+                continue
+            # state == "copying": the transfer is in flight.
+            if not self._dst_live(ho):
+                # Receiver died mid-handoff: the sender's sealed pages
+                # are released and the router re-targets via the
+                # re-dispatch path.
+                ho.dst_pages = []  # died with the incarnation's pool
+                self._abort_handoff(ho, "receiver_dead", tick, now,
+                                    redispatch_q)
+                continue
+            if ho.ticks_left > 0:
+                ho.ticks_left -= 1
+            if ho.ticks_left > 0:
+                continue
+            if ho.drop:
+                self._abort_handoff(ho, "dropped", tick, now,
+                                    redispatch_q)
+                continue
+            auth = self._auth[rid]
+            if not ho.copied:
+                # Adoption check FIRST: a page set whose stamps do not
+                # match the authoritative context is refused — the
+                # request re-prefills, garbage is never decoded.
+                if not verify_page_crcs(
+                        ho.crcs, context_tokens(auth.prompt, auth.out),
+                        ho.cached, self.geometry["page_size"]):
+                    self.kv_refusals += 1
+                    self._abort_handoff(ho, "kv_corrupt", tick, now,
+                                        redispatch_q)
+                    continue
+                ho.dst_rep.core.compute.adopt_pages(
+                    ho.src_rep.core.compute, ho.pages, ho.dst_pages)
+                ho.copied = True
+            local = Request(rid=rid, prompt=auth.prompt,
+                            max_new_tokens=auth.max_new_tokens,
+                            arrival=auth.arrival, deadline=auth.deadline,
+                            session=auth.session, tenant=auth.tenant)
+            local.out = list(auth.out)
+            local.admitted_at = auth.admitted_at
+            slot = ho.dst_rep.core.sched.bind_transfer(
+                local, ho.dst_pages, ho.cached, ho.owner, now)
+            if slot is None:
+                # The receiver filled up (slots or quota) between
+                # placement and completion. If ANOTHER decode replica
+                # could take the transfer right now, re-target instead
+                # of pinning pages on the stalled one: release the
+                # destination pages and return to pending (the content
+                # re-copies — correctness over the wasted copy).
+                others = [
+                    m for m in self.router.dispatchable("decode")
+                    if m.replica is not ho.dst_rep
+                    and self._can_take(m, ho, local)
+                ]
+                if others:
+                    ho.dst_rep.core.sched.pool.free(list(ho.dst_pages),
+                                                    ho.owner)
+                    ho.dst = None
+                    ho.dst_rep = None
+                    ho.dst_pages = []
+                    ho.copied = False
+                    ho.state = "pending"
+                continue
+            epoch = self.router.grant(rid, ho.dst)
+            local._fleet_epoch = epoch
+            self._holder[rid] = (ho.dst_rep, local)
+            if auth.cancel_requested:
+                local.cancel()
+                ho.dst_rep.core.flag_cancel()
+            ho.state = "done"
+            self.handoffs += 1
+            self.handoff_pages += len(ho.pages)
+            if self._src_live(ho):
+                ho.src_rep.core.sched.release_handoff(
+                    ho.private, ho.nodes, ho.owner)
+            del self._handoffs[rid]
+            self._handoff_done_tick.append((rid, ho.dst))
+            self._log_handoff(ho, "done", tick, now)
+
     # -- dispatch ------------------------------------------------------
 
     def _dispatch(self, req: Request, *, tick: int, redispatch: bool) -> bool:
-        member = self.router.pick(req)
+        phase = "prefill" if self.pools is not None else None
+        member = self.router.pick(req, phase)
+        if member is None and phase is not None:
+            # Prefill pool empty (crashes / circuit breaks / leaves):
+            # degrade this request to unified serving on whatever can
+            # take work instead of stalling behind the dead pool.
+            member = self.router.pick(req)
+            if member is not None:
+                now = self.clock() - self._t0
+                self._note_degraded("prefill", tick, now)
+                self._degraded_rids.add(req.rid)
         if member is None:
             return False
+        if redispatch and self.redispatch == "resume" and req.out:
+            # KV transfer integrity, failover leg (ISSUE 13): the
+            # committed context a resume re-dispatch re-prefills is
+            # verified against the stamp taken when the request was
+            # stranded — it used to be re-adopted unchecked. A
+            # mismatch (or an injected kv_corrupt@fleet.resume) falls
+            # back to discard semantics: the tokens are regenerated
+            # from the prompt, never decoded as-is.
+            stamp = getattr(req, "_ctx_crc", None)
+            if self.faults is not None:
+                for f in self.faults.poll("fleet.resume",
+                                          self._resume_seq):
+                    if f.kind != "kv_corrupt":
+                        raise ValueError(
+                            f"fault kind {f.kind!r} is inert at "
+                            "fleet.resume"
+                        )
+                    stamp = (stamp ^ 0x5A5A5A5A) if stamp is not None \
+                        else 1
+            self._resume_seq += 1
+            if stamp is None or stamp != context_crc(req.prompt, req.out):
+                self.kv_refusals += 1
+                self.events.append({
+                    "kind": "resume_refused", "id": req.rid,
+                    "tokens_discarded": len(req.out),
+                })
+                req.out.clear()
+                req.first_token_at = None
         epoch = self.router.grant(req.rid, member.name)
         if redispatch and self.redispatch == "discard":
             req.out.clear()
@@ -636,6 +1110,13 @@ class Fleet:
         if auth is None or auth.terminal:
             return
         auth.cancel()
+        ho = self._handoffs.get(rid)
+        if ho is not None:
+            # Mid-handoff cancel: the transfer aborts at its next
+            # processing step and the cancel rides the re-dispatch
+            # (the new incarnation sweeps it terminally).
+            ho.cancelled = True
+            return
         held = self._holder.get(rid)
         if held is not None:
             replica, local = held
@@ -661,6 +1142,10 @@ class Fleet:
             auth.quota_wait_s += local.quota_wait_s
             if auth.admitted_at is None:
                 auth.admitted_at = local.admitted_at
+            # Resume-path integrity stamp (ISSUE 13): taken the moment
+            # the failover strands the request; verified before the
+            # re-dispatch re-prefills the committed context.
+            auth._ctx_crc = context_crc(auth.prompt, auth.out)
             stranded.append(auth)
         return sorted(stranded, key=lambda r: r.rid)
 
@@ -726,6 +1211,16 @@ class Fleet:
             )
         return name
 
+    def _crash_member(self, member, *, tick: int, now: float,
+                      zombie: int = 0) -> None:
+        member.replica.alive = False
+        self.crashes += 1
+        if zombie > 0:
+            member.replica.zombie_until = tick + zombie
+            self._zombies.append(member.replica)
+        self._log_replica(member.name, "crash", tick, now,
+                          zombie_ticks=zombie)
+
     def _apply_fault(self, f, *, tick: int, now: float,
                      redispatch_q: deque) -> None:
         if f.kind == "replica_crash":
@@ -733,16 +1228,42 @@ class Fleet:
             member = self.router.members.get(name)
             if member is None or not member.replica.alive:
                 return
-            member.replica.alive = False
-            self.crashes += 1
-            zombie = int(f.arg("zombie_ticks", 0))
-            if zombie > 0:
-                member.replica.zombie_until = tick + zombie
-                self._zombies.append(member.replica)
-            self._log_replica(name, "crash", tick, now, zombie_ticks=zombie)
+            self._crash_member(member, tick=tick, now=now,
+                               zombie=int(f.arg("zombie_ticks", 0)))
+        elif f.kind == "pool_crash":
+            # Pool-collapse driver (ISSUE 13): kill every live member
+            # of one phase pool — the degradation path's test vehicle.
+            pool = f.arg("pool")
+            if self.pools is None or pool not in ("prefill", "decode"):
+                raise ValueError(
+                    f"fault {f.kind}@{f.site}: pool={pool!r} needs a "
+                    "disaggregated fleet with pool 'prefill' or 'decode'"
+                )
+            for member in list(self.router.members.values()):
+                if (member.replica.phase == pool
+                        and member.replica.alive):
+                    self._crash_member(member, tick=tick, now=now,
+                                       zombie=int(f.arg("zombie_ticks",
+                                                        0)))
         elif f.kind == "replica_join":
+            phase = f.arg("pool")
+            if phase is None:
+                # A disaggregated fleet's unlabeled join lands in the
+                # decode pool (capacity there unblocks handoffs); a
+                # unified fleet's join stays phaseless.
+                phase = "decode" if self.pools is not None else None
+            elif phase not in ("prefill", "decode"):
+                raise ValueError(
+                    f"fault {f.kind}@{f.site}: pool={phase!r} must be "
+                    "'prefill' or 'decode'"
+                )
+            elif self.pools is None:
+                raise ValueError(
+                    f"fault {f.kind}@{f.site}: pool={phase!r} on a "
+                    "unified fleet — there are no pools to join"
+                )
             for _ in range(int(f.arg("replicas", 1))):
-                self._join(tick=tick, now=now)
+                self._join(tick=tick, now=now, phase=phase)
         elif f.kind == "replica_leave":
             name = self._resolve_fault_target(f)
             member = self.router.members.get(name)
@@ -782,6 +1303,7 @@ class Fleet:
         n_total = len(reqs)
         tick = self._tick
         while n_done < n_total:
+            self._tick = tick
             now = clock() - t0
             if self.faults is not None:
                 for f in self.faults.fire("fleet.tick", tick):
@@ -809,6 +1331,13 @@ class Fleet:
                     self._retire_counts(member.replica)
                     self._log_replica(member.name, "drain_complete", tick,
                                       now)
+            # Disaggregation (ISSUE 13): clear degradation latches for
+            # pools that repopulated, then advance every in-flight KV
+            # handoff (aborts feed redispatch_q ahead of the dispatch
+            # pass below; completions bind decode-ready this tick).
+            self._check_restored(tick, now)
+            if self._handoffs:
+                self._process_handoffs(tick, now, redispatch_q)
             # Dispatch: failovers first (they already waited), then due
             # arrivals, FCFS. A re-dispatch happens EXACTLY once per
             # failover — the queue is drained head-first and a request
@@ -832,6 +1361,11 @@ class Fleet:
             # lets `mctpu trace` anchor a discard re-dispatch's token
             # reset ahead of the new replica's first emission.
             failed_over, self._failed_over_tick = self._failed_over_tick, []
+            ho_started, self._handoff_started_tick = \
+                self._handoff_started_tick, []
+            ho_done, self._handoff_done_tick = self._handoff_done_tick, []
+            ho_aborted, self._handoff_aborted_tick = \
+                self._handoff_aborted_tick, []
             if self.fleet_sink is not None:
                 arrived_now = []
                 while announce and announce[0][0] <= now:
@@ -844,6 +1378,17 @@ class Fleet:
                     "dispatched": dispatched, "redispatched": redispatched,
                     "failed_over": [[rid, name]
                                     for rid, name in failed_over],
+                    # Handoff markers (ISSUE 13), ordered in the JSONL
+                    # BEFORE any replica record of this tick: a done
+                    # marker always precedes the decode pool's first
+                    # emission for the rid, which is what lets `mctpu
+                    # trace`/`explain` anchor the phase transition.
+                    "handoff_started": [[rid, src]
+                                        for rid, src in ho_started],
+                    "handoff_done": [[rid, dst] for rid, dst in ho_done],
+                    "handoff_aborted": [[rid, why]
+                                        for rid, why in ho_aborted],
+                    "handoffs_inflight": len(self._handoffs),
                     "redispatch": self.redispatch,
                     "load": {m.name: [len(m.replica.core.sched.queue),
                                       sum(1 for s in
@@ -926,7 +1471,7 @@ class Fleet:
             clock.advance(tick_s)
             if n_done >= n_total:
                 break
-            if not any_work and not self._zombies:
+            if not any_work and not self._zombies and not self._handoffs:
                 # Fleet idle: nothing in flight on any LIVE replica. A
                 # dead-but-undetected member may still hold work — keep
                 # ticking until heartbeat staleness surfaces it. Else
@@ -1032,6 +1577,11 @@ class Fleet:
             circuit_opens=self.circuit_opens, decode_ticks=decode_ticks,
             prefill_chunks=prefills, preemptions=preempts,
             replicas_final=len(self.router.members),
+            handoffs=self.handoffs, handoff_pages=self.handoff_pages,
+            handoffs_aborted=self.handoffs_aborted,
+            kv_refusals=self.kv_refusals,
+            degraded_unified=len(self._degraded_rids), pools=self.pools,
+            handoff_log=self.handoff_log,
             dispatch_trace=self.dispatch_trace, events=self.events,
             replica_log=self.replica_log, prefix=prefix_totals,
         )

@@ -152,21 +152,29 @@ class Router:
             )
         return backoff_delay(flaps - 1, self.backoff_base, self.jitter)
 
-    def dispatchable(self) -> list[Member]:
-        """Members that may receive NEW work, in deterministic order."""
+    def dispatchable(self, phase: str | None = None) -> list[Member]:
+        """Members that may receive NEW work, in deterministic order.
+        `phase` restricts to one pool of a disaggregated fleet
+        (ISSUE 13): only members whose replica carries that phase tag —
+        an empty result is how the fleet detects a collapsed pool and
+        degrades to unified serving instead of stalling."""
         return [m for m in sorted(self.members.values(),
                                   key=lambda m: m.name)
-                if not m.draining]
+                if not m.draining
+                and (phase is None
+                     or getattr(m.replica, "phase", None) == phase)]
 
     # -- dispatch ------------------------------------------------------
 
-    def pick(self, req) -> Member | None:
+    def pick(self, req, phase: str | None = None) -> Member | None:
         """The replica `req` should run on, or None when nothing can
         take work. Least-loaded reads each replica's load() (backed by
         its PR-6 registry gauges); session requests rendezvous-hash
         onto the surviving membership; ties break on name, so identical
-        fleets make identical choices."""
-        cands = self.dispatchable()
+        fleets make identical choices. `phase` restricts the candidate
+        set to one pool (ISSUE 13) — session affinity then rendezvous-
+        hashes over that pool's membership only."""
+        cands = self.dispatchable(phase)
         if not cands:
             return None
         if self.policy == "session" and req.session is not None:

@@ -150,6 +150,18 @@ step profile_lm 900 python scripts/profile_lm.py
 step bench_fleet_engine 900 python scripts/bench_fleet.py \
     --compute engine --replicas 2 --requests 32 --rate 200 \
     --log summary --fault-plan "replica_crash@fleet.tick:30?replica=0"
+# ISSUE 13 (disaggregated serving): the engine-backed 1+1 pool split on
+# real chips — prefill and decode replicas stop sharing an accelerator,
+# KV page sets move through engine.adopt_pages. Banks the chip
+# disagg-vs-unified pair for PERF.md's ISSUE 13 section (the CPU sim
+# charges both phases one tick, so the phase-asymmetry win is ONLY
+# measurable here): run the unified twin right after with identical
+# workload flags and compare tokens/s + TTFT/TPOT percentiles.
+step bench_fleet_disagg 900 python scripts/bench_fleet.py \
+    --compute engine --pools prefill:1,decode:1 --handoff-ticks 1 \
+    --requests 32 --rate 200 --log summary
+step bench_fleet_disagg_unified_twin 900 python scripts/bench_fleet.py \
+    --compute engine --replicas 2 --requests 32 --rate 200 --log summary
 # PR-5 (elasticity): the width-invariant canonical-tree step on a real
 # chip mesh — banks the elastic-vs-plain step-time ratio for PERF.md
 # (CPU-banked 2x at the reference config; TPU fusion/collective costs

@@ -1,0 +1,620 @@
+"""Disaggregated prefill/decode serving with crash-safe page-granular
+KV handoff (ISSUE 13, serve/handoff.py + serve/fleet.py).
+
+THE acceptance shapes live here:
+- a 2-pool storm with crashes (one prefill replica killed MID-HANDOFF),
+  a pool-collapse degradation, and injected transfer corruption
+  completes with zero lost/duplicated requests, finished outputs
+  exactly equal to the unified fleet's per request, run-vs-run bitwise
+  (dispatch CRC + blame CRC — the CI disagg gate re-proves this at
+  10^5 requests);
+- every transfer-integrity failure (kv_corrupt, handoff_drop, dead
+  sender, dead receiver, corrupted resume context) resolves to
+  exactly-once re-prefill — garbage is never decoded;
+- blame conservation holds with handoff_wait as its own category, and
+  the trace's phase-transition marker is ordered before the decode
+  pool's first emission.
+
+SimCompute keeps the proofs sharp (token j of rid is a closed form),
+and the engine-backed twin proves the handed-off decode — including
+through prefix sharing — is BITWISE the unified one.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.faults import FaultInjector, parse_plan, \
+    validate_plan_sites
+from mpi_cuda_cnn_tpu.obs.causal import BlameAccumulator
+from mpi_cuda_cnn_tpu.serve.fleet import (
+    Fleet,
+    SimCompute,
+    make_fleet_workload,
+    parse_pools,
+)
+from mpi_cuda_cnn_tpu.serve.handoff import (
+    context_crc,
+    context_tokens,
+    page_crcs,
+    verify_page_crcs,
+)
+
+VOCAB = 512
+POOLS = {"prefill": 2, "decode": 2}
+
+
+def expected_out(req, *, salt=0, n=None, vocab=VOCAB):
+    n = req.max_new_tokens if n is None else n
+    return [
+        ((req.rid * 1000003 + j * 2654435761 + salt * 97
+          + int(req.prompt.size) * 8191) & 0xFFFFFFFF) % vocab
+        for j in range(n)
+    ]
+
+
+def workload(n=400, rate=800.0, seed=0, **kw):
+    kw.setdefault("vocab", VOCAB)
+    kw.setdefault("prompt_min", 8)
+    kw.setdefault("prompt_max", 48)
+    kw.setdefault("out_min", 4)
+    kw.setdefault("out_max", 32)
+    return make_fleet_workload(n=n, rate=rate, seed=seed, **kw)
+
+
+def disagg_fleet(*, pools=POOLS, plan=None, seed=0, handoff_ticks=2, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("num_pages", 33)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("check_every", 8)
+    return Fleet(
+        lambda name: SimCompute(vocab=VOCAB, chunk=16, salt=seed),
+        pools=pools, handoff_ticks=handoff_ticks,
+        faults=FaultInjector(plan) if plan else None,
+        **kw,
+    )
+
+
+def unified_fleet(*, replicas=4, plan=None, seed=0, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("num_pages", 33)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("check_every", 8)
+    return Fleet(
+        lambda name: SimCompute(vocab=VOCAB, chunk=16, salt=seed),
+        replicas=replicas,
+        faults=FaultInjector(plan) if plan else None,
+        **kw,
+    )
+
+
+# The acceptance fault plan: a prefill replica (r0) killed while
+# transfers are in flight, a decode-pool collapse (both decode
+# replicas), and an elastic decode join. handoff_ticks=2 keeps a crash
+# window open on every transfer.
+CRASH_PLAN = ("replica_crash@fleet.tick:40?replica=0&zombie_ticks=4;"
+              "pool_crash@fleet.tick:120?pool=decode;"
+              "replica_join@fleet.tick:200?pool=decode")
+
+
+# ------------------------------------------------------- protocol unit
+
+
+def test_parse_pools_grammar():
+    assert parse_pools("prefill:2,decode:3") == {"prefill": 2, "decode": 3}
+    for bad in ("prefill:2", "decode:1", "prefill:0,decode:1",
+                "prefill:2,decode:1,prefill:1", "warmup:1,decode:1",
+                "prefill,decode:1"):
+        with pytest.raises(ValueError):
+            parse_pools(bad)
+
+
+def test_page_crcs_cover_exactly_the_cached_rows():
+    """The integrity stamp is a pure function of the token ids whose KV
+    rows each page holds — rows 0..cached-1 only (the in-flight token
+    is not yet a cache row), page-granular, order-sensitive."""
+    prompt = np.arange(10, dtype=np.int32)
+    toks = context_tokens(prompt, [99, 98])
+    crcs = page_crcs(toks, cached=11, page_size=4)
+    assert len(crcs) == 3  # ceil(11 / 4)
+    assert verify_page_crcs(crcs, toks, 11, 4)
+    # The un-cached tail token is outside the stamp.
+    assert crcs == page_crcs(context_tokens(prompt, [99, 77]), 11, 4)
+    # Any cached-row change, page order change, or stamp flip refuses.
+    other = context_tokens(np.arange(1, 11, dtype=np.int32), [99, 98])
+    assert not verify_page_crcs(crcs, other, 11, 4)
+    assert not verify_page_crcs(list(reversed(crcs)), toks, 11, 4)
+    assert not verify_page_crcs([crcs[0] ^ 1, *crcs[1:]], toks, 11, 4)
+    assert not verify_page_crcs(crcs[:-1], toks, 11, 4)
+    assert context_crc(prompt, [99, 98]) != context_crc(prompt, [99, 97])
+
+
+def test_slo_scheduler_owns_decode_pool_admission():
+    """Each pool's SLOScheduler owns its own admission: the decode
+    side's transfer binding enforces the tenant slot quota exactly as
+    the prefill side's admit() does (ISSUE 13 — TTFT and TPOT budgets
+    no longer share one gate)."""
+    from mpi_cuda_cnn_tpu.serve.pool import PagePool
+    from mpi_cuda_cnn_tpu.serve.scheduler import (
+        Request,
+        SLOPolicy,
+        SLOScheduler,
+    )
+
+    pool = PagePool(16)
+    sched = SLOScheduler(policy=SLOPolicy(slot_quota={"t0": 1}),
+                         slots=3, pool=pool, page_size=4, max_len=32)
+    r0 = Request(rid=0, prompt=np.arange(4), max_new_tokens=4, tenant="t0")
+    r1 = Request(rid=1, prompt=np.arange(4), max_new_tokens=4, tenant="t0")
+    r2 = Request(rid=2, prompt=np.arange(4), max_new_tokens=4, tenant="t1")
+    owner = ("handoff", 0, 0)
+    pages = pool.try_alloc(2, owner)
+    assert sched.bind_transfer(r0, pages, cached=5, owner=owner,
+                               now=0.0) is not None
+    # Same tenant at quota: the transfer waits (bind refuses, nothing
+    # changes); another tenant's transfer is unaffected.
+    assert not sched.transfer_quota_ok(r1)
+    pages1 = pool.try_alloc(2, ("handoff", 1, 1))
+    assert sched.bind_transfer(r1, pages1, cached=5,
+                               owner=("handoff", 1, 1), now=0.0) is None
+    assert sched.transfer_quota_ok(r2)
+    pool.free(pages1, ("handoff", 1, 1))
+    sched.check()
+
+
+# ------------------------------------------------- the storm acceptance
+
+
+def test_disagg_storm_deterministic_and_outputs_equal_unified():
+    """THE acceptance at tier-1 size: the 2-pool storm with a prefill
+    replica killed mid-handoff, a decode-pool collapse, and a join
+    completes every request; two identical-seed runs are BITWISE equal
+    (dispatch trace, outputs, handoff/degradation counters); and every
+    finished output equals the UNIFIED fleet's for the same workload —
+    the split changes the schedule, never the tokens."""
+    results = []
+    for _ in range(2):
+        res = disagg_fleet(plan=CRASH_PLAN).run(workload())
+        assert all(r.terminal for r in res.requests)
+        assert res.handoffs > 0 and res.crashes >= 3
+        results.append(res)
+    a, b = results
+    assert a.dispatch_trace == b.dispatch_trace
+    assert a.trace_crc == b.trace_crc and a.ticks == b.ticks
+    assert a.outputs() == b.outputs()
+    assert a.status_counts() == b.status_counts()
+    assert (a.handoffs, a.handoffs_aborted, a.kv_refusals,
+            a.degraded_unified) == (b.handoffs, b.handoffs_aborted,
+                                    b.kv_refusals, b.degraded_unified)
+    unified = unified_fleet().run(workload())
+    outs_d, outs_u = a.outputs(), unified.outputs()
+    for rid, out in outs_u.items():
+        assert outs_d[rid] == out, f"request {rid}"
+    # Zero double generation anywhere: the closed form is exact.
+    for r in a.finished_requests():
+        assert r.out == expected_out(r), f"request {r.rid}"
+        assert len(r.out) == r.max_new_tokens
+
+
+def test_prefill_replica_crash_mid_handoff_reprefills_exactly_once():
+    """Sender dies with transfers in flight: the receiver's partial
+    adoption is revoked (its pool stays clean — end-of-run check), the
+    stranded requests re-prefill elsewhere exactly once, and no token
+    is lost or doubled."""
+    fleet = disagg_fleet(
+        plan="replica_crash@fleet.tick:40?replica=0", handoff_ticks=5)
+    res = fleet.run(workload())
+    dead = [r for r in res.handoff_log
+            if r["state"] == "aborted" and r["reason"] == "sender_dead"]
+    assert dead, "no handoff was in flight at the crash — widen the window"
+    assert res.handoffs_aborted >= len(dead)
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r), f"request {r.rid}"
+    # Exactly-once: an aborted handoff's rid re-dispatches once per
+    # abort, never twice for one abort event.
+    redis = [rid for (_, rid, _, _, kind) in res.dispatch_trace
+             if kind == "redispatch"]
+    aborted_rids = [r["rid"] for r in res.handoff_log
+                    if r["state"] == "aborted"]
+    for rid in set(aborted_rids):
+        assert redis.count(rid) >= aborted_rids.count(rid)
+
+
+def test_decode_replica_crash_mid_handoff_releases_sender():
+    """Receiver dies mid-copy: the sender's sealed pages are released
+    (its pool proves clean at exit) and the router re-targets through
+    the re-dispatch path — outputs stay exact."""
+    fleet = disagg_fleet(
+        pools={"prefill": 2, "decode": 1},
+        plan="replica_crash@fleet.tick:20?replica=2", handoff_ticks=8)
+    res = fleet.run(workload(n=250))
+    dead = [r for r in res.handoff_log
+            if r["state"] == "aborted" and r["reason"] == "receiver_dead"]
+    assert dead, "no handoff targeted the receiver at its crash"
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r), f"request {r.rid}"
+
+
+def test_kv_corrupt_handoff_is_refused_never_decoded():
+    """A corrupted page fails CRC verification at adoption: the
+    transfer is refused, the request re-prefills, and the final output
+    is still the exact closed form — garbage never decodes."""
+    plan = ("kv_corrupt@fleet.handoff:2?page=0;"
+            "kv_corrupt@fleet.handoff:7")
+    res = disagg_fleet(plan=plan).run(workload())
+    assert res.kv_refusals == 2
+    refused = [r for r in res.handoff_log
+               if r["state"] == "aborted" and r["reason"] == "kv_corrupt"]
+    assert len(refused) == 2
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r), f"request {r.rid}"
+    # The refused rids finished anyway (re-prefilled elsewhere).
+    for rec in refused:
+        req = next(r for r in res.requests if r.rid == rec["rid"])
+        assert req.status == "finished"
+
+
+def test_handoff_drop_resolves_exactly_once():
+    res = disagg_fleet(
+        plan="handoff_drop@fleet.handoff:1").run(workload(n=200))
+    dropped = [r for r in res.handoff_log
+               if r["state"] == "aborted" and r["reason"] == "dropped"]
+    assert len(dropped) == 1
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r)
+
+
+def test_pool_collapse_degrades_to_unified_and_restores():
+    """The decode pool emptying flips affected requests to unified
+    serving (prefill replicas decode locally) instead of stalling —
+    with degraded/restored obs events latched once per episode — and
+    the fleet keeps completing requests throughout."""
+    fleet = disagg_fleet(
+        plan="pool_crash@fleet.tick:60?pool=decode",
+        backoff_base=0.05)
+    res = fleet.run(workload())
+    assert res.degraded_unified > 0
+    kinds = [(e["name"], e["kind"]) for e in res.replica_log
+             if e["kind"] in ("degraded", "restored")]
+    assert ("decode", "degraded") in kinds
+    assert ("decode", "restored") in kinds  # restarts repopulated it
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r)
+
+
+def test_prefill_pool_collapse_dispatches_unified():
+    """The PREFILL pool emptying degrades new dispatches onto decode
+    replicas, which serve them end to end (no handoff)."""
+    fleet = disagg_fleet(
+        pools={"prefill": 1, "decode": 2},
+        plan="pool_crash@fleet.tick:30?pool=prefill",
+        backoff_base=1.0)  # slow restart: the degradation window is wide
+    res = fleet.run(workload(n=250))
+    assert res.degraded_unified > 0
+    assert ("prefill", "degraded") in [
+        (e["name"], e["kind"]) for e in res.replica_log
+        if e["kind"] == "degraded"]
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r)
+
+
+def test_resume_context_crc_refuses_corrupt_committed_tokens():
+    """The failover resume path now verifies the committed context it
+    re-prefills (it used to re-adopt it unchecked): an injected
+    kv_corrupt@fleet.resume forces the fallback to discard semantics —
+    the tokens regenerate from the prompt and the final output is still
+    exact."""
+    plan = ("replica_crash@fleet.tick:40?replica=1;"
+            "kv_corrupt@fleet.resume:0")
+    res = unified_fleet(plan=plan).run(workload())
+    assert res.kv_refusals == 1
+    assert any(e["kind"] == "resume_refused" for e in res.events)
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r), f"request {r.rid}"
+
+
+def test_cancel_mid_handoff_aborts_and_terminates():
+    """A client cancel landing while the rid's KV is in flight aborts
+    the transfer (both ends released) and the cancel rides the
+    re-dispatch to a terminal 'cancelled' status."""
+    fleet = disagg_fleet(handoff_ticks=8)
+    reqs = workload(n=60, rate=300.0)
+    target = {}
+
+    def fleet_sink(rec):
+        # Cancel the first rid whose handoff starts, the moment the
+        # marker appears (sinks run mid-loop — the supported surface).
+        if not target and rec.get("handoff_started"):
+            rid = rec["handoff_started"][0][0]
+            target["rid"] = rid
+            fleet.cancel(rid)
+
+    fleet.fleet_sink = fleet_sink
+    res = fleet.run(reqs)
+    assert target, "no handoff ever started"
+    req = next(r for r in res.requests if r.rid == target["rid"])
+    assert req.status == "cancelled"
+    cancelled = [r for r in res.handoff_log
+                 if r["state"] == "aborted" and r["reason"] == "cancelled"]
+    assert cancelled and cancelled[0]["rid"] == target["rid"]
+    assert all(r.terminal for r in res.requests)
+
+
+def test_disagg_storm_100k_scale():
+    """The full 10^5-request acceptance storm (CI runs the same shape
+    twice through `mctpu fleet-bench` + `mctpu compare ci/disagg_gate`):
+    2 pools, a prefill replica killed mid-handoff, a decode-pool
+    collapse, a join — all terminal, zero lost/double tokens at scale,
+    outputs equal to the unified fleet's."""
+    plan = ("replica_crash@fleet.tick:4000?replica=0&zombie_ticks=4;"
+            "pool_crash@fleet.tick:12000?pool=decode;"
+            "replica_join@fleet.tick:20000?pool=decode")
+    res = disagg_fleet(pools={"prefill": 2, "decode": 2}, slots=8,
+                       plan=plan, check_every=256,
+                       ).run(workload(n=100_000, rate=2000.0))
+    assert len(res.requests) == 100_000
+    assert all(r.terminal for r in res.requests)
+    assert res.handoffs > 0 and res.handoffs_aborted > 0
+    assert any(r["reason"] == "sender_dead" for r in res.handoff_log
+               if r["state"] == "aborted"), "crash missed the window"
+    assert res.degraded_unified > 0
+    for r in res.finished_requests():
+        assert r.out == expected_out(r)
+    unified = unified_fleet(replicas=4, slots=8,
+                            check_every=256).run(
+        workload(n=100_000, rate=2000.0))
+    outs_d, outs_u = res.outputs(), unified.outputs()
+    for rid, out in outs_u.items():
+        assert outs_d[rid] == out
+
+
+# ------------------------------------------------------- obs round trip
+
+
+def test_blame_handoff_wait_conserved():
+    """`mctpu explain`'s new category: handoff wait is billed as its
+    own blame with conservation preserved — every terminal request's
+    categories still sum bitwise to its tick span through handoffs,
+    aborts, crashes, and degradation."""
+    acc = BlameAccumulator(detail=True)
+    fleet = disagg_fleet(plan=CRASH_PLAN,
+                         fleet_sink=acc.ingest_fleet,
+                         replica_tick_sink=acc.ingest_tick)
+    res = fleet.run(workload())
+    assert acc.check("fleet") == []
+    blames = acc.blames()["fleet"]
+    assert len(blames) == len(res.requests)
+    for b in blames.values():
+        assert b.terminal and b.conserved
+    totals = acc.summary_fields("fleet")["categories"]
+    assert totals["handoff_wait"] > 0
+    # Handed-off requests carry handoff_wait; aborted ones also replay.
+    handed = {r["rid"] for r in res.handoff_log if r["state"] == "done"}
+    assert any(blames[rid].cats["handoff_wait"] > 0 for rid in handed)
+    aborted = {r["rid"] for r in res.handoff_log
+               if r["state"] == "aborted"}
+    assert any(blames[rid].cats["redispatch_replay"] > 0
+               for rid in aborted)
+
+
+def test_trace_marker_ordered_before_decode_pool_emission():
+    """The fleet emits its record (with the handoff_done marker) before
+    stepping replicas, so in the record stream the phase transition
+    precedes the decode pool's first emission for the rid — the
+    ordering `mctpu trace` anchors the lifecycle on."""
+    records = []
+    fleet = disagg_fleet(
+        fleet_sink=lambda r: records.append({"event": "fleet", **r}),
+        replica_tick_sink=lambda r: records.append({"event": "tick", **r}),
+    )
+    res = fleet.run(workload(n=80, rate=300.0))
+    assert res.handoffs > 0
+    done_idx = {}
+    for i, rec in enumerate(records):
+        if rec["event"] == "fleet":
+            for rid, _dst in rec.get("handoff_done") or []:
+                done_idx.setdefault(rid, i)
+    assert done_idx
+    dst_of = {r["rid"]: r["dst"] for r in res.handoff_log
+              if r["state"] == "done"}
+    checked = 0
+    for i, rec in enumerate(records):
+        if rec["event"] != "tick":
+            continue
+        for _slot, rid in rec.get("decoded") or []:
+            if rid in done_idx and \
+                    rec["mode"] == f"fleet/{dst_of[rid]}":
+                assert done_idx[rid] < i, f"rid {rid}"
+                done_idx.pop(rid)
+                checked += 1
+    assert checked > 0
+
+
+def test_fleet_bench_cli_disagg_e2e_trace_explain_and_gate(tmp_path):
+    """`mctpu fleet-bench --pools` -> trace -> explain -> compare round
+    trip: the disagg run's telemetry reconstructs consistently across
+    the handoff, blame conserves, and two identical-seed runs pass the
+    CI disagg gate (exact equality on the handoff / degradation / blame
+    counters) while a different seed fails it."""
+    import os
+
+    from mpi_cuda_cnn_tpu.obs.causal import explain_main
+    from mpi_cuda_cnn_tpu.obs.regress import compare_main
+    from mpi_cuda_cnn_tpu.obs.timeline import trace_main
+    from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main
+
+    args = ["--pools", "prefill:2,decode:2", "--handoff-ticks", "2",
+            "--requests", "80", "--rate", "500",
+            "--fault-plan",
+            "replica_crash@fleet.tick:30?replica=0&zombie_ticks=2",
+            "--seed", "3"]
+    runs = []
+    for tag in ("a", "b"):
+        path = str(tmp_path / f"disagg_{tag}.jsonl")
+        assert fleet_bench_main([*args, "--metrics-jsonl", path]) == 0
+        runs.append(path)
+    assert trace_main([runs[0]]) == 0
+    assert explain_main([runs[0]]) == 0
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gate = os.path.join(repo, "ci", "disagg_gate.json")
+    assert compare_main([*runs, "--gate", gate]) == 0
+
+    drifted = str(tmp_path / "disagg_c.jsonl")
+    assert fleet_bench_main([*args[:-1], "4",
+                             "--metrics-jsonl", drifted]) == 0
+    assert compare_main([runs[0], drifted, "--gate", gate]) == 1
+
+
+def test_fleet_bench_cli_rejects_bad_pools_and_sites():
+    from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main
+
+    assert fleet_bench_main(["--pools", "prefill:2"]) == 2
+    with pytest.raises(SystemExit) as exc:
+        fleet_bench_main(["--fault-plan", "handoff_drop@serve.tick:3"])
+    assert exc.value.code == 2
+    # The new kinds/sites validate per surface: fleet-bench accepts
+    # them, serve-bench does not; raising kinds are not registered at
+    # the polled sites (they would be inert there).
+    plan = parse_plan("handoff_drop@fleet.handoff:1;"
+                      "kv_corrupt@fleet.handoff:2?page=1;"
+                      "kv_corrupt@fleet.resume:0;"
+                      "pool_crash@fleet.tick:5?pool=decode")
+    validate_plan_sites(plan, "fleet-bench")
+    with pytest.raises(ValueError):
+        validate_plan_sites(plan, "serve-bench")
+    with pytest.raises(ValueError):
+        validate_plan_sites(parse_plan("crash@fleet.handoff:1"),
+                            "fleet-bench")
+    with pytest.raises(ValueError):
+        validate_plan_sites(parse_plan("handoff_drop@fleet.resume:1"),
+                            "fleet-bench")
+
+
+def test_pool_crash_on_unified_fleet_errors_loudly():
+    """The inert-fault contract: pool-scoped faults on a fleet with no
+    pools must raise at fire time, never silently no-op."""
+    fleet = unified_fleet(plan="pool_crash@fleet.tick:5?pool=decode")
+    with pytest.raises(ValueError, match="disaggregated"):
+        fleet.run(workload(n=40))
+
+
+def test_handoff_faults_on_unified_fleet_refused_at_construction():
+    """fleet.handoff/fleet.resume are POLLED sites that only a pooled
+    fleet reaches: a unified fleet must refuse such a plan up front
+    (the silent-never-fires class the SITES validator exists for),
+    both at the library layer and through the CLI."""
+    from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main
+
+    with pytest.raises(ValueError, match="silently never fire"):
+        unified_fleet(plan="handoff_drop@fleet.handoff:0")
+    with pytest.raises(ValueError, match="silently never fire"):
+        unified_fleet(plan="kv_corrupt@fleet.handoff:0?page=1")
+    assert fleet_bench_main(["--requests", "8", "--fault-plan",
+                             "handoff_drop@fleet.handoff:0"]) == 1
+    # fleet.resume stays legal on a unified fleet — failover resume
+    # re-dispatches exist there (the backfill satellite's own test
+    # drives it); only the never-reached handoff site is refused.
+    unified_fleet(plan="kv_corrupt@fleet.resume:0")
+    # ... but NOT under discard re-dispatch, which carries no
+    # committed context to corrupt: refused up front, same contract.
+    with pytest.raises(ValueError, match="silently never fire"):
+        unified_fleet(plan="kv_corrupt@fleet.resume:0",
+                      redispatch="discard")
+    # --handoff-ticks without --pools would be silently ignored:
+    # loud config error instead.
+    assert fleet_bench_main(["--requests", "8",
+                             "--handoff-ticks", "3"]) == 2
+
+
+def test_degraded_unified_counts_unique_requests():
+    """A request that degrades repeatedly (handoff aborted for an
+    empty decode pool, then degraded again when its re-prefill
+    completes against the still-empty pool) counts ONCE — the summary
+    key means 'requests served unified', not 'degradation events'."""
+    fleet = disagg_fleet(
+        pools={"prefill": 2, "decode": 1},
+        plan="replica_crash@fleet.tick:30?replica=2",
+        handoff_ticks=4, backoff_base=2.0)  # long decode outage
+    res = fleet.run(workload(n=200))
+    assert res.degraded_unified > 0
+    assert res.degraded_unified <= len(res.requests)
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r)
+
+
+def test_disagg_summary_and_handoff_records_schema():
+    from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
+
+    res = disagg_fleet(plan=CRASH_PLAN).run(workload(n=150))
+    s = json.loads(json.dumps(res.summary()))
+    assert s["handoffs"] == res.handoffs > 0
+    assert s["pools"] == {"prefill": 2, "decode": 2}
+    for key in ("handoff_pages", "handoffs_aborted", "kv_refusals",
+                "degraded_unified"):
+        assert key in s
+    for rec in res.handoff_log:
+        validate_record(make_record("handoff", 0.0, **rec))
+    # A unified fleet stamps the same keys as zeros (the gate contract:
+    # every gated metric exists in every fleet-bench run).
+    u = unified_fleet().run(workload(n=50))
+    su = u.summary()
+    assert su["handoffs"] == 0 and su["kv_refusals"] == 0
+    assert "pools" not in su
+
+
+# ------------------------------------------------- engine-backed parity
+
+
+@pytest.mark.parametrize("prefix", [False, True])
+def test_engine_disagg_outputs_match_unified_through_handoff(prefix):
+    """The model-backed twin (one PagedEngine per replica, shared
+    weights): KV pages handed prefill->decode through the cross-engine
+    page copy decode to BITWISE the same tokens as the unified fleet —
+    with prefix sharing on, the parity holds THROUGH a handoff whose
+    block table leads with shared tree pages (the handoff-interleaved
+    sharing case)."""
+    import jax
+
+    from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+    from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+    from mpi_cuda_cnn_tpu.serve.fleet import EngineCompute
+
+    model = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
+    params = model.init(jax.random.key(0))
+    geom = dict(slots=2, num_pages=17, page_size=4, max_len=48)
+
+    def reqs():
+        return make_fleet_workload(n=14, vocab=13, prompt_min=6,
+                                   prompt_max=12, out_min=4, out_max=10,
+                                   rate=300.0, seed=3,
+                                   prefix_mix=0.7 if prefix else 0.0)
+
+    def factory(name):
+        return EngineCompute(PagedEngine(model, params, prefill_chunk=8,
+                                         **geom))
+
+    disagg = Fleet(factory, pools={"prefill": 1, "decode": 1},
+                   handoff_ticks=2, prefix=prefix, **geom).run(reqs())
+    unified = Fleet(factory, replicas=2, prefix=prefix,
+                    **geom).run(reqs())
+    assert disagg.handoffs > 0
+    assert disagg.status_counts() == {"finished": 14}
+    assert disagg.outputs() == unified.outputs()
+    if prefix:
+        assert disagg.prefix["prefix_hits"] > 0
+        # Sharing on vs off stays bitwise THROUGH the handoff.
+        plain = Fleet(factory, pools={"prefill": 1, "decode": 1},
+                      handoff_ticks=2, prefix=False, **geom).run(reqs())
+        assert plain.outputs() == disagg.outputs()

@@ -188,17 +188,23 @@ def test_scheduler_admit_finish_preempt_keep_pool_consistent():
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
 def test_pagepool_randomized_op_sequence_invariant(dtype):
     """Seeded randomized-sequence invariant (ISSUE 7 satellite,
-    extended for ISSUE 9): a few hundred random admit / prefill-chunk /
-    decode-growth / preempt / cancel / expire operations — now
-    interleaved with prefix-cache share / acquire / COW / insert /
-    LRU-evict / release traffic (half the prompts draw from a shared
-    template pool, a reclaim op squeezes retained pages out) — against
-    a real PagedEngine cache in each storage dtype, with the extended
+    extended for ISSUE 9 and again for ISSUE 13): a few hundred random
+    admit / prefill-chunk / decode-growth / preempt / cancel / expire
+    operations — interleaved with prefix-cache share / acquire / COW /
+    insert / LRU-evict / release traffic (half the prompts draw from a
+    shared template pool, a reclaim op squeezes retained pages out)
+    AND with cross-pool KV-handoff traffic against a SECOND
+    engine+pool+scheduler (detach-for-handoff seals pages under the
+    transfer token, the receiver adopts via the cross-engine page copy
+    and binds decode-ready, and a random half of the transfers are
+    REVOKED mid-flight instead — both ends released) — against real
+    PagedEngine caches in each storage dtype, with the extended
     sched.check() (pool no-leak / no-double-book / scratch-never-
     circulates PLUS refcount conservation and no-writable-shared-page)
-    after EVERY step. The fleet's re-dispatch path (serve/fleet.py)
-    drives this exact scheduler+pool+prefix triple per replica, so it
-    inherits the guarantee."""
+    on BOTH pools after EVERY step. The fleet's re-dispatch and
+    disaggregated-handoff paths (serve/fleet.py) drive these exact
+    scheduler+pool+prefix triples per replica, so they inherit the
+    guarantee."""
     from mpi_cuda_cnn_tpu.serve.prefix_cache import PrefixCache
 
     params = MODEL.init(jax.random.key(2))
@@ -210,6 +216,18 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     prefix = PrefixCache(pool, page_size=4)
     sched = ContinuousScheduler(slots=3, pool=pool, page_size=4, max_len=32,
                                 prefix=prefix)
+    # The decode-side twin (ISSUE 13): its own engine/pool/scheduler —
+    # handed-off requests decode (and, after a preemption there,
+    # re-prefill) on this pair.
+    engine_b = PagedEngine(MODEL, params, slots=3, num_pages=10,
+                           page_size=4, prefill_chunk=4, max_len=32,
+                           cache_dtype=dtype)
+    pool_b = PagePool(10)
+    sched_b = ContinuousScheduler(slots=3, pool=pool_b, page_size=4,
+                                  max_len=32,
+                                  prefix=PrefixCache(pool_b, page_size=4))
+    transfers = {"done": 0, "revoked": 0}
+    next_hid = [0]
     rng = np.random.default_rng(11)
     # Shared template prompts: same-template requests exercise full-page
     # acquire; divergent suffixes at non-page-aligned depths hit COW.
@@ -239,31 +257,33 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
         submitted.append(req)
         sched.submit([req])
 
-    def prefill_step():
-        slot = sched.prefill_slot()
+    def prefill_step(sc=None, en=None):
+        sc, en = sc or sched, en or engine
+        slot = sc.prefill_slot()
         if slot is None:
             return
         if slot.cow is not None:
-            engine.copy_page(*slot.cow)
-            sched.cow_complete(slot)
-        n, nxt = engine.run_prefill_chunk(slot)
+            en.copy_page(*slot.cow)
+            sc.cow_complete(slot)
+        n, nxt = en.run_prefill_chunk(slot)
         slot.cached += n
         if slot.cached >= slot.target:
-            sched.note_prefill_complete(slot)
+            sc.note_prefill_complete(slot)
             slot.req.out.append(int(nxt))
             if slot.req.done:
-                sched.finish(slot, now)
+                sc.finish(slot, now)
 
-    def decode_step_op():
-        dslots = sched.grow_for_decode(now)
+    def decode_step_op(sc=None, en=None):
+        sc, en = sc or sched, en or engine
+        dslots = sc.grow_for_decode(now)
         if not dslots:
             return
-        toks = engine.run_decode_tick(dslots)
+        toks = en.run_decode_tick(dslots)
         for s in dslots:
             s.cached += 1
             s.req.out.append(int(toks[s.idx]))
             if s.req.done:
-                sched.finish(s, now)
+                sc.finish(s, now)
 
     def preempt_op():
         bound = [s for s in sched.slots if not s.free]
@@ -275,32 +295,85 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
         if live:
             live[int(rng.integers(len(live)))].cancel()
             sched.sweep(now)
+            sched_b.sweep(now)
 
     def reclaim_op():
         # The squeeze/pressure path: evict up to 2 LRU refcount-0
         # prefix pages (never a referenced one — free() would raise).
         prefix.reclaim(int(rng.integers(1, 3)))
 
+    def handoff_op():
+        # Cross-pool transfer (ISSUE 13): seal a decoding slot's page
+        # set off scheduler A under the handoff token, then either
+        # adopt it into B (cross-engine page copy + decode-ready bind)
+        # or REVOKE the transfer mid-flight — both ends released, the
+        # request requeued at A's head (the abort-re-prefill path).
+        cands = [s for s in sched.slots
+                 if s.decoding and not s.req.terminal and s.cow is None]
+        if not cands:
+            return
+        slot = cands[int(rng.integers(len(cands)))]
+        req, cached = slot.req, slot.cached
+        owner = ("handoff", req.rid, next_hid[0])
+        next_hid[0] += 1
+        pages, private, nodes = sched.detach_for_handoff(slot, owner)
+        dst = pool_b.try_alloc(len(pages), owner)
+        if dst is None or rng.random() < 0.5:
+            # Revoked (receiver dry, dropped, or CRC-refused): release
+            # both ends, requeue for re-prefill on A.
+            if dst is not None:
+                pool_b.free(dst, owner)
+            sched.release_handoff(private, nodes, owner)
+            req.status = "queued"
+            sched.queue.appendleft(req)
+            transfers["revoked"] += 1
+            return
+        engine_b.adopt_pages(engine, pages, dst)
+        bound = sched_b.bind_transfer(req, dst, cached, owner, now)
+        if bound is None:
+            # No free receiver slot: treat as a revoke (the fleet
+            # would keep waiting; the invariant walk releases).
+            pool_b.free(dst, owner)
+            sched.release_handoff(private, nodes, owner)
+            req.status = "queued"
+            sched.queue.appendleft(req)
+            transfers["revoked"] += 1
+            return
+        sched.release_handoff(private, nodes, owner)
+        transfers["done"] += 1
+
+    def check_both():
+        sched.check()
+        sched_b.check()
+
     ops = [submit_one, lambda: sched.admit(now), prefill_step,
            decode_step_op, preempt_op, cancel_op,
-           lambda: sched.sweep(now), reclaim_op]
-    weights = np.array([0.22, 0.18, 0.2, 0.18, 0.08, 0.05, 0.05, 0.04])
+           lambda: sched.sweep(now), reclaim_op, handoff_op,
+           lambda: decode_step_op(sched_b, engine_b),
+           lambda: sched_b.admit(now),
+           lambda: prefill_step(sched_b, engine_b)]
+    weights = np.array([0.18, 0.14, 0.16, 0.12, 0.06, 0.04, 0.04, 0.03,
+                        0.09, 0.08, 0.03, 0.03])
     for _ in range(300):
         now += float(rng.uniform(0.0, 0.02))  # deadlines really expire
         ops[int(rng.choice(len(ops), p=weights))]()
-        sched.check()
-    # Drain: the surviving work must complete and hand every page back.
-    while sched.unfinished:
-        sched.sweep(now)
-        sched.admit(now)
-        prefill_step()
-        decode_step_op()
-        sched.check()
+        check_both()
+    # Drain BOTH schedulers: the surviving work must complete and hand
+    # every page of both pools back.
+    while sched.unfinished or sched_b.unfinished:
+        for sc, en in ((sched, engine), (sched_b, engine_b)):
+            sc.sweep(now)
+            sc.admit(now)
+            prefill_step(sc, en)
+            decode_step_op(sc, en)
+        check_both()
         now += 0.01
     assert all(r.terminal for r in submitted)
     prefix.clear()   # retained LRU pages hand back at teardown
-    sched.check()
+    sched_b.prefix.clear()
+    check_both()
     assert pool.free_pages == pool.usable
+    assert pool_b.free_pages == pool_b.usable
     # The randomized walk must have exercised the interesting paths —
     # including the whole ISSUE 9 surface.
     assert sched.preemptions > 0
@@ -311,6 +384,10 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     assert prefix.stats["cow_copies"] > 0
     assert prefix.stats["inserts"] > 0
     assert prefix.stats["evictions"] > 0
+    # The cross-pool surface (ISSUE 13): both the adopt and the revoke
+    # legs of the transfer protocol ran.
+    assert transfers["done"] > 0
+    assert transfers["revoked"] > 0
 
 
 def test_engine_preemption_recovers_and_completes():
