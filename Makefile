@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative bench_serve bench_fleet serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve bench_serve_spec bench_fleet serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -139,6 +139,14 @@ bench_speculative:
 # (scripts/bench_serve.py == `mctpu serve-bench`).
 bench_serve:
 	$(PY) scripts/bench_serve.py
+
+# Speculative serving (ISSUE 14): the spec-on/off tick-count pair on
+# template traffic — per-slot prompt-lookup proposal + one batched
+# verify per tick; outputs bitwise-equal, ticks drop with acceptance.
+bench_serve_spec:
+	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9 \
+	  --spec lookup --spec-k 8
+	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9
 
 # Fleet storm benchmark: N replicas behind the failure-aware router,
 # seeded Poisson arrivals, optional injected replica crashes/joins

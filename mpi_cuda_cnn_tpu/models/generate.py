@@ -505,7 +505,10 @@ def _accept_and_emit(u, y, out, n_out):
     and prompt-lookup runners so the two can never drift: u (1, k)
     verify inputs, y (1, k) target argmax picks. Accept the longest
     prefix where input i+1 equals the target's pick at row i (j in
-    [1, k] tokens emitted per round)."""
+    [1, k] tokens emitted per round). The serving engine's batched
+    form (ISSUE 14) applies the SAME law host-side per slot —
+    serve/spec.accept_len — and a randomized equivalence test
+    (tests/test_spec_serve.py) pins the two dialects against drift."""
     matches = u[0, 1:] == y[0, :-1]
     return _emit_rows(y, matches, out, n_out)
 

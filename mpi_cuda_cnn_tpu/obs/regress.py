@@ -111,7 +111,12 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                # category — the disagg determinism gate pins them at
                # exact equality (zeros on a unified fleet).
                "blame_handoff_wait", "handoffs", "handoff_pages",
-               "handoffs_aborted", "kv_refusals", "degraded_unified")
+               "handoffs_aborted", "kv_refusals", "degraded_unified",
+               # Batched speculative decoding (ISSUE 14): rounds run,
+               # draft tokens proposed/accepted — the fleet/spec
+               # determinism gates pin them at exact equality (zeros
+               # on a spec-off run).
+               "spec_rounds", "spec_proposed", "spec_accepted")
 
 # Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
 # summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to

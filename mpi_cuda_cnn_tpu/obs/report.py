@@ -310,7 +310,8 @@ def summarize(records: Iterable[dict], *,
               "watchdog_slow_ticks", "tokens_per_s",
               "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms",
               "prefix_hits", "prefix_misses", "prefix_hit_tokens",
-              "prefix_cow", "prefix_evictions")}
+              "prefix_cow", "prefix_evictions",
+              "spec_rounds", "spec_proposed", "spec_accepted")}
             for r in serves
         ]
 
@@ -567,15 +568,22 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
     if "serve" in summary:
         lines += [
             "| serve run | requests | tokens/s | decode ticks "
-            "| prefill chunks | preempt | TTFT p99 ms | tok p99 ms |",
-            "|---|---|---|---|---|---|---|---|",
+            "| prefill chunks | preempt | TTFT p99 ms | tok p99 ms "
+            "| spec accept |",
+            "|---|---|---|---|---|---|---|---|---|",
         ]
         for s in summary["serve"]:
+            # Speculative acceptance rate (ISSUE 14): accepted draft
+            # tokens / proposed, em-dash on spec-off runs.
+            prop = s.get("spec_proposed") or 0
+            acc = (f"{100.0 * (s.get('spec_accepted') or 0) / prop:.1f}%"
+                   if prop else "—")
             lines.append(
                 f"| {s['mode']} | {_fmt(s['requests'])} "
                 f"| {_fmt(s['tokens_per_s'])} | {_fmt(s['decode_ticks'])} "
                 f"| {_fmt(s['prefill_chunks'])} | {_fmt(s['preemptions'])} "
-                f"| {_fmt(s['ttft_p99_ms'])} | {_fmt(s['tpot_p99_ms'])} |"
+                f"| {_fmt(s['ttft_p99_ms'])} | {_fmt(s['tpot_p99_ms'])} "
+                f"| {acc} |"
             )
         lines.append("")
         # Prefix-cache table (ISSUE 9): only for runs that did any

@@ -127,7 +127,11 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # failed, reason "pages"/"slots"/"quota", holders the occupying
     # rids: the blocker edges `mctpu explain` blames queue waits on),
     # and "preempted_for" ([[victim, beneficiary]] — whose page need
-    # forced each eviction).
+    # forced each eviction). Speculative runs (ISSUE 14) carry "spec"
+    # ([[rid, proposed, accepted]] per slot round — a spec decode tick
+    # commits 1 + accepted tokens for its rid, which is how `mctpu
+    # trace` keeps the token cross-check exact under variable-length
+    # commits).
     "tick": ("tick", "now", "queue", "free_pages"),
     # One benchmark headline (bench.py, scripts/bench_decode.py,
     # scripts/bench_speculative.py): "metric" names the measured

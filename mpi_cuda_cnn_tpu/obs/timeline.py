@@ -59,6 +59,12 @@ class Lifecycle:
     handoffs: int = 0
     prefix_hits: int = 0
     prefix_hit_tokens: int = 0
+    # Speculative decoding (ISSUE 14): rounds this request ran and
+    # draft tokens its target accepted — a spec round's decode event
+    # carries [slot, emitted] detail instead of the bare slot, which is
+    # what keeps tokens_accounted exact under variable-length commits.
+    spec_rounds: int = 0
+    spec_accepted: int = 0
     derived_status: str | None = None
     terminal_now: float | None = None
     # Milliseconds spent per state, summed across segments.
@@ -68,17 +74,21 @@ class Lifecycle:
     def tokens_accounted(self) -> int:
         """Tokens the tick trail accounts for: one at each completed
         prefill (the engine emits the first token at prefill
-        completion, per readmission) + one per decode tick. A fleet
-        re-dispatch under the "discard" policy throws the dead
-        replica's partial output away — the trail records the fact (a
-        `redispatched` event with detail "discard", ordered BEFORE the
-        new replica's first emission), so the account resets with it.
-        Under "resume" the committed tokens carry over and the count
-        just keeps accumulating across replicas."""
+        completion, per readmission) + one per decode tick — except a
+        SPECULATIVE decode round (ISSUE 14), whose [slot, emitted]
+        detail carries the round's variable-length commit (1..k
+        tokens). A fleet re-dispatch under the "discard" policy throws
+        the dead replica's partial output away — the trail records the
+        fact (a `redispatched` event with detail "discard", ordered
+        BEFORE the new replica's first emission), so the account
+        resets with it. Under "resume" the committed tokens carry over
+        and the count just keeps accumulating across replicas."""
         n = 0
         for e in self.events:
-            if e[2] in ("first_token", "decode"):
+            if e[2] == "first_token":
                 n += 1
+            elif e[2] == "decode":
+                n += e[3][1] if isinstance(e[3], list) else 1
             elif e[2] == "redispatched" and e[3] == "discard":
                 n = 0
         return n
@@ -172,10 +182,22 @@ def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
                 lc.events.append((tick, now, "prefill", pf[2]))
                 if pf[-1] == "emit":
                     lc.events.append((tick, now, "first_token", None))
+            # Speculative rounds (ISSUE 14): [rid, proposed, accepted]
+            # per slot — the decode event's detail becomes
+            # [slot, emitted] (= 1 + accepted) so the token account
+            # stays exact, and the round itself is the trace's
+            # spec-round marker.
+            spec_acc = {e[0]: e[2] for e in rec.get("spec") or []}
             for slot, rid in rec.get("decoded") or []:
                 lc = life(mode, rid)
                 lc.decode_ticks += 1
-                lc.events.append((tick, now, "decode", slot))
+                if rid in spec_acc:
+                    lc.spec_rounds += 1
+                    lc.spec_accepted += spec_acc[rid]
+                    lc.events.append((tick, now, "decode",
+                                      [slot, 1 + spec_acc[rid]]))
+                else:
+                    lc.events.append((tick, now, "decode", slot))
             for rid in rec.get("preempted") or []:
                 lc = life(mode, rid)
                 lc.preemptions += 1
@@ -475,6 +497,8 @@ def trace_main(argv: list[str] | None = None) -> int:
                             "decode_ticks": lc.decode_ticks,
                             "prefix_hits": lc.prefix_hits,
                             "prefix_hit_tokens": lc.prefix_hit_tokens,
+                            "spec_rounds": lc.spec_rounds,
+                            "spec_accepted": lc.spec_accepted,
                             "tokens": lc.tokens_accounted,
                             "consistent": lc.consistent,
                         }

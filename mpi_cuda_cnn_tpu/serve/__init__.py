@@ -10,7 +10,12 @@ prefix-sharing tree: refcounted read-only pages, copy-on-write, LRU
 retention — ISSUE 9; scheduler.py's SLOScheduler is the matching
 SLO-aware admission/preemption policy), handoff.py (the disaggregated
 prefill/decode pools' crash-safe page-granular KV transfer protocol —
-ISSUE 13; fleet.py drives it, engine.adopt_pages is the device copy).
+ISSUE 13; fleet.py drives it, engine.adopt_pages is the device copy),
+spec.py (batched speculative decoding's jax-free policy half —
+ISSUE 14: prompt-lookup proposal, the greedy acceptance law, the round
+scaffold engine.run and ReplicaCore.step share; the engine compiles
+the batched verify block, the scheduler owns the acceptance-aware page
+accounting).
 """
 
 from .engine import PagedEngine, ServeResult
@@ -33,6 +38,7 @@ from .scheduler import (
     StaticScheduler,
     pages_for,
 )
+from .spec import LookupProposer, accept_len, lookup_propose
 
 __all__ = [
     "ContinuousScheduler",
@@ -40,6 +46,7 @@ __all__ = [
     "Fleet",
     "FleetResult",
     "Handoff",
+    "LookupProposer",
     "PagedEngine",
     "PagedKVCache",
     "PagePool",
@@ -52,7 +59,9 @@ __all__ = [
     "ServeResult",
     "SimCompute",
     "StaticScheduler",
+    "accept_len",
     "init_paged_cache",
+    "lookup_propose",
     "pages_for",
     "parse_pools",
 ]

@@ -222,6 +222,26 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "continuous scheduler: hash-keyed prefix "
                          "pages with refcounts + COW — cache-hit "
                          "requests prefill only their suffix")
+    ap.add_argument("--spec", default="off",
+                    choices=["off", "lookup", "draft"],
+                    help="batched speculative decoding (ISSUE 14), "
+                         "continuous mode only: lookup = draft-free "
+                         "prompt lookup over each request's committed "
+                         "context (the agentic/template-traffic form); "
+                         "draft = a cheap sliding-window draft model "
+                         "behind the same interface. Per tick: per-slot "
+                         "k-token proposal + ONE batched verify block; "
+                         "T=0 outputs stay bitwise spec-off's while "
+                         "the tick count drops with acceptance")
+    ap.add_argument("--spec-k", type=int, default=8,
+                    help="speculative round width: candidate tokens "
+                         "verified per slot per tick (>= 2)")
+    ap.add_argument("--spec-ngram", type=int, default=2,
+                    help="prompt-lookup match length (--spec lookup)")
+    ap.add_argument("--draft-dim", type=int, default=0,
+                    help="draft model width (--spec draft; 0 = dim/2)")
+    ap.add_argument("--draft-depth", type=int, default=0,
+                    help="draft model depth (--spec draft; 0 = 1)")
     ap.add_argument("--scheduler", default="fcfs",
                     choices=["fcfs", "slo"],
                     help="continuous-batching policy: fcfs (default) "
@@ -267,6 +287,17 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
 
     cache_dtype = pick_cache_dtype(args.cache_dtype, heads=args.heads,
                                    kv_heads=args.kv_heads or None)
+    if args.spec != "off" and args.mode == "static":
+        # Same contract as --prefix-cache: speculation is iteration-
+        # level; a pure-static run would silently measure spec-off.
+        print("error: --spec needs continuous batching (--mode "
+              "continuous or both; static is the one-token baseline)",
+              file=sys.stderr)
+        return 2
+    if args.spec != "off" and args.spec_k < 2:
+        print(f"error: --spec-k {args.spec_k} would propose nothing "
+              "(want >= 2)", file=sys.stderr)
+        return 2
     model = TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.heads, depth=args.depth,
         max_seq=args.max_seq, kv_heads=args.kv_heads,
@@ -274,12 +305,26 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
     params = model.init(jax.random.key(args.seed))
     max_len = args.prompt_max + args.out_max
     pages = args.pages or args.slots * pages_for(max_len, args.page_size) + 1
+    draft_model = draft_params = None
+    if args.spec == "draft":
+        # The cheap draft: narrower/shallower, same vocab/heads — its
+        # params come from a DIFFERENT key so the draft is a genuinely
+        # distinct model (a draft equal to the target would accept
+        # everything and measure nothing).
+        draft_model = TransformerLM(
+            vocab=args.vocab, dim=args.draft_dim or max(args.dim // 2, 16),
+            heads=args.heads, depth=args.draft_depth or 1,
+            max_seq=args.max_seq, kv_heads=args.kv_heads,
+        )
+        draft_params = draft_model.init(jax.random.key(args.seed + 1))
     engine = PagedEngine(
         model, params, slots=args.slots, num_pages=pages,
         page_size=args.page_size, prefill_chunk=args.prefill_chunk,
         cache_dtype=cache_dtype, max_len=max_len,
         attn_kernel=args.attn_kernel,
         weights_dtype=args.decode_weights_dtype,
+        spec=args.spec, spec_k=args.spec_k, spec_ngram=args.spec_ngram,
+        draft_model=draft_model, draft_params=draft_params,
     )
     if args.scheduler == "slo":
         args.mode = "continuous"
@@ -333,6 +378,12 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         engine.run(make_workload(**{**workload_kw, "n": 1, "rate": 0.0,
                                     "deadline_s": 0.0}),
                    mode=modes[0])
+        if args.spec != "off":
+            # Warm the speculative verify program too (one continuous
+            # spec round on the throwaway request).
+            engine.run(make_workload(**{**workload_kw, "n": 1, "rate": 0.0,
+                                        "deadline_s": 0.0}),
+                       mode="continuous", spec=True)
         if args.prefix_cache:
             engine.copy_page(0, 0)
         for mode in modes:
@@ -375,6 +426,8 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                                         and mode == "continuous"),
                                 policy=(sched_policy
                                         if mode == "continuous" else None),
+                                spec=(args.spec != "off"
+                                      and mode == "continuous"),
                                 **run_kw)
             s = result.summary()
             # Blame stamp (ISSUE 11): the crc + per-category totals
@@ -398,6 +451,7 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                 "cache_dtype": cache_dtype, "rate": args.rate,
                 "attn_kernel": args.attn_kernel,
                 "weights_dtype": engine.weights_dtype,
+                "spec": args.spec, "spec_k": args.spec_k,
                 "slots": args.slots, "page_size": args.page_size,
                 "pages": pages, **s,
             })
@@ -406,6 +460,7 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                               "cache_dtype": cache_dtype,
                               "attn_kernel": args.attn_kernel,
                               "weights_dtype": engine.weights_dtype,
+                              "spec": args.spec, "spec_k": args.spec_k,
                               **s}))
     if alert_engine is not None:
         print(json.dumps({"metric": "serve_alerts_fired",
@@ -524,6 +579,18 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                     help="per-replica prefix-sharing KV cache: "
                          "cache-hit requests prefill only their suffix "
                          "(restarted incarnations come back cold)")
+    ap.add_argument("--spec", default="off",
+                    choices=["off", "lookup"],
+                    help="per-replica batched speculative decoding "
+                         "(ISSUE 14): lookup = draft-free prompt "
+                         "lookup; every replica (and every restarted "
+                         "incarnation) speculates identically, so the "
+                         "dispatch trace stays seed-deterministic "
+                         "(model-draft is a serve-bench/engine surface)")
+    ap.add_argument("--spec-k", type=int, default=8,
+                    help="speculative round width per slot per tick")
+    ap.add_argument("--spec-ngram", type=int, default=2,
+                    help="prompt-lookup match length (--spec lookup)")
     ap.add_argument("--scheduler", default="fcfs",
                     choices=["fcfs", "slo"],
                     help="per-replica batching policy: fcfs or the "
@@ -639,6 +706,8 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 cache_dtype=args.cache_dtype, max_len=max_len,
                 attn_kernel=args.attn_kernel,
                 weights_dtype=args.decode_weights_dtype,
+                spec=args.spec, spec_k=args.spec_k,
+                spec_ngram=args.spec_ngram,
             ))
     else:
         def compute_factory(name):
@@ -730,6 +799,8 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 registry=registry, fleet_sink=fleet_sink,
                 replica_tick_sink=replica_tick_sink,
                 prefix=args.prefix_cache, sched_policy=sched_policy,
+                spec=args.spec, spec_k=args.spec_k,
+                spec_ngram=args.spec_ngram,
                 pools=pools, handoff_ticks=args.handoff_ticks,
                 # The per-transfer lifecycle log is only ever emitted at
                 # --log full; at summary-mode storm scale retaining it
@@ -790,6 +861,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
         metrics.log("serve", **{
             "bench": "fleet", "policy": args.policy,
             "redispatch": args.redispatch,
+            "spec": args.spec, "spec_k": args.spec_k,
             "replicas_initial": (sum(pools.values()) if pools
                                  else args.replicas),
             "rate": args.rate,

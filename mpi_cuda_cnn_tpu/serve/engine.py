@@ -13,6 +13,15 @@ batching never retraces as the batch composition churns:
   of the final valid position's logits) — TTFT is paid at prefill
   completion, not at the next decode tick.
 
+A speculative engine (ISSUE 14: spec="lookup"/"draft") compiles ONE
+additional program, the batched verify block — every slot's k candidate
+rows at per-slot positions through the same paged_forward, rows past a
+slot's round width riding along valid=False. serve/spec.py owns the
+jax-free policy half (proposal, greedy acceptance, the round scaffold
+shared with the fleet's ReplicaCore); the scheduler owns the
+acceptance-aware page accounting (opportunistic growth toward k,
+rejected-draft page rollback at commit).
+
 Both donate the page pools, so the cache updates in place across ticks
 (utils/donation discipline; the pool is the engine's dominant buffer).
 Sampling is greedy — the serving benches measure schedule/memory
@@ -55,6 +64,12 @@ from .paged_cache import (
     paged_forward,
 )
 from .prefix_cache import PrefixCache, empty_prefix_fields
+from .spec import (
+    SPEC_MODES,
+    LookupProposer,
+    empty_spec_fields,
+    run_round,
+)
 from .scheduler import (
     ContinuousScheduler,
     Request,
@@ -115,6 +130,10 @@ class ServeResult:
     # Prefix-cache structural counters (ISSUE 9): always present (zeros
     # with sharing off) so gated metrics exist in every run.
     prefix: dict = dataclasses.field(default_factory=empty_prefix_fields)
+    # Speculative-decoding counters (ISSUE 14): rounds run, draft
+    # tokens proposed, draft tokens accepted — always present (zeros
+    # with spec off) so the gated metrics exist in every run.
+    spec: dict = dataclasses.field(default_factory=empty_spec_fields)
 
     @property
     def finished_requests(self) -> list[Request]:
@@ -181,6 +200,9 @@ class ServeResult:
             # Prefix-sharing counters (ISSUE 9), flat so `mctpu
             # compare` gates them as serve.<mode>.prefix_hits etc.
             **self.prefix,
+            # Speculative-decoding counters (ISSUE 14), flat so `mctpu
+            # compare` gates them as serve.<mode>.spec_rounds etc.
+            **self.spec,
             # Per-tenant status/latency counts (ISSUE 8): the summary
             # keys `mctpu compare` flattens as serve.<mode>.tenant.<t>.*
             # and `mctpu health` falls back to on summary-only logs.
@@ -223,6 +245,75 @@ def _observe_request(registry, r: Request) -> None:
         )
 
 
+class DraftProposer:
+    """Model-draft proposal behind the LookupProposer interface
+    (ISSUE 14): a cheap draft model proposes each slot's k-1 candidate
+    tokens by greedy argmax over a fixed sliding WINDOW of the
+    request's committed context — cacheless, so the draft needs no
+    paged pools, no COW, and no handoff story of its own (the full
+    per-slot draft KV cache is the chip-scale follow-up; T=0 exactness
+    never depends on the draft, only the acceptance rate does). The
+    draft steps are BATCHED across slots like the verify block: one
+    jitted (batch, W) window forward per draft position — k-1 forwards
+    and k-1 host syncs per tick, however many slots speculate — with
+    static shapes, compiled once."""
+
+    def __init__(self, model: TransformerLM, params, *, window: int = 32,
+                 batch: int = 1):
+        import jax
+
+        self.model = model
+        self.window = min(window, model.max_seq)
+        self.batch = batch
+        self.params = params
+
+        @jax.jit
+        def step(params, toks, n_valid):
+            # Full causal forward over the padded windows; each row's
+            # proposal is the argmax after its last VALID position
+            # (causal masking keeps the pad tail out of that logit).
+            logits = model.apply(params, toks, moe_inference=True)
+            picks = jnp.argmax(logits, axis=-1)            # (B, W)
+            idx = jnp.maximum(n_valid - 1, 0)
+            return jnp.take_along_axis(
+                picks, idx[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+        self._step = step
+
+    def propose(self, ctx: np.ndarray, n_props: int) -> np.ndarray:
+        return self.propose_batch([ctx], [n_props])[0]
+
+    def propose_batch(self, ctxs, n_props):
+        """Per-slot proposals for one round, drafted in lockstep: draft
+        position i runs ONE (batch, W) forward for every slot at once
+        (rows past a slot's own width ride along; their picks are
+        dropped host-side)."""
+        n_max = max(n_props, default=0)
+        if n_max == 0:
+            return [np.empty(0, np.int32) for _ in ctxs]
+        if len(ctxs) > self.batch:
+            raise ValueError(
+                f"{len(ctxs)} draft contexts exceed batch {self.batch}")
+        w = self.window
+        bufs = [[int(t) for t in c[-w:]] for c in ctxs]
+        outs = [[] for _ in ctxs]
+        for step_i in range(n_max):
+            toks = np.zeros((self.batch, w), np.int32)
+            n_valid = np.ones((self.batch,), np.int32)
+            for i, buf in enumerate(bufs):
+                win = buf[-w:]
+                toks[i, : len(win)] = win
+                n_valid[i] = max(len(win), 1)
+            picks = np.asarray(self._step(
+                self.params, jnp.asarray(toks), jnp.asarray(n_valid)))
+            for i, buf in enumerate(bufs):
+                if step_i < n_props[i]:
+                    t = int(picks[i])
+                    outs[i].append(t)
+                    buf.append(t)
+        return [np.asarray(o, np.int32) for o in outs]
+
+
 class PagedEngine:
     """Greedy serving engine over a paged KV cache.
 
@@ -247,10 +338,29 @@ class PagedEngine:
                  num_pages: int = 64, page_size: int = 16,
                  prefill_chunk: int = 32, cache_dtype="float32",
                  max_len: int | None = None, attn_kernel: str = "gather",
-                 weights_dtype: str = "float32"):
+                 weights_dtype: str = "float32", spec: str = "off",
+                 spec_k: int = 8, spec_ngram: int = 2,
+                 draft_model: TransformerLM | None = None,
+                 draft_params=None):
         from ..models.generate import pick_cache_dtype, pick_weights_dtype
         from ..ops.pallas_gemv import quantize_decode_params
 
+        if spec not in SPEC_MODES:
+            raise ValueError(f"spec {spec!r}: want one of {SPEC_MODES}")
+        if spec != "off" and spec_k < 2:
+            raise ValueError(
+                f"spec_k must be >= 2 (k={spec_k} would propose nothing)")
+        if spec == "draft":
+            if draft_model is None or draft_params is None:
+                raise ValueError(
+                    "spec='draft' needs draft_model + draft_params")
+            if draft_model.vocab != model.vocab:
+                raise ValueError(
+                    f"target vocab {model.vocab} != draft vocab "
+                    f"{draft_model.vocab}")
+        self.spec_mode = spec
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
         self.model = model
         self.slots = slots
         self.page_size = page_size
@@ -319,6 +429,30 @@ class PagedEngine:
         self._prefill = donate_jit(prefill)
         self._copy = donate_jit(copy)
         self._adopt = donate_jit(adopt)
+        # Speculative verify (ISSUE 14): ONE batched block forward per
+        # round — every slot's k candidate rows at per-slot positions
+        # through the same paged_forward the plain tick compiles, with
+        # per-row validity (short rounds and dead slots write scratch).
+        # Compiled only when speculation is configured: a spec-off
+        # engine keeps exactly its two programs.
+        self._spec = None
+        self._draft_proposer = None
+        if spec != "off":
+            kk = spec_k
+
+            def spec_tick(cache: PagedKVCache, params, toks, pos, valid):
+                positions = pos[:, None] + jnp.arange(kk)[None, :]
+                logits, cache = paged_forward(
+                    model, params, toks, positions, valid, cache
+                )
+                return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            self._spec = donate_jit(spec_tick)
+            if spec == "draft":
+                self._draft_proposer = DraftProposer(
+                    draft_model, quantize_decode_params(
+                        draft_params, self.weights_dtype),
+                    batch=slots)
 
     # -- host-side helpers ------------------------------------------------
 
@@ -431,11 +565,41 @@ class PagedEngine:
         # mctpu: disable=MCT007
         return np.asarray(nxt)
 
+    def run_spec_tick(self, rounds):
+        """ONE batched speculative verify over this tick's rounds
+        (ISSUE 14): rounds is spec.run_round's [(slot, u, width)] —
+        each slot's verify inputs land in its own engine row at its own
+        positions [cached, cached+width), rows past a slot's width (and
+        every dead slot) ride along valid=False with their writes
+        routed to the scratch page. Returns each slot's per-row greedy
+        picks (the verify_fn contract run_round consumes)."""
+        kk = self.spec_k
+        toks = np.zeros((self.slots, kk), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        valid = np.zeros((self.slots, kk), bool)
+        table = np.zeros((self.slots, self._table_width), np.int32)
+        for s, u, w in rounds:
+            toks[s.idx, :w] = u
+            pos[s.idx] = s.cached
+            valid[s.idx, :w] = True
+            table[s.idx, : len(s.pages)] = s.pages
+        cache, picks = self._spec(
+            self._cache_view(table), self.params, jnp.asarray(toks),
+            jnp.asarray(pos), jnp.asarray(valid),
+        )
+        self._pages = cache.pages
+        # The sanctioned sync: one host transfer per BATCHED verify
+        # round (every slot's picks in one array), not per sequence.
+        # mctpu: disable=MCT007
+        picks = np.asarray(picks)
+        return [picks[s.idx, :w] for s, _, w in rounds]
+
     def run(self, requests: list[Request], *, mode: str = "continuous",
             time_fn=time.perf_counter, faults=None, max_queue: int | None = None,
             watchdog_s: float = 0.0, sleep_fn=time.sleep,
             registry=None, tick_sink=None, prefix: bool = False,
-            policy: SLOPolicy | None = None) -> ServeResult:
+            policy: SLOPolicy | None = None,
+            spec: bool = False) -> ServeResult:
         """Serve `requests` to a terminal status each; return ServeResult.
 
         Requests are mutated in place (out/timestamps/status); arrivals
@@ -464,9 +628,37 @@ class PagedEngine:
         classes, per-tenant quotas, burn-driven preemption). Both apply
         to iteration-level scheduling only — static batching is the
         reservation baseline the comparison measures.
+
+        Speculative decoding (ISSUE 14): `spec=True` (on an engine
+        constructed with spec="lookup"/"draft") replaces the one-token
+        decode tick with a speculative ROUND — per-slot k-token
+        proposal, ONE batched verify forward, greedy acceptance
+        committing 1..k tokens per slot per tick (serve/spec.py).
+        Iteration-level only, like prefix sharing: static stays the
+        one-token baseline. At T=0 (the engine's only sampling) the
+        emitted streams are the target's own greedy continuations —
+        bitwise-equal to a spec-off run per request, while the tick
+        count drops with the acceptance rate.
         """
+        if spec and self.spec_mode == "off":
+            raise ValueError(
+                "run(spec=True) on an engine constructed with "
+                "spec='off' — pass spec='lookup' or 'draft' at "
+                "construction (the verify program compiles there)"
+            )
+        if spec and mode != "continuous":
+            raise ValueError(
+                "speculative decoding is iteration-level — continuous "
+                "batching only (static is the one-token-per-tick "
+                "reservation baseline)"
+            )
         pool = PagePool(self.num_pages)
         pcache = PrefixCache(pool, self.page_size) if prefix else None
+        proposer = None
+        if spec:
+            proposer = (self._draft_proposer if self.spec_mode == "draft"
+                        else LookupProposer(self.spec_ngram))
+        spec_rounds = spec_proposed = spec_accepted = 0
         sched_kw = dict(slots=self.slots, pool=pool,
                         page_size=self.page_size, max_len=self.max_len,
                         max_queue=max_queue, prefix=pcache)
@@ -578,7 +770,8 @@ class PagedEngine:
                                                     ContinuousScheduler):
                         sched.finish(slot, time_fn() - t0)
 
-            dslots = sched.grow_for_decode(time_fn() - t0)
+            dslots = sched.grow_for_decode(
+                time_fn() - t0, spec_k=self.spec_k if spec else 1)
             decoded = [[s.idx, s.req.rid] for s in dslots]
             for r in sched.dropped:
                 # admit/grow_for_decode may have failed a livelocked
@@ -587,7 +780,34 @@ class PagedEngine:
                     failed_logged.add(r.rid)
                     events.append({"kind": "request_failed", "id": r.rid,
                                    "mode": mode, "reason": r.fail_reason})
-            if dslots:
+            spec_rec = None
+            emitted_decode = 0
+            if dslots and spec:
+                # Speculative round (ISSUE 14): propose per slot, ONE
+                # batched verify block, greedy acceptance — each slot
+                # commits 1..k tokens; commit_spec rolls rejected-draft
+                # pages back into the pool.
+                widths = [sched.spec_width(s, self.spec_k) for s in dslots]
+                results = run_round(dslots, widths, proposer,
+                                    self.run_spec_tick)
+                decode_ticks += 1
+                now = time_fn() - t0
+                spec_rec = []
+                for s, w, j, toks_out in results:
+                    sched.commit_spec(s, j)
+                    for t in toks_out:
+                        self._emit(s, t, now)
+                    emitted_decode += j
+                    spec_rec.append([s.req.rid, w - 1, j - 1])
+                    spec_rounds += 1
+                    spec_proposed += w - 1
+                    spec_accepted += j - 1
+                    if registry is not None:
+                        registry.observe("serve.spec.accepted", j - 1)
+                    if s.req.done and isinstance(sched, ContinuousScheduler):
+                        sched.finish(s, now)
+                progressed = True
+            elif dslots:
                 nxt = self.run_decode_tick(dslots)
                 decode_ticks += 1
                 now = time_fn() - t0
@@ -596,6 +816,7 @@ class PagedEngine:
                     self._emit(s, int(nxt[s.idx]), now)
                     if s.req.done and isinstance(sched, ContinuousScheduler):
                         sched.finish(s, now)
+                emitted_decode = len(dslots)
                 progressed = True
 
             if isinstance(sched, StaticScheduler) and sched.batch_done():
@@ -693,6 +914,12 @@ class PagedEngine:
                 # when they happen instead of at end of run.
                 "terminal": [terminal_fields(r) for r in new_fin + new_drop],
             }
+            if spec_rec is not None:
+                # Speculative round detail (ISSUE 14): [rid, proposed,
+                # accepted] per slot — `mctpu trace` derives the round's
+                # emitted count (1 + accepted) from it, so the token
+                # cross-check survives variable-length commits.
+                tick_rec["spec"] = spec_rec
             if prefix_tick is not None:
                 # Prefix-cache panel fields (ISSUE 9): this tick's hit
                 # markers ([rid, matched_tokens] — the lifecycle event
@@ -716,11 +943,17 @@ class PagedEngine:
                     registry.inc("serve.decode_ticks")
                 if prefill_rec is not None:
                     registry.inc("serve.prefill_chunks")
-                emitted = len(decoded) + (1 if prefill_rec is not None
-                                          and prefill_rec[-1] == "emit"
-                                          else 0)
+                emitted = emitted_decode + (1 if prefill_rec is not None
+                                            and prefill_rec[-1] == "emit"
+                                            else 0)
                 if emitted:
                     registry.inc("serve.tokens_emitted", emitted)
+                if spec_rec:
+                    registry.inc("serve.spec.rounds", len(spec_rec))
+                    registry.inc("serve.spec.proposed",
+                                 sum(p for _, p, _ in spec_rec))
+                    registry.inc("serve.spec.accepted_total",
+                                 sum(a for _, _, a in spec_rec))
                 if preempted:
                     registry.inc("serve.preemptions", len(preempted))
                 if prefix_tick is not None:
@@ -768,4 +1001,6 @@ class PagedEngine:
             prefill_chunks=prefill_chunks, preemptions=sched.preemptions,
             duration_s=time_fn() - t0, events=events,
             watchdog_slow_ticks=watchdog_slow, prefix=prefix_fields,
+            spec={"spec_rounds": spec_rounds, "spec_proposed": spec_proposed,
+                  "spec_accepted": spec_accepted},
         )

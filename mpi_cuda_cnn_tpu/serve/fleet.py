@@ -84,6 +84,7 @@ from .handoff import (
 from .pool import PagePool
 from .prefix_cache import PrefixCache, empty_prefix_fields
 from .router import CircuitOpen, Router
+from .spec import LookupProposer, empty_spec_fields, run_round
 from .scheduler import (
     ContinuousScheduler,
     Request,
@@ -112,11 +113,13 @@ class SimCompute:
         self.chunk = chunk
         self.salt = salt
 
-    def _tok(self, req: Request) -> int:
-        j = len(req.out)
+    def _tok_at(self, req: Request, j: int) -> int:
         h = (req.rid * 1000003 + j * 2654435761 + self.salt * 97
              + int(req.prompt.size) * 8191) & 0xFFFFFFFF
         return h % self.vocab
+
+    def _tok(self, req: Request) -> int:
+        return self._tok_at(req, len(req.out))
 
     def prefill_chunk(self, slot) -> tuple[int, int]:
         n = min(self.chunk, slot.target - slot.cached)
@@ -124,6 +127,18 @@ class SimCompute:
 
     def decode(self, dslots) -> dict[int, int]:
         return {s.idx: self._tok(s.req) for s in dslots}
+
+    def verify(self, rounds):
+        """Speculative verify, sim form (ISSUE 14): the target's pick
+        for verify row i is the pure token mix at output position
+        len(out) + i — exactly the token the spec-off tick stream would
+        emit there, so sim spec-on outputs are bitwise spec-off's for
+        any proposer while the variable-length commit/rollback
+        machinery runs for real."""
+        return [
+            [self._tok_at(s.req, len(s.req.out) + i) for i in range(w)]
+            for s, _u, w in rounds
+        ]
 
     def copy_page(self, src: int, dst: int) -> None:
         """Sim COW is pure bookkeeping: tokens are a function of
@@ -161,6 +176,13 @@ class EngineCompute:
         indices — the device half of the prefill->decode handoff."""
         self.engine.adopt_pages(src_compute.engine, src_pages, dst_pages)
 
+    def verify(self, rounds):
+        """Speculative verify, engine form (ISSUE 14): the batched
+        verify program — the engine must have been constructed with
+        spec="lookup"/"draft" (the fleet bench's compute factory
+        threads --spec through)."""
+        return self.engine.run_spec_tick(rounds)
+
 
 class ReplicaCore:
     """One replica's steppable engine loop over the PR-3 scheduler.
@@ -173,7 +195,20 @@ class ReplicaCore:
     def __init__(self, compute, *, slots: int, num_pages: int,
                  page_size: int, max_len: int, max_queue: int | None = None,
                  on_emit=None, check_every: int = 1, prefix: bool = False,
-                 policy=None):
+                 policy=None, spec: str = "off", spec_k: int = 8,
+                 spec_ngram: int = 2):
+        if spec not in ("off", "lookup"):
+            # Fleet speculation is the draft-free form: a per-replica
+            # draft model is an engine-construction concern (the bench
+            # factory could thread one), and the sim storms have no
+            # draft to run — "lookup" is the serving-fleet contract.
+            raise ValueError(
+                f"fleet spec {spec!r}: want 'off' or 'lookup'")
+        self.spec = spec
+        self.spec_k = spec_k
+        self.proposer = (LookupProposer(spec_ngram) if spec != "off"
+                         else None)
+        self.spec_stats = empty_spec_fields()
         pool = PagePool(num_pages)
         self.prefix = PrefixCache(pool, page_size) if prefix else None
         sched_kw = dict(slots=slots, pool=pool, page_size=page_size,
@@ -264,9 +299,33 @@ class ReplicaCore:
                     # set and detached the slot — decode happens on the
                     # receiving pool's replica.
                     pass
-        dslots = sched.grow_for_decode(now)
+        dslots = sched.grow_for_decode(
+            now, spec_k=self.spec_k if self.spec != "off" else 1)
         decoded = [[s.idx, s.req.rid] for s in dslots]
-        if dslots:
+        spec_rec = None
+        if dslots and self.spec != "off":
+            # Speculative round (ISSUE 14): the SAME spec.run_round
+            # scaffold engine.run drives — proposal + one batched
+            # verify (compute.verify: jitted block on engine replicas,
+            # the pure token mix on sim) + greedy acceptance, with
+            # commit_spec rolling rejected-draft pages back.
+            widths = [sched.spec_width(s, self.spec_k) for s in dslots]
+            results = run_round(dslots, widths, self.proposer,
+                                self.compute.verify)
+            self.decode_ticks += 1
+            progressed = True
+            spec_rec = []
+            for s, w, j, toks_out in results:
+                sched.commit_spec(s, j)
+                for t in toks_out:
+                    self._emit(s.req, t, now)
+                spec_rec.append([s.req.rid, w - 1, j - 1])
+                self.spec_stats["spec_rounds"] += 1
+                self.spec_stats["spec_proposed"] += w - 1
+                self.spec_stats["spec_accepted"] += j - 1
+                if s.req.done:
+                    sched.finish(s, now)
+        elif dslots:
             toks = self.compute.decode(dslots)
             self.decode_ticks += 1
             progressed = True
@@ -304,6 +363,8 @@ class ReplicaCore:
         }
         if prefix_tick is not None:
             rec["prefix_hits"] = prefix_tick["hits"]
+        if spec_rec is not None:
+            rec["spec"] = spec_rec
         return rec, new_fin, new_drop
 
     def prefix_stats(self) -> dict:
@@ -320,6 +381,11 @@ class ReplicaCore:
             for k in self.prefix.stats:
                 self.prefix.stats[k] = 0
 
+    def reset_spec_stats(self) -> None:
+        """Spec-counter twin of reset_prefix_stats (retirement at
+        failover — a zombie's later rounds must not re-bank)."""
+        self.spec_stats = empty_spec_fields()
+
 
 class Replica:
     """One fleet member: a named ReplicaCore plus the PR-6 registry its
@@ -331,7 +397,8 @@ class Replica:
     def __init__(self, name: str, compute, *, slots: int, num_pages: int,
                  page_size: int, max_len: int, max_queue: int | None = None,
                  check_every: int = 1, on_emit=None, clock=None,
-                 prefix: bool = False, policy=None, phase: str | None = None):
+                 prefix: bool = False, policy=None, phase: str | None = None,
+                 spec: str = "off", spec_k: int = 8, spec_ngram: int = 2):
         self.name = name
         # Pool membership of a disaggregated fleet (ISSUE 13):
         # "prefill" | "decode" | None (unified). A restarted
@@ -342,6 +409,7 @@ class Replica:
             compute, slots=slots, num_pages=num_pages, page_size=page_size,
             max_len=max_len, max_queue=max_queue, check_every=check_every,
             on_emit=on_emit, prefix=prefix, policy=policy,
+            spec=spec, spec_k=spec_k, spec_ngram=spec_ngram,
         )
         self.alive = True
         self.zombie_until = -1   # fleet tick a partitioned zombie stops at
@@ -372,6 +440,12 @@ class Replica:
             r.inc("serve.prefix.hits", len(rec["prefix_hits"]))
             r.inc("serve.prefix.hit_tokens",
                   sum(m for _, m in rec["prefix_hits"]))
+        if rec.get("spec"):
+            r.inc("serve.spec.rounds", len(rec["spec"]))
+            r.inc("serve.spec.proposed",
+                  sum(p for _, p, _ in rec["spec"]))
+            r.inc("serve.spec.accepted_total",
+                  sum(a for _, _, a in rec["spec"]))
         self.pending_dispatches = 0
         return rec, new_fin, new_drop
 
@@ -420,6 +494,9 @@ class FleetResult:
     # across every replica incarnation; zeros with sharing off so the
     # gated metrics exist in every fleet-bench run.
     prefix: dict = dataclasses.field(default_factory=empty_prefix_fields)
+    # Fleet-wide speculative-decoding counters (ISSUE 14): same
+    # contract — summed across incarnations, zeros with spec off.
+    spec: dict = dataclasses.field(default_factory=empty_spec_fields)
 
     @property
     def output_tokens(self) -> int:
@@ -503,6 +580,9 @@ class FleetResult:
             # Prefix-sharing counters (ISSUE 9): flat keys the fleet
             # determinism gate pins at exact equality.
             **self.prefix,
+            # Speculative-decoding counters (ISSUE 14): flat keys the
+            # fleet/spec determinism gates pin at exact equality.
+            **self.spec,
             # Per-tenant status/latency counts (ISSUE 8) — same shape
             # and flattening as ServeResult.summary's block.
             "tenants": tenant_block(self.requests),
@@ -531,7 +611,8 @@ class Fleet:
                  registry: MetricsRegistry | None = None, fleet_sink=None,
                  replica_tick_sink=None, jitter=None, prefix: bool = False,
                  sched_policy=None, pools: dict[str, int] | str | None = None,
-                 handoff_ticks: int = 1, log_handoffs: bool = True):
+                 handoff_ticks: int = 1, log_handoffs: bool = True,
+                 spec: str = "off", spec_k: int = 8, spec_ngram: int = 2):
         if isinstance(pools, str):
             pools = parse_pools(pools)
         if pools is not None:
@@ -581,10 +662,16 @@ class Fleet:
         # PrefixCache over its own pool (a restarted incarnation comes
         # back cold) and, with sched_policy, an SLOScheduler instead of
         # FCFS — the same upgrade engine.run applies single-engine.
+        # spec (ISSUE 14): per-replica speculative decoding — same
+        # geometry discipline as prefix: every replica (and every
+        # restarted incarnation) speculates identically, so the
+        # dispatch trace stays a pure function of (seed, plan, shape).
         self.geometry = dict(slots=slots, num_pages=num_pages,
                              page_size=page_size, max_len=max_len,
                              max_queue=max_queue, check_every=check_every,
-                             prefix=prefix, policy=sched_policy)
+                             prefix=prefix, policy=sched_policy,
+                             spec=spec, spec_k=spec_k,
+                             spec_ngram=spec_ngram)
         self.redispatch = redispatch
         self.tick_s = tick_s
         self.faults = faults
@@ -631,6 +718,7 @@ class Fleet:
         self._handoff_aborted_tick: list[tuple[int, str]] = []
         self._retired = [0, 0, 0]  # decode_ticks, prefill_chunks, preempts
         self._retired_prefix = empty_prefix_fields()
+        self._retired_spec = empty_spec_fields()
         self._failed_over_tick: list[tuple[int, str]] = []
         self._auth: dict[int, Request] = {}
         # rid -> (holding replica, live local copy): where a cancel()
@@ -1188,10 +1276,13 @@ class Fleet:
         self._retired[2] += core.sched.preemptions
         for k, v in core.prefix_stats().items():
             self._retired_prefix[k] += v
+        for k, v in core.spec_stats.items():
+            self._retired_spec[k] += v
         # A later zombie step must not re-bank these.
         core.decode_ticks = core.prefill_chunks = 0
         core.sched.preemptions = 0
         core.reset_prefix_stats()
+        core.reset_spec_stats()
 
     def _resolve_fault_target(self, f) -> str:
         """The rN name a crash/leave fault targets. A name that no
@@ -1428,6 +1519,8 @@ class Fleet:
                             "aborted")},
                         **({"prefix_hits": rec["prefix_hits"]}
                            if "prefix_hits" in rec else {}),
+                        **({"spec": rec["spec"]}
+                           if "spec" in rec else {}),
                         "terminal": [terminal_fields(r) for r in synced],
                     })
             for rep in list(self._zombies):
@@ -1460,6 +1553,8 @@ class Fleet:
                             "aborted")},
                         **({"prefix_hits": rec["prefix_hits"]}
                            if "prefix_hits" in rec else {}),
+                        **({"spec": rec["spec"]}
+                           if "spec" in rec else {}),
                         "terminal": [terminal_fields(r) for r in synced],
                     })
             if self.registry is not None:
@@ -1569,6 +1664,10 @@ class Fleet:
         for m in self.router.members.values():
             for k, v in m.replica.core.prefix_stats().items():
                 prefix_totals[k] += v
+        spec_totals = dict(self._retired_spec)
+        for m in self.router.members.values():
+            for k, v in m.replica.core.spec_stats.items():
+                spec_totals[k] += v
         return FleetResult(
             requests=reqs, ticks=tick, duration_s=clock() - t0,
             dispatches=self.dispatches, redispatches=self.redispatches,
@@ -1584,6 +1683,7 @@ class Fleet:
             handoff_log=self.handoff_log,
             dispatch_trace=self.dispatch_trace, events=self.events,
             replica_log=self.replica_log, prefix=prefix_totals,
+            spec=spec_totals,
         )
 
 

@@ -692,6 +692,38 @@ class ContinuousScheduler(_SchedulerBase):
             self._note_blocked(self.queue[0], "slots", self._occupants())
         return bound
 
+    def spec_width(self, slot: Slot, k: int) -> int:
+        """How many candidate tokens this slot's speculative round may
+        verify THIS tick (ISSUE 14): capped by k, by the tokens the
+        request still owes (overshooting the budget would write cache
+        rows for tokens that can never be emitted), and by the rows the
+        slot's pages actually cover (grow_for_decode extends toward k
+        opportunistically — a dry pool narrows the round instead of
+        preempting; width 1 is exactly the spec-off tick). Always >= 1:
+        the spec-off growth loop guaranteed the next row's page."""
+        avail = len(slot.pages) * self.page_size - slot.cached
+        remaining = slot.req.max_new_tokens - len(slot.req.out)
+        return max(1, min(k, remaining, avail))
+
+    def commit_spec(self, slot: Slot, j: int) -> None:
+        """Commit a speculative round's j accepted tokens (ISSUE 14):
+        advance the written extent, then ROLL BACK pages that now hold
+        only rejected-draft rows — freed through the ownership check,
+        so a rejected token's KV is never live (never readable through
+        any block table, never transferable by a handoff, and the pool
+        invariant keeps proving zero leaks). Stale rejected rows inside
+        the kept tail page are overwritten by the next round's writes
+        before any row can read them (the decode_block write-then-read
+        discipline) and masked off until then. Iteration-level only —
+        static batching's up-front reservations are never trimmed (the
+        engine refuses spec + static)."""
+        slot.cached += j
+        keep = pages_for(slot.cached, self.page_size)
+        if len(slot.pages) > keep:
+            surplus = slot.pages[keep:]
+            del slot.pages[keep:]
+            self.pool.free(surplus, slot.req.rid)
+
     def preempt(self, slot: Slot, for_rid: int | None = None) -> None:
         """Evict `slot`: free its pages, requeue its request at the
         HEAD (it keeps FCFS priority and its emitted tokens; the grown
@@ -712,7 +744,8 @@ class ContinuousScheduler(_SchedulerBase):
         driven choice."""
         return max(victims, key=lambda s: s.admit_seq)
 
-    def grow_for_decode(self, now: float = 0.0) -> list[Slot]:
+    def grow_for_decode(self, now: float = 0.0,
+                        spec_k: int = 1) -> list[Slot]:
         """Give every decoding slot the page its next cache row needs,
         reclaiming LRU-retained prefix pages first (ISSUE 9 — evicted
         cache beats evicted work), then preempting victim sequences
@@ -721,7 +754,18 @@ class ContinuousScheduler(_SchedulerBase):
         is dry and ALONE can never grow — no victim remains — so its
         request is failed terminally (the livelock guard's decode
         half) instead of raising: the engine keeps serving everything
-        else."""
+        else.
+
+        spec_k > 1 (ISSUE 14): after the guaranteed next-row growth,
+        each survivor is OPPORTUNISTICALLY extended toward the pages
+        its speculative verify block wants (k candidate rows, capped at
+        the request's remaining budget) — try_alloc + LRU prefix
+        reclaim only, NEVER preemption: speculation is a bet, and a bet
+        must not evict committed work. Whatever width the pool covers
+        is what spec_width reports for the round; a dry pool degrades
+        to width 1, which is exactly the spec-off tick — so the
+        livelock guard, the preemption policy, and the survivor set are
+        bitwise those of a spec-off run."""
         survivors = []
         for slot in sorted(self.decode_slots(), key=lambda s: s.admit_seq):
             if slot.free or not slot.decoding:
@@ -760,6 +804,19 @@ class ContinuousScheduler(_SchedulerBase):
                 self.preempt(victim, for_rid=slot.req.rid)
             if not stalled and not slot.free and slot.decoding:
                 survivors.append(slot)
+        if spec_k > 1:
+            for slot in survivors:
+                remaining = slot.req.max_new_tokens - len(slot.req.out)
+                want = pages_for(slot.cached + min(spec_k, remaining),
+                                 self.page_size)
+                while len(slot.pages) < want:
+                    got = self.pool.try_alloc(1, slot.req.rid)
+                    if (got is None and self.prefix is not None
+                            and self.prefix.reclaim(1)):
+                        got = self.pool.try_alloc(1, slot.req.rid)
+                    if got is None:
+                        break  # speculate narrower, never preempt
+                    slot.pages.extend(got)
         return survivors
 
 
@@ -813,8 +870,11 @@ class StaticScheduler(_SchedulerBase):
             bound.append(slot)
         return bound
 
-    def grow_for_decode(self, now: float = 0.0) -> list[Slot]:
+    def grow_for_decode(self, now: float = 0.0,
+                        spec_k: int = 1) -> list[Slot]:
         """No growth, no preemption — pages were reserved at admission.
+        (spec_k is signature compatibility only: speculation is
+        iteration-level — the engine refuses spec + static.)
         Decoding slots whose request is already done (or aborted) still
         HOLD their slot and pages (the batch drains as one); the engine
         keeps them out of the tick's valid mask."""

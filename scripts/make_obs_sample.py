@@ -22,8 +22,13 @@ round-trip tests pin, and the health golden shows violated AND met
 objectives. ISSUE 9 turns on prefix sharing for the continuous run
 over a --prefix-mix workload (shared template prompts), so the sample
 carries `prefix_hits` tick markers and the `prefix` cache-panel
-fields the trace/top surfaces render. Rerun after any deliberate
-schema or rendering change:
+fields the trace/top surfaces render. ISSUE 14 turns on batched
+speculative decoding (prompt lookup, k=4) for the same continuous
+run, so the sample carries `spec` tick round markers
+([rid, proposed, accepted] — variable-length commits the trace token
+cross-check must absorb) and the report's serving table renders the
+acceptance-rate column. Rerun after any deliberate schema or
+rendering change:
 
     JAX_PLATFORMS=cpu python scripts/make_obs_sample.py
 """
@@ -72,7 +77,8 @@ def build_records():
     model = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
     params = model.init(jax.random.key(0))
     engine = PagedEngine(model, params, slots=3, num_pages=10, page_size=4,
-                         prefill_chunk=8, max_len=40)
+                         prefill_chunk=8, max_len=40, spec="lookup",
+                         spec_k=4)
     records: list[dict] = []
     # ONE alert engine across both modes, fed every record in file
     # order — exactly what a replay of the finished file folds, so the
@@ -114,11 +120,14 @@ def build_records():
         res = engine.run(reqs, mode=mode, time_fn=clock,
                          sleep_fn=clock.advance, faults=faults,
                          registry=registry, tick_sink=sink,
-                         # Prefix sharing is continuous-only (static is
-                         # the reservation baseline): the continuous
-                         # half of the sample carries the ISSUE 9
-                         # prefix_hits/prefix tick fields.
-                         prefix=(mode == "continuous"))
+                         # Prefix sharing and speculation are
+                         # continuous-only (static is the reservation /
+                         # one-token baseline): the continuous half of
+                         # the sample carries the ISSUE 9
+                         # prefix_hits/prefix tick fields AND the
+                         # ISSUE 14 spec round markers.
+                         prefix=(mode == "continuous"),
+                         spec=(mode == "continuous"))
         s = res.summary()
         emit(make_record("blame", clock.now, **blame.summary_fields(mode)),
              clock)

@@ -20,6 +20,7 @@
 #   8d. bench_configs      (five-config rows, two-point — round-5 form)
 #   8e. bench_speculative  (draft/lookup speculation incl. T=0.8 rows)
 #   8f. bench_serve        (paged-KV continuous vs static batching; PR-3)
+#   8g. bench_serve_spec   (batched speculative serving pair; ISSUE 14)
 #   9. profile_lm          (step-time attribution; VERDICT #3)
 #   9b. profile_moe        (MoE component attribution + chunk sweep)
 #  10. make -C native test_tpu  (C driver on the chip)
@@ -141,6 +142,24 @@ step bench_serve_prefix_kernel 900 python scripts/bench_serve.py \
     --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
     --prefix-cache --kv-heads 2 --cache-dtype auto \
     --attn-kernel pallas --decode-weights-dtype auto
+# ISSUE 14 (speculative serving): the spec-on/off pair on a real chip —
+# batched speculative decoding inside the continuous-batching engine
+# (per-slot prompt-lookup proposal + ONE batched verify per tick).
+# Banks chip tokens/s + TTFT/TPOT for PERF.md's "Speculative serving"
+# table next to the CPU tick counts: on CPU the verify block costs ~k
+# one-token ticks so only the TICK count drops; on chip the k-row
+# verify is bandwidth-bound like the 1-row tick (same cache reads) and
+# the tick drop converts to wall-clock. Run with a REAL checkpoint when
+# one is at hand — random-init weights only loop weakly, so acceptance
+# (and the win) is floor, not ceiling, here.
+step bench_serve_spec 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --kv-heads 2 --cache-dtype auto --attn-kernel pallas \
+    --decode-weights-dtype auto --spec lookup --spec-k 8
+step bench_serve_spec_off 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --kv-heads 2 --cache-dtype auto --attn-kernel pallas \
+    --decode-weights-dtype auto
 step profile_lm 900 python scripts/profile_lm.py
 # PR-7 (fleet): the engine-backed fleet on a real chip — N PagedEngine
 # replicas (shared weights) behind the failure-aware router, one crash

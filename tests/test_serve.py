@@ -201,15 +201,21 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     PagedEngine caches in each storage dtype, with the extended
     sched.check() (pool no-leak / no-double-book / scratch-never-
     circulates PLUS refcount conservation and no-writable-shared-page)
-    on BOTH pools after EVERY step. The fleet's re-dispatch and
-    disaggregated-handoff paths (serve/fleet.py) drive these exact
-    scheduler+pool+prefix triples per replica, so they inherit the
-    guarantee."""
+    on BOTH pools after EVERY step. ISSUE 14 adds speculative rounds:
+    a spec decode op grows toward the k-row verify width, commits a
+    VARIABLE number of tokens (whatever greedy acceptance yields), and
+    commit_spec's rejected-draft ROLLBACK hands surplus pages back —
+    the walk must observe both a multi-token commit and a rollback.
+    The fleet's re-dispatch and disaggregated-handoff paths
+    (serve/fleet.py) drive these exact scheduler+pool+prefix triples
+    per replica, so they inherit the guarantee."""
     from mpi_cuda_cnn_tpu.serve.prefix_cache import PrefixCache
+    from mpi_cuda_cnn_tpu.serve.spec import LookupProposer, run_round
 
     params = MODEL.init(jax.random.key(2))
     engine = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
-                         prefill_chunk=4, max_len=32, cache_dtype=dtype)
+                         prefill_chunk=4, max_len=32, cache_dtype=dtype,
+                         spec="lookup", spec_k=4)
     # Host pool sized to the engine's device page arrays — the pairing
     # ReplicaCore uses: page indices from this pool index those arrays.
     pool = PagePool(10)
@@ -221,7 +227,7 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     # re-prefill) on this pair.
     engine_b = PagedEngine(MODEL, params, slots=3, num_pages=10,
                            page_size=4, prefill_chunk=4, max_len=32,
-                           cache_dtype=dtype)
+                           cache_dtype=dtype, spec="lookup", spec_k=4)
     pool_b = PagePool(10)
     sched_b = ContinuousScheduler(slots=3, pool=pool_b, page_size=4,
                                   max_len=32,
@@ -282,6 +288,29 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
         for s in dslots:
             s.cached += 1
             s.req.out.append(int(toks[s.idx]))
+            if s.req.done:
+                sc.finish(s, now)
+
+    proposer = LookupProposer(ngram=2)
+    spec_seen = {"rounds": 0, "multi": 0, "rollbacks": 0}
+
+    def spec_decode_op(sc=None, en=None):
+        # Speculative round (ISSUE 14): grow toward the k-row verify
+        # width, ONE batched verify, variable-length commit, rollback
+        # of rejected-draft pages.
+        sc, en = sc or sched, en or engine
+        dslots = sc.grow_for_decode(now, spec_k=4)
+        if not dslots:
+            return
+        widths = [sc.spec_width(s, 4) for s in dslots]
+        results = run_round(dslots, widths, proposer, en.run_spec_tick)
+        for s, w, j, toks in results:
+            pages_before = len(s.pages)
+            sc.commit_spec(s, j)
+            spec_seen["rounds"] += 1
+            spec_seen["multi"] += j > 1
+            spec_seen["rollbacks"] += len(s.pages) < pages_before
+            s.req.out.extend(toks)
             if s.req.done:
                 sc.finish(s, now)
 
@@ -351,9 +380,11 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
            lambda: sched.sweep(now), reclaim_op, handoff_op,
            lambda: decode_step_op(sched_b, engine_b),
            lambda: sched_b.admit(now),
-           lambda: prefill_step(sched_b, engine_b)]
-    weights = np.array([0.18, 0.14, 0.16, 0.12, 0.06, 0.04, 0.04, 0.03,
-                        0.09, 0.08, 0.03, 0.03])
+           lambda: prefill_step(sched_b, engine_b),
+           spec_decode_op,
+           lambda: spec_decode_op(sched_b, engine_b)]
+    weights = np.array([0.18, 0.14, 0.16, 0.06, 0.06, 0.04, 0.04, 0.03,
+                        0.09, 0.04, 0.03, 0.03, 0.06, 0.04])
     for _ in range(300):
         now += float(rng.uniform(0.0, 0.02))  # deadlines really expire
         ops[int(rng.choice(len(ops), p=weights))]()
@@ -388,6 +419,12 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     # legs of the transfer protocol ran.
     assert transfers["done"] > 0
     assert transfers["revoked"] > 0
+    # The speculative surface (ISSUE 14): rounds ran, at least one
+    # committed more than one token, and at least one rollback handed
+    # rejected-draft pages back through the ownership check.
+    assert spec_seen["rounds"] > 0
+    assert spec_seen["multi"] > 0
+    assert spec_seen["rollbacks"] > 0
 
 
 def test_engine_preemption_recovers_and_completes():

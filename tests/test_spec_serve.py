@@ -1,0 +1,478 @@
+"""Batched speculative decoding inside the serving engine (ISSUE 14).
+
+The acceptance contract: at T=0 a spec-on engine's per-request outputs
+are BITWISE the spec-off engine's — through cache dtypes, prefix
+sharing on/off, preemption, crash/failover, and a disaggregated
+prefill->decode handoff — while the decode-tick count drops with the
+acceptance rate. The greedy acceptance law itself is pinned against
+models/generate's jitted core so the two dialects can never drift
+(the T>0 law stays generate.py's, gated by test_spec_sampling.py's
+distribution-equality tests).
+"""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+from mpi_cuda_cnn_tpu.serve.fleet import Fleet, SimCompute, make_fleet_workload
+from mpi_cuda_cnn_tpu.serve.scheduler import ContinuousScheduler, Request
+from mpi_cuda_cnn_tpu.serve.spec import (
+    accept_len,
+    empty_spec_fields,
+    lookup_propose,
+)
+
+MODEL = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=64)
+DRAFT = TransformerLM(vocab=13, dim=16, heads=2, depth=1, max_seq=64)
+
+
+def _params():
+    return MODEL.init(jax.random.key(0))
+
+
+def _workload(rng, n=5, max_new=16, prompt_len=(4, 10)):
+    return [
+        Request(rid=i,
+                prompt=rng.integers(0, 13, (int(rng.integers(*prompt_len)),))
+                .astype(np.int32),
+                max_new_tokens=int(rng.integers(4, max_new)))
+        for i in range(n)
+    ]
+
+
+def _outputs(res):
+    return {r.rid: list(r.out) for r in res.requests}
+
+
+# -- the shared acceptance core ----------------------------------------
+
+
+def test_accept_len_matches_generate_acceptance_core():
+    """THE no-drift gate: serve/spec.accept_len (numpy host dialect)
+    and models/generate._accept_and_emit (the jitted lax dialect the
+    B=1 speculative paths run) implement ONE greedy acceptance law.
+    Randomized verify-input/target-pick pairs must produce the same
+    emitted count j and the same emitted rows."""
+    from jax import lax
+
+    from mpi_cuda_cnn_tpu.models.generate import _accept_and_emit
+
+    rng = np.random.default_rng(0)
+    for trial in range(64):
+        k = int(rng.integers(2, 9))
+        u = rng.integers(0, 5, (k,)).astype(np.int32)
+        y = rng.integers(0, 5, (k,)).astype(np.int32)
+        # Force long accepted prefixes in half the trials (uniform
+        # draws rarely match, and the all-accept path must be covered).
+        if trial % 2:
+            n_match = int(rng.integers(0, k))
+            u[1 : 1 + n_match] = y[:n_match]
+        j_host = accept_len(u, y)
+        out = jnp.zeros((1, k + 8), jnp.int32)
+        j_jit, cur, out = _accept_and_emit(
+            jnp.asarray(u)[None, :], jnp.asarray(y)[None, :], out, 0
+        )
+        assert int(j_jit) == j_host, (trial, u, y)
+        np.testing.assert_array_equal(
+            np.asarray(out)[0, :j_host], y[:j_host], err_msg=str(trial)
+        )
+        assert int(cur[0]) == y[j_host - 1]
+
+
+def test_lookup_propose_contract():
+    ctx = np.asarray([1, 2, 3, 9, 9, 1, 2], np.int32)
+    # Tail 2-gram (1, 2) occurred at positions 0-1 -> proposals follow
+    # it: 3, 9, 9.
+    np.testing.assert_array_equal(lookup_propose(ctx, 3, 2), [3, 9, 9])
+    # No earlier occurrence -> repeat the current token.
+    np.testing.assert_array_equal(
+        lookup_propose(np.asarray([5, 6, 7], np.int32), 3, 2), [7, 7, 7]
+    )
+    # Match so late the continuation runs out -> pad with the last
+    # available token.
+    ctx2 = np.asarray([4, 8, 4, 8], np.int32)  # (4, 8) recurs at the end
+    np.testing.assert_array_equal(lookup_propose(ctx2, 3, 2), [4, 8, 8])
+    # MOST RECENT occurrence wins.
+    ctx3 = np.asarray([1, 2, 5, 1, 2, 6, 1, 2], np.int32)
+    np.testing.assert_array_equal(lookup_propose(ctx3, 2, 2), [6, 1])
+
+
+# -- scheduler: acceptance-aware page accounting -----------------------
+
+
+def test_scheduler_spec_growth_and_rollback():
+    """grow_for_decode(spec_k=) extends a decoding slot's pages toward
+    its speculative width WITHOUT preempting; commit_spec commits j
+    tokens and rolls pages holding only rejected rows back into the
+    pool — ownership-checked, invariant-checked after every step."""
+    from mpi_cuda_cnn_tpu.serve.pool import PagePool, pages_for
+
+    pool = PagePool(12)  # 11 usable pages of 4
+    sched = ContinuousScheduler(slots=2, pool=pool, page_size=4, max_len=44)
+    req = Request(rid=0, prompt=np.arange(6, dtype=np.int32) % 13,
+                  max_new_tokens=24)
+    sched.submit([req])
+    (slot,) = sched.admit(0.0)
+    slot.cached = slot.target
+    req.out.append(1)
+    sched.check()
+    # Spec growth: want pages for cached + min(k, remaining) rows.
+    dslots = sched.grow_for_decode(0.0, spec_k=8)
+    assert dslots == [slot]
+    assert len(slot.pages) == pages_for(slot.cached + 8, 4)
+    assert sched.spec_width(slot, 8) == 8
+    sched.check()
+    # Commit 3 of 8: pages past the committed extent (rejected-draft
+    # rows only) return to the pool.
+    free_before = pool.free_pages
+    sched.commit_spec(slot, 3)
+    assert slot.cached == slot.target + 3
+    assert len(slot.pages) == pages_for(slot.cached, 4)
+    assert pool.free_pages > free_before
+    sched.check()
+    # A dry pool degrades the width instead of preempting: fill the
+    # pool with a second request, then grow again.
+    req2 = Request(rid=1, prompt=np.arange(4, dtype=np.int32) % 13,
+                   max_new_tokens=4)
+    sched.submit([req2])
+    (slot2,) = sched.admit(0.0)
+    blocker = pool.try_alloc(pool.free_pages, "blocker")
+    dslots = sched.grow_for_decode(0.0, spec_k=8)
+    assert slot in dslots
+    assert sched.preemptions == 0          # speculation never evicts
+    w = sched.spec_width(slot, 8)
+    assert 1 <= w < 8
+    pool.free(blocker, "blocker")
+    assert not slot2.free                  # untouched by spec growth
+    sched.check()
+
+
+# -- engine parity ------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_engine_spec_on_off_bitwise_parity(dtype):
+    """T=0 spec-on outputs are bitwise spec-off's per request, across
+    cache dtypes — the tentpole acceptance gate. The spec run must
+    also stamp nonzero round counters."""
+    params = _params()
+    rng = np.random.default_rng(7)
+    reqs = _workload(rng, n=5, max_new=18)
+    off = PagedEngine(MODEL, params, slots=2, num_pages=31, page_size=8,
+                      prefill_chunk=4, max_len=40, cache_dtype=dtype)
+    res_off = off.run(copy.deepcopy(reqs), mode="continuous")
+    on = PagedEngine(MODEL, params, slots=2, num_pages=31, page_size=8,
+                     prefill_chunk=4, max_len=40, cache_dtype=dtype,
+                     spec="lookup", spec_k=6)
+    res_on = on.run(copy.deepcopy(reqs), mode="continuous", spec=True)
+    assert _outputs(res_on) == _outputs(res_off), dtype
+    assert res_on.spec["spec_rounds"] > 0
+    assert res_on.spec["spec_proposed"] > 0
+    assert res_off.spec == empty_spec_fields()
+
+
+def test_engine_spec_parity_through_preemption_and_prefix():
+    """The same bitwise contract through recompute preemption (tiny
+    pool) and prefix sharing (shared templates, COW at divergence) —
+    the interactions ISSUE 14 forces through the page accounting."""
+    params = _params()
+    rng = np.random.default_rng(5)
+    reqs = _workload(rng, n=6, max_new=16, prompt_len=(5, 9))
+    # Preemption leg: a pool far smaller than the worst case.
+    off = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                      prefill_chunk=8, max_len=40)
+    r_off = off.run(copy.deepcopy(reqs), mode="continuous")
+    assert r_off.preemptions > 0
+    on = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                     prefill_chunk=8, max_len=40, spec="lookup", spec_k=8)
+    r_on = on.run(copy.deepcopy(reqs), mode="continuous", spec=True)
+    assert _outputs(r_on) == _outputs(r_off)
+    # Prefix leg: shared template prompts, sharing on both sides.
+    tmpl = rng.integers(0, 13, (12,)).astype(np.int32)
+    shared = [
+        Request(rid=i,
+                prompt=np.concatenate(
+                    [tmpl, rng.integers(0, 13, (3,)).astype(np.int32)]),
+                max_new_tokens=10)
+        for i in range(6)
+    ]
+    px_off = PagedEngine(MODEL, params, slots=3, num_pages=25, page_size=4,
+                         prefill_chunk=4, max_len=40)
+    p_off = px_off.run(copy.deepcopy(shared), mode="continuous", prefix=True)
+    px_on = PagedEngine(MODEL, params, slots=3, num_pages=25, page_size=4,
+                        prefill_chunk=4, max_len=40, spec="lookup",
+                        spec_k=8)
+    p_on = px_on.run(copy.deepcopy(shared), mode="continuous", prefix=True,
+                     spec=True)
+    assert p_off.prefix["prefix_hits"] > 0
+    assert _outputs(p_on) == _outputs(p_off)
+
+
+def test_engine_spec_draft_parity():
+    """Model-draft behind the same interface: a genuinely different
+    draft model changes the speed only — outputs stay the target's
+    greedy continuations, bitwise."""
+    params = _params()
+    dparams = DRAFT.init(jax.random.key(1))
+    rng = np.random.default_rng(3)
+    reqs = _workload(rng, n=4, max_new=14)
+    off = PagedEngine(MODEL, params, slots=2, num_pages=25, page_size=8,
+                      prefill_chunk=4, max_len=40)
+    r_off = off.run(copy.deepcopy(reqs), mode="continuous")
+    on = PagedEngine(MODEL, params, slots=2, num_pages=25, page_size=8,
+                     prefill_chunk=4, max_len=40, spec="draft", spec_k=4,
+                     draft_model=DRAFT, draft_params=dparams)
+    r_on = on.run(copy.deepcopy(reqs), mode="continuous", spec=True)
+    assert _outputs(r_on) == _outputs(r_off)
+    assert r_on.spec["spec_rounds"] > 0
+
+
+def test_engine_spec_tick_count_drops_on_template_traffic():
+    """The perf pin, CPU-banked: on the --prefix-mix-style template
+    workload (greedy continuations of a small random-init model are
+    highly repetitive, exactly the regime prompt lookup exists for)
+    the spec-on run finishes in strictly fewer decode ticks with a
+    nonzero acceptance count."""
+    from mpi_cuda_cnn_tpu.serve.bench import make_workload
+
+    params = _params()
+    reqs = make_workload(n=10, vocab=13, prompt_min=6, prompt_max=14,
+                         out_min=8, out_max=24, rate=0.0, seed=2,
+                         prefix_mix=0.9)
+    off = PagedEngine(MODEL, params, slots=3, num_pages=40, page_size=8,
+                      prefill_chunk=8, max_len=48)
+    r_off = off.run(copy.deepcopy(reqs), mode="continuous")
+    on = PagedEngine(MODEL, params, slots=3, num_pages=40, page_size=8,
+                     prefill_chunk=8, max_len=48, spec="lookup", spec_k=8)
+    r_on = on.run(copy.deepcopy(reqs), mode="continuous", spec=True)
+    assert _outputs(r_on) == _outputs(r_off)
+    assert r_on.decode_ticks < r_off.decode_ticks
+    assert r_on.spec["spec_accepted"] > 0
+
+
+def test_engine_spec_misconfig_raises():
+    params = _params()
+    with pytest.raises(ValueError, match="spec"):
+        PagedEngine(MODEL, params, spec="nope")
+    with pytest.raises(ValueError, match="spec_k"):
+        PagedEngine(MODEL, params, spec="lookup", spec_k=1)
+    with pytest.raises(ValueError, match="draft"):
+        PagedEngine(MODEL, params, spec="draft")
+    eng = PagedEngine(MODEL, params, slots=2, num_pages=13, page_size=8)
+    req = [Request(rid=0, prompt=np.arange(4) % 13, max_new_tokens=4)]
+    with pytest.raises(ValueError, match="spec='off'"):
+        eng.run(req, mode="continuous", spec=True)
+    spec_eng = PagedEngine(MODEL, params, slots=2, num_pages=13,
+                           page_size=8, spec="lookup")
+    with pytest.raises(ValueError, match="static"):
+        spec_eng.run(req, mode="static", spec=True)
+
+
+# -- fleet: crash/failover and disaggregated handoff --------------------
+
+
+def test_sim_fleet_spec_parity_determinism_and_crash():
+    """Sim fleet: spec-on outputs equal spec-off's (the sim verify is
+    the token mix itself), two identical-seed spec runs are bitwise
+    equal (trace CRC + spec counters), and a zombie crash changes
+    nothing — the committed-token account carries across failover."""
+    from mpi_cuda_cnn_tpu.faults import FaultInjector
+
+    def factory(name):
+        return SimCompute(vocab=512, chunk=32, salt=0)
+
+    reqs = make_fleet_workload(n=250, vocab=512, prompt_min=8,
+                               prompt_max=96, out_min=8, out_max=96,
+                               rate=300.0, seed=0, prefix_mix=0.5)
+
+    def run(spec, plan=None):
+        fleet = Fleet(
+            factory, replicas=3, slots=4, page_size=16, max_len=192,
+            spec=spec, spec_k=8,
+            faults=FaultInjector(plan) if plan else None,
+        )
+        return fleet.run(copy.deepcopy(reqs))
+
+    r_off = run("off")
+    r_on = run("lookup")
+    assert r_on.outputs() == r_off.outputs()
+    assert r_on.spec["spec_rounds"] > 0
+    r_on2 = run("lookup")
+    assert r_on2.trace_crc == r_on.trace_crc
+    assert r_on2.spec == r_on.spec
+    assert r_on2.status_counts() == r_on.status_counts()
+    r_crash = run("lookup",
+                  "replica_crash@fleet.tick:40?replica=1&zombie_ticks=3")
+    assert r_crash.outputs() == r_off.outputs()
+    assert r_crash.crashes == 1
+    assert r_crash.redispatches > 0
+
+
+def test_engine_fleet_spec_crash_parity():
+    """Engine-backed fleet: spec-on with a mid-run crash produces the
+    crash-free spec-off fleet's outputs per request — the crash/
+    failover leg of the ISSUE 14 acceptance gate."""
+    from mpi_cuda_cnn_tpu.faults import FaultInjector
+
+    model = TransformerLM(vocab=13, dim=32, heads=2, depth=1, max_seq=64)
+    params = model.init(jax.random.key(0))
+
+    def factory_for(spec):
+        def factory(name):
+            return EngineOf(spec)
+        return factory
+
+    def EngineOf(spec):
+        from mpi_cuda_cnn_tpu.serve.fleet import EngineCompute
+
+        return EngineCompute(PagedEngine(
+            model, params, slots=3, num_pages=31, page_size=8,
+            prefill_chunk=8, max_len=56, spec=spec, spec_k=6,
+        ))
+
+    reqs = make_fleet_workload(n=24, vocab=13, prompt_min=4, prompt_max=12,
+                               out_min=4, out_max=20, rate=200.0, seed=1)
+    base = Fleet(factory_for("off"), replicas=2, slots=3, num_pages=31,
+                 page_size=8, max_len=56)
+    r_base = base.run(copy.deepcopy(reqs))
+    crash = Fleet(factory_for("lookup"), replicas=2, slots=3, num_pages=31,
+                  page_size=8, max_len=56, spec="lookup", spec_k=6,
+                  faults=FaultInjector(
+                      "replica_crash@fleet.tick:30?replica=0"))
+    r_crash = crash.run(copy.deepcopy(reqs))
+    assert r_crash.crashes == 1
+    assert r_crash.outputs() == r_base.outputs()
+    assert r_crash.spec["spec_rounds"] > 0
+
+
+def test_engine_disagg_spec_parity_through_handoff():
+    """Disaggregated pools with speculation on the decode side: the
+    handed-off page sets decode speculatively and the outputs stay
+    bitwise the unified spec-off fleet's — the through-a-handoff leg
+    of the acceptance gate."""
+    from mpi_cuda_cnn_tpu.serve.fleet import EngineCompute
+
+    model = TransformerLM(vocab=13, dim=32, heads=2, depth=1, max_seq=64)
+    params = model.init(jax.random.key(0))
+
+    def factory_for(spec):
+        def factory(name):
+            return EngineCompute(PagedEngine(
+                model, params, slots=3, num_pages=31, page_size=8,
+                prefill_chunk=8, max_len=56, spec=spec, spec_k=6,
+            ))
+        return factory
+
+    reqs = make_fleet_workload(n=20, vocab=13, prompt_min=4, prompt_max=12,
+                               out_min=4, out_max=20, rate=200.0, seed=4)
+    unified = Fleet(factory_for("off"), replicas=2, slots=3, num_pages=31,
+                    page_size=8, max_len=56)
+    r_uni = unified.run(copy.deepcopy(reqs))
+    disagg = Fleet(factory_for("lookup"), slots=3, num_pages=31,
+                   page_size=8, max_len=56, spec="lookup", spec_k=6,
+                   pools={"prefill": 1, "decode": 1}, handoff_ticks=2)
+    r_dis = disagg.run(copy.deepcopy(reqs))
+    assert r_dis.handoffs > 0
+    assert r_dis.outputs() == r_uni.outputs()
+    assert r_dis.spec["spec_rounds"] > 0
+
+
+# -- observability ------------------------------------------------------
+
+
+def test_spec_tick_records_trace_and_report(tmp_path):
+    """Tick records carry the spec round detail, `mctpu trace`'s token
+    cross-check stays exact under variable-length commits (exit 0),
+    and the report's serve table renders the acceptance column."""
+    from mpi_cuda_cnn_tpu.obs.report import render_markdown, summarize
+    from mpi_cuda_cnn_tpu.obs.schema import dump_records, make_record
+    from mpi_cuda_cnn_tpu.obs.timeline import reconstruct, trace_main
+
+    params = _params()
+    rng = np.random.default_rng(9)
+    reqs = _workload(rng, n=4, max_new=14)
+    eng = PagedEngine(MODEL, params, slots=2, num_pages=25, page_size=8,
+                      prefill_chunk=4, max_len=40, spec="lookup", spec_k=6)
+    records = []
+
+    def sink(rec):
+        records.append(make_record("tick", rec["now"], **rec))
+
+    res = eng.run(reqs, mode="continuous", spec=True, tick_sink=sink)
+    assert any(r.get("spec") for r in records)
+    for rec in res.request_records():
+        records.append(make_record("request", 1.0, **rec))
+    records.append(make_record("serve", 1.0, bench="serve",
+                               **res.summary()))
+    path = tmp_path / "spec_run.jsonl"
+    dump_records(records, path)
+    # Lifecycle reconstruction: token account exact, spec counters up.
+    lcs = reconstruct(records)["continuous"]
+    assert all(lc.consistent for lc in lcs.values())
+    assert sum(lc.spec_rounds for lc in lcs.values()) \
+        == res.spec["spec_rounds"]
+    assert sum(lc.spec_accepted for lc in lcs.values()) \
+        == res.spec["spec_accepted"]
+    assert trace_main([str(path)]) == 0
+    # Report: the serving table's acceptance column.
+    md = render_markdown(summarize(records))
+    assert "spec accept" in md
+    prop, acc = res.spec["spec_proposed"], res.spec["spec_accepted"]
+    assert f"{100.0 * acc / prop:.1f}%" in md
+
+
+def test_spec_registry_metrics():
+    """The serve.spec.* registry family: round/proposal/acceptance
+    counters plus the accepted-per-round histogram."""
+    from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
+
+    params = _params()
+    rng = np.random.default_rng(11)
+    reqs = _workload(rng, n=4, max_new=12)
+    eng = PagedEngine(MODEL, params, slots=2, num_pages=25, page_size=8,
+                      prefill_chunk=4, max_len=40, spec="lookup", spec_k=6)
+    registry = MetricsRegistry()
+    res = eng.run(reqs, mode="continuous", spec=True, registry=registry)
+    assert registry.counters["serve.spec.rounds"].value \
+        == res.spec["spec_rounds"]
+    assert registry.counters["serve.spec.proposed"].value \
+        == res.spec["spec_proposed"]
+    assert registry.counters["serve.spec.accepted_total"].value \
+        == res.spec["spec_accepted"]
+    h = registry.histograms["serve.spec.accepted"]
+    assert h.count == res.spec["spec_rounds"]
+
+
+def test_serve_bench_cli_spec_e2e_and_compare_flattening(tmp_path):
+    """`mctpu serve-bench --spec lookup` end-to-end: strict-valid
+    JSONL, spec fields stamped in the serve summary, and `mctpu
+    compare` flattening exposes serve.<mode>.spec_* metrics."""
+    from mpi_cuda_cnn_tpu.obs.regress import metrics_from_records
+    from mpi_cuda_cnn_tpu.obs.schema import load_records
+    from mpi_cuda_cnn_tpu.serve.bench import serve_bench_main
+
+    sink = tmp_path / "serve_spec.jsonl"
+    rc = serve_bench_main([
+        "--requests", "6", "--dim", "32", "--depth", "1", "--heads", "2",
+        "--vocab", "64", "--max-seq", "128", "--prompt-min", "4",
+        "--prompt-max", "12", "--out-min", "4", "--out-max", "12",
+        "--slots", "2", "--page-size", "8", "--prefill-chunk", "8",
+        "--mode", "continuous", "--spec", "lookup", "--spec-k", "4",
+        "--metrics-jsonl", str(sink),
+    ])
+    assert rc == 0
+    recs = load_records(sink, strict=True)
+    serve = [r for r in recs if r["event"] == "serve"]
+    assert serve and serve[-1]["spec"] == "lookup"
+    assert serve[-1]["spec_rounds"] > 0
+    flat = metrics_from_records(recs)
+    for k in ("spec_rounds", "spec_proposed", "spec_accepted"):
+        assert f"serve.continuous.{k}" in flat
+    # Config errors exit 2 with one-line messages.
+    assert serve_bench_main(["--spec", "lookup", "--mode", "static"]) == 2
+    assert serve_bench_main(["--spec", "lookup", "--spec-k", "1"]) == 2
