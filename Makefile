@@ -184,6 +184,18 @@ report:
 health:
 	$(PY) -m mpi_cuda_cnn_tpu health $(RUN) $(if $(SLO),--slo $(SLO))
 
+# Deterministic flight-recorder replay (ISSUE 15, obs/replay.py):
+# reconstruct the full serving state from a --log full trail,
+# cross-checking the stamped per-tick state_crc (exit 1 on drift):
+#   make replay RUN=run.jsonl [TICK=4000]
+# First-divergence localization between two identical-seed trails:
+#   make diverge A=run_a.jsonl B=run_b.jsonl
+replay:
+	$(PY) -m mpi_cuda_cnn_tpu replay $(RUN) $(if $(TICK),--at-tick $(TICK))
+
+diverge:
+	$(PY) -m mpi_cuda_cnn_tpu diverge $(A) $(B)
+
 # Style gate + the framework-invariant analyzer (ISSUE 10): ruff at
 # the pyproject scope, then `mctpu lint` (rules MCT001-MCT007 — jax
 # purity, clock/RNG/donation discipline, schema/fault-site

@@ -18,6 +18,8 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu compare base.jsonl new.jsonl    # regression gate
     python -m mpi_cuda_cnn_tpu health run.jsonl --slo slo.json # SLO verdicts
     python -m mpi_cuda_cnn_tpu lint --format json              # invariant lint
+    python -m mpi_cuda_cnn_tpu replay run.jsonl --at-tick 40   # state replay
+    python -m mpi_cuda_cnn_tpu diverge a.jsonl b.jsonl         # 1st divergence
 """
 
 from __future__ import annotations
@@ -263,6 +265,21 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.causal import explain_main
 
         return explain_main(argv[1:])
+    if argv and argv[0] == "replay":
+        # Offline: deterministic flight-recorder replay — reconstruct
+        # the full serving state from a run's tick trail, cross-checking
+        # the stamped per-tick state digests (obs.replay, ISSUE 15) —
+        # jax-free.
+        from .obs.replay import replay_main
+
+        return replay_main(argv[1:])
+    if argv and argv[0] == "diverge":
+        # Offline: first-divergence localization between two
+        # identical-seed trails — the determinism gates' forensic tool
+        # (obs.diverge, ISSUE 15) — jax-free.
+        from .obs.diverge import diverge_main
+
+        return diverge_main(argv[1:])
     if argv and argv[0] == "top":
         # Live dashboard: tail (or replay) a metrics JSONL and render
         # the engine/trainer gauges in place (obs.top) — jax-free.

@@ -97,6 +97,10 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                "fenced_discards", "crashes", "joins", "leaves",
                "restarts", "circuit_opens", "replicas", "trace_crc",
                "alerts_fired", "alerts_crc",
+               # Flight recorder (ISSUE 15): the per-tick state-digest
+               # chain — the determinism gates pin it at 0%/equal, and
+               # a failure's next step is `mctpu diverge A B`.
+               "state_crc",
                # Prefix-sharing structural counters (ISSUE 9).
                "prefix_hits", "prefix_misses", "prefix_hit_tokens",
                "prefix_cow", "prefix_inserts", "prefix_evictions",
@@ -341,6 +345,45 @@ def best_of(metric_sets: list[dict[str, float]]) -> dict[str, float]:
     return out
 
 
+def _has_tick_trail(path: str | Path) -> bool:
+    """Whether a run file carries per-tick records (cheap textual scan
+    with early exit — the hint below must not re-parse a storm file)."""
+    try:
+        with Path(path).open() as fh:
+            for line in fh:
+                if '"event": "tick"' in line or '"event": "fleet"' in line:
+                    return True
+    except OSError:
+        return False
+    return False
+
+
+def _print_diverge_hint(paths: list[str], rows: list[dict],
+                        regressed: list[str]) -> None:
+    """Determinism-failure next step (ISSUE 15): when a gated *_crc /
+    equal-direction metric regressed between exactly two runs that both
+    carry tick trails, name the exact `mctpu diverge` invocation that
+    localizes the first divergent tick."""
+    if len(paths) != 2:
+        return
+    bad = {r["metric"] for r in rows if r.get("verdict") == "REGRESS"
+           and (r.get("direction") == "equal"
+                or r["metric"].endswith("_crc"))}
+    if not (bad & set(regressed)):
+        return
+    if _has_tick_trail(paths[0]) and _has_tick_trail(paths[1]):
+        print(f"hint: determinism metric(s) drifted "
+              f"({', '.join(sorted(bad & set(regressed)))}) and both "
+              "runs carry tick trails — localize the first divergent "
+              f"tick with:\n  mctpu diverge {paths[0]} {paths[1]}",
+              file=sys.stderr)
+    else:
+        print("hint: determinism metric(s) drifted "
+              f"({', '.join(sorted(bad & set(regressed)))}) — re-run "
+              "both storms with --log full and localize the first "
+              "divergent tick with `mctpu diverge A B`", file=sys.stderr)
+
+
 def compare_main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="mctpu compare",
@@ -391,6 +434,7 @@ def compare_main(argv: list[str] | None = None) -> int:
     if regressed:
         print(f"REGRESSION: {len(regressed)} metric(s) worse than "
               f"tolerance: {', '.join(regressed)}", file=sys.stderr)
+        _print_diverge_hint(args.paths, rows, regressed)
         return 1
     n_ok = sum(1 for r in rows if r["verdict"] == "ok")
     if n_ok == 0:

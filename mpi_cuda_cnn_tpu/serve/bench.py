@@ -453,7 +453,12 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                 "weights_dtype": engine.weights_dtype,
                 "spec": args.spec, "spec_k": args.spec_k,
                 "slots": args.slots, "page_size": args.page_size,
-                "pages": pages, **s,
+                "pages": pages,
+                # Whether the continuous run shared prefixes (ISSUE 15):
+                # the replay reconstruction needs the flag — a sharing-on
+                # run with zero hits digests (0,0,...) where a
+                # sharing-off run digests None.
+                "prefix_cache": bool(args.prefix_cache), **s,
             })
             print(json.dumps({"bench": "serve", "backend":
                               jax.default_backend(),
@@ -866,7 +871,10 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                                  else args.replicas),
             "rate": args.rate,
             "slots": args.slots, "page_size": args.page_size,
-            "pages": pages, "compute": args.compute, **s,
+            "pages": pages, "compute": args.compute,
+            # Flight-recorder geometry flag (ISSUE 15): `mctpu replay`
+            # rebuilds each replica's mirror with sharing on/off from it.
+            "prefix_cache": bool(args.prefix_cache), **s,
         })
         print(json.dumps({"bench": "fleet", "compute": args.compute,
                           "policy": args.policy, **s}))

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
@@ -76,6 +77,7 @@ from .scheduler import (
     SLOPolicy,
     SLOScheduler,
     StaticScheduler,
+    scheduler_digest,
     tenant_block,
     terminal_fields,
 )
@@ -93,6 +95,11 @@ def request_record(r: Request, mode: str) -> dict:
         "status": r.status,
         "tenant": r.tenant or "default",
         "prompt_tokens": int(r.prompt.size),
+        # The token budget (ISSUE 15): what obs/replay.py needs to
+        # reconstruct static reservations and done-checks from the
+        # trail alone (output_tokens only equals it for finished
+        # requests).
+        "max_new_tokens": int(r.max_new_tokens),
         "output_tokens": len(r.out),
         "ttft_ms": (None if r.first_token_at is None
                     else round(1e3 * (r.first_token_at - r.arrival), 3)),
@@ -134,6 +141,11 @@ class ServeResult:
     # tokens proposed, draft tokens accepted — always present (zeros
     # with spec off) so the gated metrics exist in every run.
     spec: dict = dataclasses.field(default_factory=empty_spec_fields)
+    # Flight-recorder chain (ISSUE 15): crc32 chained over every tick's
+    # state digest — ONE number that pins the full per-tick state
+    # trajectory, stamped in the summary so the 0%/equal determinism
+    # gates cover it even on summary-only runs.
+    state_crc: int = 0
 
     @property
     def finished_requests(self) -> list[Request]:
@@ -193,6 +205,9 @@ class ServeResult:
             "watchdog_slow_ticks": self.watchdog_slow_ticks,
             "duration_s": round(self.duration_s, 4),
             "tokens_per_s": round(self.tokens_per_s, 2),
+            # Per-tick state-digest chain (ISSUE 15): gated at 0%/equal
+            # by the determinism gates like trace_crc/blame_crc.
+            "state_crc": self.state_crc,
             "ttft_p50_ms": pct_nearest(ttft, 50),
             "ttft_p99_ms": pct_nearest(ttft, 99),
             "tpot_p50_ms": pct_nearest(tpot, 50),
@@ -679,6 +694,8 @@ class PagedEngine:
         sched.submit(requests)
         n_reqs = sched.unfinished
         decode_ticks = prefill_chunks = 0
+        state_chain = 0
+        spec_extra = (1, self.spec_k) if spec else (0, 0)
         events: list[dict] = []
         failed_logged: set[int] = set()  # rids with a request_failed event
         watchdog_slow = 0
@@ -871,6 +888,14 @@ class PagedEngine:
             preempted = [v for v, _ in preempted_pairs]
             blocked = sched.drain_blocked()
             prefix_tick = pcache.drain_tick() if pcache is not None else None
+            # Flight recorder (ISSUE 15): the end-of-iteration state
+            # digest, stamped on the tick record and chained into the
+            # summary's state_crc — computed on EVERY run (bare runs
+            # included: the chain is what the determinism gates pin on
+            # summary-only storms). O(slots) per tick.
+            state_crc = scheduler_digest(sched, extra=spec_extra)
+            state_chain = zlib.crc32(state_crc.to_bytes(4, "little"),
+                                     state_chain)
             if not want_ticks:
                 sched.check()
                 tick_idx += 1
@@ -913,7 +938,17 @@ class PagedEngine:
                 # good/bad events the SLO burn-rate rules fold, emitted
                 # when they happen instead of at end of run.
                 "terminal": [terminal_fields(r) for r in new_fin + new_drop],
+                # Flight recorder (ISSUE 15): crc32 of the canonical
+                # host-side state after this iteration — `mctpu replay`
+                # recomputes it from the events above at every tick.
+                "state_crc": state_crc,
             }
+            if squeezes:
+                # Pages an injected squeeze currently holds: the replay
+                # reconstruction needs it to account the pool's free
+                # count (squeeze allocations have no scheduling event).
+                tick_rec["squeezed"] = sum(len(sq["pages"])
+                                           for sq in squeezes)
             if spec_rec is not None:
                 # Speculative round detail (ISSUE 14): [rid, proposed,
                 # accepted] per slot — `mctpu trace` derives the round's
@@ -1003,4 +1038,5 @@ class PagedEngine:
             watchdog_slow_ticks=watchdog_slow, prefix=prefix_fields,
             spec={"spec_rounds": spec_rounds, "spec_proposed": spec_proposed,
                   "spec_accepted": spec_accepted},
+            state_crc=state_chain,
         )

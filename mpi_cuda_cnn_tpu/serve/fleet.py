@@ -83,12 +83,13 @@ from .handoff import (
 )
 from .pool import PagePool
 from .prefix_cache import PrefixCache, empty_prefix_fields
-from .router import CircuitOpen, Router
+from .router import CircuitOpen, Router, fleet_state_digest
 from .spec import LookupProposer, empty_spec_fields, run_round
 from .scheduler import (
     ContinuousScheduler,
     Request,
     SLOScheduler,
+    scheduler_digest,
     tenant_block,
     terminal_fields,
     validate_request,
@@ -219,6 +220,9 @@ class ReplicaCore:
         else:
             self.sched = ContinuousScheduler(**sched_kw)
         self.compute = compute
+        # Precomputed digest config (ISSUE 15): built once — step()
+        # stamps a state digest per tick of a 10^5 storm.
+        self._digest_extra = ((1, spec_k) if spec != "off" else (0, 0))
         self.on_emit = on_emit
         # Disaggregated serving hook (ISSUE 13): called when a slot's
         # prefill completes with decode work remaining; returning True
@@ -360,9 +364,22 @@ class ReplicaCore:
             "finished": [r.rid for r in new_fin],
             "aborted": [[r.rid, r.status] for r in new_drop],
             "progressed": progressed or bool(admitted or new_fin or new_drop),
+            # Flight recorder (ISSUE 15): this replica's end-of-step
+            # state digest — the ONE scheduler_digest spelling, stamped
+            # on every ReplicaCore tick (zombie steps included while
+            # their records still flow) and chained into the fleet
+            # summary's state_crc.
+            "state_crc": scheduler_digest(sched, extra=self._digest_extra),
         }
         if prefix_tick is not None:
             rec["prefix_hits"] = prefix_tick["hits"]
+            # Cumulative tree stats (ISSUE 15): the replay
+            # reconstruction derives hit/miss counts itself and adopts
+            # the cow/insert/eviction deltas from here (both feed the
+            # digest's prefix tuple and the free-page conservation
+            # audit).
+            rec["prefix"] = {"shared_pages": self.prefix.shared_pages,
+                             **self.prefix.stats}
         if spec_rec is not None:
             rec["spec"] = spec_rec
         return rec, new_fin, new_drop
@@ -497,6 +514,11 @@ class FleetResult:
     # Fleet-wide speculative-decoding counters (ISSUE 14): same
     # contract — summed across incarnations, zeros with spec off.
     spec: dict = dataclasses.field(default_factory=empty_spec_fields)
+    # Flight-recorder chain (ISSUE 15): crc32 chained over every
+    # per-tick state digest (router record, then each stepped replica)
+    # in emission order — the whole state trajectory as ONE gated
+    # number, present on summary-only storms.
+    state_crc: int = 0
 
     @property
     def output_tokens(self) -> int:
@@ -568,6 +590,9 @@ class FleetResult:
             "restarts": self.restarts,
             "circuit_opens": self.circuit_opens,
             "trace_crc": self.trace_crc,
+            # Per-tick state-digest chain (ISSUE 15): the determinism
+            # gates pin it at 0%/equal next to trace_crc/blame_crc.
+            "state_crc": self.state_crc,
             # Disaggregated-serving counters (ISSUE 13): flat keys the
             # disagg determinism gate pins at exact equality; zeros on
             # a unified fleet so they exist in every fleet-bench run.
@@ -716,6 +741,17 @@ class Fleet:
         self._handoff_started_tick: list[tuple[int, str]] = []
         self._handoff_done_tick: list[tuple[int, str]] = []
         self._handoff_aborted_tick: list[tuple[int, str]] = []
+        # Placement/re-target markers (ISSUE 15): a placement allocates
+        # the destination pages and an un-place (bind-time re-target)
+        # releases them, both without any other trail event — the
+        # replay reconstruction needs the moments to account the
+        # receiver pool's free count.
+        self._handoff_placed_tick: list[tuple[int, str]] = []
+        self._handoff_unplaced_tick: list[tuple[int, str]] = []
+        # Flight-recorder chain (ISSUE 15): crc32 chained over every
+        # per-tick digest in emission order (fleet/router digest, then
+        # each stepped replica's) — the summary's state_crc.
+        self.state_chain = 0
         self._retired = [0, 0, 0]  # decode_ticks, prefill_chunks, preempts
         self._retired_prefix = empty_prefix_fields()
         self._retired_spec = empty_spec_fields()
@@ -1032,6 +1068,7 @@ class Fleet:
                 ho.dst_pages = dst_pages
                 ho.state = "copying"
                 ho.ticks_left = self.handoff_ticks
+                self._handoff_placed_tick.append((rid, member.name))
                 continue
             # state == "copying": the transfer is in flight.
             if not self._dst_live(ho):
@@ -1088,6 +1125,7 @@ class Fleet:
                 if others:
                     ho.dst_rep.core.sched.pool.free(list(ho.dst_pages),
                                                     ho.owner)
+                    self._handoff_unplaced_tick.append((rid, ho.dst))
                     ho.dst = None
                     ho.dst_rep = None
                     ho.dst_pages = []
@@ -1112,7 +1150,11 @@ class Fleet:
 
     # -- dispatch ------------------------------------------------------
 
-    def _dispatch(self, req: Request, *, tick: int, redispatch: bool) -> bool:
+    def _dispatch(self, req: Request, *, tick: int,
+                  redispatch: bool) -> str | None:
+        """Place `req` on a replica; returns the member NAME (the
+        flight-recorder record needs the routing decision's target, not
+        just that one was made) or None when nothing can take work."""
         phase = "prefill" if self.pools is not None else None
         member = self.router.pick(req, phase)
         if member is None and phase is not None:
@@ -1125,7 +1167,7 @@ class Fleet:
                 self._note_degraded("prefill", tick, now)
                 self._degraded_rids.add(req.rid)
         if member is None:
-            return False
+            return None
         if redispatch and self.redispatch == "resume" and req.out:
             # KV transfer integrity, failover leg (ISSUE 13): the
             # committed context a resume re-dispatch re-prefills is
@@ -1184,7 +1226,7 @@ class Fleet:
         self.redispatches += redispatch
         if self.registry is not None:
             self.registry.inc(f"fleet.{kind}es")
-        return True
+        return member.name
 
     def cancel(self, rid: int) -> None:
         """Client-side abort of `rid`, fleet-wide: marks the
@@ -1225,7 +1267,6 @@ class Fleet:
             auth = self._auth[local.rid]
             if auth.terminal:
                 continue
-            self.router.revoke(local.rid)
             auth.preemptions += local.preemptions
             auth.quota_wait_s += local.quota_wait_s
             if auth.admitted_at is None:
@@ -1235,7 +1276,14 @@ class Fleet:
             # re-dispatch re-prefills the committed context.
             auth._ctx_crc = context_crc(auth.prompt, auth.out)
             stranded.append(auth)
-        return sorted(stranded, key=lambda r: r.rid)
+        stranded.sort(key=lambda r: r.rid)
+        # Revoke in SORTED order — the order the dead-replica record's
+        # `stranded` list carries, so the replay reconstruction chains
+        # the identical fence ops (ISSUE 15; epoch counters are
+        # order-independent, only the fence_crc chain cares).
+        for auth in stranded:
+            self.router.revoke(auth.rid)
+        return stranded
 
     def _fail_over(self, member, *, tick: int, now: float,
                    redispatch_q: deque) -> None:
@@ -1434,18 +1482,26 @@ class Fleet:
             # failover — the queue is drained head-first and a request
             # enters it only via _harvest.
             dispatched, redispatched = [], []
+            dispatched_to, redispatched_to = [], []
             while redispatch_q:
                 req = redispatch_q[0]
-                if not self._dispatch(req, tick=tick, redispatch=True):
+                name = self._dispatch(req, tick=tick, redispatch=True)
+                if name is None:
                     break
                 redispatch_q.popleft()
                 redispatched.append(req.rid)
+                # Target + carried context length (post discard/refusal
+                # — the replica-local out the new incarnation starts
+                # with): what the replay reconstruction re-submits.
+                redispatched_to.append([req.rid, name, len(req.out)])
             while pending and pending[0].arrival <= now:
                 req = pending[0]
-                if not self._dispatch(req, tick=tick, redispatch=False):
+                name = self._dispatch(req, tick=tick, redispatch=False)
+                if name is None:
                     break
                 pending.popleft()
                 dispatched.append(req.rid)
+                dispatched_to.append([req.rid, name])
             # The fleet record goes out BEFORE the replicas step: the
             # tick's routing decisions precede, in the JSONL, any token
             # the target replica emits this same tick — which is what
@@ -1457,16 +1513,50 @@ class Fleet:
             ho_done, self._handoff_done_tick = self._handoff_done_tick, []
             ho_aborted, self._handoff_aborted_tick = \
                 self._handoff_aborted_tick, []
+            ho_placed, self._handoff_placed_tick = \
+                self._handoff_placed_tick, []
+            ho_unplaced, self._handoff_unplaced_tick = \
+                self._handoff_unplaced_tick, []
+            # Flight recorder (ISSUE 15): the router/fleet state digest
+            # at record-emission time — membership, in-flight handoff
+            # states, dispatch backlog, and the running fence chain —
+            # computed on every run (the chain is gate-pinned on
+            # summary-only storms) and stamped on the fleet record.
+            members = self.router.members
+            mparts = []
+            for name in sorted(members):
+                m = members[name]
+                mparts.append((name, m.replica.phase or "", m.draining,
+                               m.replica.alive))
+            hparts = []
+            if self._handoffs:
+                hparts = [(rid, ho.state, ho.src, ho.dst or "")
+                          for rid, ho in sorted(self._handoffs.items())]
+            fleet_crc = fleet_state_digest(
+                mparts, hparts, len(pending),
+                [r.rid for r in redispatch_q] if redispatch_q else (),
+                self.router.fence_crc,
+            )
+            self.state_chain = zlib.crc32(fleet_crc.to_bytes(4, "little"),
+                                          self.state_chain)
             if self.fleet_sink is not None:
                 arrived_now = []
                 while announce and announce[0][0] <= now:
                     arrived_now.append(announce.popleft()[1])
                 self.fleet_sink({
                     "tick": tick, "now": round(now, 4),
+                    "state_crc": fleet_crc,
                     "replicas": len(self.router.members),
                     "pending": len(pending) + len(redispatch_q),
                     "arrived": arrived_now,
                     "dispatched": dispatched, "redispatched": redispatched,
+                    # Routing targets (ISSUE 15): which replica each
+                    # decision placed the rid on — the event the replay
+                    # reconstruction sources queue membership from (the
+                    # bare rid lists above keep the pre-ISSUE-15 shape
+                    # for trace/explain/top).
+                    "dispatched_to": dispatched_to,
+                    "redispatched_to": redispatched_to,
                     "failed_over": [[rid, name]
                                     for rid, name in failed_over],
                     # Handoff markers (ISSUE 13), ordered in the JSONL
@@ -1479,6 +1569,10 @@ class Fleet:
                     "handoff_done": [[rid, dst] for rid, dst in ho_done],
                     "handoff_aborted": [[rid, why]
                                         for rid, why in ho_aborted],
+                    "handoff_placed": [[rid, dst]
+                                       for rid, dst in ho_placed],
+                    "handoff_unplaced": [[rid, dst]
+                                         for rid, dst in ho_unplaced],
                     "handoffs_inflight": len(self._handoffs),
                     "redispatch": self.redispatch,
                     "load": {m.name: [len(m.replica.core.sched.queue),
@@ -1503,6 +1597,8 @@ class Fleet:
                 synced = self._sync_terminal(rep, new_fin + new_drop, now)
                 n_done += len(synced)
                 any_work = any_work or rec["progressed"] or rep.core.unfinished
+                self.state_chain = zlib.crc32(
+                    rec["state_crc"].to_bytes(4, "little"), self.state_chain)
                 if self.replica_tick_sink is not None:
                     # `terminal` carries the FENCE-ACCEPTED set (the
                     # authoritative requests), not the replica-local
@@ -1516,8 +1612,9 @@ class Fleet:
                            ("queue", "running", "free_pages", "admitted",
                             "prefill", "decoded", "preempted",
                             "blocked", "preempted_for", "finished",
-                            "aborted")},
-                        **({"prefix_hits": rec["prefix_hits"]}
+                            "aborted", "state_crc")},
+                        **({"prefix_hits": rec["prefix_hits"],
+                            "prefix": rec["prefix"]}
                            if "prefix_hits" in rec else {}),
                         **({"spec": rec["spec"]}
                            if "spec" in rec else {}),
@@ -1541,6 +1638,14 @@ class Fleet:
                 # commits are fence-refused, so the trail rightly
                 # excludes its records.
                 member = self.router.members.get(rep.name)
+                if member is not None and member.replica is rep:
+                    # Pre-failover zombie telemetry is part of the same
+                    # in-flight drain: its state digest chains exactly
+                    # while its records still flow (post-failover both
+                    # stop together — the trail and the chain agree).
+                    self.state_chain = zlib.crc32(
+                        rec["state_crc"].to_bytes(4, "little"),
+                        self.state_chain)
                 if (member is not None and member.replica is rep
                         and self.replica_tick_sink is not None):
                     self.replica_tick_sink({
@@ -1550,8 +1655,9 @@ class Fleet:
                            ("queue", "running", "free_pages", "admitted",
                             "prefill", "decoded", "preempted",
                             "blocked", "preempted_for", "finished",
-                            "aborted")},
-                        **({"prefix_hits": rec["prefix_hits"]}
+                            "aborted", "state_crc")},
+                        **({"prefix_hits": rec["prefix_hits"],
+                            "prefix": rec["prefix"]}
                            if "prefix_hits" in rec else {}),
                         **({"spec": rec["spec"]}
                            if "spec" in rec else {}),
@@ -1611,6 +1717,16 @@ class Fleet:
                         from .engine import _observe_request
                         for req in failed_now:
                             _observe_request(self.registry, req)
+                    if failed_now:
+                        # The mass failure empties both dispatch queues:
+                        # chain the post-clear router digest so the
+                        # flight-recorder chain reflects the transition
+                        # (the synthetic record below carries it too).
+                        router_crc = fleet_state_digest(
+                            (), (), 0, (), self.router.fence_crc)
+                        self.state_chain = zlib.crc32(
+                            router_crc.to_bytes(4, "little"),
+                            self.state_chain)
                     if failed_now and self.replica_tick_sink is not None:
                         # One router-attributed tick record carries the
                         # mass failure into the trail: the burn-rate
@@ -1622,6 +1738,7 @@ class Fleet:
                         self.replica_tick_sink({
                             "tick": tick, "now": round(now, 4),
                             "mode": "fleet/router",
+                            "state_crc": router_crc,
                             "queue": 0, "running": 0, "free_pages": 0,
                             "admitted": [], "prefill": None,
                             "decoded": [], "preempted": [],
@@ -1683,7 +1800,7 @@ class Fleet:
             handoff_log=self.handoff_log,
             dispatch_trace=self.dispatch_trace, events=self.events,
             replica_log=self.replica_log, prefix=prefix_totals,
-            spec=spec_totals,
+            spec=spec_totals, state_crc=self.state_chain,
         )
 
 

@@ -39,10 +39,35 @@ Three concerns, one per class group:
 from __future__ import annotations
 
 import dataclasses
+import zlib
 
 from ..utils.retry import backoff_delay
 
 POLICIES = ("least_loaded", "session")
+
+
+def fence_chain(crc: int, *op) -> int:
+    """THE fence-epoch chain step (ISSUE 15): one grant/revoke op
+    folded into the running crc32 — `("g", rid, name, epoch)` /
+    `("r", rid)`. Shared by Router.grant/revoke (producer) and
+    obs/replay.py's FleetMirror (reconstruction) so the two can never
+    drift on the op serialization."""
+    return zlib.crc32(repr(op).encode(), crc)
+
+
+def fleet_state_digest(members, handoffs, pending: int, redispatch,
+                       fence_crc: int) -> int:
+    """THE canonical fleet/router state digest (ISSUE 15), shared by
+    serve/fleet.py (producer) and obs/replay.py (reconstruction):
+    `members` is an iterable of (name, phase, draining, alive) in name
+    order, `handoffs` of (rid, state, src, dst) in rid order, `pending`
+    the undispatched-arrival count, `redispatch` the re-dispatch queue's
+    rids in order, and `fence_crc` the router's running generation-fence
+    chain (Router.fence_crc — every grant/revoke in commit order, so the
+    whole epoch history folds into one number without serializing the
+    O(total rids) fence map per tick)."""
+    return zlib.crc32(repr((tuple(members), tuple(handoffs), pending,
+                            tuple(redispatch), fence_crc)).encode())
 
 
 def stable_hash(*parts) -> int:
@@ -106,6 +131,11 @@ class Router:
         # rid -> (replica name, epoch): the generation-token fence.
         self._fence: dict[int, tuple[str, int]] = {}
         self._epoch: dict[int, int] = {}
+        # Running crc32 chain over every grant/revoke in commit order
+        # (ISSUE 15): the fence-epoch component of the per-tick fleet
+        # state digest. O(1) per fence op; obs/replay.py mirrors the
+        # same ops from the trail and must land on the same number.
+        self.fence_crc = 0
 
     # -- membership ----------------------------------------------------
 
@@ -192,6 +222,7 @@ class Router:
         epoch = self._epoch.get(rid, -1) + 1
         self._epoch[rid] = epoch
         self._fence[rid] = (name, epoch)
+        self.fence_crc = fence_chain(self.fence_crc, "g", rid, name, epoch)
         return epoch
 
     def fence_ok(self, rid: int, name: str, epoch: int) -> bool:
@@ -207,6 +238,7 @@ class Router:
         shut. The epoch counter is untouched, so the next grant still
         moves forward."""
         self._fence.pop(rid, None)
+        self.fence_crc = fence_chain(self.fence_crc, "r", rid)
 
     def fence_of(self, rid: int) -> tuple[str, int] | None:
         return self._fence.get(rid)

@@ -40,6 +40,8 @@ treat this abort/resume traffic as the steady state, not the exception.
 from __future__ import annotations
 
 import dataclasses
+import zlib
+from array import array as _pack
 from collections import deque
 from collections.abc import Iterable
 
@@ -47,6 +49,81 @@ import numpy as np
 
 from .pool import PagePool, pages_for
 from .prefix_cache import PrefixCache
+
+
+# -- per-tick state digests (ISSUE 15) ----------------------------------
+#
+# The deterministic flight recorder: every producer (engine tick,
+# ReplicaCore tick, fleet/router record) stamps a `state_crc` — a crc32
+# of a canonical, jax-free projection of its full host-side serving
+# state — so a failed 0%/equal determinism gate localizes to the first
+# divergent TICK instead of "trace_crc differs" over a 10^5 storm.
+# obs/replay.py reconstructs the same projection purely from the trail
+# events and recomputes this digest at every tick; obs/diverge.py diffs
+# two trails at their first digest disagreement. BOTH sides call the
+# ONE spelling below, so producer and replayer can never drift on what
+# "the state" means.
+
+def _rid_sig(rid: int) -> int:
+    """Order-insensitive per-rid mixer for the queue-membership
+    signature (Knuth multiplicative hash; xor-combined so the
+    scheduler maintains it in O(1) per queue mutation)."""
+    return (rid * 2654435761 ^ 0x9E3779B9) & 0xFFFFFFFF
+
+
+def state_digest(queue_len: int, queue_head: int, queue_tail: int,
+                 queue_sig: int, slots_flat, free_pages: int,
+                 prefix=None, extra=(0, 0)) -> int:
+    """THE canonical state digest (crc32), shared by every producer and
+    the replayer. `slots_flat` is the FLAT int sequence of
+    per-occupied-slot sextets (idx, rid, cached, target, block-table
+    pages, shared refs) in idx order — page OWNERSHIP as counts
+    (physical indices are an engine layout detail; the logical state
+    is what replays). The queue is projected to (length, head rid,
+    tail rid, membership signature): exact membership and the
+    FCFS-relevant order anchors in O(1) per tick — a mid-queue
+    permutation alone is not captured, but any such divergence changes
+    the very next admission and lands in `slots_flat` one tick later.
+    `prefix` is the prefix-tree stat tuple (or None — a sharing-off
+    run; length-framed so the two can never alias), `extra` static
+    config (spec on/width). Serialized as a packed int64 array, not
+    repr: this runs once per replica per tick of a 10^5 storm, and the
+    byte layout is part of the digest contract."""
+    parts = [queue_len, queue_head, queue_tail, queue_sig, free_pages,
+             len(slots_flat)]
+    parts.extend(slots_flat)
+    if prefix is None:
+        parts.append(-1)
+    else:
+        parts.append(len(prefix))
+        parts.extend(prefix)
+    parts.extend(extra)
+    return zlib.crc32(_pack("q", parts).tobytes())
+
+
+def scheduler_digest(sched, extra=(0, 0)) -> int:
+    """Producer-side binding of state_digest over a live scheduler:
+    queue order anchors + per-slot extents/pages/refs + pool free count
+    + prefix-tree stats. O(slots) per call — the storm-scale budget
+    (the queue signature is maintained incrementally by the mutation
+    helpers below, never recomputed by scan)."""
+    q = sched.queue
+    flat: list[int] = []
+    ext = flat.extend
+    for s in sched.slots:
+        r = s.req
+        if r is not None:
+            ext((s.idx, r.rid, s.cached, s.target, len(s.pages),
+                 len(s.refs)))
+    prefix = None
+    pc = sched.prefix
+    if pc is not None:
+        st = pc.stats
+        prefix = (len(pc.nodes), st["hits"], st["misses"], st["hit_tokens"],
+                  st["cow_copies"], st["inserts"], st["evictions"])
+    return state_digest(len(q), q[0].rid if q else -1,
+                        q[-1].rid if q else -1, sched.queue_sig, flat,
+                        sched.pool.free_pages, prefix, extra)
 
 
 def validate_request(r: Request, *, max_len: int, page_size: int,
@@ -256,6 +333,11 @@ class _SchedulerBase:
         self.max_queue = max_queue
         self.prefix = prefix
         self.queue: deque[Request] = deque()
+        # Incremental queue-membership signature (ISSUE 15): xor of
+        # _rid_sig over queued rids, maintained by the _q_* helpers at
+        # every mutation site so the per-tick state digest stays O(slots)
+        # even when a storm's backlog holds tens of thousands of rids.
+        self.queue_sig = 0
         self.finished: list[Request] = []
         # Terminal non-finished requests (expired/cancelled/rejected/
         # failed) — with `finished`, every submitted request lands in
@@ -297,7 +379,7 @@ class _SchedulerBase:
                              usable=self.pool.usable)
             if r.deadline is not None:
                 self.has_deadlines = True
-            self.queue.append(r)
+            self._q_append(r)
 
     @property
     def unfinished(self) -> int:
@@ -305,6 +387,30 @@ class _SchedulerBase:
 
     def next_arrival(self) -> float | None:
         return min((r.arrival for r in self.queue), default=None)
+
+    # The queue mutation helpers every site below goes through, so the
+    # digest signature can never drift from the deque (ISSUE 15).
+    def _q_append(self, r: Request) -> None:
+        self.queue.append(r)
+        self.queue_sig ^= _rid_sig(r.rid)
+
+    def _q_appendleft(self, r: Request) -> None:
+        self.queue.appendleft(r)
+        self.queue_sig ^= _rid_sig(r.rid)
+
+    def _q_popleft(self) -> Request:
+        r = self.queue.popleft()
+        self.queue_sig ^= _rid_sig(r.rid)
+        return r
+
+    def _q_rebuild(self, kept: deque[Request]) -> None:
+        """Wholesale queue replacement (sweep / queue bound / SLO admit
+        — sites that already paid an O(queue) scan)."""
+        self.queue = kept
+        sig = 0
+        for r in kept:
+            sig ^= _rid_sig(r.rid)
+        self.queue_sig = sig
 
     def drain_preempted(self) -> list[tuple[int, int | None]]:
         """(victim, beneficiary) pairs preempted since the last call
@@ -564,7 +670,7 @@ class _SchedulerBase:
                 dropped.append(self._drop(r, "expired", now, "deadline"))
             else:
                 kept.append(r)
-        self.queue = kept
+        self._q_rebuild(kept)
         for slot in self.slots:
             if slot.free or slot.req.terminal:
                 continue  # terminal slot awaiting static drain
@@ -605,7 +711,7 @@ class _SchedulerBase:
                 rejected.append(self._drop(r, "rejected", now, "queue full"))
             else:
                 kept.append(r)
-        self.queue = kept
+        self._q_rebuild(kept)
         return rejected
 
 
@@ -672,7 +778,7 @@ class ContinuousScheduler(_SchedulerBase):
                 # Livelock guard: no sequence of preemptions can ever
                 # free enough pages — requeueing forever would starve
                 # the head-of-line forever. Terminal failure.
-                self.queue.popleft()
+                self._q_popleft()
                 self._drop(req, "failed", now,
                            f"context of {req.context_len} tokens needs "
                            f"{need} pages; pool owns {self.pool.usable}")
@@ -683,7 +789,7 @@ class ContinuousScheduler(_SchedulerBase):
                 # it (the ISSUE 11 blocker edge).
                 self._note_blocked(req, "pages", self._occupants())
                 break
-            self.queue.popleft()
+            self._q_popleft()
             bound.append(slot)
         if (self.queue and self.queue[0].arrival <= now
                 and not any(s.free for s in self.slots)):
@@ -735,7 +841,7 @@ class ContinuousScheduler(_SchedulerBase):
         self.preemptions += 1
         self.preempted_log.append((req.rid, for_rid))
         req.status = "queued"
-        self.queue.appendleft(req)
+        self._q_appendleft(req)
         self._release(slot)
 
     def _choose_victim(self, victims: list[Slot]) -> Slot:
@@ -852,7 +958,7 @@ class StaticScheduler(_SchedulerBase):
             if need > self.pool.usable:
                 # Even an empty pool could never reserve it: terminal
                 # failure (static's livelock-guard analog).
-                self.queue.popleft()
+                self._q_popleft()
                 self._drop(req, "failed", now,
                            f"worst-case extent of {need} pages exceeds "
                            f"the pool's {self.pool.usable}")
@@ -865,7 +971,7 @@ class StaticScheduler(_SchedulerBase):
                 # an injected squeeze does.
                 self._note_blocked(req, "pages", self._occupants())
                 break
-            self.queue.popleft()
+            self._q_popleft()
             self._bind(slot, req, pages, now)
             bound.append(slot)
         return bound
@@ -1153,6 +1259,6 @@ class SLOScheduler(ContinuousScheduler):
             usage[tenant] = (held_slots + 1,
                              held_pages + len(slot.pages) - len(slot.refs))
         if taken:
-            self.queue = deque(r for r in self.queue
-                               if id(r) not in taken)
+            self._q_rebuild(deque(r for r in self.queue
+                                  if id(r) not in taken))
         return bound

@@ -76,9 +76,14 @@ def build_records():
 
     model = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=48)
     params = model.init(jax.random.key(0))
-    engine = PagedEngine(model, params, slots=3, num_pages=10, page_size=4,
-                         prefill_chunk=8, max_len=40, spec="lookup",
-                         spec_k=4)
+    # ONE geometry definition: the engine construction AND the serve
+    # records' replay-geometry stamps read it (ISSUE 15 — a drifted
+    # stamp would fail `mctpu replay` with a confusing per-tick digest
+    # error instead of an obvious config mismatch).
+    geom = dict(slots=3, num_pages=10, page_size=4, spec="lookup",
+                spec_k=4)
+    engine = PagedEngine(model, params, prefill_chunk=8, max_len=40,
+                         **geom)
     records: list[dict] = []
     # ONE alert engine across both modes, fed every record in file
     # order — exactly what a replay of the finished file folds, so the
@@ -138,7 +143,14 @@ def build_records():
         for ev in res.events:
             emit(make_record("fault", clock.now, **{"mode": mode, **ev}),
                  clock)
-        emit(make_record("serve", clock.now, bench="serve", **s), clock)
+        # Geometry stamps (ISSUE 15): what `mctpu replay` rebuilds the
+        # mirrors from — the bench mains stamp the same keys, and the
+        # values come from the ONE `geom` the engine was built with.
+        emit(make_record("serve", clock.now, bench="serve",
+                         slots=geom["slots"], pages=geom["num_pages"],
+                         page_size=geom["page_size"], spec=geom["spec"],
+                         spec_k=geom["spec_k"],
+                         prefix_cache=(mode == "continuous"), **s), clock)
         print(f"{mode}: statuses={s['statuses']} "
               f"preemptions={s['preemptions']} ticks={s['decode_ticks']}")
     print(f"alerts: {len(alerts.alerts)} fired, crc={alerts.crc}")
@@ -148,6 +160,7 @@ def build_records():
 def main() -> int:
     from mpi_cuda_cnn_tpu.obs.causal import explain_main
     from mpi_cuda_cnn_tpu.obs.health import health_main
+    from mpi_cuda_cnn_tpu.obs.replay import replay_main
     from mpi_cuda_cnn_tpu.obs.report import report_main
     from mpi_cuda_cnn_tpu.obs.schema import dump_records
     from mpi_cuda_cnn_tpu.obs.timeline import trace_main
@@ -177,6 +190,10 @@ def main() -> int:
         # round-trip test pins bytes AND exit code).
         ("golden_serve_explain.md", explain_main,
          [rel, "--worst", "ttft", "-k", "2"], 0),
+        # ISSUE 15: the flight-recorder replay — every tick's stamped
+        # state digest cross-checked against the reconstruction, final
+        # state rendered (exit 0: the sample replays bitwise).
+        ("golden_serve_replay.md", replay_main, [rel], 0),
     ):
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):

@@ -1,0 +1,362 @@
+"""Flight-recorder replay + first-divergence localization (ISSUE 15).
+
+The acceptance surface:
+
+- `mctpu replay` folds a trail back into the reconstructed state
+  machine and the recomputed digest matches the stamped `state_crc` at
+  EVERY tick — engine trails (static + continuous + prefix sharing +
+  speculation + preemptions + expiries: the checked-in sample) and
+  fleet trails (crashes + a partitioned zombie + elastic join + prefix
+  + spec + SLO scheduling + disaggregated handoffs with injected
+  drops/corruption), byte-pinned against the golden rendering.
+- A single perturbed record makes replay exit 1 naming the tick, and
+  `mctpu diverge` report exactly the perturbed tick, the affected
+  rid(s), and a nonempty state delta.
+- Legacy trails (pre-ISSUE-15, no `state_crc`) and tickless summary
+  logs exit 2 with the one-line config-error contract.
+- `state_crc` is always stamped in serve/fleet summaries, flattened by
+  `mctpu compare`, pinned at 0%/equal in the determinism gates, and a
+  crc/equal gate failure prints the `mctpu diverge` invocation.
+
+The two reduced-scale storm TWINS of the CI determinism gates
+(--spec lookup, --pools) are slow-marked and ::-named in the CI obs
+step; the full-scale fleet storm replay runs as its own CI step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from mpi_cuda_cnn_tpu.obs.diverge import diverge_main
+from mpi_cuda_cnn_tpu.obs.regress import compare_main, metrics_from_records
+from mpi_cuda_cnn_tpu.obs.replay import replay_main
+from mpi_cuda_cnn_tpu.obs.schema import dump_records, load_records
+from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main
+
+REPO = Path(__file__).resolve().parents[1]
+DATA = Path(__file__).parent / "data"
+SAMPLE = DATA / "sample_serve_run.jsonl"
+
+STORM_FAULTS = ("replica_crash@fleet.tick:40?replica=1&zombie_ticks=4;"
+                "replica_crash@fleet.tick:120?replica=2;"
+                "replica_join@fleet.tick:200")
+
+
+def _run(main, argv):
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = main(argv)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def _sim_storm(path, *extra, requests=300, seed=2, log="full"):
+    rc, _out, err = _run(fleet_bench_main, [
+        "--replicas", "3", "--requests", str(requests), "--rate", "500",
+        "--seed", str(seed), "--log", log,
+        "--metrics-jsonl", str(path), *extra,
+    ])
+    assert rc == 0, err
+    return load_records(path)
+
+
+@pytest.fixture(scope="module")
+def storm_pair(tmp_path_factory):
+    """ONE identical-seed pair of full-log crash/zombie/join storms,
+    shared by the replay, diverge, and gate-wiring tests below (each
+    generating its own would dominate the tier-1 budget)."""
+    root = tmp_path_factory.mktemp("storm_pair")
+    a, b = root / "a.jsonl", root / "b.jsonl"
+    _sim_storm(a, "--fault-plan", STORM_FAULTS)
+    _sim_storm(b, "--fault-plan", STORM_FAULTS)
+    return a, b
+
+
+# ------------------------------------------------ golden + engine trail
+
+
+def test_golden_replay_roundtrip(monkeypatch, capsys):
+    """`mctpu replay` on the checked-in sample run (engine static +
+    continuous with prefix sharing, speculation, preemptions, slow
+    faults, and expiries) cross-checks every tick digest and renders
+    byte-for-byte the golden (regenerate via make_obs_sample.py)."""
+    monkeypatch.chdir(REPO)
+    assert replay_main(["tests/data/sample_serve_run.jsonl"]) == 0
+    assert capsys.readouterr().out == \
+        (DATA / "golden_serve_replay.md").read_text()
+
+
+def test_replay_at_tick_renders_midrun_state(monkeypatch):
+    monkeypatch.chdir(REPO)
+    rc, out, _ = _run(replay_main,
+                      ["tests/data/sample_serve_run.jsonl",
+                       "--at-tick", "10", "--format", "json"])
+    assert rc == 0
+    state = json.loads(out)["state"]
+    # Mid-run: something is actually in flight in at least one mode.
+    assert any(state[m]["slots"] for m in ("static", "continuous"))
+
+
+def test_replay_detects_perturbed_record(tmp_path):
+    """Dropping one decoded entry from one tick makes replay exit 1
+    naming that exact tick — the flight-recorder tamper check."""
+    records = load_records(SAMPLE)
+    tick = None
+    for rec in records:
+        # The static stream's decode ticks (the continuous half's
+        # decodes ride the spec round entries instead).
+        if rec.get("event") == "tick" and rec.get("mode") == "static" \
+                and len(rec.get("decoded") or []) > 1:
+            rec["decoded"] = rec["decoded"][1:]
+            tick = rec["tick"]
+            break
+    assert tick is not None
+    p = tmp_path / "tampered.jsonl"
+    dump_records(records, p)
+    rc, _out, err = _run(replay_main, [str(p)])
+    assert rc == 1
+    assert f"tick {tick}" in err and "drift" in err.lower()
+
+
+# ------------------------------------------------ legacy/config errors
+
+
+def test_replay_legacy_trail_exits_2(tmp_path):
+    """A pre-ISSUE-15 trail (tick records without state_crc) is a
+    one-line config error, exit 2 — never a traceback (the explain
+    legacy-trail contract)."""
+    records = load_records(SAMPLE)
+    for rec in records:
+        rec.pop("state_crc", None)
+    p = tmp_path / "legacy.jsonl"
+    dump_records(records, p)
+    rc, _out, err = _run(replay_main, [str(p)])
+    assert rc == 2
+    assert "state_crc" in err and "regenerate" in err
+    assert "Traceback" not in err
+    # diverge inherits the same contract on either input.
+    rc, _out, err = _run(diverge_main, [str(SAMPLE), str(p)])
+    assert rc == 2
+    assert "state_crc" in err
+
+
+def test_replay_tickless_summary_exits_2(tmp_path):
+    records = [r for r in load_records(SAMPLE)
+               if r.get("event") not in ("tick", "fleet")]
+    p = tmp_path / "summary_only.jsonl"
+    dump_records(records, p)
+    rc, _out, err = _run(replay_main, [str(p)])
+    assert rc == 2
+    assert "no tick trail" in err
+
+
+# ------------------------------------------------ fleet trails
+
+
+def test_fleet_prefspec_storm_replays_bitwise(tmp_path):
+    """The fleet determinism storm's shape in miniature — two crashes
+    (one partitioned zombie), an elastic join, prefix sharing, and
+    speculative decoding — replays with zero digest drift at every
+    fleet/replica tick."""
+    p = tmp_path / "storm.jsonl"
+    _sim_storm(p, "--prefix-cache", "--prefix-mix", "0.5",
+               "--spec", "lookup", "--spec-k", "4",
+               "--fault-plan", STORM_FAULTS)
+    rc, out, err = _run(replay_main, [str(p)])
+    assert rc == 0, err
+    assert "zero drift" in out
+
+
+def test_fleet_slo_deadline_storm_replays_bitwise(tmp_path):
+    p = tmp_path / "slo.jsonl"
+    _sim_storm(p, "--scheduler", "slo", "--tenants", "3",
+               "--tenant-priority", "t0=2", "--tenant-quota", "t1=slots:2",
+               "--deadline-ms", "150", "--max-queue", "8",
+               requests=200, seed=3)
+    rc, _out, err = _run(replay_main, [str(p)])
+    assert rc == 0, err
+
+
+def test_disagg_storm_with_handoff_faults_replays_bitwise(tmp_path):
+    """The 2-pool form: KV handoffs (placement, re-target, completion),
+    an injected dropped transfer, an injected corrupted page set, and a
+    corrupted resume context — every abort path's page accounting
+    reconstructs exactly."""
+    p = tmp_path / "disagg.jsonl"
+    rc, _out, err = _run(fleet_bench_main, [
+        "--pools", "prefill:1,decode:2", "--handoff-ticks", "2",
+        "--requests", "200", "--rate", "400", "--seed", "3",
+        "--log", "full", "--metrics-jsonl", str(p),
+        "--fault-plan", "handoff_drop@fleet.handoff:3;"
+                        "kv_corrupt@fleet.handoff:7;"
+                        "kv_corrupt@fleet.resume:0",
+    ])
+    assert rc == 0, err
+    rc, _out, err = _run(replay_main, [str(p)])
+    assert rc == 0, err
+
+
+def test_empty_fleet_mass_failure_replays_bitwise(tmp_path):
+    """Total outage: the lone replica crashes with its circuit opened;
+    the router-attributed mass-failure record (and the emptied dispatch
+    queues) replay against the stamped router digest."""
+    p = tmp_path / "massfail.jsonl"
+    rc, _out, err = _run(fleet_bench_main, [
+        "--replicas", "1", "--requests", "40", "--rate", "200",
+        "--seed", "5", "--max-flaps", "0", "--log", "full",
+        "--metrics-jsonl", str(p),
+        "--fault-plan", "replica_crash@fleet.tick:10?replica=0",
+    ])
+    assert rc == 0, err
+    rc, _out, err = _run(replay_main, [str(p)])
+    assert rc == 0, err
+
+
+# ------------------------------------------------ diverge
+
+
+def test_diverge_identical_trails_exit_0(storm_pair):
+    a, b = storm_pair
+    rc, out, _err = _run(diverge_main, [str(a), str(b)])
+    assert rc == 0
+    assert "no divergence" in out
+    # The same storm replays clean (the crash/zombie/join shape without
+    # prefix/spec — the base-fleet leg of the replay matrix).
+    rc, _out, err = _run(replay_main, [str(a)])
+    assert rc == 0, err
+
+
+def test_diverge_pins_perturbed_tick_rid_and_delta(storm_pair, tmp_path):
+    """THE acceptance pin: a single perturbed record localizes to
+    exactly its tick, names the affected rid, and the state delta is
+    nonempty (the rid's slot extent differs between the two sides)."""
+    a = storm_pair[0]
+    b = tmp_path / "b.jsonl"
+    records = load_records(a)
+    tick = rid = None
+    for rec in records:
+        if rec.get("event") == "tick" and rec.get("tick", 0) > 30 \
+                and len(rec.get("decoded") or []) > 1:
+            rid = rec["decoded"][0][1]
+            rec["decoded"] = rec["decoded"][1:]
+            tick = rec["tick"]
+            break
+    assert tick is not None
+    dump_records(records, b)
+    rc, out, _err = _run(diverge_main, [str(a), str(b), "--format", "json"])
+    assert rc == 1
+    report = json.loads(out)
+    assert report["divergence"]["tick"] == tick
+    assert rid in report["divergence"]["rids"]
+    assert report["delta"], "state delta must be nonempty"
+    assert any(f"rid {rid}" in line for line in report["delta"])
+    # The md rendering carries the same anchors.
+    rc, out, _err = _run(diverge_main, [str(a), str(b)])
+    assert rc == 1
+    assert f"tick {tick}" in out and str(rid) in out
+
+
+# ------------------------------------------------ gate wiring
+
+
+def test_state_crc_stamped_flattened_gated_and_seed_stable(storm_pair):
+    """state_crc is an always-stamped summary key, `mctpu compare`
+    flattens it, identical-seed storms chain the identical value, and
+    all three determinism gates pin it at 0%/equal."""
+    a, b = storm_pair
+    ra, rb = load_records(a), load_records(b)
+    sa = [r for r in ra if r.get("event") == "serve"][0]
+    sb = [r for r in rb if r.get("event") == "serve"][0]
+    assert isinstance(sa["state_crc"], int)
+    assert sa["state_crc"] == sb["state_crc"]
+    flat = metrics_from_records(ra)
+    assert "serve.fleet.state_crc" in flat
+    for gate in ("fleet_gate", "spec_gate", "disagg_gate"):
+        spec = json.loads((REPO / "ci" / f"{gate}.json").read_text())
+        assert spec["metrics"]["serve.fleet.state_crc"] == \
+            {"tol_pct": 0, "direction": "equal"}
+
+
+def test_compare_crc_failure_prints_diverge_hint(storm_pair, tmp_path):
+    """A failed *_crc/equal gate between two trail-carrying runs names
+    the exact `mctpu diverge A B` next step."""
+    a = storm_pair[0]
+    b = tmp_path / "b.jsonl"
+    records = load_records(a)
+    # A genuinely diverged twin: perturb one scheduling event AND the
+    # summary chain (what a real nondeterminism would do).
+    for rec in records:
+        if rec.get("event") == "serve":
+            rec["state_crc"] ^= 1
+    dump_records(records, b)
+    gate = tmp_path / "gate.json"
+    gate.write_text(json.dumps({"metrics": {
+        "serve.fleet.state_crc": {"tol_pct": 0, "direction": "equal"}}}))
+    rc, _out, err = _run(compare_main, [str(a), str(b), "--gate", str(gate)])
+    assert rc == 1
+    assert f"mctpu diverge {a} {b}" in err
+    # Without tick trails (summary-only files) the hint says to re-run
+    # at --log full instead of naming an impossible invocation.
+    a2, b2 = tmp_path / "a2.jsonl", tmp_path / "b2.jsonl"
+    for src, dst in ((a, a2), (b, b2)):
+        dump_records([r for r in load_records(src)
+                      if r.get("event") not in ("tick", "fleet")], dst)
+    rc, _out, err = _run(compare_main,
+                         [str(a2), str(b2), "--gate", str(gate)])
+    assert rc == 1
+    assert "--log full" in err
+
+
+# ------------------------------------------------ storm twins (slow)
+
+
+def test_replay_spec_storm_twin(tmp_path):
+    """Reduced-scale twin of the CI spec determinism storm: prefix +
+    --spec lookup + crashes (zombie) + join at 20k requests, full-log,
+    replayed with zero drift (slow; ::-named in the CI obs step — the
+    full-scale fleet form runs as its own CI step)."""
+    p = tmp_path / "spec_storm.jsonl"
+    rc, _out, err = _run(fleet_bench_main, [
+        "--replicas", "4", "--requests", "20000", "--rate", "2000",
+        "--slots", "8", "--seed", "0", "--spec", "lookup", "--spec-k", "8",
+        "--prefix-cache", "--prefix-mix", "0.5", "--log", "full",
+        "--metrics-jsonl", str(p),
+        "--fault-plan", "replica_crash@fleet.tick:800?replica=1&zombie_ticks=4;"
+                        "replica_crash@fleet.tick:2400?replica=2;"
+                        "replica_join@fleet.tick:4000",
+    ])
+    assert rc == 0, err
+    rc, out, err = _run(replay_main, [str(p)])
+    assert rc == 0, err
+    assert "zero drift" in out
+
+
+def test_replay_disagg_storm_twin(tmp_path):
+    """Reduced-scale twin of the CI disagg determinism storm: 2+2
+    pools, a prefill replica killed mid-handoff as a zombie, a decode-
+    pool collapse, and a decode join at 20k requests — the handoff
+    protocol's whole page-accounting surface replays bitwise (slow)."""
+    p = tmp_path / "disagg_storm.jsonl"
+    rc, _out, err = _run(fleet_bench_main, [
+        "--pools", "prefill:2,decode:2", "--handoff-ticks", "2",
+        "--requests", "20000", "--rate", "2000", "--slots", "8",
+        "--seed", "0", "--log", "full", "--metrics-jsonl", str(p),
+        "--fault-plan", "replica_crash@fleet.tick:800?replica=0&zombie_ticks=4;"
+                        "pool_crash@fleet.tick:2400?pool=decode;"
+                        "replica_join@fleet.tick:4000?pool=decode",
+    ])
+    assert rc == 0, err
+    rc, out, err = _run(replay_main, [str(p)])
+    assert rc == 0, err
+    assert "zero drift" in out
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"] + sys.argv[1:]))
