@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative bench_serve bench_serve_spec bench_fleet serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve bench_serve_spec bench_fleet autosize serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -154,6 +154,16 @@ bench_serve_spec:
 bench_fleet:
 	$(PY) -m mpi_cuda_cnn_tpu fleet-bench --replicas 4 --requests 2000 \
 	  --rate 500 --log summary
+
+# Offline goodput-frontier capacity search (ISSUE 16, obs/autosize.py):
+# candidate fleet topologies at a fixed chip budget, each a seeded
+# SimCompute storm scored by SLO-attained goodput; deterministic,
+# CRC-stamped (ci/autosize_gate.json pins the CI twin at 0%/equal).
+# Seed the sweep from a finished run's blame profile with
+#   make autosize SEED_FROM=run.jsonl
+autosize:
+	$(PY) -m mpi_cuda_cnn_tpu autosize --budget 4 --requests 2000 \
+	  --rate 300 --len-dist both $(if $(SEED_FROM),--seed-from $(SEED_FROM))
 
 # Regenerate the committed CI serving baseline (ci/serve_baseline.jsonl)
 # with the pinned arguments CI's candidate run uses — refresh after a

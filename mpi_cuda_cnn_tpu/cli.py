@@ -292,6 +292,15 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.regress import compare_main
 
         return compare_main(argv[1:])
+    if argv and argv[0] == "autosize":
+        # Offline capacity search: sweep candidate fleet topologies at
+        # a fixed chip budget as seeded SimCompute storms, score by
+        # SLO-attained goodput, emit a deterministic goodput frontier +
+        # recommendation; --seed-from prunes the sweep from a finished
+        # run's blame profile (obs.autosize, ISSUE 16) — jax-free.
+        from .obs.autosize import autosize_main
+
+        return autosize_main(argv[1:])
     if argv and argv[0] == "health":
         # SLO health gate: per-tenant verdict table + alert replay for
         # a finished run, exit 1 on violation (obs.health, ISSUE 8) —

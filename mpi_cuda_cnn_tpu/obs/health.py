@@ -37,6 +37,7 @@ import json
 import sys
 
 from .alerts import AlertEngine, alerts_crc, format_alert
+from .goodput import tenant_goodput_rps
 from .schema import fmt_cell as _fmt
 from .schema import iter_runs
 from .slo import (
@@ -86,6 +87,12 @@ def evaluate(records: list[dict], spec: SLOSpec,
         key = a.get("tenant") or a.get("group") or "-"
         alerts_by_tenant[str(key)] = alerts_by_tenant.get(str(key), 0) + 1
 
+    # Per-tenant SLO-attained goodput (obs/goodput.py, ISSUE 16): the
+    # verdict table's capacity column — requests/s per chip whose
+    # latency objectives ALL held. Exact-trail only; {} (em-dash
+    # column) on summary-only files.
+    tenant_rps = tenant_goodput_rps(records, spec)
+
     trains = train_health(records, spec)
     if source == "none" and trains:
         source = "train"
@@ -105,6 +112,7 @@ def evaluate(records: list[dict], spec: SLOSpec,
         "alert_crc_checked": crc_checked,
         "alert_crc_ok": crc_ok,
         "alerts_by_tenant": alerts_by_tenant,
+        "tenant_goodput": tenant_rps,
         "violations": violations,
         "healthy": not violations,
     }
@@ -115,8 +123,9 @@ def render_verdicts(ev: dict) -> str:
     if ev["verdicts"]:
         lines += [
             "| tenant | objective | events | good | bad | attainment "
-            "| target | budget left | worst burn | alerts | verdict |",
-            "|---|---|---|---|---|---|---|---|---|---|---|",
+            "| target | budget left | worst burn | goodput r/s "
+            "| alerts | verdict |",
+            "|---|---|---|---|---|---|---|---|---|---|---|---|",
         ]
         for v in ev["verdicts"]:
             obj = v.metric + (f"<={v.threshold_ms:g}ms"
@@ -129,6 +138,7 @@ def render_verdicts(ev: dict) -> str:
                 f"| {v.target:g} "
                 f"| {_fmt(None if v.budget_left is None else round(v.budget_left, 4))} "
                 f"| {_fmt(v.worst_burn)} "
+                f"| {_fmt(ev['tenant_goodput'].get(v.tenant))} "
                 f"| {ev['alerts_by_tenant'].get(v.tenant, 0)} "
                 f"| {'VIOLATED' if v.violated else 'ok'} |"
             )
@@ -203,6 +213,7 @@ def health_main(argv: list[str] | None = None) -> int:
             "alerts_fired": ev["alerts_fired"],
             "alerts_crc": ev["alerts_crc"],
             "alert_crc_ok": ev["alert_crc_ok"],
+            "tenant_goodput": ev["tenant_goodput"],
             "verdicts": [
                 {"tenant": v.tenant, "metric": v.metric,
                  "events": v.events, "good": v.good, "bad": v.bad,

@@ -155,6 +155,29 @@ def metrics_from_records(records: list[dict]) -> dict[str, float]:
                     v = _num(v)
                     if v is not None:
                         out[f"serve.{mode}.tenant.{tname}.status.{k}"] = v
+        elif ev == "goodput":
+            # Autosize sweep output (ISSUE 16): candidates flatten
+            # under their candidate spelling, the frontier summary
+            # under bare autosize.* — where the CI autosize determinism
+            # gate pins frontier_crc / recommendation_crc / evaluated
+            # at exact equality.
+            kind = rec.get("kind")
+            if kind == "candidate":
+                cand = rec.get("cand", "?")
+                for k, v in rec.items():
+                    v = _num(v)
+                    if v is not None and k not in ("schema", "t"):
+                        out[f"autosize.{cand}.{k}"] = v
+            elif kind == "frontier":
+                for k, v in rec.items():
+                    v = _num(v)
+                    if v is not None and k not in ("schema", "t"):
+                        out[f"autosize.{k}"] = v
+            else:  # kind == "run": a single measured run's goodput
+                for k, v in rec.items():
+                    v = _num(v)
+                    if v is not None and k not in ("schema", "t"):
+                        out[f"goodput.{k}"] = v
         elif ev == "train":
             v = _num(rec.get("loss"))
             if v is not None:

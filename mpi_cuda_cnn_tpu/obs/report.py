@@ -216,6 +216,32 @@ def summarize(records: Iterable[dict], *,
             for r in blames
         ]
 
+    goodputs = ev.get("goodput", [])
+    if goodputs:
+        # Autosize sweep output (obs/autosize.py, ISSUE 16): candidate
+        # rows in frontier order (the frontier record's ranking), plus
+        # the recommendation line. Standalone kind="run" measurements
+        # surface as candidates of a one-row frontier.
+        cands = {r.get("cand", "run"): r for r in goodputs
+                 if r.get("kind") in ("candidate", "run")}
+        frontier = next((r for r in reversed(goodputs)
+                         if r.get("kind") == "frontier"), None)
+        order = (frontier or {}).get("order") or sorted(cands)
+        summary["autosize"] = {
+            "candidates": [
+                {k: cands[c].get(k) for k in
+                 ("cand", "topology", "scheduler", "len_dist", "prefix",
+                  "spec", "requests", "good", "good_fraction",
+                  "per_chip_rps", "goodput_rps", "tokens_per_s",
+                  "ttft_p99_ms", "tpot_p99_ms", "estimated")}
+                for c in order if c in cands
+            ],
+            **({k: frontier.get(k) for k in
+                ("evaluated", "pruned", "seeded_from", "recommendation",
+                 "frontier_crc", "recommendation_crc")}
+               if frontier else {}),
+        }
+
     alerts = ev.get("alert", [])
     if alerts:
         by_rule: dict[str, int] = {}
@@ -503,6 +529,42 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 f"| {_fmt(r.get('crc'))} |"
             )
         lines.append("")
+    if "autosize" in summary:
+        # Goodput frontier (obs/autosize.py, ISSUE 16): candidate rows
+        # in frontier order plus the sweep's recommendation line.
+        az = summary["autosize"]
+        lines += [
+            "| frontier | topology | sched | len dist | prefix | spec "
+            "| good | good frac | per-chip r/s | tok/s | TTFT p99 ms "
+            "| TPOT p99 ms |",
+            "|---|" + "---|" * 11,
+        ]
+        for i, r in enumerate(az["candidates"], 1):
+            est = " (est)" if r.get("estimated") else ""
+            lines.append(
+                f"| {i}{est} | {_fmt(r.get('topology'))} "
+                f"| {_fmt(r.get('scheduler'))} | {_fmt(r.get('len_dist'))} "
+                f"| {'on' if r.get('prefix') else 'off'} "
+                f"| {_fmt(r.get('spec'))} | {_fmt(r.get('good'))} "
+                f"| {_fmt(r.get('good_fraction'))} "
+                f"| {_fmt(r.get('per_chip_rps'))} "
+                f"| {_fmt(r.get('tokens_per_s'))} "
+                f"| {_fmt(r.get('ttft_p99_ms'))} "
+                f"| {_fmt(r.get('tpot_p99_ms'))} |"
+            )
+        lines.append("")
+        if az.get("recommendation") is not None:
+            seeded = az.get("seeded_from")
+            lines += [
+                "| autosize | recommendation | evaluated | pruned "
+                "| seeded from | frontier crc | recommendation crc |",
+                "|---|" + "---|" * 6,
+                f"| | {az['recommendation']} | {_fmt(az.get('evaluated'))} "
+                f"| {_fmt(az.get('pruned'))} | {_fmt(seeded)} "
+                f"| {_fmt(az.get('frontier_crc'))} "
+                f"| {_fmt(az.get('recommendation_crc'))} |",
+                "",
+            ]
     if "alerts" in summary:
         al = summary["alerts"]
         lines += [

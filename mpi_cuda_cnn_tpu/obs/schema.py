@@ -149,6 +149,19 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # share ("quota_ticks"), and "crc" — the canonical per-request
     # blame CRC the fleet determinism gate pins at exact equality.
     "blame": ("mode", "requests", "categories"),
+    # One SLO-attained goodput measurement (obs/goodput.py, ISSUE 16):
+    # "kind" is run (one measured run) / candidate (one topology inside
+    # an `mctpu autosize` sweep) / frontier (the sweep's folded
+    # goodput-frontier summary + recommendation). run/candidate records
+    # carry the Goodput.fields() block (requests, good, duration_s,
+    # chips, goodput_rps, per_chip_rps, good_fraction, estimated,
+    # thresholds); candidates add their topology spelling + the
+    # underlying storm's trace/blame/state CRCs (unchanged by the sweep
+    # harness — pinned by test); the frontier adds evaluated/pruned
+    # counts, the ranked candidate order, and frontier_crc /
+    # recommendation_crc — the numbers the autosize determinism gate
+    # pins at 0%/equal.
+    "goodput": ("kind",),
     # One fired alert (obs/alerts.py, ISSUE 8): "rule" names the rule
     # instance, "kind" its class (threshold / rate_of_change / absence
     # / burn_rate), "seq" its position in the run's alert sequence

@@ -88,6 +88,10 @@ class TopState:
         # `blocked` entries), plus the block-reason mix.
         self.blockers: dict[int, int] = {}
         self.block_reasons: dict[str, int] = {}
+        # GOODPUT (ISSUE 16): autosize sweep candidates in arrival
+        # order, plus the newest frontier summary record.
+        self.goodput_cands: deque = deque(maxlen=8)
+        self.goodput_frontier: dict | None = None
         self._history = history
 
     def reset(self) -> None:
@@ -132,6 +136,11 @@ class TopState:
         elif ev == "replica":
             kind = rec.get("kind", "?")
             self.replica_kinds[kind] = self.replica_kinds.get(kind, 0) + 1
+        elif ev == "goodput":
+            if rec.get("kind") == "frontier":
+                self.goodput_frontier = rec
+            else:  # candidate / run measurements stream in live
+                self.goodput_cands.append(rec)
         elif ev == "alert":
             self.alerts_total += 1
             self.alerts_recent.append(rec)
@@ -282,6 +291,35 @@ def render(state: TopState, path: str, width: int = 96) -> str:
                 f"redispatches {_fmt(sv.get('redispatches'))}  "
                 f"fenced {_fmt(sv.get('fenced_discards'))}  "
                 f"statuses {json.dumps(sv.get('statuses'))}"
+            )
+    if state.goodput_cands or state.goodput_frontier:
+        # GOODPUT (ISSUE 16): the autosize sweep as it streams — most
+        # recent candidates with their SLO-attained per-chip rate, then
+        # the frontier's recommendation once the sweep folds.
+        lines.append("")
+        fr = state.goodput_frontier or {}
+        lines.append(
+            "GOODPUT  evaluated "
+            f"{_fmt(fr.get('evaluated', len(state.goodput_cands)))}"
+            + (f"  pruned {_fmt(fr['pruned'])}" if fr.get("pruned")
+               else "")
+            + (f"  seeded {fr['seeded_from']}" if fr.get("seeded_from")
+               else "")
+        )
+        for r in state.goodput_cands:
+            est = " est" if r.get("estimated") else ""
+            lines.append(
+                f"  {r.get('cand', 'run'):<36} "
+                f"good {_fmt(r.get('good')):>5}/{_fmt(r.get('requests'))}"
+                f"  {_fmt(r.get('per_chip_rps'))} r/s/chip{est}  "
+                f"ttft p99 {_fmt(r.get('ttft_p99_ms'))}  "
+                f"tpot p99 {_fmt(r.get('tpot_p99_ms'))}"
+            )
+        if fr.get("recommendation"):
+            lines.append(
+                f"  ➤ recommend {fr['recommendation']}  "
+                f"{_fmt(fr.get('best_per_chip_rps'))} good r/s/chip  "
+                f"crc {_fmt(fr.get('recommendation_crc'))}"
             )
     snap = state.metrics.get("train")
     if state.train or snap or state.epochs:

@@ -46,16 +46,38 @@ def _fault_plan_arg(surface: str):
     return fault_plan_arg(surface)
 
 
+def _heavy_tail_len(lrng, lo: int, hi: int) -> int:
+    """One lognormal length draw clipped to [lo, hi]: median at the
+    geometric midpoint, sigma a quarter of the log-range — most mass
+    near the low end with a heavy tail that piles up at the clip, the
+    production shape (Splitwise) uniform mixes miss."""
+    if hi <= lo:
+        return lo
+    mu = 0.5 * (np.log(lo) + np.log(hi))
+    sigma = (np.log(hi) - np.log(lo)) / 4.0
+    v = int(round(float(lrng.lognormal(mu, sigma))))
+    return min(max(v, lo), hi)
+
+
 def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
                   out_min: int, out_max: int, rate: float, seed: int,
                   deadline_s: float = 0.0, tenants: int = 0,
-                  prefix_mix: float = 0.0, prefix_pool: int = 4):
+                  prefix_mix: float = 0.0, prefix_pool: int = 4,
+                  len_dist: str = "uniform"):
     """n seeded requests: uniform prompt/output lengths in the given
     ranges, Poisson arrivals at `rate` req/s (exponential gaps; rate 0
     = everything arrives at t=0). deadline_s > 0 gives every request an
     absolute deadline of arrival + deadline_s. Regenerating with the
     same seed gives an identical workload — the cross-mode comparison
     contract.
+
+    len_dist "lognormal" (ROADMAP item 4 / ISSUE 16) draws prompt and
+    output lengths from a heavy-tail lognormal clipped to the same
+    ranges instead of uniform. The draws come from a SEPARATE (seed, 3)
+    spawn — the same isolation trick the tenant/prefix streams use —
+    so the default uniform stream is bitwise-unchanged (every committed
+    baseline and pinned CRC stays valid), and tenant labels stay
+    identical across the two mixes (the tenant stream never moves).
 
     tenants > 0 tags each request with a seeded tenant draw over
     "t0".."t{tenants-1}" (ISSUE 8's multi-tenant traffic mix). The
@@ -74,9 +96,14 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
     arrivals, and tenant labels are bitwise-identical at any mix."""
     from .scheduler import Request
 
+    if len_dist not in ("uniform", "lognormal"):
+        raise ValueError(f"len_dist {len_dist!r}: want uniform or "
+                         "lognormal")
     rng = np.random.default_rng(seed)
     trng = np.random.default_rng([seed, 1])
     prng = np.random.default_rng([seed, 2])
+    lrng = (np.random.default_rng([seed, 3])
+            if len_dist == "lognormal" else None)
     templates = [prng.integers(0, vocab, (prompt_max,)).astype(np.int32)
                  for _ in range(prefix_pool)] if prefix_mix > 0 else []
     t = 0.0
@@ -84,8 +111,12 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
     for i in range(n):
         if rate > 0:
             t += float(rng.exponential(1.0 / rate))
-        plen = int(rng.integers(prompt_min, prompt_max + 1))
-        olen = int(rng.integers(out_min, out_max + 1))
+        if lrng is None:
+            plen = int(rng.integers(prompt_min, prompt_max + 1))
+            olen = int(rng.integers(out_min, out_max + 1))
+        else:
+            plen = _heavy_tail_len(lrng, prompt_min, prompt_max)
+            olen = _heavy_tail_len(lrng, out_min, out_max)
         prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
         tenant = (f"t{int(trng.integers(0, tenants))}" if tenants > 0
                   else None)
@@ -217,6 +248,12 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "prompt prefixes (ISSUE 9 workload shape; "
                          "0 = all-unique prompts, bitwise-identical "
                          "lengths/arrivals either way)")
+    ap.add_argument("--len-dist", default="uniform",
+                    choices=["uniform", "lognormal"],
+                    help="prompt/output length mix (ISSUE 16): uniform "
+                         "over the ranges (default, bitwise-unchanged "
+                         "stream) or a heavy-tail lognormal clipped to "
+                         "them, drawn from a separate seeded spawn")
     ap.add_argument("--prefix-cache", action="store_true",
                     help="enable prefix-sharing KV cache on the "
                          "continuous scheduler: hash-keyed prefix "
@@ -343,7 +380,7 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         prompt_max=args.prompt_max, out_min=args.out_min,
         out_max=args.out_max, rate=args.rate, seed=args.seed,
         deadline_s=args.deadline_ms / 1e3, tenants=args.tenants,
-        prefix_mix=args.prefix_mix,
+        prefix_mix=args.prefix_mix, len_dist=args.len_dist,
     )
     run_kw = dict(
         max_queue=args.max_queue or None,
@@ -580,6 +617,11 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--prefix-mix", type=float, default=0.0,
                     help="fraction of requests sharing seeded template "
                          "prompt prefixes (ISSUE 9; 0 = all-unique)")
+    ap.add_argument("--len-dist", default="uniform",
+                    choices=["uniform", "lognormal"],
+                    help="prompt/output length mix (ISSUE 16): uniform "
+                         "(default, bitwise-unchanged stream) or "
+                         "heavy-tail lognormal from a separate spawn")
     ap.add_argument("--prefix-cache", action="store_true",
                     help="per-replica prefix-sharing KV cache: "
                          "cache-hit requests prefill only their suffix "
@@ -726,6 +768,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             out_max=args.out_max, rate=args.rate, seed=args.seed,
             sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
             tenants=args.tenants, prefix_mix=args.prefix_mix,
+            len_dist=args.len_dist,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

@@ -1808,19 +1808,21 @@ def make_fleet_workload(*, n: int, vocab: int, prompt_min: int,
                         prompt_max: int, out_min: int, out_max: int,
                         rate: float, seed: int, sessions: int = 0,
                         deadline_s: float = 0.0, tenants: int = 0,
-                        prefix_mix: float = 0.0) -> list[Request]:
+                        prefix_mix: float = 0.0,
+                        len_dist: str = "uniform") -> list[Request]:
     """The serve-bench workload generator plus session keys: request i
     belongs to session i % sessions (0 = sessionless), so the
     session-affinity policy has stable keys to rendezvous-hash.
-    `tenants`/`prefix_mix` pass through to make_workload's seeded
-    tenant mix and shared-template-prefix mix (ISSUE 9)."""
+    `tenants`/`prefix_mix`/`len_dist` pass through to make_workload's
+    seeded tenant mix, shared-template-prefix mix (ISSUE 9), and
+    heavy-tail length mix (ISSUE 16)."""
     from .bench import make_workload
 
     reqs = make_workload(n=n, vocab=vocab, prompt_min=prompt_min,
                          prompt_max=prompt_max, out_min=out_min,
                          out_max=out_max, rate=rate, seed=seed,
                          deadline_s=deadline_s, tenants=tenants,
-                         prefix_mix=prefix_mix)
+                         prefix_mix=prefix_mix, len_dist=len_dist)
     if sessions > 0:
         for r in reqs:
             r.session = r.rid % sessions

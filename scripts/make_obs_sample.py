@@ -157,6 +157,29 @@ def build_records():
     return records
 
 
+def build_autosize() -> int:
+    """Run a tiny-but-real `mctpu autosize` sweep (jax-free SimCompute
+    storms) into tests/data/sample_autosize_run.jsonl — the `goodput`
+    schema-family sample the report golden renders and the round-trip
+    tests replay (ISSUE 16). Small on purpose: budget 3 x both length
+    mixes = 6 seeded storms, a couple of seconds."""
+    from mpi_cuda_cnn_tpu.obs.autosize import autosize_main
+
+    run = DATA / "sample_autosize_run.jsonl"
+    run.unlink(missing_ok=True)
+    rc = autosize_main([
+        "--budget", "3", "--requests", "400", "--rate", "200",
+        "--seed", "0", "--len-dist", "both",
+        "--metrics-jsonl", str(run),
+    ])
+    if rc != 0:
+        print(f"error: autosize sample sweep exited {rc}",
+              file=sys.stderr)
+        return rc
+    print(f"wrote {run}")
+    return 0
+
+
 def main() -> int:
     from mpi_cuda_cnn_tpu.obs.causal import explain_main
     from mpi_cuda_cnn_tpu.obs.health import health_main
@@ -172,6 +195,10 @@ def main() -> int:
     slo = DATA / "sample_slo.json"
     slo.write_text(json.dumps(SAMPLE_SLO, indent=2) + "\n")
     print(f"wrote {slo}")
+    rc = build_autosize()
+    if rc != 0:
+        return rc
+    autosize_run = DATA / "sample_autosize_run.jsonl"
 
     # Render with the repo-relative path (and from the repo root) so
     # the golden titles are machine-independent — the round-trip test
@@ -194,6 +221,10 @@ def main() -> int:
         # state digest cross-checked against the reconstruction, final
         # state rendered (exit 0: the sample replays bitwise).
         ("golden_serve_replay.md", replay_main, [rel], 0),
+        # ISSUE 16: the goodput frontier + recommendation tables the
+        # report renders for an `mctpu autosize` sweep's record file.
+        ("golden_serve_autosize.md", report_main,
+         [str(autosize_run.relative_to(REPO))], 0),
     ):
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):

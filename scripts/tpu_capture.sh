@@ -21,6 +21,7 @@
 #   8e. bench_speculative  (draft/lookup speculation incl. T=0.8 rows)
 #   8f. bench_serve        (paged-KV continuous vs static batching; PR-3)
 #   8g. bench_serve_spec   (batched speculative serving pair; ISSUE 14)
+#   8h. autosize_frontier  (goodput capacity sweep; ISSUE 16 — CPU-side)
 #   9. profile_lm          (step-time attribution; VERDICT #3)
 #   9b. profile_moe        (MoE component attribution + chunk sweep)
 #  10. make -C native test_tpu  (C driver on the chip)
@@ -181,6 +182,15 @@ step bench_fleet_disagg 900 python scripts/bench_fleet.py \
     --requests 32 --rate 200 --log summary
 step bench_fleet_disagg_unified_twin 900 python scripts/bench_fleet.py \
     --compute engine --replicas 2 --requests 32 --rate 200 --log summary
+# ISSUE 16 (capacity planning): the offline goodput frontier at the
+# banked PERF.md mix — SimCompute storms, so this runs on the CPU side
+# of the host and needs no chip time; captured here so every TPU
+# session banks the frontier alongside the chip numbers it contextualises
+# (per-chip good r/s is what decides how many of THESE chips to buy).
+# Deterministic: the JSON row is bitwise-reproducible from the seed.
+step autosize_frontier 900 python -m mpi_cuda_cnn_tpu autosize \
+    --budget 4 --requests 20000 --rate 2000 --slots 8 --seed 0 \
+    --len-dist both --format json
 # PR-5 (elasticity): the width-invariant canonical-tree step on a real
 # chip mesh — banks the elastic-vs-plain step-time ratio for PERF.md
 # (CPU-banked 2x at the reference config; TPU fusion/collective costs

@@ -1,0 +1,312 @@
+"""Goodput observatory + `mctpu autosize` (ISSUE 16).
+
+THE acceptance tests live here:
+- sweep determinism: two identical-(seed, spec) autosize sweeps are
+  bitwise-identical — emitted record file, rendered frontier, and the
+  recommendation CRC — and pass the CI gate (ci/autosize_gate.json)
+  at 0%/equal;
+- blame-seeded pruning: a --seed-from profile evaluates measurably
+  fewer candidates than the exhaustive sweep while selecting the SAME
+  recommendation (equal recommendation_crc);
+- harness transparency: the unified candidate's trace/blame/state CRCs
+  equal a same-config `mctpu fleet-bench` run's — the sweep harness
+  changes nothing about the storms it measures;
+- goodput math: the exact (terminal-trail) and histogram-estimate
+  paths agree on the checked-in sample run, and the joint good/bad
+  judgment treats an unmeasured latency moment as not-good;
+- --len-dist stream isolation: the default uniform workload stream is
+  bitwise-unchanged (pinned CRC), and tenant labels are invariant
+  across mixes (the heavy-tail draws come from a separate spawn);
+- the `mctpu report` goodput-frontier rendering is byte-pinned against
+  the checked-in golden (regenerate via scripts/make_obs_sample.py).
+"""
+
+import json
+import zlib
+from pathlib import Path
+
+import pytest
+
+from mpi_cuda_cnn_tpu.obs.autosize import (
+    autosize_main,
+    blame_profile,
+    candidate_topologies,
+    dominant_category,
+    seeded_topologies,
+)
+from mpi_cuda_cnn_tpu.obs.goodput import (
+    default_goodput_spec,
+    goodput_from_records,
+    is_good,
+    tenant_goodput_rps,
+)
+from mpi_cuda_cnn_tpu.obs.regress import compare_main
+from mpi_cuda_cnn_tpu.obs.report import report_main
+from mpi_cuda_cnn_tpu.obs.schema import load_records
+from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main, make_workload
+
+REPO = Path(__file__).resolve().parents[1]
+DATA = REPO / "tests" / "data"
+
+# The canonical pinned workload-stream CRC (arrival, prompt len, output
+# len, tenant) at seed 0 — the bitwise-unchanged contract every len_dist
+# change must preserve for the DEFAULT stream.
+WORKLOAD_KW = dict(n=8, vocab=64, prompt_min=8, prompt_max=96,
+                   out_min=8, out_max=96, rate=50.0, seed=0, tenants=2)
+WORKLOAD_CRC = 1883835671
+
+
+def _canon(reqs):
+    return [[round(r.arrival, 9), int(r.prompt.size), r.max_new_tokens,
+             r.tenant] for r in reqs]
+
+
+# ------------------------------------------- len-dist stream isolation
+
+
+def test_len_dist_default_stream_bitwise_pinned():
+    """The default (and explicit uniform) workload stream is bitwise
+    what it was before --len-dist existed — committed baselines and
+    every pinned tick count stay valid."""
+    base = make_workload(**WORKLOAD_KW)
+    uni = make_workload(**WORKLOAD_KW, len_dist="uniform")
+    crc = zlib.crc32(json.dumps(_canon(base)).encode())
+    assert crc == WORKLOAD_CRC
+    assert _canon(uni) == _canon(base)
+
+
+def test_len_dist_lognormal_differs_but_tenants_invariant():
+    """The heavy-tail mix draws lengths from a separate (seed, 3)
+    spawn: lengths change, the tenant stream never moves."""
+    base = make_workload(**WORKLOAD_KW)
+    log = make_workload(**WORKLOAD_KW, len_dist="lognormal")
+    assert _canon(log) != _canon(base)
+    assert [r.tenant for r in log] == [r.tenant for r in base]
+    lens = [int(r.prompt.size) for r in log]
+    assert all(8 <= v <= 96 for v in lens)  # clipped to the range
+    with pytest.raises(ValueError):
+        make_workload(**WORKLOAD_KW, len_dist="zipf")
+
+
+# ------------------------------------------------------- goodput math
+
+
+def test_is_good_joint_over_all_latency_objectives():
+    """A request is good iff finished AND every declared latency
+    objective holds; an unmeasured moment is NOT good (goodput is a
+    guarantee, and an unmeasured TTFT guarantees nothing)."""
+    spec = default_goodput_spec(ttft_ms=100.0, tpot_ms=10.0)
+    ok = {"status": "finished", "ttft_ms": 50.0, "tpot_ms": 5.0}
+    assert is_good(ok, spec)
+    assert not is_good({**ok, "status": "expired"}, spec)
+    assert not is_good({**ok, "tpot_ms": 10.1}, spec)   # one axis blown
+    assert not is_good({**ok, "ttft_ms": None}, spec)   # unmeasured
+    assert is_good({**ok, "ttft_ms": 100.0}, spec)      # at threshold
+
+
+def test_goodput_exact_vs_estimate_agree_on_sample(monkeypatch):
+    """The histogram-estimate path (summary-only files) agrees with the
+    exact terminal-trail path on the checked-in sample run — same
+    request totals, good count within one, and the fidelity flag set."""
+    monkeypatch.chdir(REPO)
+    recs = load_records("tests/data/sample_serve_run.jsonl")
+    spec = default_goodput_spec(ttft_ms=200.0, tpot_ms=50.0)
+    exact = goodput_from_records(recs, spec)
+    summary_only = [r for r in recs
+                    if r.get("event") not in ("tick", "request")]
+    est = goodput_from_records(summary_only, spec)
+    assert not exact.estimated and est.estimated
+    assert est.requests == exact.requests
+    assert abs(est.good - exact.good) <= 1
+    assert est.duration_s == exact.duration_s
+
+
+def test_tenant_goodput_rps_shares_the_one_is_good(monkeypatch):
+    """The health column's per-tenant fold: exact-trail only, None for
+    tenants whose spec declares no latency objectives."""
+    monkeypatch.chdir(REPO)
+    recs = load_records("tests/data/sample_serve_run.jsonl")
+    spec = default_goodput_spec(ttft_ms=200.0, tpot_ms=50.0)
+    per = tenant_goodput_rps(recs, spec)
+    assert set(per) == {"t0", "t1"}
+    assert all(v is not None and v >= 0 for v in per.values())
+    # Availability-only spec: the column is em-dash (None), not zero —
+    # no latency objectives means goodput is undefined, not absent.
+    from mpi_cuda_cnn_tpu.obs.slo import default_spec
+    assert all(v is None
+               for v in tenant_goodput_rps(recs, default_spec()).values())
+    # Summary-only file: no exact trail, no estimate — empty.
+    summary_only = [r for r in recs
+                    if r.get("event") not in ("tick", "request")]
+    assert tenant_goodput_rps(summary_only, spec) == {}
+
+
+# -------------------------------------------------- candidate grammar
+
+
+def test_seeded_topologies_prune_rules():
+    """The blame-dominance pruning grammar, pinned: each dominant
+    category keeps unified plus its implicated split family, ordered
+    decode-heaviest first."""
+    assert candidate_topologies(4) == [
+        ("unified", None), ("1:3", {"prefill": 1, "decode": 3}),
+        ("2:2", {"prefill": 2, "decode": 2}),
+        ("3:1", {"prefill": 3, "decode": 1})]
+
+    def names(dom):
+        return [t[0] for t in seeded_topologies(4, dom)]
+
+    assert names(None) == ["unified", "1:3", "2:2", "3:1"]
+    assert names("handoff_wait") == ["unified", "1:3"]
+    assert names("queued_behind") == ["unified", "2:2"]
+    assert names("preempted_by") == ["unified", "1:3", "2:2"]
+
+    assert dominant_category({"handoff_wait": 5, "queued_behind": 3}) \
+        == "handoff_wait"
+    # Tie resolves toward the earlier SEED_CATEGORIES entry.
+    assert dominant_category({"handoff_wait": 5, "queued_behind": 5}) \
+        == "handoff_wait"
+    # All-zero profile: nothing to seed from.
+    assert dominant_category({"handoff_wait": 0}) is None
+    assert blame_profile([{"event": "tick"}]) is None
+
+
+# --------------------------------------- sweep determinism + CI gate
+
+
+def _sweep(tmp_path, tag, extra=()):
+    out = tmp_path / f"{tag}.jsonl"
+    rc = autosize_main(["--budget", "3", "--requests", "120",
+                        "--rate", "200", "--seed", "0",
+                        "--metrics-jsonl", str(out), *extra])
+    assert rc == 0
+    return out
+
+
+def test_autosize_sweep_determinism_bitwise_and_gate(tmp_path, capsys,
+                                                     monkeypatch):
+    """Two identical-(seed, spec) sweeps are bitwise-identical — record
+    file AND rendered frontier (recommendation CRC included) — and the
+    CI gate holds them to 0%/equal."""
+    a = _sweep(tmp_path, "a")
+    out_a = capsys.readouterr().out
+    b = _sweep(tmp_path, "b")
+    out_b = capsys.readouterr().out
+    assert a.read_bytes() == b.read_bytes()
+    assert out_a == out_b
+    assert "recommendation crc:" in out_a
+    monkeypatch.chdir(REPO)
+    assert compare_main([str(a), str(b),
+                         "--gate", "ci/autosize_gate.json"]) == 0
+    capsys.readouterr()
+
+
+def test_autosize_blame_seeded_prunes_same_recommendation(tmp_path,
+                                                          capsys):
+    """--seed-from evaluates measurably fewer candidates than the
+    exhaustive sweep while selecting the SAME recommendation (equal
+    recommendation_crc) — the whole point of reading telemetry before
+    burning sweep compute."""
+    rc = autosize_main(["--budget", "3", "--requests", "120",
+                        "--rate", "200", "--seed", "0",
+                        "--format", "json"])
+    assert rc == 0
+    full = json.loads(capsys.readouterr().out)
+
+    profile = tmp_path / "profile.jsonl"
+    profile.write_text(json.dumps(
+        {"schema": 1, "event": "blame", "t": 1.0, "mode": "fleet",
+         "requests": 120,
+         "categories": {"handoff_wait": 900, "queued_behind": 10,
+                        "preempted_by": 0}}) + "\n")
+    rc = autosize_main(["--budget", "3", "--requests", "120",
+                        "--rate", "200", "--seed", "0",
+                        "--seed-from", str(profile),
+                        "--format", "json"])
+    assert rc == 0
+    pruned = json.loads(capsys.readouterr().out)
+
+    assert pruned["seeded_from"] == "handoff_wait"
+    assert pruned["evaluated"] < full["evaluated"]
+    assert pruned["pruned"] > 0
+    assert pruned["recommendation"]["cand"] == \
+        full["recommendation"]["cand"]
+    assert pruned["recommendation_crc"] == full["recommendation_crc"]
+    # Pruning reorders/drops candidates, so the FRONTIER crc differs —
+    # only the recommendation is promised stable.
+    assert pruned["frontier_crc"] != full["frontier_crc"]
+
+
+def test_autosize_frontier_rediscovers_one_three_over_two_two(capsys):
+    """The frontier reproduces PERF.md's hand-found disagg conclusion:
+    at this decode-heavy mix the 1:3 split outranks 2:2 — the same
+    ordering the 20k-request banked table shows, pinned here at tier-1
+    scale so a ranking regression can't hide behind determinism."""
+    rc = autosize_main(["--budget", "4", "--requests", "120",
+                        "--rate", "200", "--seed", "0",
+                        "--format", "json"])
+    assert rc == 0
+    res = json.loads(capsys.readouterr().out)
+    rank = {r["cand"]: i for i, r in enumerate(res["frontier"])}
+    assert rank["1:3/fcfs/uniform/noprefix/off"] < \
+        rank["2:2/fcfs/uniform/noprefix/off"]
+
+
+def test_autosize_storm_crc_parity_with_fleet_bench(tmp_path, capsys):
+    """The sweep harness is transparent: the unified candidate's
+    trace/blame/state CRCs equal a same-config fleet-bench run's —
+    autosize changes nothing about the storms it measures."""
+    rc = autosize_main(["--budget", "3", "--requests", "120",
+                        "--rate", "200", "--seed", "0",
+                        "--format", "json"])
+    assert rc == 0
+    sweep = json.loads(capsys.readouterr().out)
+    unified = next(r for r in sweep["frontier"]
+                   if r["topology"] == "unified")
+
+    run = tmp_path / "fleet.jsonl"
+    rc = fleet_bench_main(["--replicas", "3", "--requests", "120",
+                           "--rate", "200", "--seed", "0",
+                           "--log", "summary",
+                           "--metrics-jsonl", str(run)])
+    assert rc == 0
+    capsys.readouterr()
+    serve = next(r for r in load_records(run)
+                 if r.get("event") == "serve")
+    assert unified["trace_crc"] == serve["trace_crc"]
+    assert unified["state_crc"] == serve["state_crc"]
+    assert unified["blame_crc"] == serve["blame_crc"]
+    assert unified["tokens_per_s"] == serve["tokens_per_s"]
+
+
+# ------------------------------------------------------- error paths
+
+
+def test_autosize_error_paths(tmp_path, capsys):
+    """Budget < 2 and a --seed-from file without a blame record are
+    config errors (exit 2), not silent exhaustive fallbacks."""
+    assert autosize_main(["--budget", "1"]) == 2
+    assert "nothing to decide" in capsys.readouterr().err
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps(
+        {"schema": 1, "event": "epoch", "t": 0.0, "epoch": 0,
+         "seconds": 1.0}) + "\n")
+    assert autosize_main(["--budget", "2", "--requests", "8",
+                          "--seed-from", str(empty)]) == 2
+    assert "no blame record" in capsys.readouterr().err
+    assert autosize_main(["--budget", "2", "--requests", "8",
+                          "--seed-from", str(tmp_path / "nope.jsonl")]) \
+        == 2
+
+
+# -------------------------------------------------- golden round-trip
+
+
+def test_golden_autosize_roundtrip(monkeypatch, capsys):
+    """`mctpu report` on the checked-in autosize sample run is
+    byte-for-byte the golden (regenerate via
+    scripts/make_obs_sample.py)."""
+    monkeypatch.chdir(REPO)
+    assert report_main(["tests/data/sample_autosize_run.jsonl"]) == 0
+    assert capsys.readouterr().out == \
+        (DATA / "golden_serve_autosize.md").read_text()
