@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative bench_serve bench_serve_spec bench_fleet autosize serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve bench_serve_spec bench_serve_hosttier bench_serve_pagedraft bench_fleet autosize serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -147,6 +147,25 @@ bench_serve_spec:
 	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9 \
 	  --spec lookup --spec-k 8
 	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9
+
+# Host-tier KV spill (ISSUE 17): the spill-on/off pair over a device
+# pool tight against the template working set — spilled prefix pages
+# readmit on the next hit instead of re-prefilling; outputs bitwise
+# equal, the win is the prefill-chunk / hit-token counters (PERF.md).
+bench_serve_hosttier:
+	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9 \
+	  --templates 4 --pages 16 --prefix-cache --spill --host-pages 16
+	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9 \
+	  --templates 4 --pages 16 --prefix-cache
+
+# Paged draft-model KV cache (ISSUE 17): draft speculation with the
+# persistent paged draft cache vs the cacheless ~W-row-recompute
+# window draft — outputs bitwise equal, the win is draft FLOPs/round.
+bench_serve_pagedraft:
+	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9 \
+	  --spec draft --spec-k 8 --draft-cache paged
+	$(PY) scripts/bench_serve.py --mode continuous --prefix-mix 0.9 \
+	  --spec draft --spec-k 8 --draft-cache window
 
 # Fleet storm benchmark: N replicas behind the failure-aware router,
 # seeded Poisson arrivals, optional injected replica crashes/joins

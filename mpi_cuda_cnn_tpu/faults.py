@@ -47,10 +47,12 @@ kinds:
                 number): both ends release their pages and the
                 request re-prefills exactly once
 - ``kv_corrupt``    — corrupt one page's integrity stamp of the Nth
-                handoff (``page=K``, site fleet.handoff) or the Nth
+                handoff (``page=K``, site fleet.handoff), the Nth
                 resume re-dispatch's committed context (site
-                fleet.resume): verification refuses the transfer and
-                the request re-prefills — garbage is never decoded
+                fleet.resume), or the Nth host-tier page spill (site
+                tier.spill, ISSUE 17): verification refuses the
+                transfer/readmission and the request re-prefills —
+                garbage is never decoded
 
 Recovery — `supervise()` is the `--max-restarts N` loop: it runs one
 training attempt, and on a crash rebuilds the trainer and resumes from
@@ -137,6 +139,14 @@ SITES: dict[str, dict[str, frozenset[str]]] = {
     },
     "serve-bench": {
         "serve.tick": frozenset({"crash", "io", "squeeze", "slow"}),
+        # Host-tier spill integrity (ISSUE 17). Polled, not fired (the
+        # spill happens inside the prefix cache's reclaim path, not at
+        # a tick boundary), so crash/io are deliberately absent — they
+        # would be inert. Triggers on the SPILL sequence number (the
+        # Nth device->host page spill); kv_corrupt flips the spilled
+        # page's integrity stamp so the later readmission is refused
+        # and the request re-prefills — garbage is never decoded.
+        "tier.spill": frozenset({"kv_corrupt"}),
     },
     "fleet-bench": {
         "fleet.tick": frozenset({"crash", "io", "replica_crash",
@@ -151,6 +161,10 @@ SITES: dict[str, dict[str, frozenset[str]]] = {
         # transfer across a failover).
         "fleet.handoff": frozenset({"handoff_drop", "kv_corrupt"}),
         "fleet.resume": frozenset({"kv_corrupt"}),
+        # Per-replica host-tier spills (ISSUE 17): same polled site the
+        # serve-bench surface registers, trigger value = the replica's
+        # own spill sequence number.
+        "tier.spill": frozenset({"kv_corrupt"}),
     },
 }
 

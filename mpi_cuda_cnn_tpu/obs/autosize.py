@@ -123,11 +123,12 @@ _LEN_AXIS = {"uniform": ["uniform"], "lognormal": ["lognormal"],
              "both": ["uniform", "lognormal"]}
 _SCHED_AXIS = {"fcfs": ["fcfs"], "slo": ["slo"],
                "both": ["fcfs", "slo"]}
+_SPILL_AXIS = {"off": [False], "on": [True], "both": [False, True]}
 
 
 def run_candidate(args, spec: SLOSpec, *, pools: dict | None,
                   scheduler: str, prefix: bool, spec_mode: str,
-                  len_dist: str) -> dict:
+                  len_dist: str, spill: bool = False) -> dict:
     """One candidate topology as a seeded SimCompute storm — the SAME
     fleet construction fleet-bench uses (defaults and all), so the
     storm's trace/blame/state CRCs are unchanged by the sweep harness
@@ -157,7 +158,8 @@ def run_candidate(args, spec: SLOSpec, *, pools: dict | None,
         prompt_max=args.prompt_max, out_min=args.out_min,
         out_max=args.out_max, rate=args.rate, seed=args.seed,
         deadline_s=args.deadline_ms / 1e3, tenants=args.tenants,
-        len_dist=len_dist,
+        len_dist=len_dist, prefix_mix=args.prefix_mix,
+        templates=args.templates,
     )
     clock = FakeClock()
     registry = MetricsRegistry(clock=clock)
@@ -179,6 +181,7 @@ def run_candidate(args, spec: SLOSpec, *, pools: dict | None,
         spec=spec_mode, spec_k=8, spec_ngram=2,
         pools=dict(pools) if pools else None, handoff_ticks=1,
         log_handoffs=False,
+        host_pages=(args.host_pages or pages) if spill else 0,
     )
     result = fleet.run(reqs)
     s = result.summary()
@@ -191,11 +194,13 @@ def run_candidate(args, spec: SLOSpec, *, pools: dict | None,
             else "unified")
     return {
         "cand": "/".join((topo, scheduler, len_dist,
-                          "prefix" if prefix else "noprefix", spec_mode)),
+                          "prefix" if prefix else "noprefix", spec_mode)
+                         + (("spill",) if spill else ())),
         "topology": topo,
         "scheduler": scheduler,
         "prefix": prefix,
         "spec": spec_mode,
+        "spill": spill,
         "len_dist": len_dist,
         **g.fields(),
         "finished": (s.get("statuses") or {}).get("finished", 0),
@@ -235,13 +240,18 @@ def sweep(args, spec: SLOSpec, dominant: str | None) -> dict:
         for sched in _SCHED_AXIS[args.schedulers]:
             for pfx in _PREFIX_AXIS[args.prefix]:
                 for spm in _SPEC_AXIS[args.spec]:
-                    axes.append((ldist, sched, pfx, spm))
+                    for spl in _SPILL_AXIS[args.spill]:
+                        if spl and not pfx:
+                            # The host tier spills prefix-tree pages;
+                            # spill-on/prefix-off has nothing to spill.
+                            continue
+                        axes.append((ldist, sched, pfx, spm, spl))
     rows = []
     for topo, pools in topos:
-        for ldist, sched, pfx, spm in axes:
+        for ldist, sched, pfx, spm, spl in axes:
             rows.append(run_candidate(
                 args, spec, pools=pools, scheduler=sched, prefix=pfx,
-                spec_mode=spm, len_dist=ldist))
+                spec_mode=spm, len_dist=ldist, spill=spl))
     ranked = sorted(rows, key=_rank_key)
     rec = ranked[0] if ranked else None
     return {
@@ -268,16 +278,17 @@ def render_frontier(res: dict, args) -> str:
         "thresholds: " + ", ".join(
             f"{k}<={v:g}ms" for k, v in res["thresholds"].items()),
         "",
-        "| rank | topology | sched | len dist | prefix | spec "
+        "| rank | topology | sched | len dist | prefix | spec | spill "
         "| good | good frac | per-chip r/s | tok/s | TTFT p99 ms "
         "| TPOT p99 ms |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for i, r in enumerate(res["ranked"], 1):
         lines.append(
             f"| {i} | {r['topology']} | {r['scheduler']} "
             f"| {r['len_dist']} | {'on' if r['prefix'] else 'off'} "
-            f"| {r['spec']} | {r['good']} | {_fmt(r['good_fraction'])} "
+            f"| {r['spec']} | {'on' if r.get('spill') else 'off'} "
+            f"| {r['good']} | {_fmt(r['good_fraction'])} "
             f"| {_fmt(r['per_chip_rps'])} | {_fmt(r['tokens_per_s'])} "
             f"| {_fmt(r['ttft_p99_ms'])} | {_fmt(r['tpot_p99_ms'])} |"
         )
@@ -375,6 +386,22 @@ def autosize_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--spec", default="off",
                     choices=["off", "lookup", "both"],
                     help="speculative decoding axis")
+    ap.add_argument("--spill", default="off",
+                    choices=["off", "on", "both"],
+                    help="host-tier prefix-cache spill axis (spill-on "
+                         "candidates require the prefix axis on; the "
+                         "spill-on/prefix-off combos are skipped)")
+    ap.add_argument("--host-pages", type=int, default=0,
+                    help="host-tier capacity for spill-on candidates "
+                         "(0 = match the device pool size)")
+    ap.add_argument("--prefix-mix", type=float, default=0.0,
+                    help="fraction of requests sharing a workload "
+                         "prefix template (what gives the prefix and "
+                         "spill axes something to hit)")
+    ap.add_argument("--templates", type=int, default=0,
+                    help="seeded shared-prefix template pool size "
+                         "(0 = legacy two-template mix; default "
+                         "workload CRCs are bitwise-unchanged)")
     ap.add_argument("--slo", default=None,
                     help="SLO spec JSON (obs.slo grammar) whose latency "
                          "objectives define goodput; default: "
@@ -397,6 +424,11 @@ def autosize_main(argv: list[str] | None = None) -> int:
     if args.budget < 2:
         print(f"error: --budget {args.budget}: a capacity search over "
               "one chip has nothing to decide (want >= 2)",
+              file=sys.stderr)
+        return 2
+    if args.spill == "on" and args.prefix == "off":
+        print("error: --spill on needs the prefix axis (--prefix "
+              "on/both): the host tier spills prefix-tree pages",
               file=sys.stderr)
         return 2
     try:

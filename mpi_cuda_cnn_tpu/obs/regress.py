@@ -120,7 +120,13 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                # draft tokens proposed/accepted — the fleet/spec
                # determinism gates pin them at exact equality (zeros
                # on a spec-off run).
-               "spec_rounds", "spec_proposed", "spec_accepted")
+               "spec_rounds", "spec_proposed", "spec_accepted",
+               # Host-tier KV spill (ISSUE 17): spill / readmission /
+               # CRC-refusal / host-LRU-eviction counters — the
+               # fleet/spec/disagg determinism gates pin them at exact
+               # equality (zeros on a spill-off run).
+               "tier_spills", "tier_readmits", "tier_refusals",
+               "tier_host_evictions")
 
 # Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
 # summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to

@@ -51,6 +51,11 @@ from .schema import iter_runs
 
 _PREFIX_STATS = ("hits", "misses", "hit_tokens", "cow_copies",
                  "inserts", "evictions")
+# Host-tier counters (ISSUE 17), adopted cumulatively from the tick
+# record's prefix block like cow/inserts/evictions: the readmit delta
+# drives the free-page law (a readmission allocates a device page with
+# no other trail event), the rest ride the digest's tier tuple.
+_TIER_STATS = ("spills", "readmits", "refusals", "host_evictions")
 
 
 class ReplayError(Exception):
@@ -90,7 +95,8 @@ class SchedMirror:
 
     def __init__(self, *, label: str, slots: int, num_pages: int,
                  page_size: int, reqinfo: dict, static: bool = False,
-                 prefix: bool = False, spec_extra=(0, 0)):
+                 prefix: bool = False, spec_extra=(0, 0), tier: bool = False,
+                 draft_usable: int = 0):
         self.label = label
         self.slots: list[_Slot | None] = [None] * slots
         self.queue: deque[int] = deque()
@@ -107,6 +113,20 @@ class SchedMirror:
         # cumulative stats block (their deltas drive the free-page and
         # refs accounting, and the digest pins the adopted values).
         self.pstats = dict.fromkeys(_PREFIX_STATS, 0)
+        # Host spill tier (ISSUE 17): cumulative tier counters + host
+        # occupancy, adopted from the tick record's prefix block; the
+        # readmit delta joins the free-page law and the whole tuple
+        # joins the digest (the producer's PrefixCache.digest_tuple).
+        self.tier = tier
+        self.tstats = dict.fromkeys(_TIER_STATS, 0)
+        self.host_used = 0
+        # Paged draft cache (ISSUE 17): per-engine-slot draft page
+        # counts re-derived from the spec rounds via the page law
+        # "after a slot's round the draft holds pages_for(committed
+        # rows)" — entries persist lazily across slot release, exactly
+        # like the producer's PagedDraftProposer state.
+        self.draft_usable = draft_usable       # 0 = no paged draft
+        self.draft_pages: dict[int, int] = {}  # engine slot idx -> pages
 
     # -- queue ops (mirroring the scheduler's _q_* helpers) ------------
 
@@ -171,15 +191,24 @@ class SchedMirror:
         prec = rec.get("prefix")
         evict_delta = 0
         insert_delta = 0
+        readmit_delta = 0
         if prec is not None:
             insert_delta = prec["inserts"] - self.pstats["inserts"]
             evict_delta = prec["evictions"] - self.pstats["evictions"]
             self.pstats["cow_copies"] = prec["cow_copies"]
             self.pstats["inserts"] = prec["inserts"]
             self.pstats["evictions"] = prec["evictions"]
+            if self.tier:
+                readmit_delta = prec["readmits"] - self.tstats["readmits"]
+                for k in _TIER_STATS:
+                    self.tstats[k] = prec[k]
+                self.host_used = prec["host_used"]
         # LRU reclaim returns tree leaves to the pool (admission or
-        # growth pressure — the tick's eviction delta is the only trace).
-        self.free += evict_delta
+        # growth pressure — the tick's eviction delta is the only
+        # trace); a host-tier readmission pulls one page back OUT per
+        # readmit (the tier's re-insert allocates a fresh device page,
+        # which the requesting slot then shares like any resident hit).
+        self.free += evict_delta - readmit_delta
 
         # 1. Aborts (sweep expiries/cancels, queue-bound rejections,
         # livelock failures): wherever the rid sits. Static in-flight
@@ -261,6 +290,7 @@ class SchedMirror:
         # decode, so the two spellings agree).
         spec = rec.get("spec")
         if spec is not None:
+            slot_of_rid = {rid: idx for idx, rid in rec.get("decoded") or []}
             for rid, _proposed, accepted in spec:
                 at = self._slot_of(rid)
                 if at is None:
@@ -269,6 +299,15 @@ class SchedMirror:
                         f"{rid} with no bound slot", tick=tick,
                         stream=self.label, rids=[rid])
                 s = at[1]
+                if self.draft_usable:
+                    # The paged-draft page law (ISSUE 17): after this
+                    # slot's round the draft holds pages_for(committed
+                    # rows), committed rows = context-1 at propose time
+                    # (the last committed token is the round's input,
+                    # not yet a draft cache row).
+                    prompt, _mx = self._req(rid, tick, "draft round")
+                    rows = prompt + self.outlen.get(rid, 0) - 1
+                    self.draft_pages[slot_of_rid[rid]] = pages_for(rows, ps)
                 j = 1 + accepted
                 s.cached += j
                 self.outlen[rid] = self.outlen.get(rid, 0) + j
@@ -316,13 +355,31 @@ class SchedMirror:
         prefix = None
         if self.prefix:
             st = self.pstats
-            prefix = (st["inserts"] - st["evictions"], st["hits"],
+            # Node count: inserts - evictions, plus readmits with a
+            # tier on (a readmitted node re-enters the tree without an
+            # insert — the producer counts only fresh adoptions).
+            nodes = st["inserts"] - st["evictions"]
+            if self.tier:
+                nodes += self.tstats["readmits"]
+            prefix = (nodes, st["hits"],
                       st["misses"], st["hit_tokens"], st["cow_copies"],
                       st["inserts"], st["evictions"])
+            if self.tier:
+                prefix += (self.tstats["spills"], self.tstats["readmits"],
+                           self.tstats["refusals"],
+                           self.tstats["host_evictions"], self.host_used)
+        extra = self.spec_extra
+        if self.draft_usable:
+            # The paged-draft digest extension (engine.run's spelling):
+            # (spec on, k, draft paged, free draft pages, tracked slots).
+            extra = extra + (1,
+                             self.draft_usable
+                             - sum(self.draft_pages.values()),
+                             len(self.draft_pages))
         q = self.queue
         return state_digest(len(q), q[0] if q else -1, q[-1] if q else -1,
                             self.queue_sig, slots, self.free - squeezed,
-                            prefix, self.spec_extra)
+                            prefix, extra)
 
     def check(self, rec: dict) -> None:
         """The per-tick cross-check: recomputed digest == stamped, and
@@ -355,6 +412,13 @@ class SchedMirror:
         }
         if self.prefix:
             out["prefix"] = dict(self.pstats)
+        if self.tier:
+            out["tier"] = {**self.tstats, "host_used": self.host_used}
+        if self.draft_usable:
+            out["draft"] = {
+                "free": self.draft_usable - sum(self.draft_pages.values()),
+                "tracked": len(self.draft_pages),
+            }
         return out
 
 
@@ -430,6 +494,7 @@ class FleetMirror:
             page_size=int(self.cfg["page_size"]), reqinfo=self.reqinfo,
             prefix=bool(self.cfg.get("prefix_cache")),
             spec_extra=self._spec_extra(),
+            tier=bool(self.cfg.get("host_pages")),
         )
         m = _Member(name, phase, gen, sched)
         self.members[name] = m
@@ -715,12 +780,23 @@ def split_run(records: list[dict]) -> dict:
 
 def _engine_mirror(mode: str, cfg: dict, reqinfo: dict) -> SchedMirror:
     spec_on = (mode == "continuous" and cfg.get("spec", "off") != "off")
+    draft_usable = 0
+    if (spec_on and cfg.get("spec") == "draft"
+            and cfg.get("draft_cache") == "paged"):
+        # The draft pool's usable size (ISSUE 17): slots x
+        # pages_for(max_len) — PagedDraftProposer's full-coverage
+        # sizing, so the mirror can re-derive free draft pages from
+        # the per-slot page law alone.
+        draft_usable = int(cfg["slots"]) * pages_for(
+            int(cfg["max_len"]), int(cfg["page_size"]))
     return SchedMirror(
         label=mode, slots=int(cfg["slots"]), num_pages=int(cfg["pages"]),
         page_size=int(cfg["page_size"]), reqinfo=reqinfo,
         static=(mode == "static"),
         prefix=bool(cfg.get("prefix_cache")) and mode == "continuous",
         spec_extra=(1, int(cfg.get("spec_k", 0))) if spec_on else (0, 0),
+        tier=(mode == "continuous" and bool(cfg.get("host_pages"))),
+        draft_usable=draft_usable,
     )
 
 
@@ -831,6 +907,14 @@ def _render_sched(snap: dict) -> list[str]:
         p = snap["prefix"]
         lines.append(
             "prefix: " + ", ".join(f"{k}={p[k]}" for k in _PREFIX_STATS))
+    if "tier" in snap:
+        t = snap["tier"]
+        lines.append("host tier: " + ", ".join(
+            f"{k}={t[k]}" for k in (*_TIER_STATS, "host_used")))
+    if "draft" in snap:
+        d = snap["draft"]
+        lines.append(f"draft pool: free={d['free']} "
+                     f"tracked={d['tracked']}")
     return lines
 
 

@@ -337,6 +337,8 @@ def summarize(records: Iterable[dict], *,
               "ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms",
               "prefix_hits", "prefix_misses", "prefix_hit_tokens",
               "prefix_cow", "prefix_evictions",
+              "host_pages", "tier_spills", "tier_readmits",
+              "tier_refusals", "tier_host_evictions",
               "spec_rounds", "spec_proposed", "spec_accepted")}
             for r in serves
         ]
@@ -666,6 +668,25 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                     f"| {_fmt(s['prefix_hit_tokens'])} "
                     f"| {_fmt(s['prefix_cow'])} "
                     f"| {_fmt(s['prefix_evictions'])} |"
+                )
+            lines.append("")
+        # Host-tier table (ISSUE 17): only for runs that ran WITH a
+        # host tier (host_pages stamped nonzero) — spill-off runs stamp
+        # all-zero tier counters and must not grow a table of dashes.
+        truns = [s for s in summary["serve"] if s.get("host_pages")]
+        if truns:
+            lines += [
+                "| host tier | host pages | spills | readmits "
+                "| refusals | host evictions |",
+                "|---|---|---|---|---|---|",
+            ]
+            for s in truns:
+                lines.append(
+                    f"| {s['mode']} | {_fmt(s['host_pages'])} "
+                    f"| {_fmt(s['tier_spills'])} "
+                    f"| {_fmt(s['tier_readmits'])} "
+                    f"| {_fmt(s['tier_refusals'])} "
+                    f"| {_fmt(s['tier_host_evictions'])} |"
                 )
             lines.append("")
     if "metrics" in summary:

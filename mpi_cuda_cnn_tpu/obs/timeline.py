@@ -65,6 +65,13 @@ class Lifecycle:
     # what keeps tokens_accounted exact under variable-length commits.
     spec_rounds: int = 0
     spec_accepted: int = 0
+    # Host-tier readmissions (ISSUE 17): admissions whose device-tree
+    # miss was served from the spilled host tier — the prefix_hit's
+    # sibling marker (a readmitted chunk counts as a hit at bind, so
+    # the hit marker still fires; this one says WHERE the pages came
+    # from).
+    tier_readmits: int = 0
+    tier_readmit_tokens: int = 0
     derived_status: str | None = None
     terminal_now: float | None = None
     # Milliseconds spent per state, summed across segments.
@@ -175,6 +182,17 @@ def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
                 lc.prefix_hits += 1
                 lc.prefix_hit_tokens += matched
                 lc.events.append((tick, now, "prefix_hit", matched))
+            for rid, depth in rec.get("prefix_readmits") or []:
+                # Host-tier readmission (ISSUE 17): the chunk ending at
+                # `depth` prompt tokens came back from the spilled host
+                # tier instead of re-prefilling — the marker that
+                # explains a device-tree miss that still prefilled only
+                # the suffix.
+                lc = life(mode, rid)
+                lc.tier_readmits += 1
+                lc.tier_readmit_tokens = max(lc.tier_readmit_tokens,
+                                             depth)
+                lc.events.append((tick, now, "tier_readmit", depth))
             pf = rec.get("prefill")
             if pf:
                 lc = life(mode, pf[1])
@@ -497,6 +515,7 @@ def trace_main(argv: list[str] | None = None) -> int:
                             "decode_ticks": lc.decode_ticks,
                             "prefix_hits": lc.prefix_hits,
                             "prefix_hit_tokens": lc.prefix_hit_tokens,
+                            "tier_readmits": lc.tier_readmits,
                             "spec_rounds": lc.spec_rounds,
                             "spec_accepted": lc.spec_accepted,
                             "tokens": lc.tokens_accounted,

@@ -83,6 +83,10 @@ class TopState:
         # count = its pool size): the fixed scale its pressure bar
         # renders against.
         self.free_hi: dict[str, float] = {}
+        # Host-tier occupancy high-water per mode (ISSUE 17): the scale
+        # the host-tier bar renders against until the serve record's
+        # host_pages stamp gives the true capacity.
+        self.tier_hi: dict[str, float] = {}
         # TOP-BLOCKERS (ISSUE 11): ticks each holder rid kept a blocked
         # admission waiting (joint attribution over the tick records'
         # `blocked` entries), plus the block-reason mix.
@@ -109,6 +113,9 @@ class TopState:
             self.queue_hist.setdefault(
                 mode, deque(maxlen=self._history)
             ).append(rec.get("queue", 0))
+            hu = (rec.get("prefix") or {}).get("host_used")
+            if hu is not None:
+                self.tier_hi[mode] = max(self.tier_hi.get(mode, 0.0), hu)
             for entry in rec.get("blocked") or []:
                 rid, reason, holders = entry[0], entry[1], entry[2]
                 self.block_reasons[reason] = \
@@ -215,6 +222,21 @@ def render(state: TopState, path: str, width: int = 96) -> str:
                 f"lru {_fmt(pfx.get('retained_pages'))} "
                 f"{bar(pfx.get('retained_pages'), pool_hi, width=8)} "
                 f"free {_fmt(free)} {bar(free, pool_hi, width=8)}"
+            )
+        if pfx and "host_used" in pfx:
+            # Host-tier panel (ISSUE 17): spilled-page occupancy bar
+            # against the tier capacity (the serve record's host_pages
+            # stamp, or the running high-water while the run is live)
+            # plus the spill/readmit/refusal/eviction totals.
+            cap = ((state.serve.get(mode) or {}).get("host_pages")
+                   or state.tier_hi.get(mode))
+            lines.append(
+                f"  host tier: used {_fmt(pfx.get('host_used'))} "
+                f"{bar(pfx.get('host_used'), cap, width=10)}  "
+                f"spill {_fmt(pfx.get('spills'))}  "
+                f"readmit {_fmt(pfx.get('readmits'))}  "
+                f"refused {_fmt(pfx.get('refusals'))}  "
+                f"host-evict {_fmt(pfx.get('host_evictions'))}"
             )
         if counters:
             lines.append(

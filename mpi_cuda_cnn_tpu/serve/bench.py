@@ -63,7 +63,7 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
                   out_min: int, out_max: int, rate: float, seed: int,
                   deadline_s: float = 0.0, tenants: int = 0,
                   prefix_mix: float = 0.0, prefix_pool: int = 4,
-                  len_dist: str = "uniform"):
+                  len_dist: str = "uniform", templates: int = 0):
     """n seeded requests: uniform prompt/output lengths in the given
     ranges, Poisson arrivals at `rate` req/s (exponential gaps; rate 0
     = everything arrives at t=0). deadline_s > 0 gives every request an
@@ -93,7 +93,15 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
     exists for (varying lengths hit the tree at different depths, so
     COW branching is exercised too). All prefix decisions come from a
     (seed, 2) spawn and OVERWRITE an already-drawn prompt, so lengths,
-    arrivals, and tenant labels are bitwise-identical at any mix."""
+    arrivals, and tenant labels are bitwise-identical at any mix.
+
+    templates > 0 (ISSUE 17) overrides prefix_pool with an explicitly
+    sized template WORKING SET whose content comes from a SEPARATE
+    (seed, 4) spawn — the --len-dist precedent again, so the default
+    (templates=0) stream is bitwise-unchanged and every pinned workload
+    CRC stays valid. Sizing the working set past the device page pool
+    is what makes the host-tier spill/readmit story measurable: more
+    templates than HBM retains forces LRU reclaim between hits."""
     from .scheduler import Request
 
     if len_dist not in ("uniform", "lognormal"):
@@ -104,8 +112,15 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
     prng = np.random.default_rng([seed, 2])
     lrng = (np.random.default_rng([seed, 3])
             if len_dist == "lognormal" else None)
-    templates = [prng.integers(0, vocab, (prompt_max,)).astype(np.int32)
-                 for _ in range(prefix_pool)] if prefix_mix > 0 else []
+    if templates > 0:
+        wrng = np.random.default_rng([seed, 4])
+        pool_n = templates
+        tmpl_rng = wrng
+    else:
+        pool_n = prefix_pool
+        tmpl_rng = prng
+    templates = [tmpl_rng.integers(0, vocab, (prompt_max,)).astype(np.int32)
+                 for _ in range(pool_n)] if prefix_mix > 0 else []
     t = 0.0
     reqs = []
     for i in range(n):
@@ -121,7 +136,7 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
         tenant = (f"t{int(trng.integers(0, tenants))}" if tenants > 0
                   else None)
         if templates and float(prng.random()) < prefix_mix:
-            k = int(prng.integers(0, prefix_pool))
+            k = int(prng.integers(0, pool_n))
             shared = plen - max(1, plen // 4)
             if shared > 0:
                 prompt = np.concatenate(
@@ -259,6 +274,23 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "continuous scheduler: hash-keyed prefix "
                          "pages with refcounts + COW — cache-hit "
                          "requests prefill only their suffix")
+    ap.add_argument("--templates", type=int, default=0,
+                    help="prefix template working-set size (ISSUE 17): "
+                         "overrides the default 4-template pool with N "
+                         "templates drawn from a separate seeded spawn "
+                         "(default workload bitwise-unchanged); size it "
+                         "past the device page pool to exercise the "
+                         "host tier (needs --prefix-mix > 0)")
+    ap.add_argument("--spill", action="store_true",
+                    help="host-tier KV spill (ISSUE 17): LRU-reclaimed "
+                         "refcount-0 prefix pages spill to a bounded "
+                         "host-memory tier instead of being discarded; "
+                         "a later prefix hit readmits them (CRC-sealed "
+                         "at the tier crossing — corrupt spills are "
+                         "refused and re-prefill). Needs --prefix-cache")
+    ap.add_argument("--host-pages", type=int, default=0,
+                    help="host-tier capacity in pages (--spill; 0 = "
+                         "match the device pool)")
     ap.add_argument("--spec", default="off",
                     choices=["off", "lookup", "draft"],
                     help="batched speculative decoding (ISSUE 14), "
@@ -279,6 +311,15 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                     help="draft model width (--spec draft; 0 = dim/2)")
     ap.add_argument("--draft-depth", type=int, default=0,
                     help="draft model depth (--spec draft; 0 = 1)")
+    ap.add_argument("--draft-cache", default="window",
+                    choices=["window", "paged"],
+                    help="draft KV form (--spec draft, ISSUE 17): "
+                         "window = cacheless sliding-window draft "
+                         "(recomputes ~W tokens per proposal); paged = "
+                         "the draft holds its own paged KV cache, "
+                         "per-slot block tables growing/rolling back in "
+                         "lockstep with commit_spec (same T=0 outputs, "
+                         "~W x fewer draft FLOPs per round)")
     ap.add_argument("--scheduler", default="fcfs",
                     choices=["fcfs", "slo"],
                     help="continuous-batching policy: fcfs (default) "
@@ -335,6 +376,26 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         print(f"error: --spec-k {args.spec_k} would propose nothing "
               "(want >= 2)", file=sys.stderr)
         return 2
+    if args.draft_cache == "paged" and args.spec != "draft":
+        # Loud-config-error convention: the knob only shapes the draft
+        # proposer; swept without one it would silently measure nothing.
+        print("error: --draft-cache paged needs --spec draft",
+              file=sys.stderr)
+        return 2
+    if args.spill and not args.prefix_cache:
+        print("error: --spill needs --prefix-cache (the host tier "
+              "spills prefix-cache pages; there is nothing to spill)",
+              file=sys.stderr)
+        return 2
+    if args.host_pages and not args.spill:
+        print("error: --host-pages needs --spill (without the tier the "
+              "capacity knob would be silently ignored)",
+              file=sys.stderr)
+        return 2
+    if args.templates and not args.prefix_mix > 0:
+        print("error: --templates needs --prefix-mix > 0 (no request "
+              "draws a template prefix at mix 0)", file=sys.stderr)
+        return 2
     model = TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.heads, depth=args.depth,
         max_seq=args.max_seq, kv_heads=args.kv_heads,
@@ -362,7 +423,9 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         weights_dtype=args.decode_weights_dtype,
         spec=args.spec, spec_k=args.spec_k, spec_ngram=args.spec_ngram,
         draft_model=draft_model, draft_params=draft_params,
+        draft_cache=args.draft_cache,
     )
+    host_pages = (args.host_pages or pages) if args.spill else 0
     if args.scheduler == "slo":
         args.mode = "continuous"
     if args.prefix_cache and args.mode == "static":
@@ -381,6 +444,7 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         out_max=args.out_max, rate=args.rate, seed=args.seed,
         deadline_s=args.deadline_ms / 1e3, tenants=args.tenants,
         prefix_mix=args.prefix_mix, len_dist=args.len_dist,
+        templates=args.templates,
     )
     run_kw = dict(
         max_queue=args.max_queue or None,
@@ -423,6 +487,11 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                        mode="continuous", spec=True)
         if args.prefix_cache:
             engine.copy_page(0, 0)
+        if args.spill:
+            # Warm the readmission restore program (scratch page onto
+            # itself, like the COW warm-up above — harmless: scratch is
+            # the sanctioned garbage sink).
+            engine.readmit_page(0, engine.spill_page(0))
         for mode in modes:
             faults = None
             if args.fault_plan:
@@ -465,6 +534,8 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                                         if mode == "continuous" else None),
                                 spec=(args.spec != "off"
                                       and mode == "continuous"),
+                                host_pages=(host_pages
+                                            if mode == "continuous" else 0),
                                 **run_kw)
             s = result.summary()
             # Blame stamp (ISSUE 11): the crc + per-category totals
@@ -495,7 +566,14 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                 # the replay reconstruction needs the flag — a sharing-on
                 # run with zero hits digests (0,0,...) where a
                 # sharing-off run digests None.
-                "prefix_cache": bool(args.prefix_cache), **s,
+                "prefix_cache": bool(args.prefix_cache),
+                # Host-tier + draft-cache geometry (ISSUE 17): the
+                # replay mirror rebuilds the tier digest extension from
+                # host_pages > 0 and the draft-pool extension from
+                # draft_cache == "paged" (max_len sizes the draft pool).
+                "host_pages": host_pages,
+                "draft_cache": args.draft_cache,
+                "max_len": max_len, **s,
             })
             print(json.dumps({"bench": "serve", "backend":
                               jax.default_backend(),
@@ -626,6 +704,21 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                     help="per-replica prefix-sharing KV cache: "
                          "cache-hit requests prefill only their suffix "
                          "(restarted incarnations come back cold)")
+    ap.add_argument("--templates", type=int, default=0,
+                    help="prefix template working-set size (ISSUE 17): "
+                         "N templates from a separate seeded spawn "
+                         "(default workload bitwise-unchanged; needs "
+                         "--prefix-mix > 0)")
+    ap.add_argument("--spill", action="store_true",
+                    help="per-replica host-tier KV spill (ISSUE 17): "
+                         "LRU-reclaimed prefix pages spill to a bounded "
+                         "host tier and readmit on the next hit "
+                         "(CRC-sealed; sim compute is accounting-only). "
+                         "A restarted incarnation drops its tier with "
+                         "its pool. Needs --prefix-cache")
+    ap.add_argument("--host-pages", type=int, default=0,
+                    help="host-tier capacity per replica in pages "
+                         "(--spill; 0 = match the device pool)")
     ap.add_argument("--spec", default="off",
                     choices=["off", "lookup"],
                     help="per-replica batched speculative decoding "
@@ -722,8 +815,23 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
               "performs no KV handoffs)", file=sys.stderr)
         return 2
 
+    if args.spill and not args.prefix_cache:
+        print("error: --spill needs --prefix-cache (the host tier "
+              "spills prefix-cache pages; there is nothing to spill)",
+              file=sys.stderr)
+        return 2
+    if args.host_pages and not args.spill:
+        print("error: --host-pages needs --spill (without the tier the "
+              "capacity knob would be silently ignored)",
+              file=sys.stderr)
+        return 2
+    if args.templates and not args.prefix_mix > 0:
+        print("error: --templates needs --prefix-mix > 0 (no request "
+              "draws a template prefix at mix 0)", file=sys.stderr)
+        return 2
     max_len = args.prompt_max + args.out_max
     pages = args.pages or args.slots * pages_for(max_len, args.page_size) + 1
+    host_pages = (args.host_pages or pages) if args.spill else 0
     if args.compute == "engine":
         import jax
 
@@ -768,7 +876,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             out_max=args.out_max, rate=args.rate, seed=args.seed,
             sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
             tenants=args.tenants, prefix_mix=args.prefix_mix,
-            len_dist=args.len_dist,
+            len_dist=args.len_dist, templates=args.templates,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -847,6 +955,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 registry=registry, fleet_sink=fleet_sink,
                 replica_tick_sink=replica_tick_sink,
                 prefix=args.prefix_cache, sched_policy=sched_policy,
+                host_pages=host_pages,
                 spec=args.spec, spec_k=args.spec_k,
                 spec_ngram=args.spec_ngram,
                 pools=pools, handoff_ticks=args.handoff_ticks,
@@ -917,7 +1026,10 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             "pages": pages, "compute": args.compute,
             # Flight-recorder geometry flag (ISSUE 15): `mctpu replay`
             # rebuilds each replica's mirror with sharing on/off from it.
-            "prefix_cache": bool(args.prefix_cache), **s,
+            "prefix_cache": bool(args.prefix_cache),
+            # Host-tier geometry (ISSUE 17): the replay mirror extends
+            # each replica's digest with the tier tuple iff > 0.
+            "host_pages": host_pages, **s,
         })
         print(json.dumps({"bench": "fleet", "compute": args.compute,
                           "policy": args.policy, **s}))

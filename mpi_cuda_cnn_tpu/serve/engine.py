@@ -58,11 +58,13 @@ import numpy as np
 
 from ..models.transformer import TransformerLM
 from ..utils.donation import donate_jit
+from .host_tier import TIER_SPILL_SITE, HostTier
 from .paged_cache import (
     PagedKVCache,
     PagePool,
     init_paged_cache,
     paged_forward,
+    pages_for,
 )
 from .prefix_cache import PrefixCache, empty_prefix_fields
 from .spec import (
@@ -319,13 +321,227 @@ class DraftProposer:
                 win = buf[-w:]
                 toks[i, : len(win)] = win
                 n_valid[i] = max(len(win), 1)
+            # The sanctioned sync: one host transfer per BATCHED draft
+            # step (every slot's pick in one array), not per sequence.
+            # mctpu: disable=MCT007
             picks = np.asarray(self._step(
                 self.params, jnp.asarray(toks), jnp.asarray(n_valid)))
             for i, buf in enumerate(bufs):
                 if step_i < n_props[i]:
+                    # Host-side already (the batched fetch above);
+                    # int() here is list bookkeeping, not a new sync.
+                    # mctpu: disable=MCT007
                     t = int(picks[i])
                     outs[i].append(t)
                     buf.append(t)
+        return [np.asarray(o, np.int32) for o in outs]
+
+
+class PagedDraftProposer:
+    """The paged draft-model KV cache (ISSUE 17, the PR-14 remainder):
+    the draft becomes just another paged-cache client — its own small
+    PagePool + per-slot block tables growing and rolling back in
+    lockstep with the target's commit_spec — replacing the cacheless
+    sliding-window draft that recomputes ~W x the FLOPs per round.
+
+    Per round and slot the paged draft runs CATCH-UP (the tokens
+    committed since its last round — at steady state the previous
+    round's accepted count, not the whole window) plus n single-token
+    proposal steps, against its own persistent KV pages. At round end
+    each slot's draft rows are TRIMMED back to the committed context
+    (pages holding only proposal rows return to the draft pool) — the
+    rollback twin of the target scheduler's commit_spec page law, so a
+    rejected draft token's KV is never live on either cache. Proposal
+    rows inside the kept partial page are overwritten before they are
+    ever read (paged_update_attend writes first; the causal mask keeps
+    unwritten positions out of the softmax).
+
+    Page accounting laws (what `mctpu replay` mirrors, the state_crc
+    extension): after a slot's round the draft holds exactly
+    pages_for(committed_rows) pages, where committed_rows is the
+    slot's pre-commit `cached` (= len(prompt)+len(out)-1 at propose
+    time); a slot's state persists LAZILY across release (reset on the
+    next rid mismatch or context shrink), and the pool is sized to
+    slots x pages_for(max_len) so the deterministic schedule never
+    depends on a draft-pool dry path. T=0 exactness never depends on
+    the draft (the acceptance scaffold is the same for any proposer) —
+    only FLOPs per round do.
+    """
+
+    # run_round feeds slot identities (and every slot's real context)
+    # to proposers that carry per-slot cache state.
+    needs_slots = True
+
+    def __init__(self, model: TransformerLM, params, *, slots: int,
+                 page_size: int, max_len: int, cache_dtype=jnp.float32,
+                 chunk: int = 32, attn_kernel: str = "gather"):
+        self.model = model
+        self.params = params
+        self.slots = slots
+        self.page_size = page_size
+        self.max_len = min(max_len, model.max_seq)
+        self.table_width = pages_for(self.max_len, page_size)
+        self.chunk = chunk
+        self.attn_kernel = attn_kernel
+        # +1 for the reserved scratch page: full per-slot coverage, so
+        # draft paging changes FLOPs, never the serving schedule.
+        self.pool = PagePool(slots * self.table_width + 1)
+        tmpl = init_paged_cache(model, slots=slots,
+                                num_pages=slots * self.table_width + 1,
+                                page_size=page_size, dtype=cache_dtype,
+                                max_len=self.max_len, kernel=attn_kernel)
+        self._pages = tmpl.pages
+        # Per-slot draft state, indexed by ENGINE slot idx: the rid the
+        # cache rows belong to, committed rows held, physical pages.
+        self._rid: list = [None] * slots
+        self._cached = [0] * slots
+        self._spages: list[list[int]] = [[] for _ in range(slots)]
+
+        ck = self.chunk
+
+        def catchup(cache: PagedKVCache, params, toks, pos0, n_valid):
+            positions = pos0[:, None] + jnp.arange(ck)[None, :]
+            valid = jnp.arange(ck)[None, :] < n_valid[:, None]
+            _, cache = paged_forward(model, params, toks, positions,
+                                     valid, cache)
+            return cache
+
+        def step(cache: PagedKVCache, params, toks, pos, live):
+            logits, cache = paged_forward(
+                model, params, toks[:, None], pos[:, None], live[:, None],
+                cache,
+            )
+            return cache, jnp.argmax(
+                logits[:, 0, :], axis=-1).astype(jnp.int32)
+
+        self._catchup = donate_jit(catchup)
+        self._step = donate_jit(step)
+
+    @property
+    def tracked(self) -> int:
+        """Slots carrying draft-cache state (the digest's lazy-state
+        count — entries persist across slot release until reused)."""
+        return sum(1 for r in self._rid if r is not None)
+
+    def _owner(self, idx: int) -> tuple:
+        return ("draft", idx)
+
+    def _reset(self, idx: int, rid) -> None:
+        if self._spages[idx]:
+            self.pool.free(self._spages[idx], self._owner(idx))
+        self._rid[idx] = rid
+        self._cached[idx] = 0
+        self._spages[idx] = []
+
+    def _ensure_pages(self, idx: int, rows: int) -> None:
+        need = pages_for(rows, self.page_size) - len(self._spages[idx])
+        if need > 0:
+            got = self.pool.try_alloc(need, self._owner(idx))
+            assert got is not None, "draft pool sized to full coverage"
+            self._spages[idx].extend(got)
+
+    def _trim(self, idx: int, rows: int) -> None:
+        keep = pages_for(rows, self.page_size)
+        extra = self._spages[idx][keep:]
+        if extra:
+            self.pool.free(extra, self._owner(idx))
+            del self._spages[idx][keep:]
+
+    def _cache_view(self, table: np.ndarray) -> PagedKVCache:
+        return PagedKVCache(pages=self._pages,
+                            block_table=jnp.asarray(table),
+                            page_size=self.page_size,
+                            kernel=self.attn_kernel)
+
+    def end_run(self) -> None:
+        """Release every slot's draft pages and prove the draft pool
+        clean — the engine's end-of-run twin of the main pool check."""
+        for idx in range(self.slots):
+            if self._spages[idx]:
+                self.pool.free(self._spages[idx], self._owner(idx))
+            self._rid[idx] = None
+            self._cached[idx] = 0
+            self._spages[idx] = []
+        self.pool.check()
+        assert self.pool.free_pages == self.pool.usable, \
+            "draft pages leaked"
+
+    def propose_batch(self, ctxs, n_props, dslots):
+        """One paged draft round over this tick's decoding slots:
+        reset stale state (rid change / context shrink — the preempt
+        rollback), grow each slot's block table to cover catch-up +
+        proposal rows, run batched catch-up chunks then n single-token
+        steps, and trim every slot back to its committed rows."""
+        outs = [np.empty(0, np.int32) for _ in ctxs]
+        work = []       # (idx, ctx, n, committed_rows)
+        for s, ctx, n in zip(dslots, ctxs, n_props):
+            idx = s.idx
+            rows = len(ctx) - 1     # committed KV rows the draft holds
+            if self._rid[idx] != s.req.rid or self._cached[idx] > rows:
+                self._reset(idx, s.req.rid)
+            self._ensure_pages(idx, rows + max(n, 0))
+            work.append((idx, ctx, n, rows))
+        # Batched catch-up: every behind slot advances `chunk` rows per
+        # jitted call until all hold their committed rows.
+        table = np.zeros((self.slots, self.table_width), np.int32)
+        for idx, _, _, _ in work:
+            table[idx, : len(self._spages[idx])] = self._spages[idx]
+        while True:
+            toks = np.zeros((self.slots, self.chunk), np.int32)
+            pos0 = np.zeros((self.slots,), np.int32)
+            n_valid = np.zeros((self.slots,), np.int32)
+            behind = False
+            for idx, ctx, _, rows in work:
+                got = self._cached[idx]
+                if got >= rows:
+                    continue
+                n = min(self.chunk, rows - got)
+                toks[idx, :n] = ctx[got : got + n]
+                pos0[idx] = got
+                n_valid[idx] = n
+                self._cached[idx] = got + n
+                behind = True
+            if not behind:
+                break
+            cache = self._catchup(
+                self._cache_view(table), self.params, jnp.asarray(toks),
+                jnp.asarray(pos0), jnp.asarray(n_valid),
+            )
+            self._pages = cache.pages
+        # n proposal steps, batched across slots: step t feeds the
+        # previous pick (step 1: the slot's last committed token) at
+        # position rows + t - 1, writing that row and reading the
+        # causal prefix below it.
+        n_max = max(n_props, default=0)
+        if n_max > 0:
+            cur = np.zeros((self.slots,), np.int32)
+            pos = np.zeros((self.slots,), np.int32)
+            for idx, ctx, n, rows in work:
+                cur[idx] = ctx[-1]
+                pos[idx] = rows
+            for t in range(n_max):
+                live = np.zeros((self.slots,), bool)
+                for i, (idx, ctx, n, rows) in enumerate(work):
+                    live[idx] = t < n
+                cache, picks = self._step(
+                    self._cache_view(table), self.params,
+                    jnp.asarray(cur), jnp.asarray(pos), jnp.asarray(live),
+                )
+                self._pages = cache.pages
+                # The sanctioned sync: one host transfer per BATCHED
+                # draft step (every slot's pick in one array).
+                # mctpu: disable=MCT007
+                picks = np.asarray(picks)
+                for i, (idx, ctx, n, rows) in enumerate(work):
+                    if t < n:
+                        outs[i] = np.append(outs[i], picks[idx])
+                        cur[idx] = picks[idx]
+                        pos[idx] += 1
+        # Roll back to committed rows: pages holding only proposal
+        # rows return to the draft pool (commit_spec's rollback twin).
+        for idx, ctx, n, rows in work:
+            self._trim(idx, rows)
+            self._cached[idx] = rows
         return [np.asarray(o, np.int32) for o in outs]
 
 
@@ -356,12 +572,15 @@ class PagedEngine:
                  weights_dtype: str = "float32", spec: str = "off",
                  spec_k: int = 8, spec_ngram: int = 2,
                  draft_model: TransformerLM | None = None,
-                 draft_params=None):
+                 draft_params=None, draft_cache: str = "window"):
         from ..models.generate import pick_cache_dtype, pick_weights_dtype
         from ..ops.pallas_gemv import quantize_decode_params
 
         if spec not in SPEC_MODES:
             raise ValueError(f"spec {spec!r}: want one of {SPEC_MODES}")
+        if draft_cache not in ("window", "paged"):
+            raise ValueError(
+                f"draft_cache {draft_cache!r}: want 'window' or 'paged'")
         if spec != "off" and spec_k < 2:
             raise ValueError(
                 f"spec_k must be >= 2 (k={spec_k} would propose nothing)")
@@ -376,6 +595,7 @@ class PagedEngine:
         self.spec_mode = spec
         self.spec_k = spec_k
         self.spec_ngram = spec_ngram
+        self.draft_cache = draft_cache
         self.model = model
         self.slots = slots
         self.page_size = page_size
@@ -436,6 +656,17 @@ class PagedEngine:
                 for c, s in zip(pages, src_pool)
             ]
 
+        def restore(pages, host_rows, dst):
+            # Host-tier readmission (ISSUE 17): scatter one spilled
+            # page's host-resident rows back into every layer's pools
+            # at the freshly allocated device page — adopt()'s
+            # host->device twin, one page per call (readmissions are
+            # per-walk-chunk events, not bulk transfers).
+            return [
+                {name: c[name].at[dst].set(h[name]) for name in c}
+                for c, h in zip(pages, host_rows)
+            ]
+
         # Donate the cache: the page pools update in place tick-to-tick
         # (the engine always adopts the returned cache) instead of
         # allocating a second pool-sized buffer per dispatch. donate_jit
@@ -444,6 +675,7 @@ class PagedEngine:
         self._prefill = donate_jit(prefill)
         self._copy = donate_jit(copy)
         self._adopt = donate_jit(adopt)
+        self._restore = donate_jit(restore)
         # Speculative verify (ISSUE 14): ONE batched block forward per
         # round — every slot's k candidate rows at per-slot positions
         # through the same paged_forward the plain tick compiles, with
@@ -464,10 +696,17 @@ class PagedEngine:
 
             self._spec = donate_jit(spec_tick)
             if spec == "draft":
-                self._draft_proposer = DraftProposer(
-                    draft_model, quantize_decode_params(
-                        draft_params, self.weights_dtype),
-                    batch=slots)
+                dparams = quantize_decode_params(draft_params,
+                                                 self.weights_dtype)
+                if draft_cache == "paged":
+                    self._draft_proposer = PagedDraftProposer(
+                        draft_model, dparams, slots=slots,
+                        page_size=page_size, max_len=self.max_len,
+                        cache_dtype=self.cache_dtype,
+                        chunk=prefill_chunk, attn_kernel=attn_kernel)
+                else:
+                    self._draft_proposer = DraftProposer(
+                        draft_model, dparams, batch=slots)
 
     # -- host-side helpers ------------------------------------------------
 
@@ -495,6 +734,29 @@ class PagedEngine:
         source's reference via scheduler.cow_complete afterwards."""
         self._pages = self._copy(self._pages, jnp.int32(src),
                                  jnp.int32(dst))
+
+    def spill_page(self, page: int):
+        """Fetch one device page's KV rows (every layer's keys/values,
+        plus int8 scales) to host memory — HostTier.spill_fn. The
+        device->host transfer happens HERE, before the pool frees the
+        page; the page's content is then owned by the tier entry until
+        readmission or host eviction."""
+        # Device->host fetch of the evicted page — the spill's one
+        # sanctioned sync (an np.asarray per layer pool).
+        # mctpu: disable=MCT007
+        return [{name: np.asarray(c[name][page]) for name in c}
+                for c in self._pages]
+
+    def readmit_page(self, page: int, payload) -> None:
+        """Restore a spilled page's host-resident KV rows into device
+        page `page` — HostTier.readmit_fn, called only AFTER the CRC
+        verify accepted the entry (a refused spill is never restored,
+        so garbage rows cannot enter the pools)."""
+        self._pages = self._restore(
+            self._pages,
+            [{name: jnp.asarray(h[name]) for name in h} for h in payload],
+            jnp.int32(page),
+        )
 
     def adopt_pages(self, src_engine: "PagedEngine", src_pages,
                     dst_pages) -> None:
@@ -614,7 +876,7 @@ class PagedEngine:
             watchdog_s: float = 0.0, sleep_fn=time.sleep,
             registry=None, tick_sink=None, prefix: bool = False,
             policy: SLOPolicy | None = None,
-            spec: bool = False) -> ServeResult:
+            spec: bool = False, host_pages: int = 0) -> ServeResult:
         """Serve `requests` to a terminal status each; return ServeResult.
 
         Requests are mutated in place (out/timestamps/status); arrivals
@@ -654,6 +916,15 @@ class PagedEngine:
         emitted streams are the target's own greedy continuations —
         bitwise-equal to a spec-off run per request, while the tick
         count drops with the acceptance rate.
+
+        Host-tier spill (ISSUE 17): `host_pages > 0` (requires
+        prefix=True) puts a bounded HostTier under the prefix cache —
+        LRU-reclaimed refcount-0 prefix pages spill device->host
+        instead of being discarded, and a later prefix hit readmits
+        them host->device (serve/host_tier.py). CRC-sealed at the tier
+        crossing: a torn/corrupt spill is refused and degrades to
+        re-prefill. Outputs stay bitwise-identical to a spill-off run
+        in f32; only the prefill-chunk count (and TTFT) change.
         """
         if spec and self.spec_mode == "off":
             raise ValueError(
@@ -667,12 +938,38 @@ class PagedEngine:
                 "batching only (static is the one-token-per-tick "
                 "reservation baseline)"
             )
+        if host_pages > 0 and not prefix:
+            raise ValueError(
+                "host_pages > 0 without prefix=True — the host tier "
+                "spills prefix-cache pages; there is nothing to spill"
+            )
+        if host_pages == 0 and faults is not None:
+            # Inert-fault contract, tier leg (mirrors Fleet.__init__):
+            # without a host tier no spill ever happens, so a tier.spill
+            # fault would silently never fire.
+            inert = [f"{f.kind}@{f.site}"
+                     for f in faults.pending(TIER_SPILL_SITE)]
+            if inert:
+                raise ValueError(
+                    f"fault(s) {', '.join(sorted(set(inert)))} need a "
+                    "host tier (--spill / host_pages > 0) — without one "
+                    "they would silently never fire"
+                )
         pool = PagePool(self.num_pages)
-        pcache = PrefixCache(pool, self.page_size) if prefix else None
+        tier = None
+        if host_pages > 0:
+            tier = HostTier(
+                host_pages, spill_fn=self.spill_page,
+                readmit_fn=self.readmit_page,
+                fault_poll=((lambda seq: faults.poll(TIER_SPILL_SITE, seq))
+                            if faults is not None else None),
+            )
+        pcache = PrefixCache(pool, self.page_size, tier) if prefix else None
         proposer = None
         if spec:
             proposer = (self._draft_proposer if self.spec_mode == "draft"
                         else LookupProposer(self.spec_ngram))
+        draft_paged = isinstance(proposer, PagedDraftProposer)
         spec_rounds = spec_proposed = spec_accepted = 0
         sched_kw = dict(slots=self.slots, pool=pool,
                         page_size=self.page_size, max_len=self.max_len,
@@ -695,6 +992,11 @@ class PagedEngine:
         n_reqs = sched.unfinished
         decode_ticks = prefill_chunks = 0
         state_chain = 0
+        # Digest framing: spec-off (0, 0), window-draft/lookup spec
+        # (1, k) — both the ISSUE-14/15 spellings, bit-for-bit. A PAGED
+        # draft (ISSUE 17) extends the tuple with its pool state per
+        # tick below; the longer frame can never alias the shorter one
+        # (state_digest length-frames the extra block).
         spec_extra = (1, self.spec_k) if spec else (0, 0)
         events: list[dict] = []
         failed_logged: set[int] = set()  # rids with a request_failed event
@@ -893,6 +1195,13 @@ class PagedEngine:
             # summary's state_crc — computed on EVERY run (bare runs
             # included: the chain is what the determinism gates pin on
             # summary-only storms). O(slots) per tick.
+            if draft_paged:
+                # Paged-draft pool state rides the digest (ISSUE 17):
+                # free draft pages + slots carrying lazy draft state —
+                # `mctpu replay` re-derives both from the spec round
+                # records (the pages_for page law).
+                spec_extra = (1, self.spec_k, 1,
+                              proposer.pool.free_pages, proposer.tracked)
             state_crc = scheduler_digest(sched, extra=spec_extra)
             state_chain = zlib.crc32(state_crc.to_bytes(4, "little"),
                                      state_chain)
@@ -966,6 +1275,16 @@ class PagedEngine:
                     "retained_pages": pcache.retained_pages(),
                     **pcache.stats,
                 }
+                if tier is not None:
+                    # Host-tier panel fields (ISSUE 17): cumulative
+                    # spill/readmit/refusal/host-eviction counters +
+                    # occupancy in the prefix block (the `mctpu top`
+                    # cache panel / replay mirror source), plus this
+                    # tick's readmit lifecycle markers ([rid, tokens] —
+                    # the `mctpu trace` event).
+                    tick_rec["prefix"].update(tier.stats)
+                    tick_rec["prefix"]["host_used"] = tier.host_used
+                    tick_rec["prefix_readmits"] = prefix_tick["readmits"]
             if tick_sink is not None:
                 tick_sink(tick_rec)
             if registry is not None:
@@ -1005,6 +1324,13 @@ class PagedEngine:
                                  pcache.shared_pages)
                     registry.set("serve.prefix.retained_pages",
                                  pcache.retained_pages())
+                    if tier is not None:
+                        # Cumulative counters are SET, not inc'd: the
+                        # tier already accumulates; gauges mirror it.
+                        for key, val in tier.stats.items():
+                            registry.set(f"serve.tier.{key}", val)
+                        registry.set("serve.tier.host_used",
+                                     tier.host_used)
                 for r in new_fin + new_drop:
                     _observe_request(registry, r)
             sched.check()
@@ -1022,7 +1348,13 @@ class PagedEngine:
             prefix_fields = pcache.summary_fields()
             pcache.clear()
             # clear() evicts; freeze the counters at pre-flush values
-            # (end-of-run teardown is not cache pressure).
+            # (end-of-run teardown is not cache pressure — and it never
+            # SPILLS: a run-end spill burst would land after the last
+            # tick's digest, leaving tier counters no record covers).
+        if draft_paged:
+            # Release the draft pool and prove it clean — the draft's
+            # twin of the main-pool leak check below.
+            proposer.end_run()
         sched.check()
         terminal = sched.finished + sched.dropped
         if len(terminal) != n_reqs:

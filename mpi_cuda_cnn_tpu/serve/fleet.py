@@ -72,6 +72,7 @@ from collections import deque
 
 from ..faults import FakeClock
 from ..obs.metrics import MetricsRegistry
+from .host_tier import TIER_SPILL_SITE, HostTier
 from .handoff import (
     Handoff,
     context_crc,
@@ -197,7 +198,8 @@ class ReplicaCore:
                  page_size: int, max_len: int, max_queue: int | None = None,
                  on_emit=None, check_every: int = 1, prefix: bool = False,
                  policy=None, spec: str = "off", spec_k: int = 8,
-                 spec_ngram: int = 2):
+                 spec_ngram: int = 2, host_pages: int = 0,
+                 tier_fault_poll=None):
         if spec not in ("off", "lookup"):
             # Fleet speculation is the draft-free form: a per-replica
             # draft model is an engine-construction concern (the bench
@@ -211,7 +213,30 @@ class ReplicaCore:
                          else None)
         self.spec_stats = empty_spec_fields()
         pool = PagePool(num_pages)
-        self.prefix = PrefixCache(pool, page_size) if prefix else None
+        if host_pages > 0 and not prefix:
+            raise ValueError(
+                "host_pages > 0 without prefix=True — the host tier "
+                "spills prefix-tree pages; there is nothing to spill "
+                "without the tree"
+            )
+        self.tier = None
+        if host_pages > 0:
+            # Per-incarnation tier (ISSUE 17): it dies with the replica
+            # like its PagePool — a cold restart comes back with the
+            # host tier EMPTY, same as the device tree. Under
+            # EngineCompute the tier carries real KV payloads via the
+            # replica engine's spill/readmit programs; the sim tier is
+            # accounting-only (same schedule, no device rows).
+            engine = getattr(compute, "engine", None)
+            self.tier = HostTier(
+                host_pages,
+                spill_fn=engine.spill_page if engine is not None else None,
+                readmit_fn=(engine.readmit_page if engine is not None
+                            else None),
+                fault_poll=tier_fault_poll,
+            )
+        self.prefix = (PrefixCache(pool, page_size, self.tier)
+                       if prefix else None)
         sched_kw = dict(slots=slots, pool=pool, page_size=page_size,
                         max_len=max_len, max_queue=max_queue,
                         prefix=self.prefix)
@@ -380,6 +405,15 @@ class ReplicaCore:
             # audit).
             rec["prefix"] = {"shared_pages": self.prefix.shared_pages,
                              **self.prefix.stats}
+            if self.tier is not None:
+                # Host-tier fields (ISSUE 17): cumulative tier counters
+                # + occupancy on the same dict, and the tick's
+                # readmission markers — engine.run's spelling, so the
+                # replay reconstruction and `mctpu trace` fold engine
+                # and fleet trails identically.
+                rec["prefix"].update(self.tier.stats)
+                rec["prefix"]["host_used"] = self.tier.host_used
+                rec["prefix_readmits"] = prefix_tick["readmits"]
         if spec_rec is not None:
             rec["spec"] = spec_rec
         return rec, new_fin, new_drop
@@ -397,6 +431,9 @@ class ReplicaCore:
         if self.prefix is not None:
             for k in self.prefix.stats:
                 self.prefix.stats[k] = 0
+        if self.tier is not None:
+            for k in self.tier.stats:
+                self.tier.stats[k] = 0
 
     def reset_spec_stats(self) -> None:
         """Spec-counter twin of reset_prefix_stats (retirement at
@@ -415,7 +452,8 @@ class Replica:
                  page_size: int, max_len: int, max_queue: int | None = None,
                  check_every: int = 1, on_emit=None, clock=None,
                  prefix: bool = False, policy=None, phase: str | None = None,
-                 spec: str = "off", spec_k: int = 8, spec_ngram: int = 2):
+                 spec: str = "off", spec_k: int = 8, spec_ngram: int = 2,
+                 host_pages: int = 0, tier_fault_poll=None):
         self.name = name
         # Pool membership of a disaggregated fleet (ISSUE 13):
         # "prefill" | "decode" | None (unified). A restarted
@@ -427,6 +465,7 @@ class Replica:
             max_len=max_len, max_queue=max_queue, check_every=check_every,
             on_emit=on_emit, prefix=prefix, policy=policy,
             spec=spec, spec_k=spec_k, spec_ngram=spec_ngram,
+            host_pages=host_pages, tier_fault_poll=tier_fault_poll,
         )
         self.alive = True
         self.zombie_until = -1   # fleet tick a partitioned zombie stops at
@@ -637,7 +676,8 @@ class Fleet:
                  replica_tick_sink=None, jitter=None, prefix: bool = False,
                  sched_policy=None, pools: dict[str, int] | str | None = None,
                  handoff_ticks: int = 1, log_handoffs: bool = True,
-                 spec: str = "off", spec_k: int = 8, spec_ngram: int = 2):
+                 spec: str = "off", spec_k: int = 8, spec_ngram: int = 2,
+                 host_pages: int = 0):
         if isinstance(pools, str):
             pools = parse_pools(pools)
         if pools is not None:
@@ -672,6 +712,18 @@ class Fleet:
                     "disaggregated fleet (--pools) — on a unified fleet "
                     "they would silently never fire"
                 )
+        if host_pages == 0 and faults is not None:
+            # Same inert-fault contract, tier leg: without a host tier
+            # no spill ever happens, so a tier.spill fault would
+            # silently never fire.
+            inert = [f"{f.kind}@{f.site}"
+                     for f in faults.pending(TIER_SPILL_SITE)]
+            if inert:
+                raise ValueError(
+                    f"fault(s) {', '.join(sorted(set(inert)))} need a "
+                    "host tier (--spill / host_pages > 0) — without one "
+                    "they would silently never fire"
+                )
         if redispatch == "discard" and faults is not None \
                 and faults.pending("fleet.resume"):
             # Same contract, resume leg: discard re-dispatches never
@@ -691,12 +743,16 @@ class Fleet:
         # geometry discipline as prefix: every replica (and every
         # restarted incarnation) speculates identically, so the
         # dispatch trace stays a pure function of (seed, plan, shape).
+        # host_pages (ISSUE 17): per-replica host spill tier, part of
+        # the common geometry like the page pool — every incarnation
+        # gets its own bounded tier, and a cold restart drops it (the
+        # tier dies with the replica, like its pools).
         self.geometry = dict(slots=slots, num_pages=num_pages,
                              page_size=page_size, max_len=max_len,
                              max_queue=max_queue, check_every=check_every,
                              prefix=prefix, policy=sched_policy,
                              spec=spec, spec_k=spec_k,
-                             spec_ngram=spec_ngram)
+                             spec_ngram=spec_ngram, host_pages=host_pages)
         self.redispatch = redispatch
         self.tick_s = tick_s
         self.faults = faults
@@ -779,9 +835,17 @@ class Fleet:
     # -- membership ----------------------------------------------------
 
     def _new_replica(self, name: str) -> Replica:
+        # The tier fault hook is fleet-shared (ISSUE 17): every
+        # replica's tier polls the ONE injector, each with its own
+        # spill sequence — a `kv_corrupt@tier.spill:N` fires on the
+        # first tier to reach spill N (deterministic: the fleet steps
+        # replicas in name order on one clock).
+        poll = None
+        if self.faults is not None and self.geometry["host_pages"] > 0:
+            poll = functools.partial(self.faults.poll, TIER_SPILL_SITE)
         rep = Replica(name, self.compute_factory(name),
                       clock=self.clock, phase=self._phase_of.get(name),
-                      **self.geometry)
+                      tier_fault_poll=poll, **self.geometry)
         rep.core.on_emit = self._make_emit(rep)
         rep.core.on_prefill_done = self._make_prefill_done(rep)
         return rep
@@ -1616,6 +1680,8 @@ class Fleet:
                         **({"prefix_hits": rec["prefix_hits"],
                             "prefix": rec["prefix"]}
                            if "prefix_hits" in rec else {}),
+                        **({"prefix_readmits": rec["prefix_readmits"]}
+                           if "prefix_readmits" in rec else {}),
                         **({"spec": rec["spec"]}
                            if "spec" in rec else {}),
                         "terminal": [terminal_fields(r) for r in synced],
@@ -1659,6 +1725,8 @@ class Fleet:
                         **({"prefix_hits": rec["prefix_hits"],
                             "prefix": rec["prefix"]}
                            if "prefix_hits" in rec else {}),
+                        **({"prefix_readmits": rec["prefix_readmits"]}
+                           if "prefix_readmits" in rec else {}),
                         **({"spec": rec["spec"]}
                            if "spec" in rec else {}),
                         "terminal": [terminal_fields(r) for r in synced],
@@ -1809,20 +1877,23 @@ def make_fleet_workload(*, n: int, vocab: int, prompt_min: int,
                         rate: float, seed: int, sessions: int = 0,
                         deadline_s: float = 0.0, tenants: int = 0,
                         prefix_mix: float = 0.0,
-                        len_dist: str = "uniform") -> list[Request]:
+                        len_dist: str = "uniform",
+                        templates: int = 0) -> list[Request]:
     """The serve-bench workload generator plus session keys: request i
     belongs to session i % sessions (0 = sessionless), so the
     session-affinity policy has stable keys to rendezvous-hash.
-    `tenants`/`prefix_mix`/`len_dist` pass through to make_workload's
-    seeded tenant mix, shared-template-prefix mix (ISSUE 9), and
-    heavy-tail length mix (ISSUE 16)."""
+    `tenants`/`prefix_mix`/`len_dist`/`templates` pass through to
+    make_workload's seeded tenant mix, shared-template-prefix mix
+    (ISSUE 9), heavy-tail length mix (ISSUE 16), and sized template
+    pool (ISSUE 17)."""
     from .bench import make_workload
 
     reqs = make_workload(n=n, vocab=vocab, prompt_min=prompt_min,
                          prompt_max=prompt_max, out_min=out_min,
                          out_max=out_max, rate=rate, seed=seed,
                          deadline_s=deadline_s, tenants=tenants,
-                         prefix_mix=prefix_mix, len_dist=len_dist)
+                         prefix_mix=prefix_mix, len_dist=len_dist,
+                         templates=templates)
     if sessions > 0:
         for r in reqs:
             r.session = r.rid % sessions

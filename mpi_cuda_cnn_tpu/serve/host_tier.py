@@ -1,0 +1,202 @@
+"""Host-memory spill tier for refcount-0 prefix pages (ISSUE 17,
+ROADMAP item 3).
+
+At production fleet scale the shared-template working set vastly
+exceeds one replica's HBM: the prefix tree's LRU reclaim (ISSUE 9)
+throws away exactly the pages that earn the banked -34% prefill win,
+and the next request paying a cold miss re-prefills the whole template.
+This module is the capacity lever between those two outcomes — a
+bounded HOST-memory tier the prefix cache spills reclaimed pages into
+instead of discarding them, and readmits from on the next hit:
+
+- SPILL: when LRU pressure evicts a refcount-0 leaf node, the page's
+  covered token chunk + an integrity stamp (and, under an engine, the
+  device page's KV rows) move to the host tier before the device page
+  is freed. The tier is keyed by the CUMULATIVE token prefix the page
+  covers — the same pure-function-of-token-ids property the handoff
+  protocol rests on (serve/handoff.py), so a later request matching
+  that prefix can find the entry with no tree state surviving.
+- READMIT: a prefix walk that misses in the device tree consults the
+  tier; a hit re-allocates a device page, restores the KV rows
+  (engine) or just the accounting (sim), re-inserts the tree node, and
+  the walk continues — the request's prefill drops to its suffix
+  exactly as if the page had never been evicted.
+- REFUSE: the tier crossing is guarded by the handoff protocol's
+  seal/CRC/adopt discipline. Each spill stamps the crc32 of the int32
+  token ids the page covers (`handoff.page_crcs`' law, one page's
+  slice); readmission recomputes the expected stamp from the REQUESTING
+  prompt and refuses on any mismatch — a torn or corrupt spill
+  (modeled by `kv_corrupt@tier.spill`) is dropped, counted, and
+  degrades to a plain miss: the request re-prefills, garbage is never
+  decoded.
+
+The tier is bounded (`host_pages`) with its own LRU: spilling into a
+full tier evicts the oldest host entry first (counted — at that point
+the bytes are genuinely gone). A sim tier (no spill/readmit callbacks)
+is accounting-only: entries carry stamps but no KV payload, which is
+what lets the fleet's 10^5 sim storms exercise the full spill/readmit/
+refusal schedule with devices absent.
+
+Everything here is host-side, jax-free (`mctpu lint` MCT001), and
+deterministic: spill order is the LRU reclaim order, readmission order
+is the request stream's, so two identical-seed runs produce
+bitwise-identical tier schedules — the property the CI gates pin, and
+the reason the tier's counters fold into the per-tick `state_crc`
+digest (scheduler.state_digest / obs.replay.SchedMirror mirror the
+same tuple).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+__all__ = ["HostTier", "TIER_SPILL_SITE", "chunk_crc", "empty_tier_fields"]
+
+# The polled fault site (faults.SITES): trigger value = the tier's own
+# spill sequence number, kind kv_corrupt flips the stamped CRC.
+TIER_SPILL_SITE = "tier.spill"
+
+# The stamp-corruption idiom shared with the handoff/resume kv_corrupt
+# sites: flip known bits so the verify arithmetic, not luck, refuses.
+_CORRUPT_MASK = 0x5A5A5A5A
+
+
+def chunk_crc(tokens: np.ndarray) -> int:
+    """One page's integrity stamp: crc32 over the int32 token ids whose
+    KV rows the page holds — `handoff.page_crcs`' per-page law applied
+    to a single full page (the only granularity the prefix tree
+    spills)."""
+    return zlib.crc32(np.asarray(tokens, np.int32).tobytes())
+
+
+def empty_tier_fields() -> dict:
+    """The zero-valued summary block a spill-off run stamps, so every
+    gated tier metric exists in every run (the fleet/spec/disagg-gate
+    contract, same as prefix_cache.empty_prefix_fields)."""
+    return {"tier_spills": 0, "tier_readmits": 0, "tier_refusals": 0,
+            "tier_host_evictions": 0}
+
+
+class _Entry:
+    """One spilled page: the prefix-path key it answers to, the chunk's
+    token ids (the readmitted node's content), the seal-time CRC, and
+    the opaque host KV payload (None under a sim tier)."""
+
+    __slots__ = ("key", "tokens", "crc", "payload", "seq")
+
+    def __init__(self, key: bytes, tokens: np.ndarray, crc: int,
+                 payload, seq: int):
+        self.key = key
+        self.tokens = tokens
+        self.crc = crc
+        self.payload = payload
+        self.seq = seq
+
+
+class HostTier:
+    """The bounded host tier, one per scheduler/pool pair (per replica
+    in the fleet — a cold restart rebuilds the replica and the tier
+    dies with the incarnation, like its PagePool).
+
+    `spill_fn(page) -> payload` fetches a device page's KV rows to host
+    memory at spill time; `readmit_fn(page, payload)` restores them
+    into a freshly allocated device page at readmission. Both None =
+    the sim tier (pure accounting). `fault_poll(seq) -> faults` is the
+    injection hook (wired to FaultInjector.poll("tier.spill", seq) by
+    the bench surfaces); kv_corrupt flips the stored stamp.
+    """
+
+    def __init__(self, host_pages: int, *, spill_fn=None, readmit_fn=None,
+                 fault_poll=None):
+        if host_pages < 1:
+            raise ValueError(f"host_pages must be >= 1 (got {host_pages})")
+        self.host_pages = host_pages
+        self.spill_fn = spill_fn
+        self.readmit_fn = readmit_fn
+        self.fault_poll = fault_poll
+        self._entries: dict[bytes, _Entry] = {}
+        self._seq = 0          # spill sequence number (the fault trigger)
+        self._clock = 0        # host-LRU clock
+        self.stats = {"spills": 0, "readmits": 0, "refusals": 0,
+                      "host_evictions": 0}
+
+    @property
+    def host_used(self) -> int:
+        return len(self._entries)
+
+    # -- spill ----------------------------------------------------------
+
+    def spill(self, path_key: bytes, tokens: np.ndarray, page: int) -> None:
+        """Accept one evicted page: seal (stamp + optional device
+        fetch), store under the cumulative prefix key, evicting the
+        host-LRU entry first when full. Called by PrefixCache._evict
+        BEFORE it frees the device page."""
+        crc = chunk_crc(tokens)
+        if self.fault_poll is not None:
+            for f in self.fault_poll(self._seq):
+                if f.kind != "kv_corrupt":
+                    raise ValueError(
+                        f"fault kind {f.kind!r} is inert at tier.spill"
+                    )
+                crc ^= _CORRUPT_MASK
+        self._seq += 1
+        payload = self.spill_fn(page) if self.spill_fn is not None else None
+        if path_key in self._entries:
+            # Re-spill of a readmission-then-re-eviction: replace in
+            # place (the newer seal wins; occupancy unchanged).
+            old = self._entries.pop(path_key)
+            del old
+        elif len(self._entries) >= self.host_pages:
+            victim = min(self._entries.values(), key=lambda e: e.seq)
+            del self._entries[victim.key]
+            self.stats["host_evictions"] += 1
+        self._clock += 1
+        self._entries[path_key] = _Entry(path_key, tokens.copy(), crc,
+                                         payload, self._clock)
+        self.stats["spills"] += 1
+
+    # -- readmission ----------------------------------------------------
+
+    def lookup(self, path_key: bytes, expected: np.ndarray):
+        """The prefix walk's tier consult: the entry under `path_key`,
+        CRC-verified against the REQUESTING prompt's chunk (the
+        authoritative expected token ids). A miss returns None; a stamp
+        mismatch (torn/corrupt spill) drops the entry, counts a
+        refusal, and returns None — the caller treats it as a plain
+        miss and the request re-prefills, never decodes the payload."""
+        entry = self._entries.get(path_key)
+        if entry is None:
+            return None
+        if entry.crc != chunk_crc(expected):
+            del self._entries[entry.key]
+            self.stats["refusals"] += 1
+            return None
+        return entry
+
+    def take(self, entry: _Entry, page: int) -> None:
+        """Complete a readmission: restore the payload into the freshly
+        allocated device `page` (engine) and drop the host entry — the
+        page lives in the device tree again."""
+        if self.readmit_fn is not None and entry.payload is not None:
+            self.readmit_fn(page, entry.payload)
+        del self._entries[entry.key]
+        self.stats["readmits"] += 1
+
+    # -- digest ---------------------------------------------------------
+
+    def digest_tuple(self) -> tuple:
+        """The tier's contribution to the per-tick state digest — ONE
+        spelling, consumed by scheduler.scheduler_digest and mirrored
+        by obs.replay.SchedMirror from the tick record's cumulative
+        counters."""
+        return (self.stats["spills"], self.stats["readmits"],
+                self.stats["refusals"], self.stats["host_evictions"],
+                self.host_used)
+
+    def summary_fields(self) -> dict:
+        return {"tier_spills": self.stats["spills"],
+                "tier_readmits": self.stats["readmits"],
+                "tier_refusals": self.stats["refusals"],
+                "tier_host_evictions": self.stats["host_evictions"]}

@@ -109,6 +109,21 @@ class PagePool:
         if readonly:
             self._ro.add(page)
 
+    def freeze(self, page: int, owner) -> None:
+        """Mark an owned page read-only WITHOUT an ownership transfer —
+        the host-tier readmission primitive (ISSUE 17): the prefix
+        cache allocates a fresh device page under its own owner and
+        freezes it before restoring spilled content, so the page enters
+        the shareable set under the same no-writable-page-shared
+        invariant adopt(readonly=True) provides at insert time."""
+        got = self._owner.get(page)
+        if got != owner:
+            raise RuntimeError(
+                f"page {page} is owned by {got}, not {owner} — "
+                "refusing to freeze it"
+            )
+        self._ro.add(page)
+
     def share(self, page: int, reader) -> None:
         """Grant `reader` one reference on a read-only page. Sharing a
         writable page is the corruption this layer exists to prevent —

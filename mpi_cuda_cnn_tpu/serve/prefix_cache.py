@@ -54,13 +54,17 @@ PREFIX_OWNER = "__prefix__"
 class PrefixNode:
     """One shared page of prompt KV: `tokens` are the page_size prompt
     tokens it covers, `page` the physical page index, `children` the
-    continuations keyed by their tokens-bytes."""
+    continuations keyed by their tokens-bytes. `path` is the CUMULATIVE
+    prefix bytes root..this-node inclusive — the host-tier spill key
+    (ISSUE 17): a spilled page must be findable by a later request with
+    no tree state surviving, and the cumulative token prefix is the one
+    name both sides can compute independently."""
 
     __slots__ = ("node_id", "tokens", "page", "children", "parent_map",
-                 "key", "last_used")
+                 "key", "last_used", "path")
 
     def __init__(self, node_id: int, tokens: np.ndarray, page: int,
-                 parent_map: dict, key: bytes):
+                 parent_map: dict, key: bytes, path: bytes = b""):
         self.node_id = node_id
         self.tokens = tokens
         self.page = page
@@ -68,6 +72,7 @@ class PrefixNode:
         self.parent_map = parent_map
         self.key = key
         self.last_used = 0
+        self.path = path
 
 
 @dataclasses.dataclass
@@ -99,9 +104,13 @@ class PrefixCache:
     and LRU reclaim. One instance per scheduler/pool pair — per
     replica in the fleet (each replica owns its pool)."""
 
-    def __init__(self, pool: PagePool, page_size: int):
+    def __init__(self, pool: PagePool, page_size: int, tier=None):
         self.pool = pool
         self.page_size = page_size
+        # Optional host-memory spill tier (serve/host_tier.py, ISSUE
+        # 17): None keeps the ISSUE-9 discard-on-reclaim behavior
+        # bit-for-bit (digests, schedules, summaries all unchanged).
+        self.tier = tier
         self.root_children: dict[bytes, PrefixNode] = {}
         self.nodes: dict[int, PrefixNode] = {}     # node_id -> node
         self._next_id = 0
@@ -111,6 +120,7 @@ class PrefixCache:
         # Per-tick telemetry, drained by the engine/replica step like
         # the scheduler's preempted_log.
         self._tick_hits: list[list[int]] = []
+        self._tick_readmits: list[list[int]] = []
         self._tick_deltas = {"cow": 0, "evictions": 0, "inserts": 0}
 
     # -- bookkeeping helpers --------------------------------------------
@@ -129,9 +139,14 @@ class PrefixCache:
                    if self.pool.refs(n.page) == 0)
 
     def drain_tick(self) -> dict:
-        """This tick's prefix moments: hits [[rid, matched_tokens]] and
-        cow/eviction/insert deltas since the last drain."""
+        """This tick's prefix moments: hits [[rid, matched_tokens]],
+        cow/eviction/insert deltas since the last drain, and — with a
+        host tier attached — the tick's readmission lifecycle markers
+        [[rid, prefix_tokens]] (the `mctpu trace` anchor)."""
         out = {"hits": self._tick_hits, **self._tick_deltas}
+        if self.tier is not None:
+            out["readmits"] = self._tick_readmits
+            self._tick_readmits = []
         self._tick_hits = []
         self._tick_deltas = {"cow": 0, "evictions": 0, "inserts": 0}
         return out
@@ -156,6 +171,9 @@ class PrefixCache:
             chunk = toks[i * ps:(i + 1) * ps]
             if chunk.size == ps:
                 node = children.get(chunk.tobytes())
+                if node is None and self.tier is not None \
+                        and (i + 1) * ps <= max_tokens:
+                    node = self._readmit(toks, i, chunk, children, rid)
                 if node is not None:
                     nodes.append(node)
                     children = node.children
@@ -193,6 +211,37 @@ class PrefixCache:
             self._touch(cow)
         return Acquisition(nodes=nodes, cow=cow, cow_valid=j,
                            matched=matched)
+
+    def _readmit(self, toks: np.ndarray, i: int, chunk: np.ndarray,
+                 children: dict, rid) -> PrefixNode | None:
+        """The tier consult on a device-tree chunk miss (ISSUE 17):
+        look the cumulative prefix up in the host tier, CRC-verify the
+        entry against the requesting prompt's own chunk, allocate a
+        fresh read-only device page, restore the KV rows (engine tier)
+        and re-insert the tree node — the walk resumes sharing as if
+        the page had never been evicted. Returns None on a host miss,
+        a CRC refusal (counted by the tier — the entry is dropped and
+        the request re-prefills, never decodes the payload), or a dry
+        device pool (readmission never preempts live work; the hit
+        degrades to a miss)."""
+        ps = self.page_size
+        key = toks[:(i + 1) * ps].tobytes()
+        entry = self.tier.lookup(key, chunk)
+        if entry is None:
+            return None
+        pages = self.pool.try_alloc(1, PREFIX_OWNER)
+        if pages is None:
+            return None
+        page = pages[0]
+        self.pool.freeze(page, PREFIX_OWNER)
+        self.tier.take(entry, page)
+        self._next_id += 1
+        node = PrefixNode(self._next_id, chunk.copy(), page,
+                          children, chunk.tobytes(), key)
+        children[node.key] = node
+        self.nodes[node.node_id] = node
+        self._tick_readmits.append([rid, (i + 1) * ps])
+        return node
 
     def note_admitted(self, acq: Acquisition, rid) -> None:
         """Count one ADMITTED acquisition (the scheduler calls this at
@@ -257,7 +306,8 @@ class PrefixCache:
                 self.pool.share(page, rid)
                 self._next_id += 1
                 node = PrefixNode(self._next_id, chunk.copy(), page,
-                                  children, key)
+                                  children, key,
+                                  toks[:(c + 1) * ps].tobytes())
                 children[key] = node
                 self.nodes[node.node_id] = node
                 slot.refs.append(page)
@@ -286,7 +336,15 @@ class PrefixCache:
             freed += 1
         return freed
 
-    def _evict(self, node: PrefixNode) -> None:
+    def _evict(self, node: PrefixNode, *, spill: bool = True) -> None:
+        if spill and self.tier is not None:
+            # Spill BEFORE the device page is freed (ISSUE 17): the
+            # tier seals the page (CRC stamp + device fetch under an
+            # engine) while the content is still addressable. The
+            # device-side accounting below is unchanged either way —
+            # eviction always returns the page to the pool, which is
+            # what keeps the replay mirror's free-page law one rule.
+            self.tier.spill(node.path, node.tokens, node.page)
         self.pool.free([node.page], PREFIX_OWNER)
         del node.parent_map[node.key]
         del self.nodes[node.node_id]
@@ -295,9 +353,21 @@ class PrefixCache:
 
     def clear(self) -> int:
         """Evict every reclaimable node (end-of-run: hand all retained
-        pages back so the pool's all-free exit invariant holds).
+        pages back so the pool's all-free exit invariant holds). The
+        teardown is NOT allocation pressure — nothing spills (a
+        run-end spill burst would land after the last tick's digest,
+        leaving summary counters no tick record covers).
         Returns pages freed; raises if any node is still referenced."""
-        freed = self.reclaim(len(self.nodes))
+        freed = 0
+        while self.nodes:
+            cands = [node for node in self.nodes.values()
+                     if not node.children
+                     and self.pool.refs(node.page) == 0]
+            if not cands:
+                break
+            victim = min(cands, key=lambda nd: (nd.last_used, nd.node_id))
+            self._evict(victim, spill=False)
+            freed += 1
         if self.nodes:
             raise RuntimeError(
                 f"{len(self.nodes)} prefix page(s) still referenced at "
@@ -305,9 +375,26 @@ class PrefixCache:
             )
         return freed
 
+    def digest_tuple(self) -> tuple:
+        """The prefix cache's contribution to the per-tick state digest
+        — ONE spelling shared by scheduler.scheduler_digest and (via
+        the tick record's cumulative counters) obs.replay.SchedMirror.
+        The base seven ints are the ISSUE-9 shape bit-for-bit; a host
+        tier appends its own five (ISSUE 17) so a tier-on digest covers
+        spill/readmit/refusal/occupancy state too."""
+        t = (len(self.nodes), self.stats["hits"], self.stats["misses"],
+             self.stats["hit_tokens"], self.stats["cow_copies"],
+             self.stats["inserts"], self.stats["evictions"])
+        if self.tier is not None:
+            t += self.tier.digest_tuple()
+        return t
+
     def summary_fields(self) -> dict:
         """Cumulative stats as the flat serve-summary keys the CI gate
-        names (prefix_hits etc.)."""
+        names (prefix_hits etc.), plus the always-stamped host-tier
+        counters (zeros with no tier — the gate contract)."""
+        from .host_tier import empty_tier_fields
+
         return {
             "prefix_hits": self.stats["hits"],
             "prefix_misses": self.stats["misses"],
@@ -315,11 +402,16 @@ class PrefixCache:
             "prefix_cow": self.stats["cow_copies"],
             "prefix_inserts": self.stats["inserts"],
             "prefix_evictions": self.stats["evictions"],
+            **(self.tier.summary_fields() if self.tier is not None
+               else empty_tier_fields()),
         }
 
 
 def empty_prefix_fields() -> dict:
     """The zero-valued summary block a sharing-off run stamps, so every
     gated metric exists in every run (the fleet-gate contract)."""
+    from .host_tier import empty_tier_fields
+
     return {"prefix_hits": 0, "prefix_misses": 0, "prefix_hit_tokens": 0,
-            "prefix_cow": 0, "prefix_inserts": 0, "prefix_evictions": 0}
+            "prefix_cow": 0, "prefix_inserts": 0, "prefix_evictions": 0,
+            **empty_tier_fields()}

@@ -118,9 +118,11 @@ def scheduler_digest(sched, extra=(0, 0)) -> int:
     prefix = None
     pc = sched.prefix
     if pc is not None:
-        st = pc.stats
-        prefix = (len(pc.nodes), st["hits"], st["misses"], st["hit_tokens"],
-                  st["cow_copies"], st["inserts"], st["evictions"])
+        # ONE spelling (PrefixCache.digest_tuple): the ISSUE-9
+        # seven-tuple, plus the host tier's five when one is attached
+        # (ISSUE 17) — length-framed by state_digest, so tier-on and
+        # tier-off digests can never alias.
+        prefix = pc.digest_tuple()
     return state_digest(len(q), q[0].rid if q else -1,
                         q[-1].rid if q else -1, sched.queue_sig, flat,
                         sched.pool.free_pages, prefix, extra)

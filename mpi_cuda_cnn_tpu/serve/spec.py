@@ -180,11 +180,22 @@ def run_round(dslots, widths, proposer, verify):
     commit; the caller emits, commits cached via
     scheduler.commit_spec (which rolls the rejected-draft pages back),
     and finishes done requests.
+
+    A proposer declaring `needs_slots = True` (the paged draft cache,
+    ISSUE 17) carries per-slot KV state: it receives the slot handles
+    alongside the contexts, and EVERY slot's real context even at
+    n == 0 (a zero-proposal slot still needs its catch-up rows so the
+    draft cache tracks the committed stream — stateless proposers keep
+    the empty-context fast path).
     """
     need = [w - 1 for w in widths]
-    ctxs = [context_tokens(s.req) if n > 0 else _EMPTY
-            for s, n in zip(dslots, need)]
-    props_list = proposer.propose_batch(ctxs, need)
+    if getattr(proposer, "needs_slots", False):
+        ctxs = [context_tokens(s.req) for s in dslots]
+        props_list = proposer.propose_batch(ctxs, need, dslots)
+    else:
+        ctxs = [context_tokens(s.req) if n > 0 else _EMPTY
+                for s, n in zip(dslots, need)]
+        props_list = proposer.propose_batch(ctxs, need)
     rounds = []
     for s, w, props in zip(dslots, widths, props_list):
         u = np.empty(w, np.int32)

@@ -130,9 +130,14 @@ def build_records():
                          # one-token baseline): the continuous half of
                          # the sample carries the ISSUE 9
                          # prefix_hits/prefix tick fields AND the
-                         # ISSUE 14 spec round markers.
+                         # ISSUE 14 spec round markers. ISSUE 17 adds a
+                         # small host tier so the same half carries the
+                         # spill/readmit tier fields and
+                         # prefix_readmits markers the trace/top/report
+                         # tier surfaces render.
                          prefix=(mode == "continuous"),
-                         spec=(mode == "continuous"))
+                         spec=(mode == "continuous"),
+                         host_pages=(6 if mode == "continuous" else 0))
         s = res.summary()
         emit(make_record("blame", clock.now, **blame.summary_fields(mode)),
              clock)
@@ -150,7 +155,9 @@ def build_records():
                          slots=geom["slots"], pages=geom["num_pages"],
                          page_size=geom["page_size"], spec=geom["spec"],
                          spec_k=geom["spec_k"],
-                         prefix_cache=(mode == "continuous"), **s), clock)
+                         prefix_cache=(mode == "continuous"),
+                         host_pages=(6 if mode == "continuous" else 0),
+                         **s), clock)
         print(f"{mode}: statuses={s['statuses']} "
               f"preemptions={s['preemptions']} ticks={s['decode_ticks']}")
     print(f"alerts: {len(alerts.alerts)} fired, crc={alerts.crc}")

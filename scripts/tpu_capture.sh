@@ -21,7 +21,9 @@
 #   8e. bench_speculative  (draft/lookup speculation incl. T=0.8 rows)
 #   8f. bench_serve        (paged-KV continuous vs static batching; PR-3)
 #   8g. bench_serve_spec   (batched speculative serving pair; ISSUE 14)
-#   8h. autosize_frontier  (goodput capacity sweep; ISSUE 16 — CPU-side)
+#   8h. bench_serve_hosttier (host-tier KV spill pair; ISSUE 17)
+#   8i. bench_serve_spec_pagedraft (paged vs window draft; ISSUE 17)
+#   8j. autosize_frontier  (goodput capacity sweep; ISSUE 16 — CPU-side)
 #   9. profile_lm          (step-time attribution; VERDICT #3)
 #   9b. profile_moe        (MoE component attribution + chunk sweep)
 #  10. make -C native test_tpu  (C driver on the chip)
@@ -161,6 +163,29 @@ step bench_serve_spec_off 900 python scripts/bench_serve.py \
     --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
     --kv-heads 2 --cache-dtype auto --attn-kernel pallas \
     --decode-weights-dtype auto
+# ISSUE 17 (host-tier KV spill): the spill-on/off pair on a real chip —
+# a device pool tight against the template working set, so LRU churn
+# discards prefix pages the tier would have kept. On CPU the readmit
+# memcpy competes with a tiny model's prefill; on chip a readmit is
+# one page of HBM writes vs a full chunk's prefill FLOPs, so the
+# banked chunk-count drop converts to TTFT. Banks tokens/s +
+# TTFT/TPOT for PERF.md's ISSUE 17 table next to the CPU counters.
+step bench_serve_hosttier 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --templates 4 --pages 16 --prefix-cache --spill --host-pages 16
+step bench_serve_hosttier_off 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --templates 4 --pages 16 --prefix-cache
+# ISSUE 17 (paged draft cache): the draft-model speculation pair on a
+# real chip — paged draft (persistent KV, catch-up + one row/step) vs
+# the cacheless window draft (~W-row recompute per step). Outputs
+# bitwise equal; the FLOPs-per-round gap is what the chip measures.
+step bench_serve_spec_pagedraft 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --spec draft --spec-k 8 --draft-cache paged
+step bench_serve_spec_windowdraft 900 python scripts/bench_serve.py \
+    --mode continuous --requests 32 --rate 200 --prefix-mix 0.9 \
+    --spec draft --spec-k 8 --draft-cache window
 step profile_lm 900 python scripts/profile_lm.py
 # PR-7 (fleet): the engine-backed fleet on a real chip — N PagedEngine
 # replicas (shared weights) behind the failure-aware router, one crash

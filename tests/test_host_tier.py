@@ -1,0 +1,352 @@
+"""Host-tier KV spill + paged draft cache (ISSUE 17).
+
+The acceptance surface of serve/host_tier.py and the paged draft-model
+cache: spill-on serving must be BITWISE output-identical to spill-off
+(the tier only moves KV pages between storage tiers — it never changes
+what is computed), through COW, preemption, LRU squeeze, fleet crash /
+re-dispatch, and the disaggregated prefill->decode handoff; a corrupt
+spill must be refused by the seal-CRC discipline and degrade to
+re-prefill (never decoded); the paged draft cache must be bitwise
+equal to the cacheless draft proposer and to spec-off; readmission
+must measurably CUT prefill chunks when the shared working set
+exceeds the device pool; and the whole schedule must be deterministic
+(state_crc/trace twice-bitwise) and replayable with zero drift."""
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
+from mpi_cuda_cnn_tpu.models.transformer import TransformerLM
+from mpi_cuda_cnn_tpu.serve.engine import PagedEngine
+from mpi_cuda_cnn_tpu.serve.fleet import (
+    Fleet,
+    SimCompute,
+    make_fleet_workload,
+)
+from mpi_cuda_cnn_tpu.serve.host_tier import HostTier, chunk_crc
+from mpi_cuda_cnn_tpu.serve.scheduler import Request
+
+MODEL = TransformerLM(vocab=13, dim=32, heads=4, depth=2, max_seq=64)
+DRAFT = TransformerLM(vocab=13, dim=16, heads=2, depth=1, max_seq=64)
+PARAMS = MODEL.init(jax.random.key(0))
+DPARAMS = DRAFT.init(jax.random.key(1))
+
+# Two 16-token templates (two full pages at page_size=8) revisited in
+# alternating waves: wave k's requests hit the template wave k-2 used,
+# whose pages the k-1 wave's pressure evicted — the readmission storm.
+TMPL_A = (np.arange(16, dtype=np.int32) * 3) % 13
+TMPL_B = (np.arange(16, dtype=np.int32) * 5 + 1) % 13
+
+
+def _wave_requests():
+    out, rid = [], 0
+    for wave, tmpl in enumerate([TMPL_A, TMPL_B, TMPL_A, TMPL_B]):
+        for _ in range(2):
+            p = np.concatenate([tmpl,
+                                np.full(4, (rid * 2 + 1) % 13, np.int32)])
+            out.append(Request(rid=rid, prompt=p, max_new_tokens=13,
+                               arrival=wave * 2.0))
+            rid += 1
+    return out
+
+
+def _outs(res):
+    return {r.rid: list(r.out) for r in res.requests}
+
+
+def _engine_run(host_pages, *, faults=None, num_pages=9):
+    """The seeded readmission storm on a real f32 engine whose device
+    pool (8 usable pages) is SMALLER than the shared working set (two
+    templates x 2 pages + suffixes): spill-off re-prefills every
+    revisited template, spill-on readmits it from the host tier."""
+    clk = FakeClock()
+    e = PagedEngine(MODEL, PARAMS, slots=2, num_pages=num_pages,
+                    page_size=8, prefill_chunk=8)
+    return e.run(_wave_requests(), prefix=True, host_pages=host_pages,
+                 faults=faults, time_fn=clk, sleep_fn=clk.advance)
+
+
+def test_spill_parity_bitwise_and_prefill_chunk_reduction():
+    """The tentpole acceptance: spill-on outputs are BITWISE equal to
+    spill-off in f32, the tier actually spilled and readmitted, and
+    readmission cut prefill chunks (the capacity win the tier exists
+    for — the working set exceeds the device pool, so spill-off pays a
+    full template re-prefill every wave)."""
+    off = _engine_run(0)
+    on = _engine_run(8)
+    assert _outs(off) == _outs(on)
+    assert on.prefix["tier_spills"] > 0
+    assert on.prefix["tier_readmits"] > 0
+    assert on.prefix["tier_refusals"] == 0
+    assert on.prefill_chunks < off.prefill_chunks
+    # Spill-off stamps the tier block as zeros (the gate contract).
+    assert off.prefix["tier_spills"] == 0
+    assert off.prefix["tier_readmits"] == 0
+
+
+def test_spill_schedule_deterministic_twice_bitwise():
+    """Identical seeds -> identical spill/readmit schedule: state_crc
+    (the per-tick digest chain folds the tier tuple) and the whole
+    prefix/tier counter block repeat bitwise."""
+    a = _engine_run(8)
+    b = _engine_run(8)
+    assert a.state_crc == b.state_crc
+    assert a.prefix == b.prefix
+    assert _outs(a) == _outs(b)
+
+
+def test_spill_parity_through_cow_and_preemption():
+    """Parity holds when the storm also preempts mid-decode and COWs a
+    shared page at a divergent suffix: preempted requests requeue,
+    re-acquire through the tree (possibly via readmission), and still
+    produce spill-off's exact tokens."""
+    def run(host_pages):
+        rng = np.random.default_rng(3)
+        reqs, rid = [], 0
+        for wave, tmpl in enumerate([TMPL_A, TMPL_B, TMPL_A]):
+            for _ in range(3):
+                # Divergence INSIDE the template's second page -> COW.
+                p = tmpl.copy()
+                p[12] = (p[12] + 1 + rid) % 13
+                reqs.append(Request(
+                    rid=rid,
+                    prompt=np.concatenate(
+                        [p, rng.integers(0, 13, (3,)).astype(np.int32)]),
+                    max_new_tokens=14, arrival=wave * 1.0))
+                rid += 1
+        clk = FakeClock()
+        e = PagedEngine(MODEL, PARAMS, slots=3, num_pages=9, page_size=8,
+                        prefill_chunk=8)
+        return e.run(reqs, prefix=True, host_pages=host_pages,
+                     time_fn=clk, sleep_fn=clk.advance)
+
+    off = run(0)
+    on = run(8)
+    assert _outs(off) == _outs(on)
+    assert on.preemptions > 0
+    assert on.prefix["prefix_cow"] > 0
+    assert on.prefix["tier_spills"] > 0
+
+
+def test_corrupt_spill_refused_and_degrades_to_reprefill():
+    """kv_corrupt@tier.spill flips the seal stamp of one spilled page:
+    the later matching lookup must REFUSE it (counted), fall back to a
+    plain miss (re-prefill), and leave every output bitwise equal to
+    the clean run — corrupted KV is never decoded."""
+    clean = _engine_run(8)
+    bad = _engine_run(8, faults=FaultInjector("kv_corrupt@tier.spill:0"))
+    assert bad.prefix["tier_refusals"] >= 1
+    assert _outs(bad) == _outs(clean)
+
+
+def test_inert_tier_fault_rejected_without_tier():
+    """A kv_corrupt@tier.spill plan on a run WITHOUT a host tier would
+    silently never fire — both the engine and the fleet must reject it
+    loudly instead."""
+    with pytest.raises(ValueError, match="tier.spill"):
+        _engine_run(0, faults=FaultInjector("kv_corrupt@tier.spill:0"))
+    with pytest.raises(ValueError, match="host tier"):
+        Fleet(lambda name: SimCompute(vocab=64, chunk=8), replicas=2,
+              slots=2, num_pages=11, page_size=4, max_len=64, prefix=True,
+              clock=FakeClock(),
+              faults=FaultInjector("kv_corrupt@tier.spill:0"))
+
+
+def test_host_pages_without_prefix_rejected():
+    """host_pages > 0 without the prefix tree has nothing to spill —
+    loud config error, in the engine and the fleet alike."""
+    clk = FakeClock()
+    e = PagedEngine(MODEL, PARAMS, slots=2, num_pages=9, page_size=8,
+                    prefill_chunk=8)
+    with pytest.raises(ValueError, match="prefix"):
+        e.run(_wave_requests(), prefix=False, host_pages=8,
+              time_fn=clk, sleep_fn=clk.advance)
+    with pytest.raises(ValueError, match="prefix"):
+        Fleet(lambda name: SimCompute(vocab=64, chunk=8), replicas=2,
+              slots=2, num_pages=11, page_size=4, max_len=64,
+              prefix=False, host_pages=8, clock=FakeClock())
+
+
+def test_host_tier_bounded_lru_and_crc_unit():
+    """Unit laws of the tier itself: capacity >= 1 enforced, a full
+    tier evicts its oldest entry (counted), a refused lookup drops the
+    entry, and the seal stamp is handoff.page_crcs' per-page law."""
+    with pytest.raises(ValueError):
+        HostTier(0)
+    tier = HostTier(2)
+    t = [np.arange(8, dtype=np.int32) + i for i in range(3)]
+    for i, toks in enumerate(t):
+        tier.spill(toks.tobytes(), toks, page=i + 1)
+    assert tier.host_used == 2                  # bounded
+    assert tier.stats["host_evictions"] == 1    # oldest evicted
+    assert tier.lookup(t[0].tobytes(), t[0]) is None   # genuinely gone
+    # CRC refusal: ask for entry 1's key with entry 2's tokens.
+    assert tier.lookup(t[1].tobytes(), t[2]) is None
+    assert tier.stats["refusals"] == 1
+    assert tier.host_used == 1                  # refused entry dropped
+    entry = tier.lookup(t[2].tobytes(), t[2])
+    assert entry is not None and entry.crc == chunk_crc(t[2])
+    tier.take(entry, page=5)
+    assert tier.host_used == 0 and tier.stats["readmits"] == 1
+
+
+# -- draft-model paged cache ------------------------------------------
+
+
+def test_draft_paged_parity_vs_cacheless_and_spec_off():
+    """T=0 greedy outputs must be bitwise identical across spec-off,
+    cacheless draft speculation, and the PAGED draft cache: the target
+    verifies every proposal, so the draft's storage layout can never
+    change what is committed."""
+    def wl():
+        rng = np.random.default_rng(7)
+        return [Request(
+            rid=i,
+            prompt=rng.integers(0, 13,
+                                (int(rng.integers(4, 10)),)).astype(np.int32),
+            max_new_tokens=int(rng.integers(4, 16))) for i in range(5)]
+
+    def eng(**kw):
+        return PagedEngine(MODEL, PARAMS, slots=2, num_pages=24,
+                           page_size=8, prefill_chunk=8, **kw)
+
+    def run(e, spec):
+        clk = FakeClock()
+        return e.run(wl(), spec=spec, time_fn=clk, sleep_fn=clk.advance)
+
+    base = run(eng(), False)
+    cacheless = run(eng(spec="draft", spec_k=4, draft_model=DRAFT,
+                        draft_params=DPARAMS), True)
+    paged = run(eng(spec="draft", spec_k=4, draft_model=DRAFT,
+                    draft_params=DPARAMS, draft_cache="paged"), True)
+    assert _outs(base) == _outs(cacheless) == _outs(paged)
+    assert paged.spec["spec_rounds"] > 0
+    # Same proposals -> same acceptance account, layout-independent.
+    assert paged.spec == cacheless.spec
+
+
+# -- fleet composition -------------------------------------------------
+
+
+def _fleet_run(host_pages, *, faults=None, pools=None, n=60):
+    reqs = make_fleet_workload(n=n, vocab=64, prompt_min=24, prompt_max=32,
+                               out_min=4, out_max=8, rate=200.0, seed=7,
+                               prefix_mix=0.9, templates=6)
+    fl = Fleet(lambda name: SimCompute(vocab=64, chunk=8),
+               replicas=2, slots=2, num_pages=11, page_size=4, max_len=64,
+               prefix=True, host_pages=host_pages, clock=FakeClock(),
+               faults=faults, pools=pools, handoff_ticks=1)
+    return fl.run(reqs)
+
+
+def test_fleet_spill_parity_and_tier_stamps():
+    """Fleet sim storm: spill-on outputs equal spill-off's bitwise, the
+    per-replica tiers spilled and readmitted, and the spill-off run
+    stamps the tier block as zeros (every gated metric exists in every
+    run)."""
+    off = _fleet_run(0)
+    on = _fleet_run(8)
+    assert off.outputs() == on.outputs()
+    s = on.summary()
+    assert s["tier_spills"] > 0
+    assert s["tier_readmits"] > 0
+    so = off.summary()
+    assert so["tier_spills"] == 0 and "tier_refusals" in so
+
+
+def test_fleet_crash_cold_restart_drops_tier_parity_holds():
+    """A replica crash mid-storm rebuilds the replica — pool, prefix
+    tree, AND host tier die with the incarnation (no stale spilled KV
+    survives into the new one) — and outputs still equal the spill-off
+    twin under the same fault plan."""
+    plan = "replica_crash@fleet.tick:6?replica=0"
+    on = _fleet_run(8, faults=FaultInjector(plan))
+    off = _fleet_run(0, faults=FaultInjector(plan))
+    assert on.outputs() == off.outputs()
+    assert on.summary()["restarts"] >= 1
+
+
+def test_disagg_handoff_spill_parity():
+    """The 2-pool disaggregated storm with per-replica host tiers: the
+    prefill->decode KV handoff composes with spill/readmission at
+    bitwise output parity, and the tiers saw traffic."""
+    off = _fleet_run(0, pools="prefill:1,decode:1", n=50)
+    on = _fleet_run(8, pools="prefill:1,decode:1", n=50)
+    assert off.outputs() == on.outputs()
+    s = on.summary()
+    assert s["handoffs"] > 0
+    assert s["tier_spills"] > 0
+    assert s["tier_readmits"] > 0
+
+
+def test_fleet_corrupt_spill_refusal_parity():
+    """kv_corrupt@tier.spill in the fleet: refused (or the corrupt
+    entry aged out of the bounded tier first), outputs bitwise equal
+    the spill-off run — garbage never decodes anywhere in the fleet."""
+    bad = _fleet_run(8, faults=FaultInjector("kv_corrupt@tier.spill:0"))
+    off = _fleet_run(0)
+    assert bad.outputs() == off.outputs()
+    s = bad.summary()
+    assert s["tier_refusals"] >= 1 or s["tier_host_evictions"] > 0
+
+
+def test_spill_determinism_storm_1e5_twice_bitwise():
+    """The 10^5-request seeded sim storm with spill on, run twice:
+    trace_crc, state_crc, and the whole tier counter block repeat
+    bitwise — the CI fleet-gate discipline at full scale."""
+    def run():
+        reqs = make_fleet_workload(n=100_000, vocab=64, prompt_min=8,
+                                   prompt_max=32, out_min=4, out_max=16,
+                                   rate=2000.0, seed=0, prefix_mix=0.5,
+                                   templates=8)
+        fl = Fleet(lambda name: SimCompute(vocab=64, chunk=8),
+                   replicas=4, slots=8, num_pages=33, page_size=4,
+                   max_len=64, prefix=True, host_pages=33,
+                   clock=FakeClock())
+        return fl.run(reqs)
+
+    a, b = run(), run()
+    sa, sb = a.summary(), b.summary()
+    assert sa["trace_crc"] == sb["trace_crc"]
+    assert sa["state_crc"] == sb["state_crc"]
+    for k in ("tier_spills", "tier_readmits", "tier_refusals",
+              "tier_host_evictions"):
+        assert sa[k] == sb[k]
+    assert sa["tier_spills"] > 0 and sa["tier_readmits"] > 0
+
+
+# -- replay ------------------------------------------------------------
+
+
+def test_replay_zero_drift_on_spill_and_draft_trails(tmp_path):
+    """`mctpu replay` reconstructs a spill-enabled full-log trail and a
+    paged-draft trail with zero per-tick digest drift: the SchedMirror
+    page/tier/draft-pool laws match the engine's actual accounting at
+    every tick."""
+    from mpi_cuda_cnn_tpu.obs.replay import replay_main
+    from mpi_cuda_cnn_tpu.serve.bench import serve_bench_main
+
+    spill = str(tmp_path / "spill.jsonl")
+    assert serve_bench_main(
+        ["--requests", "12", "--vocab", "13", "--dim", "32", "--heads",
+         "4", "--depth", "2", "--slots", "2", "--pages", "9",
+         "--page-size", "8", "--prefill-chunk", "8", "--prompt-min", "8",
+         "--prompt-max", "20", "--out-min", "4", "--out-max", "8",
+         "--rate", "100", "--seed", "5", "--mode", "continuous",
+         "--prefix-cache", "--prefix-mix", "0.8", "--templates", "3",
+         "--spill", "--host-pages", "8",
+         "--metrics-jsonl", spill]) == 0
+    assert replay_main([spill]) == 0
+
+    draft = str(tmp_path / "draft.jsonl")
+    assert serve_bench_main(
+        ["--requests", "8", "--vocab", "13", "--dim", "32", "--heads",
+         "4", "--depth", "2", "--slots", "2", "--pages", "24",
+         "--page-size", "8", "--prefill-chunk", "8", "--prompt-min", "4",
+         "--prompt-max", "10", "--out-min", "4", "--out-max", "12",
+         "--rate", "100", "--seed", "5", "--mode", "continuous",
+         "--spec", "draft", "--spec-k", "4", "--draft-dim", "16",
+         "--draft-depth", "1",
+         "--draft-cache", "paged", "--metrics-jsonl", draft]) == 0
+    assert replay_main([draft]) == 0

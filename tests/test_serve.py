@@ -206,9 +206,16 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     VARIABLE number of tokens (whatever greedy acceptance yields), and
     commit_spec's rejected-draft ROLLBACK hands surplus pages back —
     the walk must observe both a multi-token commit and a rollback.
+    ISSUE 17 bolts a bounded HostTier onto scheduler A's prefix cache:
+    LRU reclaims SPILL real engine KV pages to host entries, later
+    template walks READMIT them through fresh allocations, and a
+    corrupt-seal op arms the kv_corrupt injector so at least one
+    lookup REFUSES a flipped stamp and degrades to re-prefill — all
+    under the same every-step check().
     The fleet's re-dispatch and disaggregated-handoff paths
     (serve/fleet.py) drive these exact scheduler+pool+prefix triples
     per replica, so they inherit the guarantee."""
+    from mpi_cuda_cnn_tpu.serve.host_tier import HostTier
     from mpi_cuda_cnn_tpu.serve.prefix_cache import PrefixCache
     from mpi_cuda_cnn_tpu.serve.spec import LookupProposer, run_round
 
@@ -219,7 +226,23 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     # Host pool sized to the engine's device page arrays — the pairing
     # ReplicaCore uses: page indices from this pool index those arrays.
     pool = PagePool(10)
-    prefix = PrefixCache(pool, page_size=4)
+    # Host tier on A (ISSUE 17): real engine spill/readmit callbacks —
+    # evicted KV rows round-trip through host memory — plus an armable
+    # corrupt-seal injector (the kv_corrupt@tier.spill path).
+    corrupt_pending = [0]
+
+    class _Corrupt:
+        kind = "kv_corrupt"
+
+    def tier_poll(seq):
+        if corrupt_pending[0]:
+            corrupt_pending[0] -= 1
+            return [_Corrupt]
+        return []
+
+    tier = HostTier(4, spill_fn=engine.spill_page,
+                    readmit_fn=engine.readmit_page, fault_poll=tier_poll)
+    prefix = PrefixCache(pool, page_size=4, tier=tier)
     sched = ContinuousScheduler(slots=3, pool=pool, page_size=4, max_len=32,
                                 prefix=prefix)
     # The decode-side twin (ISSUE 13): its own engine/pool/scheduler —
@@ -329,7 +352,15 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     def reclaim_op():
         # The squeeze/pressure path: evict up to 2 LRU refcount-0
         # prefix pages (never a referenced one — free() would raise).
+        # With the tier attached each eviction SPILLS instead of
+        # discarding — the pressure op doubles as the spill op.
         prefix.reclaim(int(rng.integers(1, 3)))
+
+    def corrupt_op():
+        # Arm the injector: the NEXT spill seals a flipped stamp, so a
+        # later matching tier lookup must refuse it (counted) and fall
+        # back to a plain miss — the re-prefill degrade path.
+        corrupt_pending[0] += 1
 
     def handoff_op():
         # Cross-pool transfer (ISSUE 13): seal a decoding slot's page
@@ -382,9 +413,10 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
            lambda: sched_b.admit(now),
            lambda: prefill_step(sched_b, engine_b),
            spec_decode_op,
-           lambda: spec_decode_op(sched_b, engine_b)]
-    weights = np.array([0.18, 0.14, 0.16, 0.06, 0.06, 0.04, 0.04, 0.03,
-                        0.09, 0.04, 0.03, 0.03, 0.06, 0.04])
+           lambda: spec_decode_op(sched_b, engine_b),
+           corrupt_op]
+    weights = np.array([0.16, 0.14, 0.15, 0.06, 0.06, 0.04, 0.04, 0.04,
+                        0.09, 0.04, 0.03, 0.03, 0.06, 0.04, 0.02])
     for _ in range(300):
         now += float(rng.uniform(0.0, 0.02))  # deadlines really expire
         ops[int(rng.choice(len(ops), p=weights))]()
@@ -425,6 +457,12 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     assert spec_seen["rounds"] > 0
     assert spec_seen["multi"] > 0
     assert spec_seen["rollbacks"] > 0
+    # The host-tier surface (ISSUE 17): pages spilled under pressure,
+    # readmitted through fresh allocations on later template walks, and
+    # at least one corrupt seal refused by the CRC discipline.
+    assert tier.stats["spills"] > 0
+    assert tier.stats["readmits"] > 0
+    assert tier.stats["refusals"] > 0
 
 
 def test_engine_preemption_recovers_and_completes():
