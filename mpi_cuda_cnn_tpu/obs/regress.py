@@ -126,7 +126,15 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                # fleet/spec/disagg determinism gates pin them at exact
                # equality (zeros on a spill-off run).
                "tier_spills", "tier_readmits", "tier_refusals",
-               "tier_host_evictions")
+               "tier_host_evictions",
+               # Cache-aware routing + autoscaling (ISSUE 18): routed-
+               # dispatch counters, scale-event totals, the cumulative
+               # live-replica integral, and the scale-event CRC chain —
+               # the fleet/autoscale determinism gates pin them at
+               # exact equality (zeros/empty-CRC on a hash-routed or
+               # fixed-size fleet).
+               "route_hits", "route_misses", "route_hit_tokens",
+               "scale_ups", "scale_downs", "replica_ticks", "scale_crc")
 
 # Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
 # summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to

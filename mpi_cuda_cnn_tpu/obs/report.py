@@ -305,6 +305,10 @@ def summarize(records: Iterable[dict], *,
             "ticks_logged": len(fleets),
             "replicas_last": last.get("replicas"),
             "pending_last": last.get("pending"),
+            # Cache-aware routing (ISSUE 18): the newest fleet record's
+            # cumulative per-replica [routed hits, dispatches] split —
+            # the ROUTING table's rows (absent off cache_aware).
+            "route_last": last.get("route"),
         }
 
     handoffs = ev.get("handoff", [])
@@ -339,6 +343,9 @@ def summarize(records: Iterable[dict], *,
               "prefix_cow", "prefix_evictions",
               "host_pages", "tier_spills", "tier_readmits",
               "tier_refusals", "tier_host_evictions",
+              "policy", "autoscale", "route_hits", "route_misses",
+              "route_hit_tokens", "scale_ups", "scale_downs",
+              "replica_ticks",
               "spec_rounds", "spec_proposed", "spec_accepted")}
             for r in serves
         ]
@@ -616,6 +623,18 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
             for name, per in fl["by_replica"].items():
                 lines.append(f"| {name} | {_fmt(per)} |")
             lines.append("")
+        if fl.get("route_last"):
+            # Per-replica routing split (ISSUE 18): cumulative routed
+            # hits / dispatches from the newest fleet record — where
+            # the cache-aware wins actually landed.
+            lines += ["| replica routing | routed hits | dispatches "
+                      "| hit rate |", "|---|---|---|---|"]
+            for name, pair in sorted(fl["route_last"].items()):
+                hits, disp = (pair + [0, 0])[:2]
+                rate = f"{100.0 * hits / disp:.1f}%" if disp else "—"
+                lines.append(
+                    f"| {name} | {_fmt(hits)} | {_fmt(disp)} | {rate} |")
+            lines.append("")
     if "handoffs" in summary:
         # Disaggregated KV handoffs (ISSUE 13).
         ho = summary["handoffs"]
@@ -687,6 +706,45 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                     f"| {_fmt(s['tier_readmits'])} "
                     f"| {_fmt(s['tier_refusals'])} "
                     f"| {_fmt(s['tier_host_evictions'])} |"
+                )
+            lines.append("")
+        # Cache-aware routing table (ISSUE 18): only for runs the
+        # router actually scored (cache_aware dispatches counted) — a
+        # hash-affinity run must not grow a table of zeros.
+        rruns = [s for s in summary["serve"]
+                 if (s.get("route_hits") or 0) + (s.get("route_misses")
+                                                  or 0) > 0]
+        if rruns:
+            lines += [
+                "| routing | policy | routed hits | misses "
+                "| hit tokens | hit rate |",
+                "|---|---|---|---|---|---|",
+            ]
+            for s in rruns:
+                hits = s.get("route_hits") or 0
+                total = hits + (s.get("route_misses") or 0)
+                lines.append(
+                    f"| {s['mode']} | {s.get('policy', '—')} "
+                    f"| {_fmt(hits)} | {_fmt(s.get('route_misses'))} "
+                    f"| {_fmt(s.get('route_hit_tokens'))} "
+                    f"| {100.0 * hits / total:.1f}% |"
+                )
+            lines.append("")
+        # Autoscale table (ISSUE 18): runs that scaled (or ran the
+        # policy — an autoscaled run that never moved is information).
+        aruns = [s for s in summary["serve"] if s.get("autoscale")]
+        if aruns:
+            lines += [
+                "| autoscale | scale ups | scale downs | replica ticks "
+                "| final replicas |",
+                "|---|---|---|---|---|",
+            ]
+            for s in aruns:
+                lines.append(
+                    f"| {s['mode']} | {_fmt(s.get('scale_ups'))} "
+                    f"| {_fmt(s.get('scale_downs'))} "
+                    f"| {_fmt(s.get('replica_ticks'))} "
+                    f"| {_fmt((summary.get('fleet') or {}).get('replicas_last'))} |"
                 )
             lines.append("")
     if "metrics" in summary:

@@ -147,6 +147,14 @@ def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
                 lc = life("fleet", rid)
                 lc.events.append((tick, now, "redispatched",
                                   rec.get("redispatch", "resume")))
+            # Cache-aware routing marker (ISSUE 18): the router placed
+            # rid on `name` expecting `matched` hot prefix tokens —
+            # ordered before the replica's first emission for the rid
+            # (the fleet emits its record before stepping replicas),
+            # so the marker explains the prefix_hit that follows.
+            for rid, name, matched in rec.get("route_hits") or []:
+                life("fleet", rid).events.append(
+                    (tick, now, "routed", [name, matched]))
             # Disaggregated handoff markers (ISSUE 13): the fleet emits
             # its record before stepping replicas, so the phase
             # transition (handoff/handoff_done) is ordered BEFORE the

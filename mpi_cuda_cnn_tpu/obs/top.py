@@ -73,6 +73,12 @@ class TopState:
         self.fleet: dict | None = None       # newest fleet-router tick
         self.pending_hist: deque = deque(maxlen=history)
         self.replica_kinds: dict[str, int] = {}
+        # ROUTER panel (ISSUE 18): newest per-replica cumulative
+        # [routed hits, dispatches] split (cache_aware fleet records
+        # only) and the live-replica-count trail the scale-event
+        # sparkline renders from.
+        self.route: dict[str, list] | None = None
+        self.replicas_hist: deque = deque(maxlen=history)
         # Alert stream (ISSUE 8): rolling recent window + per-rule and
         # per-severity totals for the ALERTS panel.
         self.alerts_recent: deque = deque(maxlen=6)
@@ -135,6 +141,9 @@ class TopState:
         elif ev == "fleet":
             self.fleet = rec
             self.pending_hist.append(rec.get("pending", 0))
+            self.replicas_hist.append(rec.get("replicas", 0))
+            if rec.get("route") is not None:
+                self.route = rec["route"]
             for name, triple in (rec.get("load") or {}).items():
                 free = (triple + [None, None, None])[2]
                 if free is not None:
@@ -289,6 +298,36 @@ def render(state: TopState, path: str, width: int = 96) -> str:
         if state.replica_kinds:
             lines.append("  lifecycle: " + "  ".join(
                 f"{k}:{v}" for k, v in sorted(state.replica_kinds.items())))
+        if state.route is not None:
+            # ROUTER panel (ISSUE 18): per-replica routed-hit-rate bars
+            # (cumulative routed hits / dispatches — where cache-aware
+            # scoring is landing its overlap wins) plus the scale-event
+            # trail: live replica count sparkline + applied up/down
+            # totals from the lifecycle stream.
+            sv = state.serve.get("fleet") or {}
+            rh, rm = sv.get("route_hits"), sv.get("route_misses")
+            tot = (rh or 0) + (rm or 0)
+            lines.append(
+                "  ROUTER  "
+                + (f"routed {rh}/{tot} ({100.0 * rh / tot:.0f}%)  "
+                   f"hit tokens {_fmt(sv.get('route_hit_tokens'))}"
+                   if tot else "routing live")
+            )
+            for name in sorted(state.route):
+                hits, disp = (state.route[name] + [0, 0])[:2]
+                frac = hits / disp if disp else 0.0
+                lines.append(
+                    f"    {name:<4} hits {_fmt(hits):>5}/{_fmt(disp):<5} "
+                    f"{bar(frac, 1.0, width=16)} {frac:.0%}"
+                )
+            ups = state.replica_kinds.get("scale_up", 0)
+            downs = state.replica_kinds.get("scale_down", 0)
+            if ups or downs or len(state.replicas_hist) > 1:
+                lines.append(
+                    f"  SCALE  ups {ups}  downs {downs}  replicas "
+                    f"{sparkline(state.replicas_hist)} "
+                    f"now {_fmt(fl.get('replicas'))}"
+                )
         snap = state.metrics.get("fleet", {})
         if snap.get("counters"):
             lines.append(

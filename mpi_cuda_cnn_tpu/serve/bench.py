@@ -148,6 +148,132 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
     return reqs
 
 
+def parse_turns_dist(spec: str):
+    """`--turns-dist` grammar (ISSUE 18): `uniform:LO-HI` draws each
+    session's turn count uniformly in [LO, HI]; `geometric:P` draws
+    1 + Geometric(P) — most conversations short, a heavy tail of long
+    ones. Returns the draw(rng) callable."""
+    kind, sep, body = spec.partition(":")
+    if sep and kind == "uniform":
+        lo_s, dash, hi_s = body.partition("-")
+        try:
+            lo, hi = int(lo_s), int(hi_s)
+        except ValueError:
+            lo = hi = 0
+        if dash and 1 <= lo <= hi:
+            return lambda rng: int(rng.integers(lo, hi + 1))
+        raise ValueError(
+            f"turns-dist {spec!r}: uniform wants LO-HI with "
+            "1 <= LO <= HI")
+    if sep and kind == "geometric":
+        try:
+            p = float(body)
+        except ValueError:
+            p = 0.0
+        if 0.0 < p <= 1.0:
+            return lambda rng: int(rng.geometric(p))
+        raise ValueError(
+            f"turns-dist {spec!r}: geometric wants 0 < P <= 1")
+    raise ValueError(
+        f"turns-dist {spec!r}: want 'uniform:LO-HI' or 'geometric:P'")
+
+
+def add_session_turns(reqs, *, turns_dist: str, turn_gap_s: float,
+                      vocab: int, out_min: int, out_max: int,
+                      max_len: int, seed: int):
+    """Multi-turn session follow-ups (ISSUE 18): each session's FIRST
+    request anchors a conversation; turn k+1 re-arrives carrying turn
+    k's full context — its prompt is the previous turn's prompt plus a
+    drawn continuation (the assistant reply + next user message), its
+    arrival the previous turn's arrival plus an exponential think-time
+    gap. That re-arriving shared context is the regime cache-aware
+    routing exists for: the turn's prefix is hot on exactly one
+    replica, and hash affinity only finds it by luck.
+
+    Every draw comes from a SEPARATE (seed, 5) spawn — the --len-dist
+    precedent — so the base workload is bitwise-unchanged (the pinned
+    default CRCs stay valid) and turns-off runs never touch the
+    stream. A chain stops when the grown prompt can no longer fit its
+    next output inside `max_len` (validate_request's law). Follow-up
+    rids continue from len(reqs); the merged list is re-sorted by
+    (arrival, rid) — the arrival order every consumer assumes."""
+    from .scheduler import Request
+
+    draw_turns = parse_turns_dist(turns_dist)
+    srng = np.random.default_rng([seed, 5])
+    anchors: dict = {}
+    for r in reqs:
+        if r.session is not None and r.session not in anchors:
+            anchors[r.session] = r
+    out = list(reqs)
+    rid = len(reqs)
+    for sess in sorted(anchors):
+        prev = anchors[sess]
+        for _turn in range(draw_turns(srng) - 1):
+            ext = int(srng.integers(out_min, out_max + 1))
+            olen = int(srng.integers(out_min, out_max + 1))
+            gap = (float(srng.exponential(turn_gap_s))
+                   if turn_gap_s > 0 else 0.0)
+            if prev.prompt.size + ext + olen > max_len:
+                break
+            prompt = np.concatenate(
+                [prev.prompt,
+                 srng.integers(0, vocab, (ext,)).astype(np.int32)])
+            arrival = prev.arrival + gap
+            rel_deadline = (prev.deadline - prev.arrival
+                            if prev.deadline is not None else None)
+            nr = Request(rid=rid, prompt=prompt, max_new_tokens=olen,
+                         arrival=arrival,
+                         deadline=(arrival + rel_deadline
+                                   if rel_deadline is not None else None),
+                         session=prev.session, tenant=prev.tenant)
+            out.append(nr)
+            rid += 1
+            prev = nr
+    out.sort(key=lambda r: (r.arrival, r.rid))
+    return out
+
+
+def diurnal_warp(reqs, *, amp: float, period_s: float):
+    """Deterministic diurnal time-warp (ISSUE 18): remap each Poisson
+    arrival t -> s so the instantaneous rate follows
+    rate*(1 + amp*sin(2*pi*s/period)) — a day cycle with peak
+    rate*(1+amp) and trough rate*(1-amp) — WITHOUT drawing anything
+    (the base rate cancels out of the fixed point): s solves the
+    cumulative-intensity equation Lambda(s) = t with
+    Lambda(s) = s + amp*P/(2pi)*(1 - cos(2pi*s/P)), by
+    fixed-iteration bisection (the map is monotone for amp <= 1, so
+    arrival order is preserved and two runs bisect identically).
+    amp=0 is the exact identity — the default workload CRCs stay
+    pinned. Deadlines ride along at their original arrival-relative
+    offset; the warp mutates in place and returns `reqs`."""
+    if amp <= 0:
+        return reqs
+    if amp > 1.0:
+        raise ValueError(f"diurnal amp must be <= 1 (got {amp}): past "
+                         "it the intensity goes negative at the trough")
+    if period_s <= 0:
+        raise ValueError(f"diurnal period must be > 0 (got {period_s})")
+    two_pi = 2.0 * np.pi
+    span = amp * period_s / np.pi  # max warp displacement: Lambda bound
+    for r in reqs:
+        t = r.arrival
+        lo, hi = max(0.0, t - span), t
+        for _ in range(52):  # fixed count: bitwise-identical runs
+            mid = 0.5 * (lo + hi)
+            lam = mid + amp * period_s / two_pi * (
+                1.0 - np.cos(two_pi * mid / period_s))
+            if lam < t:
+                lo = mid
+            else:
+                hi = mid
+        s = 0.5 * (lo + hi)
+        if r.deadline is not None:
+            r.deadline = s + (r.deadline - r.arrival)
+        r.arrival = s
+    return reqs
+
+
 def build_sched_policy(args, slo_spec):
     """The --scheduler/--tenant-priority/--tenant-quota surface, shared
     by serve-bench and fleet-bench (one grammar, one error story).
@@ -253,6 +379,22 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                     help="tag requests with a seeded tenant mix over "
                          "t0..t{N-1} (0 = untagged single-tenant; the "
                          "SLO layer buckets by tenant)")
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="session keys: request i belongs to session "
+                         "i %% N (0 = sessionless). On this single-"
+                         "engine bench sessions only matter as the "
+                         "--turns-dist conversation anchors")
+    ap.add_argument("--turns-dist", default=None,
+                    help="multi-turn session conversations (ISSUE 18): "
+                         "'uniform:LO-HI' or 'geometric:P' turns per "
+                         "session; turn k+1 re-arrives carrying turn "
+                         "k's full prompt as its prefix, from a "
+                         "separate seeded spawn (default workload "
+                         "bitwise-unchanged; needs --sessions)")
+    ap.add_argument("--turn-gap-ms", type=float, default=0.0,
+                    help="mean think-time between a session's turns, "
+                         "exponential draw (needs --turns-dist; 0 = "
+                         "back-to-back turns)")
     ap.add_argument("--slo", default=None,
                     help="SLO spec JSON (obs.slo grammar): run the "
                          "streaming alert engine live on the record "
@@ -396,6 +538,21 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         print("error: --templates needs --prefix-mix > 0 (no request "
               "draws a template prefix at mix 0)", file=sys.stderr)
         return 2
+    if args.turns_dist and args.sessions <= 0:
+        print("error: --turns-dist needs --sessions > 0 (turns are "
+              "per-session conversations; a sessionless workload has "
+              "no chains to grow)", file=sys.stderr)
+        return 2
+    if args.turn_gap_ms and not args.turns_dist:
+        print("error: --turn-gap-ms needs --turns-dist (without turns "
+              "there are no gaps to draw)", file=sys.stderr)
+        return 2
+    if args.turns_dist:
+        try:
+            parse_turns_dist(args.turns_dist)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     model = TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.heads, depth=args.depth,
         max_seq=args.max_seq, kv_heads=args.kv_heads,
@@ -450,6 +607,22 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         max_queue=args.max_queue or None,
         watchdog_s=args.watchdog_ms / 1e3,
     )
+
+    def build_reqs():
+        # Regenerated identically per mode (the cross-mode contract);
+        # session tags + multi-turn follow-ups (ISSUE 18) layer on top
+        # of the base stream without perturbing it.
+        reqs = make_workload(**workload_kw)
+        if args.sessions > 0:
+            for r in reqs:
+                r.session = r.rid % args.sessions
+        if args.turns_dist:
+            reqs = add_session_turns(
+                reqs, turns_dist=args.turns_dist,
+                turn_gap_s=args.turn_gap_ms / 1e3, vocab=args.vocab,
+                out_min=args.out_min, out_max=args.out_max,
+                max_len=max_len, seed=args.seed)
+        return reqs
     alert_engine = None
     slo_spec = None
     if args.slo:
@@ -525,7 +698,7 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                 blame.ingest_tick(rec)
                 if _base is not None:
                     _base(rec)
-            result = engine.run(make_workload(**workload_kw), mode=mode,
+            result = engine.run(build_reqs(), mode=mode,
                                 faults=faults, registry=registry,
                                 tick_sink=tick_sink,
                                 prefix=(args.prefix_cache
@@ -639,7 +812,15 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                          "flight (the mid-handoff crash window; "
                          "needs --pools)")
     ap.add_argument("--policy", default="least_loaded",
-                    choices=["least_loaded", "session"])
+                    choices=["least_loaded", "session", "cache_aware"],
+                    help="dispatch policy: least_loaded, session "
+                         "(rendezvous-hash affinity), or cache_aware "
+                         "(ISSUE 18: score candidates by expected "
+                         "prefix-token overlap against each replica's "
+                         "live routing digest — device tree + host "
+                         "tier; least-loaded tie-break, hash-affinity "
+                         "fallback at zero overlap. Needs "
+                         "--prefix-cache)")
     ap.add_argument("--redispatch", default="resume",
                     choices=["resume", "discard"],
                     help="failover semantics for in-flight requests: "
@@ -681,6 +862,42 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--sessions", type=int, default=0,
                     help="session keys for the affinity policy: request "
                          "i belongs to session i %% N (0 = sessionless)")
+    ap.add_argument("--turns-dist", default=None,
+                    help="multi-turn session conversations (ISSUE 18): "
+                         "'uniform:LO-HI' or 'geometric:P' turns per "
+                         "session; turn k+1 re-arrives carrying turn "
+                         "k's full prompt as its prefix, from a "
+                         "separate seeded spawn (default workload "
+                         "bitwise-unchanged; needs --sessions)")
+    ap.add_argument("--turn-gap-ms", type=float, default=0.0,
+                    help="mean think-time between a session's turns in "
+                         "fleet-clock ms, exponential draw (needs "
+                         "--turns-dist; 0 = back-to-back turns)")
+    ap.add_argument("--diurnal-amp", type=float, default=0.0,
+                    help="diurnal arrival modulation depth (ISSUE 18): "
+                         "time-warp the Poisson arrivals so the rate "
+                         "follows rate*(1 + amp*sin) over "
+                         "--diurnal-period — peak rate*(1+amp), trough "
+                         "rate*(1-amp); 0 = identity (default stream "
+                         "bitwise-unchanged), max 1. Needs --rate > 0")
+    ap.add_argument("--diurnal-period", type=float, default=10.0,
+                    help="diurnal cycle length, fleet-clock seconds "
+                         "(--diurnal-amp)")
+    ap.add_argument("--autoscale", default=None,
+                    help="online goodput autoscaler (ISSUE 18): fold "
+                         "live queue pressure, SLO burn rates (--slo), "
+                         "and the autosize frontier target "
+                         "(--autoscale-frontier) into replica "
+                         "join/leave decisions each tick. Grammar: "
+                         "comma-separated key=value over min/max/high/"
+                         "low/up/down/cooldown/burn, or bare 'on' for "
+                         "defaults (serve/autoscale.parse_autoscale)")
+    ap.add_argument("--autoscale-frontier", default=None,
+                    help="goodput JSONL from `mctpu autosize "
+                         "--metrics-jsonl`: its frontier record's "
+                         "best_per_chip_rps converts the observed "
+                         "dispatch rate into a target replica count "
+                         "(needs --autoscale)")
     ap.add_argument("--tenants", type=int, default=0,
                     help="tag requests with a seeded tenant mix over "
                          "t0..t{N-1} (0 = untagged single-tenant; the "
@@ -829,6 +1046,42 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
         print("error: --templates needs --prefix-mix > 0 (no request "
               "draws a template prefix at mix 0)", file=sys.stderr)
         return 2
+    if args.policy == "cache_aware" and not args.prefix_cache:
+        print("error: --policy cache_aware needs --prefix-cache (the "
+              "score is expected prefix-cache overlap; without the "
+              "cache every score is zero and the policy silently "
+              "degrades to its fallback)", file=sys.stderr)
+        return 2
+    if args.turns_dist and args.sessions <= 0:
+        print("error: --turns-dist needs --sessions > 0 (turns are "
+              "per-session conversations; a sessionless workload has "
+              "no chains to grow)", file=sys.stderr)
+        return 2
+    if args.turn_gap_ms and not args.turns_dist:
+        print("error: --turn-gap-ms needs --turns-dist (without turns "
+              "there are no gaps to draw)", file=sys.stderr)
+        return 2
+    if args.diurnal_amp > 0 and args.rate <= 0:
+        print("error: --diurnal-amp needs --rate > 0 (rate 0 puts "
+              "every arrival at t=0; there is no arrival process to "
+              "modulate)", file=sys.stderr)
+        return 2
+    if args.diurnal_amp > 1.0:
+        print(f"error: diurnal amp must be <= 1 (got {args.diurnal_amp})"
+              ": past it the intensity goes negative at the trough",
+              file=sys.stderr)
+        return 2
+    if args.autoscale_frontier and not args.autoscale:
+        print("error: --autoscale-frontier needs --autoscale (the "
+              "frontier is the autoscaler's lookup table; without the "
+              "policy it would be silently ignored)", file=sys.stderr)
+        return 2
+    if args.turns_dist:
+        try:
+            parse_turns_dist(args.turns_dist)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     max_len = args.prompt_max + args.out_max
     pages = args.pages or args.slots * pages_for(max_len, args.page_size) + 1
     host_pages = (args.host_pages or pages) if args.spill else 0
@@ -877,6 +1130,10 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
             tenants=args.tenants, prefix_mix=args.prefix_mix,
             len_dist=args.len_dist, templates=args.templates,
+            turns_dist=args.turns_dist,
+            turn_gap_s=args.turn_gap_ms / 1e3,
+            diurnal_amp=args.diurnal_amp,
+            diurnal_period_s=args.diurnal_period,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -896,6 +1153,22 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     rc, sched_policy = build_sched_policy(args, slo_spec)
     if rc:
         return rc
+    autoscaler = None
+    if args.autoscale:
+        from .autoscale import Autoscaler, load_frontier, parse_autoscale
+
+        try:
+            pol = parse_autoscale(args.autoscale)
+            per_chip = (load_frontier(args.autoscale_frontier)
+                        if args.autoscale_frontier else 0.0)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        # slo_spec switches the burn-rate feed on: the autoscaler runs
+        # the SAME windowed Accountant fold the alert engine does, over
+        # the fence-accepted terminal stream.
+        autoscaler = Autoscaler(pol, slo_spec=slo_spec,
+                                per_chip_rps=per_chip)
     clock = FakeClock()
     registry = MetricsRegistry(clock=clock)
     faults = FaultInjector(args.fault_plan) if args.fault_plan else None
@@ -959,6 +1232,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 spec=args.spec, spec_k=args.spec_k,
                 spec_ngram=args.spec_ngram,
                 pools=pools, handoff_ticks=args.handoff_ticks,
+                autoscale=autoscaler,
                 # The per-transfer lifecycle log is only ever emitted at
                 # --log full; at summary-mode storm scale retaining it
                 # would be pure GC ballast (the counters still stamp).
@@ -1017,6 +1291,7 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                            else alerts_crc([]))
         metrics.log("serve", **{
             "bench": "fleet", "policy": args.policy,
+            "autoscale": bool(args.autoscale),
             "redispatch": args.redispatch,
             "spec": args.spec, "spec_k": args.spec_k,
             "replicas_initial": (sum(pools.values()) if pools

@@ -220,6 +220,14 @@ class ReplicaCore:
                 "without the tree"
             )
         self.tier = None
+        # Cache-aware routing digest (ISSUE 18): the host-side set of
+        # cumulative prefix keys this replica can serve a hit from —
+        # device-tree paths plus host-tier keys, maintained
+        # incrementally by the cache/tier at their insert/readmit/
+        # evict/spill seams. Router.pick's cache_aware scoring reads it
+        # via Replica.route_keys; it is NEVER digested (replay
+        # re-applies recorded routing decisions, not pick()).
+        self.route_keys: set | None = set() if prefix else None
         if host_pages > 0:
             # Per-incarnation tier (ISSUE 17): it dies with the replica
             # like its PagePool — a cold restart comes back with the
@@ -234,8 +242,10 @@ class ReplicaCore:
                 readmit_fn=(engine.readmit_page if engine is not None
                             else None),
                 fault_poll=tier_fault_poll,
+                route_keys=self.route_keys,
             )
-        self.prefix = (PrefixCache(pool, page_size, self.tier)
+        self.prefix = (PrefixCache(pool, page_size, self.tier,
+                                   route_keys=self.route_keys)
                        if prefix else None)
         sched_kw = dict(slots=slots, pool=pool, page_size=page_size,
                         max_len=max_len, max_queue=max_queue,
@@ -480,6 +490,12 @@ class Replica:
                 + self._gauge("serve.running_slots")
                 + self.pending_dispatches)
 
+    @property
+    def route_keys(self):
+        """The core's routing digest (ISSUE 18) — what Router.pick's
+        cache_aware scoring reads; None with the prefix cache off."""
+        return self.core.route_keys
+
     def step(self, now: float):
         rec, new_fin, new_drop = self.core.step(now)
         r = self.registry
@@ -558,6 +574,23 @@ class FleetResult:
     # in emission order — the whole state trajectory as ONE gated
     # number, present on summary-only storms.
     state_crc: int = 0
+    # Cache-aware routing counters (ISSUE 18): dispatches whose
+    # cache_aware pick scored a positive expected prefix overlap
+    # (route_hit_tokens sums the matched tokens). Zeros under any other
+    # policy so the gated metrics exist in every fleet-bench run.
+    route_hits: int = 0
+    route_misses: int = 0
+    route_hit_tokens: int = 0
+    # Online-autoscaler counters (ISSUE 18): scale decisions applied,
+    # the crc32 chain over the (tick, direction, name) decision log,
+    # and the cumulative live-member step count the static-vs-
+    # autoscaled capacity comparison reads. Zeros without --autoscale
+    # (replica_ticks is always counted — a static fleet spends them
+    # too).
+    scale_ups: int = 0
+    scale_downs: int = 0
+    scale_crc: int = 0
+    replica_ticks: int = 0
 
     @property
     def output_tokens(self) -> int:
@@ -640,6 +673,17 @@ class FleetResult:
             "handoffs_aborted": self.handoffs_aborted,
             "kv_refusals": self.kv_refusals,
             "degraded_unified": self.degraded_unified,
+            # Cache-aware routing + autoscale counters (ISSUE 18): flat
+            # keys the fleet/autoscale determinism gates pin at exact
+            # equality; zeros under other policies / without the
+            # autoscaler so they exist in every fleet-bench run.
+            "route_hits": self.route_hits,
+            "route_misses": self.route_misses,
+            "route_hit_tokens": self.route_hit_tokens,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "scale_crc": self.scale_crc,
+            "replica_ticks": self.replica_ticks,
             **({"pools": dict(self.pools)} if self.pools else {}),
             # Prefix-sharing counters (ISSUE 9): flat keys the fleet
             # determinism gate pins at exact equality.
@@ -677,7 +721,7 @@ class Fleet:
                  sched_policy=None, pools: dict[str, int] | str | None = None,
                  handoff_ticks: int = 1, log_handoffs: bool = True,
                  spec: str = "off", spec_k: int = 8, spec_ngram: int = 2,
-                 host_pages: int = 0):
+                 host_pages: int = 0, autoscale=None):
         if isinstance(pools, str):
             pools = parse_pools(pools)
         if pools is not None:
@@ -724,6 +768,15 @@ class Fleet:
                     "host tier (--spill / host_pages > 0) — without one "
                     "they would silently never fire"
                 )
+        if policy == "cache_aware" and not prefix:
+            # Inert-config contract, routing leg (ISSUE 18): without
+            # the prefix cache no replica ever registers a route key,
+            # so cache-aware scoring would silently always fall back.
+            raise ValueError(
+                "policy 'cache_aware' needs prefix=True "
+                "(--prefix-cache) — without the prefix tree there are "
+                "no cache keys to route on"
+            )
         if redispatch == "discard" and faults is not None \
                 and faults.pending("fleet.resume"):
             # Same contract, resume leg: discard re-dispatches never
@@ -762,7 +815,28 @@ class Fleet:
         self.replica_tick_sink = replica_tick_sink
         self.router = Router(policy, heartbeat_miss=heartbeat_miss,
                              backoff_base=backoff_base, max_flaps=max_flaps,
-                             jitter=jitter)
+                             jitter=jitter, page_size=page_size)
+        # Online autoscaler (ISSUE 18): an object with step()/
+        # observe_terminal() (serve/autoscale.py's Autoscaler) or None.
+        # It only ever acts through the SAME join/leave machinery the
+        # fault plan drives, so replay needs no new event kinds. On a
+        # pooled fleet it governs the decode pool (prefill sizing stays
+        # the operator's — the autosize frontier picks the split).
+        self.autoscaler = autoscale
+        # Cache-aware routing counters (ISSUE 18): cumulative fleet-
+        # wide hit accounting plus the per-replica split the ROUTER
+        # top-panel bars read. Stamped (zeros) in every summary — the
+        # gate contract.
+        self.route_hits = self.route_misses = 0
+        self.route_hit_tokens = 0
+        self._route_by: dict[str, list[int]] = {}  # name -> [hits, disp]
+        self._route_hits_tick: list[list] = []     # [rid, name, matched]
+        # Autoscale counters (ISSUE 18): scale_crc chains every
+        # (tick, direction, name) decision in commit order — the
+        # scale-event log as ONE gated number.
+        self.scale_ups = self.scale_downs = 0
+        self.scale_crc = 0
+        self.replica_ticks = 0
         self.events: list[dict] = []       # obs `fault` field dicts
         self.replica_log: list[dict] = []  # obs `replica` field dicts
         self.dispatch_trace: list[tuple] = []
@@ -1232,6 +1306,25 @@ class Fleet:
                 self._degraded_rids.add(req.rid)
         if member is None:
             return None
+        if self.router.policy == "cache_aware":
+            # Route accounting (ISSUE 18): last_route_overlap is the
+            # matched prefix tokens of the pick above (0 on fallback);
+            # a degraded unified re-pick overwrote it, so the read here
+            # always describes the decision that actually placed `req`.
+            matched = self.router.last_route_overlap
+            st = self._route_by.setdefault(member.name, [0, 0])
+            st[1] += 1
+            if matched > 0:
+                st[0] += 1
+                self.route_hits += 1
+                self.route_hit_tokens += matched
+                self._route_hits_tick.append([req.rid, member.name,
+                                              matched])
+            else:
+                self.route_misses += 1
+            if self.registry is not None:
+                self.registry.inc("fleet.route_hits" if matched > 0
+                                  else "fleet.route_misses")
         if redispatch and self.redispatch == "resume" and req.out:
             # KV transfer integrity, failover leg (ISSUE 13): the
             # committed context a resume re-dispatch re-prefills is
@@ -1475,6 +1568,43 @@ class Fleet:
                 self.leaves += 1
                 self._log_replica(name, "leave", tick, now)
 
+    # -- online autoscaling (ISSUE 18) ---------------------------------
+
+    def _autoscale_step(self, tick: int, now: float,
+                        redispatch_q: deque) -> None:
+        """One autoscaler consult: fold the live pressure gauges into
+        the policy and apply its decision through the SAME membership
+        machinery the fault plan drives — a scale-out is a _join (the
+        mirrored "join" record), a scale-in drains the least-loaded
+        member (the mirrored "leave" record; drain completion
+        deregisters it like an operator leave). The scale_up/scale_down
+        marker records carry no digested state — obs surfaces read
+        them, the replay mirror ignores them."""
+        phase = "decode" if self.pools is not None else None
+        cands = [m for m in self.router.dispatchable(phase)
+                 if m.replica.alive]
+        live = len(cands)
+        load = sum(m.replica.load() for m in cands) + len(redispatch_q)
+        decision = self.autoscaler.step(now=now, live=live, load=load,
+                                        dispatched=self.dispatches)
+        if decision == "up":
+            rep = self._join(tick=tick, now=now, phase=phase)
+            self.scale_ups += 1
+            self._log_replica(rep.name, "scale_up", tick, now,
+                              replicas=live + 1)
+            self.scale_crc = zlib.crc32(
+                repr((tick, "up", rep.name)).encode(), self.scale_crc)
+        elif decision == "down" and cands:
+            victim = min(cands, key=lambda m: (m.replica.load(), m.name))
+            victim.draining = True
+            self.leaves += 1
+            self._log_replica(victim.name, "leave", tick, now)
+            self.scale_downs += 1
+            self._log_replica(victim.name, "scale_down", tick, now,
+                              replicas=live - 1)
+            self.scale_crc = zlib.crc32(
+                repr((tick, "down", victim.name)).encode(), self.scale_crc)
+
     # -- the loop ------------------------------------------------------
 
     def _validate(self, requests) -> None:
@@ -1534,6 +1664,13 @@ class Fleet:
                     self._retire_counts(member.replica)
                     self._log_replica(member.name, "drain_complete", tick,
                                       now)
+            # Online autoscaling (ISSUE 18): AFTER drain completions
+            # and failure handling (the membership it reads is this
+            # tick's), BEFORE the fleet record (the digest at emission
+            # time already reflects the decision — the replay mirror
+            # applies the tick's join/leave events before checking it).
+            if self.autoscaler is not None:
+                self._autoscale_step(tick, now, redispatch_q)
             # Disaggregation (ISSUE 13): clear degradation latches for
             # pools that repopulated, then advance every in-flight KV
             # handoff (aborts feed redispatch_q ahead of the dispatch
@@ -1581,6 +1718,8 @@ class Fleet:
                 self._handoff_placed_tick, []
             ho_unplaced, self._handoff_unplaced_tick = \
                 self._handoff_unplaced_tick, []
+            route_hits_tick, self._route_hits_tick = \
+                self._route_hits_tick, []
             # Flight recorder (ISSUE 15): the router/fleet state digest
             # at record-emission time — membership, in-flight handoff
             # states, dispatch backlog, and the running fence chain —
@@ -1639,6 +1778,16 @@ class Fleet:
                                          for rid, dst in ho_unplaced],
                     "handoffs_inflight": len(self._handoffs),
                     "redispatch": self.redispatch,
+                    # Cache-aware routing fields (ISSUE 18), only under
+                    # the policy that produces them: the tick's scoring
+                    # wins [rid, replica, matched_tokens] and the
+                    # cumulative per-replica [hits, dispatches] split
+                    # the ROUTER top panel / report tables read. Extra
+                    # fleet-record fields — replay/blame ignore them.
+                    **({"route_hits": route_hits_tick,
+                        "route": {n: list(st) for n, st in
+                                  sorted(self._route_by.items())}}
+                       if self.router.policy == "cache_aware" else {}),
                     "load": {m.name: [len(m.replica.core.sched.queue),
                                       sum(1 for s in
                                           m.replica.core.sched.slots
@@ -1657,9 +1806,22 @@ class Fleet:
                 if not rep.alive:
                     continue
                 rec, new_fin, new_drop = rep.step(now)
+                # Cumulative live-member step count (ISSUE 18): the
+                # capacity actually spent — what the static-vs-
+                # autoscaled acceptance compares. Zombies excluded
+                # (their steps serve nobody the fence accepts).
+                self.replica_ticks += 1
                 self.router.beat(member.name, tick)
                 synced = self._sync_terminal(rep, new_fin + new_drop, now)
                 n_done += len(synced)
+                if self.autoscaler is not None and synced:
+                    # Burn-rate pressure feed (ISSUE 18): the SAME
+                    # fence-accepted terminal set the streaming SLO
+                    # layer folds — a zombie's refused claims never
+                    # push the autoscaler.
+                    for r in synced:
+                        self.autoscaler.observe_terminal(
+                            terminal_fields(r), now)
                 any_work = any_work or rec["progressed"] or rep.core.unfinished
                 self.state_chain = zlib.crc32(
                     rec["state_crc"].to_bytes(4, "little"), self.state_chain)
@@ -1697,6 +1859,11 @@ class Fleet:
                 # toward n_done; after revocation they are discarded.
                 synced = self._sync_terminal(rep, new_fin + new_drop, now)
                 n_done += len(synced)
+                if self.autoscaler is not None and synced:
+                    # Fence-accepted only — same feed as live members.
+                    for r in synced:
+                        self.autoscaler.observe_terminal(
+                            terminal_fields(r), now)
                 # Pre-failover the zombie is still a member and its
                 # commits still land — its tick telemetry is part of
                 # the same in-flight drain, and `mctpu trace` needs it
@@ -1869,6 +2036,10 @@ class Fleet:
             dispatch_trace=self.dispatch_trace, events=self.events,
             replica_log=self.replica_log, prefix=prefix_totals,
             spec=spec_totals, state_crc=self.state_chain,
+            route_hits=self.route_hits, route_misses=self.route_misses,
+            route_hit_tokens=self.route_hit_tokens,
+            scale_ups=self.scale_ups, scale_downs=self.scale_downs,
+            scale_crc=self.scale_crc, replica_ticks=self.replica_ticks,
         )
 
 
@@ -1878,15 +2049,29 @@ def make_fleet_workload(*, n: int, vocab: int, prompt_min: int,
                         deadline_s: float = 0.0, tenants: int = 0,
                         prefix_mix: float = 0.0,
                         len_dist: str = "uniform",
-                        templates: int = 0) -> list[Request]:
+                        templates: int = 0,
+                        turns_dist: str | None = None,
+                        turn_gap_s: float = 0.0,
+                        diurnal_amp: float = 0.0,
+                        diurnal_period_s: float = 10.0) -> list[Request]:
     """The serve-bench workload generator plus session keys: request i
     belongs to session i % sessions (0 = sessionless), so the
     session-affinity policy has stable keys to rendezvous-hash.
     `tenants`/`prefix_mix`/`len_dist`/`templates` pass through to
     make_workload's seeded tenant mix, shared-template-prefix mix
     (ISSUE 9), heavy-tail length mix (ISSUE 16), and sized template
-    pool (ISSUE 17)."""
-    from .bench import make_workload
+    pool (ISSUE 17).
+
+    ISSUE 18's two workload shapes compose on top, both leaving the
+    base stream bitwise-unchanged when off: `diurnal_amp` > 0 time-warps
+    the arrivals into a day cycle (bench.diurnal_warp — no new draws),
+    then `turns_dist` grows each session's first request into a
+    multi-turn conversation whose turns re-arrive carrying the previous
+    turn's context (bench.add_session_turns — (seed, 5) spawn). Turns
+    chain off WARPED arrivals: think-time gaps trail the conversation's
+    actual start, which is what puts follow-up traffic inside the same
+    diurnal peak that anchored it."""
+    from .bench import add_session_turns, diurnal_warp, make_workload
 
     reqs = make_workload(n=n, vocab=vocab, prompt_min=prompt_min,
                          prompt_max=prompt_max, out_min=out_min,
@@ -1897,4 +2082,16 @@ def make_fleet_workload(*, n: int, vocab: int, prompt_min: int,
     if sessions > 0:
         for r in reqs:
             r.session = r.rid % sessions
+    if diurnal_amp > 0:
+        reqs = diurnal_warp(reqs, amp=diurnal_amp,
+                            period_s=diurnal_period_s)
+    if turns_dist:
+        if sessions <= 0:
+            raise ValueError("turns_dist needs sessions > 0 (turns are "
+                             "per-session conversations; a sessionless "
+                             "workload has no chains to grow)")
+        reqs = add_session_turns(reqs, turns_dist=turns_dist,
+                                 turn_gap_s=turn_gap_s, vocab=vocab,
+                                 out_min=out_min, out_max=out_max,
+                                 max_len=prompt_max + out_max, seed=seed)
     return reqs

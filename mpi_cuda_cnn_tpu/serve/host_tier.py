@@ -109,13 +109,20 @@ class HostTier:
     """
 
     def __init__(self, host_pages: int, *, spill_fn=None, readmit_fn=None,
-                 fault_poll=None):
+                 fault_poll=None, route_keys: set | None = None):
         if host_pages < 1:
             raise ValueError(f"host_pages must be >= 1 (got {host_pages})")
         self.host_pages = host_pages
         self.spill_fn = spill_fn
         self.readmit_fn = readmit_fn
         self.fault_poll = fault_poll
+        # Optional fleet-owned routing digest (ISSUE 18): the same set
+        # the replica's PrefixCache maintains for its tree paths; the
+        # tier registers keys it holds (spill) and unregisters keys
+        # that are genuinely gone (host-LRU eviction, CRC refusal).
+        # take() does NOT unregister — the key moves back to the tree,
+        # whose insert hook already holds it. Never digested.
+        self.route_keys = route_keys
         self._entries: dict[bytes, _Entry] = {}
         self._seq = 0          # spill sequence number (the fault trigger)
         self._clock = 0        # host-LRU clock
@@ -152,9 +159,13 @@ class HostTier:
             victim = min(self._entries.values(), key=lambda e: e.seq)
             del self._entries[victim.key]
             self.stats["host_evictions"] += 1
+            if self.route_keys is not None:
+                self.route_keys.discard(victim.key)
         self._clock += 1
         self._entries[path_key] = _Entry(path_key, tokens.copy(), crc,
                                          payload, self._clock)
+        if self.route_keys is not None:
+            self.route_keys.add(path_key)
         self.stats["spills"] += 1
 
     # -- readmission ----------------------------------------------------
@@ -172,6 +183,8 @@ class HostTier:
         if entry.crc != chunk_crc(expected):
             del self._entries[entry.key]
             self.stats["refusals"] += 1
+            if self.route_keys is not None:
+                self.route_keys.discard(entry.key)
             return None
         return entry
 

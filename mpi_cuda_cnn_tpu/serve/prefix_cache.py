@@ -104,13 +104,23 @@ class PrefixCache:
     and LRU reclaim. One instance per scheduler/pool pair — per
     replica in the fleet (each replica owns its pool)."""
 
-    def __init__(self, pool: PagePool, page_size: int, tier=None):
+    def __init__(self, pool: PagePool, page_size: int, tier=None,
+                 route_keys: set | None = None):
         self.pool = pool
         self.page_size = page_size
         # Optional host-memory spill tier (serve/host_tier.py, ISSUE
         # 17): None keeps the ISSUE-9 discard-on-reclaim behavior
         # bit-for-bit (digests, schedules, summaries all unchanged).
         self.tier = tier
+        # Optional fleet-owned routing digest (ISSUE 18): the set of
+        # cumulative prefix keys THIS replica can serve a hit from —
+        # tree node paths here, host-tier keys via the tier's own
+        # hooks; a key lives in exactly one of the two at a time.
+        # Maintained incrementally at the insert/readmit/evict seams
+        # below; read by Router.pick's cache_aware scoring. NEVER part
+        # of digest_tuple — replay re-applies recorded routing and must
+        # not need this state.
+        self.route_keys = route_keys
         self.root_children: dict[bytes, PrefixNode] = {}
         self.nodes: dict[int, PrefixNode] = {}     # node_id -> node
         self._next_id = 0
@@ -240,6 +250,9 @@ class PrefixCache:
                           children, chunk.tobytes(), key)
         children[node.key] = node
         self.nodes[node.node_id] = node
+        if self.route_keys is not None:
+            # The key moved tier -> tree; still servable, still routed.
+            self.route_keys.add(key)
         self._tick_readmits.append([rid, (i + 1) * ps])
         return node
 
@@ -310,6 +323,8 @@ class PrefixCache:
                                   toks[:(c + 1) * ps].tobytes())
                 children[key] = node
                 self.nodes[node.node_id] = node
+                if self.route_keys is not None:
+                    self.route_keys.add(node.path)
                 slot.refs.append(page)
                 slot.prefix_nodes.append(node)
                 self.stats["inserts"] += 1
@@ -344,7 +359,11 @@ class PrefixCache:
             # device-side accounting below is unchanged either way —
             # eviction always returns the page to the pool, which is
             # what keeps the replay mirror's free-page law one rule.
+            # Routing digest: the tier's spill hook keeps the key
+            # registered (it moved tree -> tier, still servable).
             self.tier.spill(node.path, node.tokens, node.page)
+        elif self.route_keys is not None:
+            self.route_keys.discard(node.path)
         self.pool.free([node.page], PREFIX_OWNER)
         del node.parent_map[node.key]
         del self.nodes[node.node_id]

@@ -17,7 +17,19 @@ Three concerns, one per class group:
   session-affinity uses RENDEZVOUS (highest-random-weight) hashing on
   (session, replica) so one session's requests land on one replica —
   its prefix/KV locality survives other replicas joining or leaving,
-  because only keys owned by a departed replica move.
+  because only keys owned by a departed replica move. Cache-aware
+  routing (ISSUE 18, after SGLang's cache-aware load balancer) scores
+  candidates by EXPECTED PREFIX OVERLAP: each replica exposes
+  `route_keys`, the host-side set of page-aligned prefix keys its
+  device tree + host tier currently hold (maintained incrementally by
+  serve/prefix_cache.py / serve/host_tier.py from the same
+  insert/evict/spill/readmit events they already account), and the
+  router walks the request's cumulative chunk keys until the first
+  miss — matched chunks × page_size is the prefill the fleet will NOT
+  redo. Highest overlap wins, ties break least-loaded-then-name, and
+  a zero-overlap request falls back to rendezvous hash affinity (when
+  it carries a session) or least-loaded, so membership churn still
+  moves only the dead replica's sessions.
 
 - **Membership + health**: replicas heartbeat every tick they step; a
   replica that misses `heartbeat_miss` consecutive ticks is declared
@@ -41,9 +53,11 @@ from __future__ import annotations
 import dataclasses
 import zlib
 
+import numpy as np
+
 from ..utils.retry import backoff_delay
 
-POLICIES = ("least_loaded", "session")
+POLICIES = ("least_loaded", "session", "cache_aware")
 
 
 def fence_chain(crc: int, *op) -> int:
@@ -111,13 +125,23 @@ class Router:
 
     def __init__(self, policy: str = "least_loaded", *,
                  heartbeat_miss: int = 3, backoff_base: float = 0.0,
-                 max_flaps: int = 3, jitter=None):
+                 max_flaps: int = 3, jitter=None, page_size: int = 0):
         if policy not in POLICIES:
             raise ValueError(f"policy {policy!r}: want one of {POLICIES}")
         if heartbeat_miss < 1:
             raise ValueError(f"heartbeat_miss must be >= 1, got "
                              f"{heartbeat_miss}")
+        if policy == "cache_aware" and page_size < 1:
+            raise ValueError("policy 'cache_aware' needs page_size >= 1 "
+                             "(the prefix keys are page-aligned)")
         self.policy = policy
+        self.page_size = page_size
+        # Matched prefix tokens of the LAST cache_aware pick (0 on
+        # fallback / other policies): the fleet reads it right after
+        # pick() to account route hits. Observability only — never part
+        # of any digest, so replay (which re-applies recorded routing,
+        # not pick()) is untouched.
+        self.last_route_overlap = 0
         self.heartbeat_miss = heartbeat_miss
         self.backoff_base = backoff_base
         self.max_flaps = max_flaps
@@ -196,17 +220,66 @@ class Router:
 
     # -- dispatch ------------------------------------------------------
 
+    def _chunk_keys(self, req) -> list[bytes]:
+        """The request's cumulative page-aligned prefix keys, in depth
+        order — THE SAME key spelling serve/prefix_cache.py inserts
+        (`toks[:(i+1)*ps].tobytes()` over full chunks only), so a
+        membership test against a replica's route_keys is exact."""
+        toks = np.asarray(req.prompt, np.int32).reshape(-1)
+        ps = self.page_size
+        return [toks[:(i + 1) * ps].tobytes()
+                for i in range(len(toks) // ps)]
+
+    def _overlap(self, member, keys) -> int:
+        """Expected prefix-hit tokens of dispatching onto `member`:
+        walk the cumulative keys in depth order, stop at the first one
+        the replica holds in neither its device tree nor its host tier
+        (a deeper chunk can't hit without its parent — the tree is
+        prefix-closed, and readmission re-walks from the root)."""
+        route = getattr(member.replica, "route_keys", None)
+        if not route:
+            return 0
+        n = 0
+        for k in keys:
+            if k not in route:
+                break
+            n += 1
+        return n * self.page_size
+
     def pick(self, req, phase: str | None = None) -> Member | None:
         """The replica `req` should run on, or None when nothing can
         take work. Least-loaded reads each replica's load() (backed by
         its PR-6 registry gauges); session requests rendezvous-hash
-        onto the surviving membership; ties break on name, so identical
-        fleets make identical choices. `phase` restricts the candidate
-        set to one pool (ISSUE 13) — session affinity then rendezvous-
-        hashes over that pool's membership only."""
+        onto the surviving membership; cache_aware (ISSUE 18) takes the
+        highest expected prefix overlap, ties broken least-loaded, and
+        falls back to hash affinity / least-loaded at zero overlap;
+        ties break on name, so identical fleets make identical choices.
+        `phase` restricts the candidate set to one pool (ISSUE 13) —
+        session affinity then rendezvous-hashes over that pool's
+        membership only."""
+        self.last_route_overlap = 0
         cands = self.dispatchable(phase)
         if not cands:
             return None
+        if self.policy == "cache_aware":
+            keys = self._chunk_keys(req)
+            if keys:
+                scored = [(self._overlap(m, keys), m) for m in cands]
+                best = max(s for s, _ in scored)
+                if best > 0:
+                    self.last_route_overlap = best
+                    return min((m for s, m in scored if s == best),
+                               key=lambda m: (m.replica.load(), m.name))
+            # Zero overlap: deterministic fallback. Hash affinity keeps
+            # a cold session pinned (its SECOND turn then scores), and
+            # membership changes still move only the dead replica's
+            # sessions — the rendezvous property cache scoring alone
+            # would not give.
+            if req.session is not None:
+                return max(cands,
+                           key=lambda m: (stable_hash(req.session, m.name),
+                                          m.name))
+            return min(cands, key=lambda m: (m.replica.load(), m.name))
         if self.policy == "session" and req.session is not None:
             return max(cands,
                        key=lambda m: (stable_hash(req.session, m.name),

@@ -164,6 +164,80 @@ def build_records():
     return records
 
 
+def build_fleet():
+    """A small deterministic FLEET run (ISSUE 18): cache-aware routing
+    + the online autoscaler over a diurnal multi-turn session storm —
+    every record FakeClock-stamped in-process (the bench main's wall_s
+    stamp would leak wall-clock into the checked-in sample). The
+    sample carries `fleet` records with the `route`/`route_hits`
+    fields (the ROUTER top panel + report routing tables + trace
+    routed markers), and scale_up/scale_down replica lifecycle
+    markers (the SCALE sparkline + autoscale table)."""
+    from mpi_cuda_cnn_tpu.faults import FakeClock
+    from mpi_cuda_cnn_tpu.obs.causal import BlameAccumulator
+    from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
+    from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
+    from mpi_cuda_cnn_tpu.serve.autoscale import (
+        Autoscaler,
+        parse_autoscale,
+    )
+    from mpi_cuda_cnn_tpu.serve.fleet import (
+        Fleet,
+        SimCompute,
+        make_fleet_workload,
+    )
+
+    records: list[dict] = []
+    clock = FakeClock()
+
+    def emit(ev: str, **rec) -> None:
+        records.append(validate_record(make_record(ev, clock.now, **rec)))
+
+    registry = MetricsRegistry(clock=clock)
+    blame = BlameAccumulator()
+
+    def fleet_sink(rec):
+        blame.ingest_fleet(rec)
+        emit("fleet", **rec)
+
+    def tick_sink(rec):
+        blame.ingest_tick(rec)
+        emit("tick", **rec)
+
+    reqs = make_fleet_workload(
+        n=24, vocab=13, prompt_min=8, prompt_max=16, out_min=4,
+        out_max=8, rate=300.0, seed=7, sessions=6, prefix_mix=0.7,
+        templates=4, turns_dist="uniform:2-3", turn_gap_s=0.01,
+        diurnal_amp=0.8, diurnal_period_s=0.15)
+    fleet = Fleet(
+        lambda name: SimCompute(vocab=13, chunk=8, salt=7),
+        replicas=1, slots=2, num_pages=9, page_size=4, max_len=24,
+        policy="cache_aware", prefix=True, host_pages=6, clock=clock,
+        registry=registry, fleet_sink=fleet_sink,
+        replica_tick_sink=tick_sink,
+        autoscale=Autoscaler(parse_autoscale(
+            "min=1,max=3,high=2,low=0.2,up=2,down=40,cooldown=0.02")))
+    res = fleet.run(reqs)
+    s = res.summary()
+    emit("blame", **blame.summary_fields("fleet"))
+    registry.set("serve.tokens_per_s", s["tokens_per_s"])
+    records.append(validate_record(
+        registry.snapshot(mode="fleet", final=True)))
+    for rec in res.replica_log:
+        emit("replica", **rec)
+    for rec in res.request_records():
+        emit("request", **rec)
+    emit("serve", bench="fleet", policy="cache_aware", autoscale=True,
+         redispatch="resume", spec="off", replicas_initial=1,
+         rate=300.0, slots=2, page_size=4, pages=9, compute="sim",
+         prefix_cache=True, host_pages=6, **s)
+    print(f"fleet: statuses={s['statuses']} "
+          f"route_hits={s['route_hits']}/{s['route_hits'] + s['route_misses']} "
+          f"ups={s['scale_ups']} downs={s['scale_downs']} "
+          f"replica_ticks={s['replica_ticks']}")
+    return records
+
+
 def build_autosize() -> int:
     """Run a tiny-but-real `mctpu autosize` sweep (jax-free SimCompute
     storms) into tests/data/sample_autosize_run.jsonl — the `goodput`
@@ -194,11 +268,15 @@ def main() -> int:
     from mpi_cuda_cnn_tpu.obs.report import report_main
     from mpi_cuda_cnn_tpu.obs.schema import dump_records
     from mpi_cuda_cnn_tpu.obs.timeline import trace_main
+    from mpi_cuda_cnn_tpu.obs.top import top_main
 
     DATA.mkdir(parents=True, exist_ok=True)
     run = DATA / "sample_serve_run.jsonl"
     dump_records(build_records(), run)
     print(f"wrote {run}")
+    fleet_run = DATA / "sample_fleet_run.jsonl"
+    dump_records(build_fleet(), fleet_run)
+    print(f"wrote {fleet_run}")
     slo = DATA / "sample_slo.json"
     slo.write_text(json.dumps(SAMPLE_SLO, indent=2) + "\n")
     print(f"wrote {slo}")
@@ -232,6 +310,19 @@ def main() -> int:
         # report renders for an `mctpu autosize` sweep's record file.
         ("golden_serve_autosize.md", report_main,
          [str(autosize_run.relative_to(REPO))], 0),
+        # ISSUE 18: the fleet sample's routing/autoscale surfaces —
+        # report's routing + autoscale tables, top's ROUTER/SCALE
+        # panel, trace's routed lifecycle markers.
+        ("golden_fleet_report.md", report_main,
+         [str(fleet_run.relative_to(REPO))], 0),
+        ("golden_fleet_top.md", top_main,
+         [str(fleet_run.relative_to(REPO)), "--once"], 0),
+        ("golden_fleet_trace.md", trace_main,
+         [str(fleet_run.relative_to(REPO)), "--width", "80"], 0),
+        # The routed lifecycle marker only renders in the per-request
+        # detail view — rid 3 is cache-aware routed (8 matched tokens).
+        ("golden_fleet_trace_detail.md", trace_main,
+         [str(fleet_run.relative_to(REPO)), "--request", "3"], 0),
     ):
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):

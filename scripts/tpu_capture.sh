@@ -216,6 +216,35 @@ step bench_fleet_disagg_unified_twin 900 python scripts/bench_fleet.py \
 step autosize_frontier 900 python -m mpi_cuda_cnn_tpu autosize \
     --budget 4 --requests 20000 --rate 2000 --slots 8 --seed 0 \
     --len-dist both --format json
+# ISSUE 18 (cache-aware routing): the engine-backed routed-vs-hash
+# pair on real chips — identical multi-turn session workload with
+# cross-session template shares, once dispatched by prefix/route-key
+# overlap and once by session rendezvous hash. The CPU rows prove the
+# hit-token win and bitwise output parity; the chip pair banks what a
+# routed hit token is WORTH in device prefill seconds (skipped chunks
+# are real FLOPs here, not sim ticks) for PERF.md's ISSUE 18 table.
+step bench_fleet_routed 900 python scripts/bench_fleet.py \
+    --compute engine --replicas 2 --requests 48 --rate 200 \
+    --policy cache_aware --prefix-cache --prefix-mix 0.5 \
+    --sessions 8 --turns-dist uniform:2-3 --turn-gap-ms 20 \
+    --log summary
+step bench_fleet_routed_hash_twin 900 python scripts/bench_fleet.py \
+    --compute engine --replicas 2 --requests 48 --rate 200 \
+    --policy session --prefix-cache --prefix-mix 0.5 \
+    --sessions 8 --turns-dist uniform:2-3 --turn-gap-ms 20 \
+    --log summary
+# ISSUE 18 (online autoscaler): the routed fleet breathing with a
+# diurnal wave on real chips — scale decisions (join/drain) pay
+# device init/teardown here, so this banks the true cost of a scale
+# event next to the CPU rows' tick arithmetic. replica_ticks vs the
+# static twin above is the capacity actually burned.
+step bench_fleet_autoscale 900 python scripts/bench_fleet.py \
+    --compute engine --replicas 1 --requests 48 --rate 200 \
+    --policy cache_aware --prefix-cache --prefix-mix 0.5 \
+    --sessions 8 --turns-dist uniform:2-3 --turn-gap-ms 20 \
+    --diurnal-amp 0.8 --diurnal-period 2 \
+    --autoscale 'min=1,max=2,high=2,low=0.5,up=2,down=20,cooldown=0.01' \
+    --log summary
 # PR-5 (elasticity): the width-invariant canonical-tree step on a real
 # chip mesh — banks the elastic-vs-plain step-time ratio for PERF.md
 # (CPU-banked 2x at the reference config; TPU fusion/collective costs

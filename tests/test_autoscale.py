@@ -1,0 +1,344 @@
+"""Online goodput autoscaler (serve/autoscale.py + Fleet wiring,
+ISSUE 18): the control plane must SIZE ITSELF — fold live queue
+pressure, SLO burn rate, and the committed autosize frontier into
+replica join/leave decisions — deterministically (two identical-seed
+storms produce bitwise-equal scale-event logs) and profitably (the
+autoscaled fleet attains the SLO gate while spending strictly fewer
+cumulative replica-ticks than the static fleet sized for peak).
+
+Same determinism discipline as test_fleet.py: Fleet.run mutates
+Request objects, so every comparison run regenerates its workload."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from mpi_cuda_cnn_tpu.obs.health import health_main
+from mpi_cuda_cnn_tpu.obs.replay import replay_main
+from mpi_cuda_cnn_tpu.obs.slo import Objective, SLOSpec
+from mpi_cuda_cnn_tpu.serve.autoscale import (
+    AutoscalePolicy,
+    Autoscaler,
+    load_frontier,
+    parse_autoscale,
+)
+from mpi_cuda_cnn_tpu.serve.bench import fleet_bench_main
+from mpi_cuda_cnn_tpu.serve.fleet import (
+    Fleet,
+    SimCompute,
+    make_fleet_workload,
+)
+
+VOCAB = 512
+
+
+def diurnal_workload(n=1500, rate=300.0, seed=5):
+    """The workload the autoscaler exists for: multi-turn session
+    chains arriving on a diurnal wave — crests need capacity the
+    troughs would waste."""
+    return make_fleet_workload(
+        n=n, vocab=VOCAB, prompt_min=8, prompt_max=48, out_min=4,
+        out_max=32, rate=rate, seed=seed, sessions=50, prefix_mix=0.5,
+        templates=4, turns_dist="geometric:0.5", turn_gap_s=0.02,
+        diurnal_amp=0.8, diurnal_period_s=2.0)
+
+
+def build_fleet(*, replicas, autoscale=None, seed=5):
+    return Fleet(
+        lambda name: SimCompute(vocab=VOCAB, chunk=16, salt=seed),
+        replicas=replicas, slots=4, num_pages=33, page_size=8,
+        max_len=96, check_every=8, policy="cache_aware", prefix=True,
+        autoscale=autoscale,
+    )
+
+
+POLICY = parse_autoscale("min=1,max=4,high=3,low=1.5,up=3,down=50,"
+                         "cooldown=0.01")
+
+
+# ------------------------------------------------- the policy grammar
+
+
+def test_parse_autoscale_grammar():
+    assert parse_autoscale("on") == AutoscalePolicy()
+    assert parse_autoscale("") == AutoscalePolicy()
+    pol = parse_autoscale("min=2,max=6,high=5.5,low=0.5,up=4,down=80,"
+                          "cooldown=0.2,burn=10")
+    assert (pol.min_replicas, pol.max_replicas) == (2, 6)
+    assert (pol.high, pol.low) == (5.5, 0.5)
+    assert (pol.up_ticks, pol.down_ticks) == (4, 80)
+    assert (pol.cooldown_s, pol.max_burn) == (0.2, 10.0)
+    for bad in ("nope=1", "min", "min=x", "min=3,max=2", "low=5,high=2",
+                "up=0", "down=0", "cooldown=-1", "min=0"):
+        with pytest.raises(ValueError):
+            parse_autoscale(bad)
+
+
+def test_load_frontier_reads_last_sweep_and_errors(tmp_path):
+    p = tmp_path / "frontier.jsonl"
+    p.write_text(
+        json.dumps({"event": "goodput", "kind": "frontier",
+                    "best_per_chip_rps": 12.5}) + "\n"
+        + json.dumps({"event": "goodput", "kind": "frontier",
+                      "best_per_chip_rps": 20.0}) + "\n")
+    assert load_frontier(p) == 20.0
+    (tmp_path / "empty.jsonl").write_text(
+        json.dumps({"event": "goodput", "kind": "row"}) + "\n")
+    with pytest.raises(ValueError, match="frontier"):
+        load_frontier(tmp_path / "empty.jsonl")
+
+
+# --------------------------------------------- the decision mechanics
+
+
+def test_hysteresis_streaks_and_cooldown():
+    """Hot pressure must HOLD for up_ticks consecutive consults before
+    a scale-out; a single calm tick resets the streak; an applied
+    decision opens a cooldown that eats would-be decisions."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, high=4.0,
+                          low=1.0, up_ticks=3, down_ticks=3,
+                          cooldown_s=0.5)
+    a = Autoscaler(pol)
+    t = 0.0
+
+    def step(load, live=1):
+        nonlocal t
+        t += 0.001
+        return a.step(now=t, live=live, load=load, dispatched=0)
+
+    assert step(10.0) is None          # streak 1
+    assert step(10.0) is None          # streak 2
+    assert step(0.0) is None           # calm: streak resets
+    assert step(10.0) is None
+    assert step(10.0) is None
+    assert step(10.0) == "up"          # 3 consecutive hot ticks
+    for _ in range(20):                # cooldown swallows everything
+        assert step(10.0) is None
+    # Between the thresholds: left alone even after the cooldown.
+    t += 1.0
+    for _ in range(10):
+        assert step(2.0, live=1) is None
+
+
+def test_bounds_respected_and_down_needs_long_calm():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2, high=4.0,
+                          low=1.0, up_ticks=1, down_ticks=5,
+                          cooldown_s=0.0)
+    a = Autoscaler(pol)
+    assert a.step(now=0.001, live=2, load=100.0, dispatched=0) is None, \
+        "already at max_replicas: no up"
+    b = Autoscaler(pol)
+    for i in range(4):
+        assert b.step(now=0.001 * (i + 1), live=2, load=0.0,
+                      dispatched=0) is None
+    assert b.step(now=0.005, live=2, load=0.0, dispatched=0) == "down"
+    c = Autoscaler(pol)
+    for i in range(10):
+        assert c.step(now=0.001 * (i + 1), live=1, load=0.0,
+                      dispatched=0) is None, "already at min: no down"
+
+
+def test_flip_reversals_back_off_exponentially():
+    """Consecutive direction reversals are backoff_delay's attempt
+    counter: an oscillating policy spaces its own decisions out
+    instead of thrashing membership."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=8, high=4.0,
+                          low=1.0, up_ticks=1, down_ticks=1,
+                          cooldown_s=0.1)
+    a = Autoscaler(pol)
+    t, gaps, last = 0.0, [], None
+    for _ in range(4):
+        # Alternate hot and calm until the next decision lands.
+        want = "down" if last == "up" else "up"
+        load = 0.0 if want == "down" else 100.0
+        live = 4
+        while True:
+            t += 0.01
+            d = a.step(now=t, live=live, load=load, dispatched=0)
+            if d is not None:
+                assert d == want
+                if last is not None:
+                    gaps.append(t)
+                last = d
+                break
+    deltas = [b - x for x, b in zip(gaps, gaps[1:])]
+    assert all(b > x * 1.5 for x, b in zip(deltas, deltas[1:])), \
+        f"cooldown must grow with each reversal, got {deltas}"
+
+
+def test_burn_latch_forces_up_pressure_with_shallow_queues():
+    """A tenant burning error budget past max_burn across EVERY window
+    (the multiwindow AND) trips up-pressure even while the queues look
+    calm — latency SLOs degrade before backlogs form."""
+    spec = SLOSpec(tenants={"*": [Objective("availability", 0.9)]},
+                   windows=[[2.0, 0.5]])
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, high=100.0,
+                          low=0.0, up_ticks=2, down_ticks=10,
+                          cooldown_s=0.0, max_burn=2.0)
+    a = Autoscaler(pol, slo_spec=spec)
+    t = 0.0
+    decisions = []
+    for _ in range(6):
+        t += 0.1
+        a.observe_terminal({"tenant": "t0", "status": "expired"}, t)
+        decisions.append(a.step(now=t, live=1, load=0.0, dispatched=0))
+    assert "up" in decisions
+    # Without the burn feed, the same consults stay quiet.
+    b = Autoscaler(pol)
+    t = 0.0
+    for _ in range(6):
+        t += 0.1
+        assert b.step(now=t, live=1, load=0.0, dispatched=0) is None
+
+
+def test_frontier_target_adds_up_pressure_and_gates_scale_in():
+    """per_chip_rps converts the observed dispatch rate into a target:
+    live below it forces up-pressure with calm queues; live above it
+    is what ALLOWS calm-queue scale-in."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=8, high=100.0,
+                          low=10.0, up_ticks=2, down_ticks=2,
+                          cooldown_s=0.0)
+    a = Autoscaler(pol, per_chip_rps=10.0, rate_window_s=1.0)
+    # 100 dispatches over 1s => rate ~100 req/s => target 8.
+    t, d, decisions = 0.0, 0, []
+    for _ in range(10):
+        t += 0.1
+        d += 10
+        decisions.append(a.step(now=t, live=2, load=0.0, dispatched=d))
+    assert "up" in decisions, "live 2 < target 8 must scale out"
+    # Calm queues (load < low) but live <= target: scale-in is gated.
+    b = Autoscaler(pol, per_chip_rps=10.0, rate_window_s=1.0)
+    t, d = 0.0, 0
+    for _ in range(10):
+        t += 0.1
+        d += 10
+        assert b.step(now=t, live=8, load=0.0, dispatched=d) is None
+
+
+# ------------------------------------------ the fleet-level acceptance
+
+
+def test_autoscaled_fleet_beats_static_peak_on_replica_ticks():
+    """THE capacity claim: on the identical diurnal storm, the
+    autoscaled fleet finishes every request, breathes in BOTH
+    directions, and spends strictly fewer cumulative replica-ticks
+    than the static fleet sized for the peak — while producing the
+    same per-request outputs (capacity changes schedule, not
+    tokens)."""
+    auto = build_fleet(replicas=1,
+                       autoscale=Autoscaler(POLICY)).run(diurnal_workload())
+    static = build_fleet(replicas=4).run(diurnal_workload())
+    assert auto.status_counts() == static.status_counts()
+    assert set(auto.status_counts()) == {"finished"}
+    assert auto.scale_ups > 0
+    assert auto.scale_downs > 0
+    assert auto.replica_ticks < static.replica_ticks, (
+        auto.replica_ticks, static.replica_ticks)
+    assert static.scale_ups == static.scale_downs == 0
+    assert static.scale_crc == 0
+    assert auto.outputs() == static.outputs()
+
+
+def test_autoscale_bitwise_deterministic():
+    """Two identical-seed autoscaled storms are bitwise equal: the
+    dispatch trace, the per-tick state-digest chain, AND the
+    scale-event chain (scale_crc chains every (tick, direction,
+    replica) in order). The CI diurnal storm re-proves this at 4x10^4
+    requests through ci/autoscale_gate.json."""
+    a = build_fleet(replicas=1,
+                    autoscale=Autoscaler(POLICY)).run(diurnal_workload())
+    b = build_fleet(replicas=1,
+                    autoscale=Autoscaler(POLICY)).run(diurnal_workload())
+    assert a.scale_ups == b.scale_ups and a.scale_downs == b.scale_downs
+    assert a.scale_crc == b.scale_crc
+    assert a.trace_crc == b.trace_crc
+    assert a.state_crc == b.state_crc
+    assert a.outputs() == b.outputs()
+
+
+def test_summary_stamps_scale_counters_on_every_run():
+    """The gate contract: every gated counter exists (zeros) in every
+    fleet run — an autoscale-off, hash-routed run still stamps all
+    seven ISSUE 18 keys, so ci/fleet_gate.json holds universally."""
+    res = Fleet(lambda name: SimCompute(vocab=VOCAB, chunk=16, salt=0),
+                replicas=2, slots=4, num_pages=33, page_size=8,
+                max_len=96).run(make_fleet_workload(
+                    n=40, vocab=VOCAB, prompt_min=8, prompt_max=48,
+                    out_min=4, out_max=16, rate=400.0, seed=0))
+    s = res.summary()
+    for key in ("route_hits", "route_misses", "route_hit_tokens",
+                "scale_ups", "scale_downs", "scale_crc"):
+        assert s[key] == 0, key
+    assert s["replica_ticks"] > 0, \
+        "a static fleet spends replica-ticks too"
+
+
+# -------------------------------- CLI end-to-end: SLO gate + replay
+
+
+LENIENT_SLO = {
+    "tenants": {"*": {
+        "availability": 0.999,
+        "ttft_ms": {"target": 0.99, "threshold_ms": 120000},
+        "tpot_ms": {"target": 0.99, "threshold_ms": 1000},
+    }},
+    "burn": {"windows_s": [[10.0, 1.0]], "max_rate": 50.0},
+    "max_alerts": 0,
+}
+
+
+def test_cli_autoscaled_run_health_ok_and_replays_bitwise(tmp_path):
+    """The full acceptance path through the CLI: a diurnal autoscaled
+    cache-aware storm at --log full meets the SLO gate (`mctpu health`
+    exit 0) and survives the flight recorder (`mctpu replay` exit 0 —
+    every per-tick digest recomputes bitwise even though membership is
+    breathing under the autoscaler, because scale decisions act only
+    through the mirrored join/leave events)."""
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps(LENIENT_SLO))
+    out = tmp_path / "run.jsonl"
+    rc = fleet_bench_main([
+        "--replicas", "1", "--requests", "800", "--rate", "300",
+        "--slots", "4", "--seed", "5", "--policy", "cache_aware",
+        "--prefix-cache", "--prefix-mix", "0.5", "--templates", "4",
+        "--sessions", "50", "--turns-dist", "geometric:0.5",
+        "--turn-gap-ms", "20", "--diurnal-amp", "0.8",
+        "--diurnal-period", "2",
+        "--autoscale", "min=1,max=4,high=3,low=1.5,up=3,down=50,"
+        "cooldown=0.01",
+        "--slo", str(slo), "--log", "full",
+        "--metrics-jsonl", str(out),
+    ])
+    assert rc == 0
+    summary = [json.loads(line) for line in out.read_text().splitlines()
+               if '"event": "serve"' in line]
+    assert len(summary) == 1 and summary[0]["autoscale"] is True
+    assert summary[0]["scale_ups"] > 0
+    assert summary[0]["route_hits"] > 0
+    assert health_main([str(out), "--slo", str(slo)]) == 0
+    assert replay_main([str(out)]) == 0
+
+
+def test_cli_frontier_feeds_the_autoscaler(tmp_path):
+    """--autoscale-frontier threads a committed autosize sweep's
+    best_per_chip_rps into the policy (exit 0, autoscaled summary);
+    a frontier file without the record is a loud config error."""
+    frontier = tmp_path / "frontier.jsonl"
+    frontier.write_text(json.dumps(
+        {"event": "goodput", "kind": "frontier",
+         "best_per_chip_rps": 200.0}) + "\n")
+    out = tmp_path / "run.jsonl"
+    rc = fleet_bench_main([
+        "--replicas", "1", "--requests", "200", "--rate", "300",
+        "--slots", "4", "--seed", "5",
+        "--autoscale", "on", "--autoscale-frontier", str(frontier),
+        "--log", "summary", "--metrics-jsonl", str(out),
+    ])
+    assert rc == 0
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"event": "goodput", "kind": "row"}) + "\n")
+    assert fleet_bench_main([
+        "--replicas", "1", "--requests", "8",
+        "--autoscale", "on", "--autoscale-frontier", str(bad),
+    ]) == 2

@@ -1,0 +1,383 @@
+"""Cache-aware fleet routing (serve/router.py cache_aware policy +
+the route-key digests serve/prefix_cache.py / serve/host_tier.py
+maintain, ISSUE 18): the router must EARN the prefill win — score
+candidates by expected prefix overlap from each replica's host-side
+key set — without moving a single token of any output, through
+crash/failover and host-tier spill.
+
+Also here: the seeded multi-turn session workload family (turn N+1
+re-arrives carrying turn N's context) and the diurnal arrival warp,
+with CRC pins proving their [seed,5] RNG stream and the warp's amp=0
+identity leave every existing seeded workload bitwise unchanged, and
+the byte-for-byte golden round-trips of the fleet sample's routing/
+autoscale observability surfaces.
+
+The determinism discipline from test_fleet.py applies throughout:
+Fleet.run MUTATES Request objects, so every comparison run gets a
+freshly generated workload — never a shared list."""
+
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
+from mpi_cuda_cnn_tpu.serve.bench import (
+    add_session_turns,
+    diurnal_warp,
+    fleet_bench_main,
+    parse_turns_dist,
+)
+from mpi_cuda_cnn_tpu.serve.fleet import (
+    Fleet,
+    SimCompute,
+    make_fleet_workload,
+)
+from mpi_cuda_cnn_tpu.serve.router import Router
+
+REPO = Path(__file__).resolve().parent.parent
+DATA = REPO / "tests" / "data"
+VOCAB = 512
+
+CRASH_PLAN = ("replica_crash@fleet.tick:40?replica=1&zombie_ticks=4;"
+              "replica_crash@fleet.tick:120?replica=2;"
+              "replica_join@fleet.tick:160")
+
+
+def workload(n=400, rate=600.0, seed=3, **kw):
+    kw.setdefault("vocab", VOCAB)
+    kw.setdefault("prompt_min", 8)
+    kw.setdefault("prompt_max", 48)
+    kw.setdefault("out_min", 4)
+    kw.setdefault("out_max", 32)
+    kw.setdefault("sessions", 8)
+    kw.setdefault("prefix_mix", 0.5)
+    kw.setdefault("templates", 4)
+    kw.setdefault("turns_dist", "uniform:2-3")
+    kw.setdefault("turn_gap_s", 0.02)
+    return make_fleet_workload(n=n, rate=rate, seed=seed, **kw)
+
+
+def sim_fleet(*, policy, plan=None, seed=3, host_pages=0, **kw):
+    kw.setdefault("replicas", 3)
+    kw.setdefault("slots", 4)
+    kw.setdefault("num_pages", 33)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("check_every", 8)
+    return Fleet(
+        lambda name: SimCompute(vocab=VOCAB, chunk=16, salt=seed),
+        policy=policy,
+        prefix=True,
+        host_pages=host_pages,
+        faults=FaultInjector(plan) if plan else None,
+        **kw,
+    )
+
+
+# --------------------------------------- the routed-prefill acceptance
+
+
+def test_cache_aware_beats_hash_affinity_same_outputs():
+    """The tentpole claim, tier-1 sized: on the IDENTICAL seeded
+    multi-turn session storm, cache-aware routing yields strictly more
+    fleet-wide prefix hit tokens than rendezvous-hash session affinity
+    AND strictly more than least-loaded, while every per-request
+    output stays bitwise identical across all three policies —
+    routing decides WHERE prefill work happens, never WHAT any replica
+    generates (the CI diurnal storm re-proves determinism at 4x10^4
+    requests through ci/autoscale_gate.json)."""
+    results = {}
+    for policy in ("cache_aware", "session", "least_loaded"):
+        res = sim_fleet(policy=policy).run(workload())
+        assert all(r.terminal for r in res.requests)
+        results[policy] = res
+    cache, sess, ll = (results["cache_aware"], results["session"],
+                       results["least_loaded"])
+    # The routed counters only exist under cache_aware...
+    assert cache.route_hits > 0
+    assert cache.route_hit_tokens > 0
+    assert sess.route_hits == sess.route_hit_tokens == 0
+    # ...and the promised overlap is real: strictly more prefix hit
+    # tokens than either fallback policy on the same requests.
+    hit_tokens = {p: r.summary()["prefix_hit_tokens"]
+                  for p, r in results.items()}
+    assert hit_tokens["cache_aware"] > hit_tokens["session"], hit_tokens
+    assert hit_tokens["cache_aware"] > hit_tokens["least_loaded"], \
+        hit_tokens
+    # Output parity: bitwise-equal tokens for every request.
+    assert cache.outputs() == sess.outputs() == ll.outputs()
+    assert cache.status_counts() == sess.status_counts()
+
+
+def test_cache_aware_parity_through_crash_and_spill():
+    """Routing-on vs routing-off output parity under the hard
+    composition: two injected crashes (one a partitioned zombie), an
+    elastic join, and a bounded host tier spilling/readmitting prefix
+    pages under page pressure. The route-key digest shrinks and grows
+    through all of it (evictions discard, spills re-register on the
+    tier's side) and not one output token moves."""
+    cache = sim_fleet(policy="cache_aware", plan=CRASH_PLAN,
+                      host_pages=16).run(workload())
+    plain = sim_fleet(policy="least_loaded", plan=CRASH_PLAN,
+                      host_pages=16).run(workload())
+    assert cache.crashes == plain.crashes == 2
+    assert cache.summary()["tier_spills"] > 0
+    assert cache.route_hits > 0
+    assert cache.outputs() == plain.outputs()
+    assert cache.status_counts() == plain.status_counts()
+
+
+def test_cache_aware_bitwise_deterministic():
+    """Two identical-seed routed runs are bitwise equal in dispatch
+    trace, state digest chain, and routed-hit accounting (workloads
+    regenerated per run — Fleet.run mutates requests)."""
+    a = sim_fleet(policy="cache_aware", host_pages=16).run(workload())
+    b = sim_fleet(policy="cache_aware", host_pages=16).run(workload())
+    assert a.trace_crc == b.trace_crc
+    assert a.state_crc == b.state_crc
+    assert (a.route_hits, a.route_misses, a.route_hit_tokens) == \
+        (b.route_hits, b.route_misses, b.route_hit_tokens)
+    assert a.outputs() == b.outputs()
+
+
+def test_route_keys_mirror_tree_and_tier_exactly():
+    """The digest invariant: after a spill-heavy routed run, every
+    live replica's route_keys is EXACTLY the set of its device-tree
+    node paths plus its host-tier keys — not one key leaked by an
+    evict, not one dropped by a readmit."""
+    fleet = sim_fleet(policy="cache_aware", host_pages=16)
+    res = fleet.run(workload())
+    assert res.summary()["tier_spills"] > 0
+    checked = 0
+    for m in fleet.router.members.values():
+        core = m.replica.core
+        want = set(core.tier._entries) if core.tier is not None else set()
+        stack = list(core.prefix.root_children.values())
+        while stack:
+            node = stack.pop()
+            want.add(node.path)
+            stack.extend(node.children.values())
+        assert m.replica.route_keys == want, m.replica.name
+        checked += 1
+    assert checked >= 1
+
+
+def test_router_overlap_walk_stops_at_first_miss():
+    """_overlap walks cumulative chunk keys in depth order and stops
+    at the first miss (the tree is prefix-closed): a replica holding
+    depth-2 but missing depth-1 scores zero, not one."""
+
+    class Rep:
+        def __init__(self, keys):
+            self.name = "r0"
+            self.route_keys = keys
+
+    router = Router("cache_aware", page_size=4)
+    req = type("R", (), {"prompt": np.arange(12, dtype=np.int32)})()
+    keys = router._chunk_keys(req)
+    assert len(keys) == 3
+    r = Router("cache_aware", page_size=4)
+    m = type("M", (), {"replica": Rep(set(keys))})()
+    assert r._overlap(m, keys) == 12
+    # Drop the SHALLOWEST key: deeper survivors must not count.
+    m.replica.route_keys = set(keys[1:])
+    assert r._overlap(m, keys) == 0
+    # Hold only the shallowest: exactly one chunk's worth.
+    m.replica.route_keys = {keys[0]}
+    assert r._overlap(m, keys) == 4
+
+
+def test_cache_aware_router_requires_page_size():
+    with pytest.raises(ValueError, match="page_size"):
+        Router("cache_aware")
+
+
+# ------------------------------ multi-turn sessions + diurnal arrivals
+
+
+def test_default_workload_crcs_are_pinned():
+    """THE stream-isolation pin: session turns draw from spawned
+    stream [seed,5] and the diurnal warp is draw-free, so every
+    workload that does not opt in is BITWISE what the previous PR
+    generated. The constants below were computed against the pre-PR
+    tree — if either moves, a new feature leaked draws into an
+    existing stream."""
+
+    def crc(reqs):
+        h = 0
+        for r in reqs:
+            h = zlib.crc32(
+                repr((r.rid, round(r.arrival, 12), r.max_new_tokens,
+                      r.session, r.tenant, r.deadline,
+                      np.asarray(r.prompt, np.int32).tobytes())).encode(),
+                h)
+        return h
+
+    plain = make_fleet_workload(
+        n=500, vocab=512, prompt_min=8, prompt_max=48, out_min=4,
+        out_max=32, rate=800.0, seed=0)
+    assert crc(plain) == 2719747999
+    rich = make_fleet_workload(
+        n=400, vocab=512, prompt_min=8, prompt_max=48, out_min=4,
+        out_max=32, rate=600.0, seed=3, sessions=8, tenants=4,
+        prefix_mix=0.5, templates=4, len_dist="lognormal")
+    assert crc(rich) == 3209773015
+
+
+def test_session_turns_extend_context_and_stay_sorted():
+    """Structure of the turn chains: every follow-up turn keeps its
+    anchor's session and tenant, its prompt EXTENDS the previous
+    turn's full context (prompt + drawn continuation) as a strict
+    prefix-preserving concatenation, it arrives strictly after the
+    turn it continues, rids stay dense, and the stream is re-sorted
+    by (arrival, rid)."""
+    base = workload(turns_dist=None, turn_gap_s=0.0)
+    turned = workload()
+    assert len(turned) > len(base)
+    assert [r.rid for r in sorted(turned, key=lambda r: r.rid)] == \
+        list(range(len(turned)))
+    arrivals = [(r.arrival, r.rid) for r in turned]
+    assert arrivals == sorted(arrivals)
+    # Chain reconstruction: a session's FIRST base request anchors the
+    # conversation; the generated turns (rid >= len(base)) continue it
+    # in rid order. Other base requests of the same session are just
+    # independent arrivals — not part of the chain.
+    chains = {}
+    for r in sorted(turned, key=lambda r: r.rid):
+        if r.rid < len(base):
+            chains.setdefault(r.session, [r])
+            continue
+        prev = chains[r.session][-1]
+        assert r.tenant == prev.tenant
+        assert r.arrival > prev.arrival
+        prev_toks = np.asarray(prev.prompt, np.int32)
+        toks = np.asarray(r.prompt, np.int32)
+        assert toks.size > prev_toks.size
+        assert np.array_equal(toks[:prev_toks.size], prev_toks)
+        chains[r.session].append(r)
+    assert any(len(c) > 1 for c in chains.values())
+
+
+def test_turns_need_sessions_and_bitwise_repeatable():
+    with pytest.raises(ValueError, match="sessions"):
+        make_fleet_workload(n=10, vocab=64, prompt_min=4, prompt_max=8,
+                            out_min=2, out_max=4, rate=100.0, seed=0,
+                            turns_dist="uniform:2-3")
+    a, b = workload(), workload()
+    assert [(r.rid, r.arrival, r.session,
+             np.asarray(r.prompt).tobytes()) for r in a] == \
+        [(r.rid, r.arrival, r.session,
+          np.asarray(r.prompt).tobytes()) for r in b]
+
+
+def test_parse_turns_dist_grammar():
+    lo, hi = 2, 5
+    draw = parse_turns_dist(f"uniform:{lo}-{hi}")
+    rng = np.random.default_rng(0)
+    vals = {int(draw(rng)) for _ in range(200)}
+    assert vals == set(range(lo, hi + 1))
+    draw = parse_turns_dist("geometric:0.5")
+    rng = np.random.default_rng(0)
+    assert all(int(draw(rng)) >= 1 for _ in range(50))
+    for bad in ("uniform:5-2", "uniform:x-3", "geometric:0",
+                "geometric:1.5", "zipf:2", "uniform", ""):
+        with pytest.raises(ValueError):
+            parse_turns_dist(bad)
+
+
+def test_diurnal_warp_identity_monotone_and_deadline_preserving():
+    """amp=0 is the bitwise identity; amp>0 keeps the arrival order
+    monotone (the warp solves a monotone fixed point), preserves every
+    request's RELATIVE deadline offset, and changes no prompt."""
+    base = workload(turns_dist=None, deadline_s=0.5)
+    ident = diurnal_warp(workload(turns_dist=None, deadline_s=0.5),
+                         amp=0.0, period_s=10.0)
+    assert [(r.arrival, r.deadline) for r in base] == \
+        [(r.arrival, r.deadline) for r in ident]
+    warped = diurnal_warp(workload(turns_dist=None, deadline_s=0.5),
+                          amp=0.8, period_s=0.1)
+    arr = [r.arrival for r in warped]
+    assert arr == sorted(arr)
+    assert any(abs(w.arrival - b.arrival) > 1e-6
+               for w, b in zip(warped, base))
+    for w, b in zip(warped, base):
+        assert w.deadline - w.arrival == pytest.approx(
+            b.deadline - b.arrival)
+        assert np.array_equal(w.prompt, b.prompt)
+
+
+# ---------------------------------------------- loud CLI config errors
+
+
+@pytest.mark.parametrize("argv", [
+    ["--policy", "cache_aware", "--requests", "4"],
+    ["--turns-dist", "uniform:2-3", "--requests", "4"],
+    ["--sessions", "2", "--turns-dist", "zipf:2", "--requests", "4"],
+    ["--sessions", "2", "--turn-gap-ms", "5", "--requests", "4"],
+    ["--diurnal-amp", "0.5", "--rate", "0", "--requests", "4"],
+    ["--diurnal-amp", "1.5", "--requests", "4"],
+    ["--autoscale", "min=3,max=2", "--requests", "4"],
+    ["--autoscale", "nope=1", "--requests", "4"],
+    ["--autoscale-frontier", "missing.jsonl", "--requests", "4"],
+])
+def test_fleet_bench_config_errors_exit_2(argv, capsys):
+    """Misconfiguration is a loud rc-2 `error:` line, never a silent
+    default: cache_aware without the prefix cache, turns without
+    sessions, a turn gap without turns, the diurnal warp without a
+    rate (or with amp > 1, which would fold time), a structurally
+    invalid autoscale spec, and a frontier without the autoscaler to
+    feed."""
+    assert fleet_bench_main(argv) == 2
+    assert "error:" in capsys.readouterr().err
+
+
+# ----------------------------------------------- golden round-trips
+
+
+def test_fleet_sample_golden_report_roundtrip(monkeypatch, capsys):
+    """`mctpu report` on the checked-in routed/autoscaled fleet sample
+    is byte-for-byte the golden — the routing, per-replica routing,
+    and autoscale tables included (regenerate via
+    scripts/make_obs_sample.py)."""
+    from mpi_cuda_cnn_tpu.obs.report import report_main
+
+    monkeypatch.chdir(REPO)
+    assert report_main(["tests/data/sample_fleet_run.jsonl"]) == 0
+    out = capsys.readouterr().out
+    assert out == (DATA / "golden_fleet_report.md").read_text()
+    assert "| routing | cache_aware |" in out.replace("policy", "routing") \
+        or "cache_aware" in out
+    assert "| autoscale |" in out
+
+
+def test_fleet_sample_golden_top_roundtrip(monkeypatch, capsys):
+    """`mctpu top --once` on the fleet sample pins the ROUTER panel
+    (per-replica hit-rate bars) and the SCALE line (replica-count
+    sparkline) byte-for-byte."""
+    from mpi_cuda_cnn_tpu.obs.top import top_main
+
+    monkeypatch.chdir(REPO)
+    assert top_main(["tests/data/sample_fleet_run.jsonl", "--once"]) == 0
+    out = capsys.readouterr().out
+    assert out == (DATA / "golden_fleet_top.md").read_text()
+    assert "ROUTER" in out and "SCALE" in out
+
+
+def test_fleet_sample_golden_trace_roundtrips(monkeypatch, capsys):
+    """`mctpu trace` on the fleet sample: the summary Gantt golden and
+    the per-request detail golden (where the routed lifecycle marker
+    renders) both hold byte-for-byte."""
+    from mpi_cuda_cnn_tpu.obs.timeline import trace_main
+
+    monkeypatch.chdir(REPO)
+    assert trace_main(["tests/data/sample_fleet_run.jsonl",
+                       "--width", "80"]) == 0
+    assert capsys.readouterr().out == \
+        (DATA / "golden_fleet_trace.md").read_text()
+    assert trace_main(["tests/data/sample_fleet_run.jsonl",
+                       "--request", "3"]) == 0
+    out = capsys.readouterr().out
+    assert out == (DATA / "golden_fleet_trace_detail.md").read_text()
+    assert "routed" in out
