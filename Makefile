@@ -6,7 +6,7 @@ PY ?= python
 DATA_DIR ?= data/mnist
 CPU8 := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: bench_decode bench_speculative bench_serve bench_serve_spec bench_serve_hosttier bench_serve_pagedraft bench_fleet autosize serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
+.PHONY: bench_decode bench_speculative bench_serve bench_serve_spec bench_serve_hosttier bench_serve_pagedraft bench_fleet autosize chaos serve-baseline profile_lm profile_moe report health lint test test_all test_serial test_dp8 test_sp8 test_ep8 test_4d8 test_4d16 test_lm_tpu test_tpu bench bench_configs bench_configs_cpu8 bench_lm northstar northstar_digits native test_native test_native_tpu get_mnist get_cifar10 get_fashion clean
 
 # Native C driver (CPU numerical reference + embedded-JAX TPU path).
 native:
@@ -183,6 +183,22 @@ bench_fleet:
 autosize:
 	$(PY) -m mpi_cuda_cnn_tpu autosize --budget 4 --requests 2000 \
 	  --rate 300 --len-dist both $(if $(SEED_FROM),--seed-from $(SEED_FROM))
+
+# Seeded fault-schedule search (ISSUE 19, chaos/): N sampled
+# (axes, plan) episodes, each a small fleet storm under a multi-fault
+# plan drawn from faults.SITES, held to the global invariant oracle
+# (exactly-once terminals with closed-form outputs, blame
+# conservation, clean pools at exit, zero-drift replay, same-seed
+# bitwise). On a violation the plan is ddmin-shrunk to a one-line
+# `--plan` repro and the minimal episode's twin trails land in
+# chaos_out/ pre-wired for `mctpu diverge`. CI runs the seed-7
+# 50-episode sweep twice under ci/chaos_gate.json; vary locally with
+#   make chaos EPISODES=200 SEED=3
+EPISODES ?= 50
+SEED ?= 7
+chaos:
+	$(PY) -m mpi_cuda_cnn_tpu chaos --episodes $(EPISODES) \
+	  --seed $(SEED) --out-dir chaos_out
 
 # Regenerate the committed CI serving baseline (ci/serve_baseline.jsonl)
 # with the pinned arguments CI's candidate run uses — refresh after a

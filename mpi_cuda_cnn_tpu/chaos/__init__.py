@@ -1,0 +1,30 @@
+"""mctpu chaos: seeded fault-schedule search over the fleet storm.
+
+Three jax-free pieces (ISSUE 19): a registry-driven plan sampler
+(`sampler`), a deterministic episode harness with a global invariant
+oracle (`episode`), and a ddmin plan minimizer (`shrink`). The
+`mctpu chaos` CLI (`cli.chaos_main`) drives all three.
+"""
+
+from .episode import EpisodeConfig, EpisodeResult, config_for, run_episode
+from .sampler import (
+    RAISING_KINDS,
+    SURFACE,
+    EpisodeAxes,
+    sample_axes,
+    sample_plan,
+)
+from .shrink import shrink
+
+__all__ = [
+    "RAISING_KINDS",
+    "SURFACE",
+    "EpisodeAxes",
+    "EpisodeConfig",
+    "EpisodeResult",
+    "config_for",
+    "run_episode",
+    "sample_axes",
+    "sample_plan",
+    "shrink",
+]

@@ -20,6 +20,7 @@ driver (native/) offers the same surface for the north star's
     python -m mpi_cuda_cnn_tpu lint --format json              # invariant lint
     python -m mpi_cuda_cnn_tpu replay run.jsonl --at-tick 40   # state replay
     python -m mpi_cuda_cnn_tpu diverge a.jsonl b.jsonl         # 1st divergence
+    python -m mpi_cuda_cnn_tpu chaos --episodes 50             # fault search
 """
 
 from __future__ import annotations
@@ -301,6 +302,17 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.autosize import autosize_main
 
         return autosize_main(argv[1:])
+    if argv and argv[0] == "chaos":
+        # Seeded fault-schedule search: sample multi-fault plans from
+        # the live faults.SITES registry, run each through the fleet
+        # storm under a global invariant oracle (terminal-exactly-once,
+        # closed-form outputs, blame conservation, pool/tier clean
+        # exit, zero-drift replay, bitwise re-run), ddmin-shrink any
+        # violation to a one-line --fault-plan repro (chaos/, ISSUE 19)
+        # — jax-free.
+        from .chaos.cli import chaos_main
+
+        return chaos_main(argv[1:])
     if argv and argv[0] == "health":
         # SLO health gate: per-tenant verdict table + alert replay for
         # a finished run, exit 1 on violation (obs.health, ISSUE 8) —

@@ -256,6 +256,27 @@ def parse_plan(spec: str) -> list[Fault]:
     return faults
 
 
+def format_fault(f: Fault) -> str:
+    """One fault back in the ``kind@site:at?k=v&k=v`` grammar — the
+    inverse of one parse_plan fragment. Args render in sorted key order
+    so equal Faults always spell identically (the chaos sampler's
+    one-line repro contract, ISSUE 19); values must survive
+    _parse_args' int->float->str ladder, which every int/float/plain
+    string does (a value containing ';', '&' or '=' would not — no
+    registered fault kind takes one)."""
+    head = f"{f.kind}@{f.site}:{f.at}"
+    if not f.args:
+        return head
+    return head + "?" + "&".join(f"{k}={f.args[k]}" for k in sorted(f.args))
+
+
+def format_plan(plan: list[Fault]) -> str:
+    """A whole plan as the ';'-joined --fault-plan string: the exact
+    round-trip twin of parse_plan (parse_plan(format_plan(p)) == p), so
+    any sampled chaos schedule is a copy-pasteable repro line."""
+    return ";".join(format_fault(f) for f in plan)
+
+
 def _parse_args(argstr: str) -> dict:
     args: dict = {}
     for kv in argstr.split("&"):

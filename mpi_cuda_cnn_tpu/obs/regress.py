@@ -192,6 +192,27 @@ def metrics_from_records(records: list[dict]) -> dict[str, float]:
                     v = _num(v)
                     if v is not None and k not in ("schema", "t"):
                         out[f"goodput.{k}"] = v
+        elif ev == "chaos":
+            # Chaos-search output (ISSUE 19): per-episode records
+            # flatten under their episode ordinal, the run summary
+            # under bare chaos.* — where the CI chaos gate pins
+            # episodes / violations / episodes_crc at exact equality.
+            kind = rec.get("kind")
+            if kind == "episode":
+                ep = rec.get("episode", "?")
+                out[f"chaos.ep{ep}.violations"] = float(
+                    len(rec.get("violations") or []))
+                for k, v in rec.items():
+                    v = _num(v)
+                    if v is not None and k not in ("schema", "t",
+                                                   "episode"):
+                        out[f"chaos.ep{ep}.{k}"] = v
+            elif kind == "summary":
+                out["chaos.failed"] = float(len(rec.get("failed") or []))
+                for k, v in rec.items():
+                    v = _num(v)
+                    if v is not None and k not in ("schema", "t"):
+                        out[f"chaos.{k}"] = v
         elif ev == "train":
             v = _num(rec.get("loss"))
             if v is not None:

@@ -242,6 +242,28 @@ def summarize(records: Iterable[dict], *,
                if frontier else {}),
         }
 
+    chaos = ev.get("chaos", [])
+    if chaos:
+        # Chaos-search output (chaos/, ISSUE 19): one row per sampled
+        # episode (plan spelling, axes, oracle verdict, CRCs), plus the
+        # search summary — and, when the search failed, the minimized
+        # repro plan.
+        csum = next((r for r in reversed(chaos)
+                     if r.get("kind") == "summary"), None)
+        summary["chaos"] = {
+            "rows": [
+                {k: r.get(k) for k in
+                 ("episode", "seed", "axes", "plan", "faults",
+                  "requests", "violations", "replay_ticks",
+                  "episode_crc", "trace_crc", "state_crc", "blame_crc")}
+                for r in chaos if r.get("kind") == "episode"
+            ],
+            **({k: csum.get(k) for k in
+                ("episodes", "violations", "failed", "episodes_crc",
+                 "min_plan", "shrink_probes")
+                if k in csum} if csum else {}),
+        }
+
     alerts = ev.get("alert", [])
     if alerts:
         by_rule: dict[str, int] = {}
@@ -572,6 +594,39 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 f"| {_fmt(az.get('pruned'))} | {_fmt(seeded)} "
                 f"| {_fmt(az.get('frontier_crc'))} "
                 f"| {_fmt(az.get('recommendation_crc'))} |",
+                "",
+            ]
+    if "chaos" in summary:
+        # Chaos search (chaos/, ISSUE 19): one row per sampled episode,
+        # then the search summary line (and the minimized repro plan
+        # when the search failed).
+        ch = summary["chaos"]
+        lines += [
+            "| chaos ep | axes | plan | faults | violations "
+            "| replay ticks | episode crc |",
+            "|---|" + "---|" * 6,
+        ]
+        for r in ch["rows"]:
+            viol = r.get("violations") or []
+            lines.append(
+                f"| {_fmt(r.get('episode'))} | {_fmt(r.get('axes'))} "
+                f"| `{r.get('plan') or '(none)'}` "
+                f"| {_fmt(r.get('faults'))} "
+                f"| {','.join(viol) if viol else 'ok'} "
+                f"| {_fmt(r.get('replay_ticks'))} "
+                f"| {_fmt(r.get('episode_crc'))} |"
+            )
+        lines.append("")
+        if "episodes" in ch:
+            lines += [
+                "| chaos | episodes | violating | episodes crc "
+                "| min plan | shrink probes |",
+                "|---|" + "---|" * 5,
+                f"| | {_fmt(ch.get('episodes'))} "
+                f"| {_fmt(ch.get('violations'))} "
+                f"| {_fmt(ch.get('episodes_crc'))} "
+                f"| {'`' + ch['min_plan'] + '`' if ch.get('min_plan') else ''} "
+                f"| {_fmt(ch.get('shrink_probes'))} |",
                 "",
             ]
     if "alerts" in summary:

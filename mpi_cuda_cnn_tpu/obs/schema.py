@@ -162,6 +162,14 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # recommendation_crc — the numbers the autosize determinism gate
     # pins at 0%/equal.
     "goodput": ("kind",),
+    # One chaos-search result (chaos/, ISSUE 19): "kind" is episode
+    # (one sampled fault-schedule episode: its --fault-plan spelling,
+    # axes label, violation check names, replay tick coverage, and the
+    # trace/state/blame/episode CRCs the chaos determinism gate pins
+    # at exact equality) / summary (the whole search: episode and
+    # violation counts, the folded episodes_crc chain, and — on a
+    # failing search — the ddmin-minimized plan + probe count).
+    "chaos": ("kind",),
     # One fired alert (obs/alerts.py, ISSUE 8): "rule" names the rule
     # instance, "kind" its class (threshold / rate_of_change / absence
     # / burn_rate), "seq" its position in the run's alert sequence

@@ -148,6 +148,86 @@ def make_workload(*, n: int, vocab: int, prompt_min: int, prompt_max: int,
     return reqs
 
 
+def load_trace(path: str) -> list[dict]:
+    """Read the request GEOMETRY out of a finished run's metrics JSONL
+    (ROADMAP item 4: trace-driven replay). Every `request` event
+    carries the full arrival shape — id, prompt_tokens,
+    max_new_tokens, arrival_s, tenant — which is exactly what a
+    workload is to a scheduler. Multi-mode runs (serve-bench --mode
+    both) record the same regenerated workload once per mode, so the
+    FIRST record per id wins; rows come back in arrival order."""
+    rows: dict[int, dict] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"--trace {path}: bad JSONL line: {e}")
+            if rec.get("event") != "request":
+                continue
+            rid = rec.get("id")
+            if rid is None or rid in rows:
+                continue
+            try:
+                rows[rid] = {
+                    "id": int(rid),
+                    "prompt_tokens": int(rec["prompt_tokens"]),
+                    "max_new_tokens": int(rec["max_new_tokens"]),
+                    "arrival_s": float(rec["arrival_s"]),
+                    "tenant": rec.get("tenant"),
+                }
+            except (KeyError, TypeError, ValueError) as e:
+                raise ValueError(
+                    f"--trace {path}: request record for id {rid!r} is "
+                    f"missing workload geometry ({e})")
+    if not rows:
+        raise ValueError(f"--trace {path}: no request records — want a "
+                         "metrics JSONL from a finished serve-bench / "
+                         "fleet-bench run")
+    return sorted(rows.values(),
+                  key=lambda r: (r["arrival_s"], r["id"]))
+
+
+def requests_from_trace(rows: list[dict], *, vocab: int, seed: int,
+                        deadline_s: float = 0.0):
+    """Fresh Request objects from trace geometry — called once per
+    mode, like make_workload, because the schedulers consume requests
+    in place. Arrival times, token budgets, ids, and tenant labels are
+    the recorded ones bit-for-bit; prompt CONTENT is synthesized per
+    id from its own seeded spawn (records do not carry tokens), so the
+    replay reproduces scheduling pressure, not token identity."""
+    from .scheduler import Request
+
+    reqs = []
+    for row in rows:
+        rng = np.random.default_rng([seed, 5, row["id"]])
+        prompt = rng.integers(0, vocab,
+                              (row["prompt_tokens"],)).astype(np.int32)
+        reqs.append(Request(
+            rid=row["id"], prompt=prompt,
+            max_new_tokens=row["max_new_tokens"],
+            arrival=row["arrival_s"],
+            deadline=(row["arrival_s"] + deadline_s if deadline_s > 0
+                      else None),
+            tenant=row["tenant"]))
+    return reqs
+
+
+def apply_trace_geometry(args, rows: list[dict]) -> None:
+    """Size the bench to the trace: request count and prompt/output
+    ranges come FROM the recorded geometry (the pool/max_len sizing
+    flags keep their meaning; a trace longer than --max-seq still
+    errors through the normal check)."""
+    args.requests = len(rows)
+    args.prompt_min = min(r["prompt_tokens"] for r in rows)
+    args.prompt_max = max(r["prompt_tokens"] for r in rows)
+    args.out_min = min(r["max_new_tokens"] for r in rows)
+    args.out_max = max(r["max_new_tokens"] for r in rows)
+
+
 def parse_turns_dist(spec: str):
     """`--turns-dist` grammar (ISSUE 18): `uniform:LO-HI` draws each
     session's turn count uniformly in [LO, HI]; `geometric:P` draws
@@ -477,11 +557,36 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
                          "'t0=pages:8/slots:2,t1=slots:1' "
                          "(needs --scheduler slo)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", default=None,
+                    help="trace-driven replay (ROADMAP item 4): rebuild "
+                         "the workload from a finished run's metrics "
+                         "JSONL `request` records — ids, prompt/output "
+                         "budgets, arrivals, and tenant labels exactly "
+                         "as recorded (prompt content re-synthesized "
+                         "per id from --seed); overrides --requests, "
+                         "--rate and the length-range flags")
     ap.add_argument("--metrics-jsonl", default=None,
                     help="append per-request obs records here")
     ap.add_argument("--device", default="auto",
                     choices=["auto", "tpu", "cpu"])
     args = ap.parse_args(argv)
+
+    trace_rows = None
+    if args.trace:
+        if args.turns_dist or args.prefix_mix > 0 or args.templates:
+            # Loud-config-error convention: these flags shape generated
+            # prompts; a trace IS the workload, so they would silently
+            # describe a run that never happens.
+            print("error: --trace replaces the generated workload; "
+                  "drop --turns-dist/--prefix-mix/--templates",
+                  file=sys.stderr)
+            return 2
+        try:
+            trace_rows = load_trace(args.trace)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        apply_trace_geometry(args, trace_rows)
 
     import jax
 
@@ -612,7 +717,12 @@ def serve_bench_main(argv: list[str] | None = None) -> int:
         # Regenerated identically per mode (the cross-mode contract);
         # session tags + multi-turn follow-ups (ISSUE 18) layer on top
         # of the base stream without perturbing it.
-        reqs = make_workload(**workload_kw)
+        if trace_rows is not None:
+            reqs = requests_from_trace(
+                trace_rows, vocab=args.vocab, seed=args.seed,
+                deadline_s=args.deadline_ms / 1e3)
+        else:
+            reqs = make_workload(**workload_kw)
         if args.sessions > 0:
             for r in reqs:
                 r.session = r.rid % args.sessions
@@ -962,6 +1072,14 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--deadline-ms", type=float, default=0.0,
                     help="per-request fleet-clock deadline (0 = none)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", default=None,
+                    help="trace-driven replay (ROADMAP item 4): feed a "
+                         "recorded request trail (any finished run's "
+                         "metrics JSONL) back through the fleet — ids, "
+                         "prompt/output budgets, arrivals, and tenant "
+                         "labels exactly as recorded (prompt content "
+                         "re-synthesized per id from --seed); overrides "
+                         "--requests, --rate and the length-range flags")
     ap.add_argument("--fault-plan", default=None,
                     type=_fault_plan_arg("fleet-bench"),
                     help="deterministic replica faults, e.g. "
@@ -1082,6 +1200,22 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    trace_rows = None
+    if args.trace:
+        if (args.turns_dist or args.prefix_mix > 0 or args.templates
+                or args.diurnal_amp > 0):
+            # Loud-config-error convention: these flags shape generated
+            # prompts/arrivals; a trace IS the workload.
+            print("error: --trace replaces the generated workload; "
+                  "drop --turns-dist/--prefix-mix/--templates/"
+                  "--diurnal-amp", file=sys.stderr)
+            return 2
+        try:
+            trace_rows = load_trace(args.trace)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        apply_trace_geometry(args, trace_rows)
     max_len = args.prompt_max + args.out_max
     pages = args.pages or args.slots * pages_for(max_len, args.page_size) + 1
     host_pages = (args.host_pages or pages) if args.spill else 0
@@ -1123,18 +1257,27 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                               salt=args.seed)
 
     try:
-        reqs = make_fleet_workload(
-            n=args.requests, vocab=args.vocab, prompt_min=args.prompt_min,
-            prompt_max=args.prompt_max, out_min=args.out_min,
-            out_max=args.out_max, rate=args.rate, seed=args.seed,
-            sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
-            tenants=args.tenants, prefix_mix=args.prefix_mix,
-            len_dist=args.len_dist, templates=args.templates,
-            turns_dist=args.turns_dist,
-            turn_gap_s=args.turn_gap_ms / 1e3,
-            diurnal_amp=args.diurnal_amp,
-            diurnal_period_s=args.diurnal_period,
-        )
+        if trace_rows is not None:
+            reqs = requests_from_trace(
+                trace_rows, vocab=args.vocab, seed=args.seed,
+                deadline_s=args.deadline_ms / 1e3)
+            if args.sessions > 0:
+                for r in reqs:
+                    r.session = r.rid % args.sessions
+        else:
+            reqs = make_fleet_workload(
+                n=args.requests, vocab=args.vocab,
+                prompt_min=args.prompt_min,
+                prompt_max=args.prompt_max, out_min=args.out_min,
+                out_max=args.out_max, rate=args.rate, seed=args.seed,
+                sessions=args.sessions, deadline_s=args.deadline_ms / 1e3,
+                tenants=args.tenants, prefix_mix=args.prefix_mix,
+                len_dist=args.len_dist, templates=args.templates,
+                turns_dist=args.turns_dist,
+                turn_gap_s=args.turn_gap_ms / 1e3,
+                diurnal_amp=args.diurnal_amp,
+                diurnal_period_s=args.diurnal_period,
+            )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

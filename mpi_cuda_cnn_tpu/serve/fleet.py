@@ -102,6 +102,20 @@ __all__ = [
 ]
 
 
+# Test-only chaos target (ISSUE 19). When set to "skip-revoke",
+# _harvest skips the LAST stranded request's fence revoke on every
+# failover: the run itself still behaves (the re-dispatch grant bumps
+# the epoch, so the zombie's commits stay refused) but the producer's
+# fence_crc chain silently diverges from what the dead-replica record
+# advertises — exactly the class of one-op bookkeeping drift the replay
+# oracle exists to catch. Nothing in production code paths ever sets
+# it; `mctpu chaos --plant` and the planted-bug test flip it via
+# chaos.episode's try/finally, and the chaos search must both FIND the
+# violation and shrink it to a minimal plan (pinning that the sampler
+# reaches the failover site and the shrinker converges).
+CHAOS_PLANT: str | None = None
+
+
 class SimCompute:
     """Device-free compute: the next token is a pure 32-bit mix of
     (rid, output position, salt) mod vocab. Identical on every replica,
@@ -1438,7 +1452,9 @@ class Fleet:
         # `stranded` list carries, so the replay reconstruction chains
         # the identical fence ops (ISSUE 15; epoch counters are
         # order-independent, only the fence_crc chain cares).
-        for auth in stranded:
+        revoked = (stranded[:-1] if CHAOS_PLANT == "skip-revoke"
+                   else stranded)
+        for auth in revoked:
             self.router.revoke(auth.rid)
         return stranded
 

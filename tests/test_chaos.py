@@ -1,0 +1,303 @@
+"""`mctpu chaos` — seeded fault-schedule search (ISSUE 19).
+
+THE acceptance tests live here:
+- plan grammar round trip: `faults.format_plan` is the exact inverse
+  of `faults.parse_plan`, so every sampled schedule is a one-line
+  `--fault-plan` repro;
+- sampler contract: draws are seed-stable, always validate against the
+  live fleet-bench site registry, and the axes sampler covers the
+  whole prefix/spec/disagg/spill/autoscale matrix;
+- clean episodes pass the FULL oracle (terminal-exactly-once,
+  closed-form outputs, blame conservation, pool/tier clean exit,
+  zero-drift replay, bitwise re-run);
+- chaos CLI determinism: two identical-seed searches emit byte-equal
+  record files and pass the CI gate (ci/chaos_gate.json) at 0%/equal;
+- plant-a-bug: with the test-only skip-revoke toggle armed the search
+  FINDS an invariant violation and ddmin-SHRINKS it to a <=2-entry
+  minimal plan whose failure really is the plant (the same minimal
+  plan passes with the plant off);
+- trace-driven replay (ROADMAP item 4): `--trace FILE` rebuilds a
+  recorded request trail geometry-exact (ids, budgets, arrivals,
+  tenants) on both benches, deterministically.
+"""
+
+import json
+import random
+from pathlib import Path
+
+import pytest
+
+from mpi_cuda_cnn_tpu.chaos.cli import chaos_main
+from mpi_cuda_cnn_tpu.chaos.episode import (
+    EpisodeConfig,
+    config_for,
+    run_episode,
+)
+from mpi_cuda_cnn_tpu.chaos.sampler import (
+    RAISING_KINDS,
+    SURFACE,
+    EpisodeAxes,
+    sample_axes,
+    sample_plan,
+)
+from mpi_cuda_cnn_tpu.chaos.shrink import shrink
+from mpi_cuda_cnn_tpu.faults import (
+    SITES,
+    Fault,
+    format_fault,
+    format_plan,
+    parse_plan,
+    validate_plan_sites,
+)
+from mpi_cuda_cnn_tpu.obs.regress import compare_main
+from mpi_cuda_cnn_tpu.serve.bench import (
+    fleet_bench_main,
+    load_trace,
+    requests_from_trace,
+    serve_bench_main,
+)
+from mpi_cuda_cnn_tpu.serve.fleet import make_fleet_workload
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ------------------------------------------------- plan grammar round trip
+
+
+def test_format_fault_spells_args_sorted():
+    f = Fault(kind="replica_crash", site="fleet.tick", at=40,
+              args={"zombie_ticks": 3, "replica": 1})
+    assert (format_fault(f)
+            == "replica_crash@fleet.tick:40?replica=1&zombie_ticks=3")
+    assert format_fault(Fault(kind="io", site="fleet.tick", at=7,
+                              args={})) == "io@fleet.tick:7"
+
+
+def test_format_plan_round_trips_parse_plan():
+    spec = ("replica_crash@fleet.tick:40?replica=1&zombie_ticks=3;"
+            "kv_corrupt@fleet.handoff:2?page=1;"
+            "replica_join@fleet.tick:90")
+    plan = parse_plan(spec)
+    assert parse_plan(format_plan(plan)) == plan
+    # Idempotent spelling: formatting the re-parse changes nothing.
+    assert format_plan(parse_plan(format_plan(plan))) == format_plan(plan)
+
+
+def test_sampled_plans_round_trip_and_validate():
+    """Property over the sampler's own draws: every sampled plan
+    re-parses to an identical Fault list and passes the same registry
+    validation `--fault-plan` applies at parse time."""
+    for seed in range(40):
+        rng = random.Random(f"round-trip:{seed}")
+        axes = sample_axes(rng)
+        spec = sample_plan(rng, axes, replicas=3)
+        plan = parse_plan(spec)
+        assert plan, spec
+        assert format_plan(plan) == spec
+        validate_plan_sites(plan, SURFACE)
+        assert not any(f.kind in RAISING_KINDS for f in plan)
+
+
+# --------------------------------------------------------- sampler contract
+
+
+def test_sampler_seed_stable_and_covers_axes_matrix():
+    rng_a, rng_b = random.Random("pin:1"), random.Random("pin:1")
+    axes_a, axes_b = sample_axes(rng_a), sample_axes(rng_b)
+    assert axes_a == axes_b
+    assert sample_plan(rng_a, axes_a, replicas=3) == \
+        sample_plan(rng_b, axes_b, replicas=3)
+    # 50 draws must cover the whole episode-axes matrix (the ISSUE 19
+    # CI run is 50 episodes — this pins that scale actually reaches
+    # every axis).
+    seen = {"pools": False, "unified": False, "prefix": False,
+            "spill": False, "spec": False, "autoscale": False}
+    for ep in range(50):
+        axes = sample_axes(random.Random(f"mctpu-chaos:7:{ep}"))
+        seen["pools"] |= axes.pools is not None
+        seen["unified"] |= axes.pools is None
+        seen["prefix"] |= axes.prefix
+        seen["spill"] |= axes.spill
+        seen["spec"] |= axes.spec != "off"
+        seen["autoscale"] |= axes.autoscale
+        if axes.spill:
+            assert axes.prefix  # spill without the prefix tree is inert
+    assert all(seen.values()), seen
+
+
+def test_sampler_gates_sites_on_topology():
+    """Unified episodes must never draw handoff/pool faults (the fleet
+    rejects them as inert at construction) and spill-off episodes must
+    never draw tier faults (they would silently not fire)."""
+    for seed in range(30):
+        rng = random.Random(f"gate:{seed}")
+        plan = parse_plan(sample_plan(
+            rng, EpisodeAxes(pools=None, prefix=True, spill=False),
+            replicas=3))
+        for f in plan:
+            assert f.site == "fleet.tick" or f.site == "fleet.resume"
+            assert f.kind != "pool_crash"
+
+
+# ------------------------------------------------------------- the oracle
+
+
+def test_clean_episode_passes_full_oracle():
+    cfg = config_for(
+        11, "replica_crash@fleet.tick:9?replica=1;"
+            "replica_join@fleet.tick:30;kv_corrupt@fleet.resume:0",
+        EpisodeAxes(pools=None, prefix=True, spill=True,
+                    spec="lookup", autoscale=True))
+    res = run_episode(cfg)
+    assert res.ok, res.violations
+    assert res.row["replay_ticks"] > 0
+    assert res.row["faults"] == 3
+    for k in ("trace_crc", "state_crc", "blame_crc", "episode_crc"):
+        assert isinstance(res.row[k], int)
+
+
+def test_shrink_refuses_a_passing_episode():
+    cfg = EpisodeConfig(seed=3, plan="replica_join@fleet.tick:20")
+    with pytest.raises(ValueError, match="passing episode"):
+        shrink(cfg)
+
+
+# -------------------------------------------------- CLI determinism + gate
+
+
+def test_chaos_cli_determinism_and_gate(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    argv = ["--episodes", "4", "--seed", "7"]
+    assert chaos_main(argv + ["--metrics-jsonl", str(a)]) == 0
+    assert chaos_main(argv + ["--metrics-jsonl", str(b)]) == 0
+    # Byte-equal record files: the chaos timeline is episode-indexed —
+    # no wall-clock anywhere in the emit path.
+    assert a.read_bytes() == b.read_bytes()
+    assert compare_main([str(a), str(b), "--gate",
+                         str(REPO / "ci" / "chaos_gate.json")]) == 0
+
+
+def test_chaos_plan_mode_replays_one_episode(tmp_path):
+    out = tmp_path / "one.jsonl"
+    rc = chaos_main(["--seed", "5", "--plan",
+                     "replica_crash@fleet.tick:12?replica=0",
+                     "--prefix", "--metrics-jsonl", str(out)])
+    assert rc == 0
+    rows = [json.loads(line) for line in out.read_text().splitlines()
+            if not line.startswith("#")]
+    assert [r["kind"] for r in rows] == ["episode", "summary"]
+    assert rows[0]["plan"] == "replica_crash@fleet.tick:12?replica=0"
+    assert rows[1]["violations"] == 0
+
+
+def test_chaos_cli_rejects_bad_config():
+    assert chaos_main(["--spill"]) == 2                 # spill sans prefix
+    assert chaos_main(["--plan", "nonsense"]) == 2      # bad grammar
+
+
+# ------------------------------------------------------------ plant-a-bug
+
+
+def test_planted_bug_found_and_shrunk_to_minimal_plan(tmp_path):
+    """THE ISSUE 19 plant-a-bug acceptance: with the test-only
+    skip-revoke toggle armed, the seeded search must FIND an invariant
+    violation and ddmin-shrink it to a <=2-entry minimal plan — and
+    that minimal plan must fail BECAUSE of the plant (same plan, plant
+    off, passes the full oracle)."""
+    out = tmp_path / "chaos.jsonl"
+    trails = tmp_path / "trails"
+    rc = chaos_main(["--episodes", "2", "--seed", "7",
+                     "--plant", "skip-revoke",
+                     "--metrics-jsonl", str(out),
+                     "--out-dir", str(trails)])
+    assert rc == 1
+    rows = [json.loads(line) for line in out.read_text().splitlines()
+            if not line.startswith("#")]
+    summary = rows[-1]
+    assert summary["kind"] == "summary"
+    assert summary["violations"] >= 1
+    min_plan = summary["min_plan"]
+    assert len(parse_plan(min_plan)) <= 2
+    assert summary["shrink_probes"] >= 1
+    # Both trails of the minimal episode landed, pre-wired for diverge.
+    assert (trails / "chaos_min_a.jsonl").exists()
+    assert (trails / "chaos_min_b.jsonl").exists()
+    # The violation is the plant's, not the schedule's: the SAME
+    # minimal episode passes with the toggle off ...
+    ep = summary["failed_episode"]
+    cfg = EpisodeConfig(seed=7 * 100003 + ep, plan=min_plan,
+                        spec="lookup")
+    assert run_episode(cfg).ok
+    # ... and fails (replay drift) with it on.
+    planted = run_episode(
+        EpisodeConfig(seed=7 * 100003 + ep, plan=min_plan,
+                      spec="lookup", plant="skip-revoke"))
+    assert {v["check"] for v in planted.violations} == {"replay"}
+
+
+# ------------------------------------------------- trace-driven replay (b)
+
+
+def _record_fleet_trail(path, *, tenants=2, requests=16):
+    rc = fleet_bench_main([
+        "--requests", str(requests), "--replicas", "2", "--rate", "40",
+        "--vocab", "64", "--prompt-min", "4", "--prompt-max", "40",
+        "--out-min", "4", "--out-max", "16",
+        "--tenants", str(tenants), "--compute", "sim",
+        "--metrics-jsonl", str(path)])
+    assert rc == 0
+
+
+def test_load_trace_rebuilds_geometry_exactly(tmp_path):
+    trail = tmp_path / "trail.jsonl"
+    _record_fleet_trail(trail)
+    rows = load_trace(str(trail))
+    want = make_fleet_workload(n=16, vocab=64, prompt_min=4,
+                               prompt_max=40, out_min=4, out_max=16,
+                               rate=40.0, seed=0, tenants=2)
+    assert len(rows) == len(want)
+    for row, req in zip(rows, sorted(want, key=lambda r: r.arrival)):
+        assert row["id"] == req.rid
+        assert row["prompt_tokens"] == int(req.prompt.size)
+        assert row["max_new_tokens"] == req.max_new_tokens
+        assert row["arrival_s"] == pytest.approx(req.arrival, abs=5e-4)
+        assert row["tenant"] == req.tenant
+    reqs = requests_from_trace(rows, vocab=64, seed=0)
+    assert [r.rid for r in reqs] == [row["id"] for row in rows]
+    assert all(int(r.prompt.size) == row["prompt_tokens"]
+               for r, row in zip(reqs, rows))
+    # Fresh objects per call — the per-mode regeneration contract.
+    again = requests_from_trace(rows, vocab=64, seed=0)
+    assert all(x is not y for x, y in zip(reqs, again))
+
+
+def test_fleet_bench_trace_replay_deterministic(tmp_path):
+    trail = tmp_path / "trail.jsonl"
+    _record_fleet_trail(trail)
+    a, b = tmp_path / "ra.jsonl", tmp_path / "rb.jsonl"
+    argv = ["--trace", str(trail), "--replicas", "2", "--compute", "sim",
+            "--log", "summary"]
+    assert fleet_bench_main(argv + ["--metrics-jsonl", str(a)]) == 0
+    assert fleet_bench_main(argv + ["--metrics-jsonl", str(b)]) == 0
+
+    def summary_of(p):
+        recs = [json.loads(line) for line in p.read_text().splitlines()
+                if not line.startswith("#")]
+        return next(r for r in recs if r.get("event") == "serve")
+
+    sa, sb = summary_of(a), summary_of(b)
+    assert sa["requests"] == 16
+    assert sa["trace_crc"] == sb["trace_crc"]
+    assert sa["state_crc"] == sb["state_crc"]
+
+
+def test_trace_loud_config_errors(tmp_path):
+    trail = tmp_path / "trail.jsonl"
+    trail.write_text("")  # empty: no request records
+    assert fleet_bench_main(["--trace", str(trail)]) == 2
+    assert serve_bench_main(["--trace", str(trail),
+                             "--prefix-mix", "0.5"]) == 2
+    assert fleet_bench_main(["--trace", str(trail),
+                             "--prefix-mix", "0.5"]) == 2
+    assert serve_bench_main(["--trace", str(tmp_path / "absent.jsonl")
+                             ]) == 2

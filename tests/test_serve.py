@@ -212,6 +212,13 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     corrupt-seal op arms the kv_corrupt injector so at least one
     lookup REFUSES a flipped stamp and degrades to re-prefill — all
     under the same every-step check().
+    ISSUE 19 adds the autoscaler's membership moves as walk ops: a
+    JOIN op brings up a whole new engine+pool+scheduler member
+    mid-walk, a dispatch op routes queued work onto joined members,
+    and a GRACEFUL-DRAIN op stops a member's admissions and requeues
+    its waiting work back while in-flight slots run to completion —
+    every member's pool under the same every-step check(), and every
+    drained member's pool must hand back every page.
     The fleet's re-dispatch and disaggregated-handoff paths
     (serve/fleet.py) drive these exact scheduler+pool+prefix triples
     per replica, so they inherit the guarantee."""
@@ -402,9 +409,63 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
         sched.release_handoff(private, nodes, owner)
         transfers["done"] += 1
 
+    # Autoscaler membership moves (ISSUE 19): joined members are whole
+    # engine+pool+scheduler triples appearing MID-WALK, exactly what a
+    # replica_join brings up; graceful drain is the scale-down leg.
+    members: list[dict] = []
+    scale = {"joins": 0, "dispatches": 0, "drains": 0}
+
+    def join_op():
+        if len(members) >= 2:
+            return
+        p = PagePool(10)
+        e = PagedEngine(MODEL, params, slots=3, num_pages=10, page_size=4,
+                        prefill_chunk=4, max_len=32, cache_dtype=dtype,
+                        spec="lookup", spec_k=4)
+        s = ContinuousScheduler(slots=3, pool=p, page_size=4, max_len=32,
+                                prefix=PrefixCache(p, page_size=4))
+        members.append({"sched": s, "engine": e, "pool": p,
+                        "draining": False})
+        scale["joins"] += 1
+
+    def member_dispatch_op():
+        # Route queued work onto a joined member — the autoscaler's
+        # whole point: new capacity takes load off the loaded one.
+        live = [m for m in members if not m["draining"]]
+        if not live or not sched.queue:
+            return
+        m = live[int(rng.integers(len(live)))]
+        m["sched"].submit([sched.queue.popleft()])
+        scale["dispatches"] += 1
+
+    def member_step_op():
+        if not members:
+            return
+        m = members[int(rng.integers(len(members)))]
+        m["sched"].sweep(now)
+        if not m["draining"]:
+            m["sched"].admit(now)
+        prefill_step(m["sched"], m["engine"])
+        decode_step_op(m["sched"], m["engine"])
+
+    def drain_op():
+        # Graceful drain: no new admissions, waiting work requeues back
+        # to A, in-flight slots run to completion — the member's pool
+        # must end the walk with every page handed back.
+        live = [m for m in members if not m["draining"]]
+        if not live:
+            return
+        m = live[int(rng.integers(len(live)))]
+        m["draining"] = True
+        while m["sched"].queue:
+            sched.queue.append(m["sched"].queue.popleft())
+        scale["drains"] += 1
+
     def check_both():
         sched.check()
         sched_b.check()
+        for m in members:
+            m["sched"].check()
 
     ops = [submit_one, lambda: sched.admit(now), prefill_step,
            decode_step_op, preempt_op, cancel_op,
@@ -414,17 +475,23 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
            lambda: prefill_step(sched_b, engine_b),
            spec_decode_op,
            lambda: spec_decode_op(sched_b, engine_b),
-           corrupt_op]
+           corrupt_op,
+           join_op, member_dispatch_op, member_step_op, drain_op]
     weights = np.array([0.16, 0.14, 0.15, 0.06, 0.06, 0.04, 0.04, 0.04,
-                        0.09, 0.04, 0.03, 0.03, 0.06, 0.04, 0.02])
-    for _ in range(300):
+                        0.09, 0.04, 0.03, 0.03, 0.06, 0.04, 0.02,
+                        0.02, 0.04, 0.05, 0.02])
+    weights = weights / weights.sum()
+    for _ in range(340):
         now += float(rng.uniform(0.0, 0.02))  # deadlines really expire
         ops[int(rng.choice(len(ops), p=weights))]()
         check_both()
-    # Drain BOTH schedulers: the surviving work must complete and hand
-    # every page of both pools back.
-    while sched.unfinished or sched_b.unfinished:
-        for sc, en in ((sched, engine), (sched_b, engine_b)):
+    # Drain every scheduler: the surviving work must complete and hand
+    # every page of every pool back — including the autoscaler-joined
+    # members', draining or not.
+    while (sched.unfinished or sched_b.unfinished
+           or any(m["sched"].unfinished for m in members)):
+        for sc, en in ((sched, engine), (sched_b, engine_b),
+                       *((m["sched"], m["engine"]) for m in members)):
             sc.sweep(now)
             sc.admit(now)
             prefill_step(sc, en)
@@ -434,9 +501,13 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     assert all(r.terminal for r in submitted)
     prefix.clear()   # retained LRU pages hand back at teardown
     sched_b.prefix.clear()
+    for m in members:
+        m["sched"].prefix.clear()
     check_both()
     assert pool.free_pages == pool.usable
     assert pool_b.free_pages == pool_b.usable
+    for m in members:
+        assert m["pool"].free_pages == m["pool"].usable
     # The randomized walk must have exercised the interesting paths —
     # including the whole ISSUE 9 surface.
     assert sched.preemptions > 0
@@ -457,6 +528,11 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     assert spec_seen["rounds"] > 0
     assert spec_seen["multi"] > 0
     assert spec_seen["rollbacks"] > 0
+    # The autoscaler-membership surface (ISSUE 19): a member joined
+    # mid-walk, took dispatched work, and gracefully drained.
+    assert scale["joins"] > 0
+    assert scale["dispatches"] > 0
+    assert scale["drains"] > 0
     # The host-tier surface (ISSUE 17): pages spilled under pressure,
     # readmitted through fresh allocations on later template walks, and
     # at least one corrupt seal refused by the CRC discipline.
