@@ -92,6 +92,8 @@ def _fail_report(args, ep: int, res: EpisodeResult) -> tuple[str, dict]:
         axes_flags.append(f"--spec {cfg.spec}")
     if cfg.autoscale:
         axes_flags.append("--autoscale")
+    if cfg.transport:
+        axes_flags.append("--transport")
     if cfg.plant:
         axes_flags.append(f"--plant {cfg.plant}")
     flags = (" " + " ".join(axes_flags)) if axes_flags else ""
@@ -138,6 +140,10 @@ def chaos_main(argv: list[str] | None = None) -> int:
                     help="speculative decoding (--plan mode)")
     ap.add_argument("--autoscale", action="store_true",
                     help="online autoscaler on (--plan mode)")
+    ap.add_argument("--transport", action="store_true",
+                    help="lossy transport bus + lease fences on; "
+                         "required for fleet.transport plan entries "
+                         "(--plan mode)")
     ap.add_argument("--no-shrink", action="store_true",
                     help="report the raw violating plan without ddmin "
                          "minimization")
@@ -149,11 +155,14 @@ def chaos_main(argv: list[str] | None = None) -> int:
                     help="append one chaos record per episode plus the "
                          "run summary (obs schema; the CI chaos gate "
                          "compares these)")
-    ap.add_argument("--plant", default=None, choices=("skip-revoke",),
-                    help="TEST-ONLY: arm a planted invariant bug in the "
-                         "fleet (serve/fleet.CHAOS_PLANT) the search "
-                         "must find and shrink — the oracle's own "
-                         "canary, never for real runs")
+    ap.add_argument("--plant", default=None,
+                    choices=("skip-revoke", "skip-dedup"),
+                    help="TEST-ONLY: arm a planted invariant bug "
+                         "(serve/fleet.CHAOS_PLANT) the search must "
+                         "find and shrink — skip-revoke drops a fence "
+                         "revoke on failover, skip-dedup disables the "
+                         "bus's commit dedup check (ISSUE 20); the "
+                         "oracle's own canary, never for real runs")
     args = ap.parse_args(argv)
     if args.spill and not args.prefix:
         print("error: --spill needs --prefix (the host tier spills "
@@ -168,7 +177,8 @@ def chaos_main(argv: list[str] | None = None) -> int:
             return 2
         axes = EpisodeAxes(pools=args.pools, prefix=args.prefix,
                            spill=args.spill, spec=args.spec,
-                           autoscale=args.autoscale)
+                           autoscale=args.autoscale,
+                           transport=args.transport)
         episodes = [(args.plan, axes)]
     else:
         episodes = []

@@ -58,6 +58,7 @@ class EpisodeConfig:
     spill: bool = False
     spec: str = "off"
     autoscale: bool = False
+    transport: bool = False
     requests: int = 32
     rate: float = 48.0
     vocab: int = 64
@@ -88,7 +89,7 @@ def config_for(seed: int, plan: str, axes, **scale) -> EpisodeConfig:
     return EpisodeConfig(
         seed=seed, plan=plan, pools=axes.pools, prefix=axes.prefix,
         spill=axes.spill, spec=axes.spec, autoscale=axes.autoscale,
-        **scale,
+        transport=axes.transport, **scale,
     )
 
 
@@ -164,6 +165,7 @@ def _run_once(cfg: EpisodeConfig, records: list[dict]) -> dict:
         prefix=cfg.prefix, spec=cfg.spec, spec_k=8, spec_ngram=2,
         pools=cfg.pools, handoff_ticks=1, log_handoffs=False,
         host_pages=host_pages, autoscale=autoscaler,
+        transport=cfg.transport,
     )
     # The planted bug (test-only): flipped around the run alone so a
     # raise can never leak the toggle into the next episode.
@@ -185,8 +187,11 @@ def _run_once(cfg: EpisodeConfig, records: list[dict]) -> dict:
         "spec": cfg.spec, "spec_k": 8, "replicas_initial": cfg.n_replicas,
         "rate": cfg.rate, "slots": cfg.slots, "page_size": cfg.page_size,
         "pages": pages, "compute": "sim", "prefix_cache": cfg.prefix,
-        "host_pages": host_pages, **s,
+        "host_pages": host_pages, "transport": cfg.transport,
+        "lease_ticks": fleet.lease_ticks, **s,
     })
+    for rec in result.transport_log:
+        records.append({"event": "transport", **rec})
     return {"result": result, "fleet": fleet, "summary": s,
             "blame": blame, "sim": fleet_mod.SimCompute(
                 vocab=cfg.vocab, chunk=16, salt=cfg.seed),
@@ -230,9 +235,17 @@ def _check_terminal_stream(cfg: EpisodeConfig, records: list[dict],
     absent (or doubled) in the stream is a lost/duplicated SLO event."""
     seen: dict[int, int] = {}
     for rec in records:
-        if rec.get("event") != "tick":
+        if rec.get("event") == "tick":
+            stream = rec.get("terminal") or ()
+        elif rec.get("event") == "fleet":
+            # Deferred terminals applied at bus pump (ISSUE 20) ride
+            # the fleet record's t_terminal stream ONLY — they never
+            # reach a replica tick record — so exactly-once is over
+            # the union of both streams.
+            stream = rec.get("t_terminal") or ()
+        else:
             continue
-        for t in rec.get("terminal") or ():
+        for t in stream:
             rid = t.get("id")
             seen[rid] = seen.get(rid, 0) + 1
     dup = sorted(rid for rid, n in seen.items() if n > 1)
@@ -334,7 +347,8 @@ def run_episode(cfg: EpisodeConfig) -> EpisodeResult:
     crc = _crc({
         "seed": cfg.seed, "plan": cfg.plan, "pools": cfg.pools,
         "prefix": cfg.prefix, "spill": cfg.spill, "spec": cfg.spec,
-        "autoscale": cfg.autoscale, "statuses": statuses,
+        "autoscale": cfg.autoscale, "transport": cfg.transport,
+        "statuses": statuses,
         "violations": sorted({v["check"] for v in violations}), **(crcs or {}),
     })
     row = {

@@ -51,6 +51,7 @@ class EpisodeAxes:
     spill: bool = False        # host-tier spill (requires prefix)
     spec: str = "off"          # speculative decoding: off | lookup
     autoscale: bool = False    # online goodput autoscaler
+    transport: bool = False    # lossy message bus + leases (ISSUE 20)
 
     def label(self) -> str:
         parts = [f"pools={self.pools}" if self.pools else "unified"]
@@ -62,6 +63,8 @@ class EpisodeAxes:
             parts.append(f"spec={self.spec}")
         if self.autoscale:
             parts.append("autoscale")
+        if self.transport:
+            parts.append("transport")
         return ",".join(parts)
 
 
@@ -79,6 +82,11 @@ def sample_axes(rng: random.Random) -> EpisodeAxes:
         spill=prefix and rng.random() < 0.5,
         spec="lookup" if rng.random() < 0.4 else "off",
         autoscale=rng.random() < 0.35,
+        # The bus routes the unified control plane only — transport +
+        # pools is a Fleet constructor error (the handoff plane stays
+        # direct-call), so like spill-without-prefix it is not a
+        # samplable point.
+        transport=pools is None and rng.random() < 0.5,
     )
 
 
@@ -93,6 +101,8 @@ def _live_pairs(axes: EpisodeAxes) -> list[tuple[str, str]]:
         if site == "fleet.handoff" and not axes.pools:
             continue
         if site == "tier.spill" and not axes.spill:
+            continue
+        if site == "fleet.transport" and not axes.transport:
             continue
         for kind in sorted(kinds - RAISING_KINDS):
             if kind == "pool_crash" and not axes.pools:
@@ -124,6 +134,21 @@ def _sample_args(rng: random.Random, site: str, kind: str,
             args["pool"] = rng.choice(["prefill", "decode"])
     elif kind == "kv_corrupt" and site == "fleet.handoff":
         args["page"] = rng.randrange(4)
+    elif kind == "partition":
+        args["replica"] = rng.randrange(replicas)
+        args["ticks"] = rng.randint(4, 12)
+    elif kind == "msg_delay":
+        args["ticks"] = rng.randint(1, 6)
+        if rng.random() < 0.5:
+            args["count"] = rng.randint(1, 3)
+        if rng.random() < 0.5:
+            args["kind"] = rng.choice(["commit", "dispatch",
+                                       "terminal", "hb"])
+    elif kind in ("msg_drop", "msg_dup"):
+        args["count"] = rng.randint(1, 3)
+        if rng.random() < 0.5:
+            args["kind"] = rng.choice(["commit", "dispatch",
+                                       "terminal", "hb"])
     return args
 
 
@@ -132,7 +157,9 @@ def _sample_at(rng: random.Random, site: str, *, max_tick: int) -> int:
     tick counter; the polled sites trigger on their own SEQUENCE
     numbers (Nth handoff / resume re-dispatch / spill), which stay
     small at episode scale."""
-    if site == "fleet.tick":
+    if site in ("fleet.tick", "fleet.transport"):
+        # Both trigger on the fleet tick counter (transport faults arm
+        # at the top of the named tick via apply_tick_faults).
         return rng.randint(1, max_tick)
     return rng.randrange(7)
 

@@ -29,9 +29,13 @@ from .episode import EpisodeConfig, run_episode
 # Required ones (replica targets, pool names) stay: dropping them
 # re-targets the fault (replica defaults to r0, pool_crash without a
 # pool is a config error) — a different schedule, not a smaller one.
-_DROPPABLE = ("zombie_ticks",)
-# Numeric args the coordinate pass walks toward their floor.
-_SHRINK_FLOORS = {"replicas": 1, "page": 0}
+# "kind" is the transport message-kind filter (ISSUE 20): dropping it
+# widens the fault to ANY message, a strictly plainer spelling.
+_DROPPABLE = ("zombie_ticks", "kind")
+# Numeric args the coordinate pass walks toward their floor. count=1
+# is one faulted message; ticks=1 is the shortest delay / partition
+# window the transport grammar accepts.
+_SHRINK_FLOORS = {"replicas": 1, "page": 0, "count": 1, "ticks": 1}
 
 
 class _Prober:
@@ -82,8 +86,9 @@ def _ddmin(plan: list[Fault], fails) -> list[Fault]:
 def _floor_at(site: str) -> int:
     """The smallest meaningful trigger per site class: fleet ticks
     start at 1 (a tick-0 fault fires before any dispatch exists);
-    sequence-numbered sites start at 0 (the first handoff/spill)."""
-    return 1 if site == "fleet.tick" else 0
+    fleet.transport arms on the same tick counter; sequence-numbered
+    sites start at 0 (the first handoff/spill)."""
+    return 1 if site in ("fleet.tick", "fleet.transport") else 0
 
 
 def _shrink_entry(plan: list[Fault], i: int, fails) -> list[Fault]:

@@ -53,6 +53,17 @@ kinds:
                 tier.spill, ISSUE 17): verification refuses the
                 transfer/readmission and the request re-prefills —
                 garbage is never decoded
+- ``msg_drop`` / ``msg_dup`` / ``msg_delay`` — arm a one-shot lossy-
+                transport effect on the fleet's message bus (ISSUE 20,
+                site fleet.transport, trigger value = fleet tick): the
+                next matching send is dropped, duplicated, or delayed
+                ``ticks`` ticks (optional ``kind=commit`` /
+                ``replica=K`` / ``count=N`` filters)
+- ``partition`` — open a ``ticks``-long network partition that drops
+                every message to/from replica ``replica=K`` (ISSUE 20):
+                the isolated replica keeps serving, gets declared dead
+                by heartbeat staleness, and every post-lease commit is
+                lease/fence-refused when the window heals
 
 Recovery — `supervise()` is the `--max-restarts N` loop: it runs one
 training attempt, and on a crash rebuilds the trainer and resumes from
@@ -110,7 +121,8 @@ class Fault:
 
 KINDS = ("crash", "io", "nan", "squeeze", "slow", "preempt",
          "replica_crash", "replica_join", "replica_leave",
-         "pool_crash", "handoff_drop", "kv_corrupt")
+         "pool_crash", "handoff_drop", "kv_corrupt",
+         "msg_drop", "msg_dup", "msg_delay", "partition")
 
 # Hook sites each CLI surface actually registers, and the kinds each
 # site's consumer APPLIES (ISSUE 7 satellite): a plan naming a site the
@@ -165,6 +177,15 @@ SITES: dict[str, dict[str, frozenset[str]]] = {
         # serve-bench surface registers, trigger value = the replica's
         # own spill sequence number.
         "tier.spill": frozenset({"kv_corrupt"}),
+        # Lossy-transport faults (ISSUE 20). Polled once per fleet
+        # TICK by the message bus (crash/io would be inert — absent).
+        # partition opens a ticks-long window dropping everything
+        # to/from replica=K; msg_drop/msg_dup/msg_delay arm one-shot
+        # effects on the next matching send (optional kind=/replica=
+        # filters, count= repeats, ticks= delay length). Requires
+        # --transport; validated inert otherwise.
+        "fleet.transport": frozenset({"msg_drop", "msg_dup",
+                                      "msg_delay", "partition"}),
     },
 }
 
@@ -476,9 +497,17 @@ class FaultInjector:
                 if i in self._fired or f.site != site or f.at != int(value):
                     continue
                 self._fired.add(i)
+                # A fault arg may share a name with the event's own
+                # keys or the logger's envelope (the transport faults'
+                # `kind=` message filter, ISSUE 20) — prefix those so
+                # the arg rides along without overwriting the record.
+                reserved = ("kind", "site", "at", "event", "t", "mode",
+                            "schema")
                 self.events.append({
                     "kind": f"injected_{f.kind}", "site": site,
-                    "at": int(value), **f.args,
+                    "at": int(value),
+                    **{(f"arg_{k}" if k in reserved else k): v
+                       for k, v in f.args.items()},
                 })
                 hits.append(f)
         return hits

@@ -67,13 +67,20 @@ from .schema import iter_runs
 # transfer — from the sealed detach on the prefill replica to the
 # decode-ready bind on the receiver (or to the abort that sends the
 # request back through redispatch_replay).
+# transport_wait (ISSUE 20): the dispatch message's wire time on the
+# lossy bus — router send to replica delivery, retransmits and
+# partition block included. Zero with the bus off OR faultless (inline
+# delivery lands in the same tick), so pre-ISSUE-20 trails fold to
+# bitwise-identical rows.
 CATEGORIES = ("self_compute", "queued_behind", "preempted_by",
-              "redispatch_replay", "router_wait", "handoff_wait")
+              "redispatch_replay", "router_wait", "handoff_wait",
+              "transport_wait")
 
 # Internal wait states -> blame category.
 _STATE_CAT = {"active": "self_compute", "queued": "queued_behind",
               "preempt_wait": "preempted_by", "replay": "redispatch_replay",
-              "router": "router_wait", "handoff": "handoff_wait"}
+              "router": "router_wait", "handoff": "handoff_wait",
+              "transport": "transport_wait"}
 
 
 def worst_k(rows, key, k: int):
@@ -314,10 +321,17 @@ class BlameAccumulator:
         ann = self._announce.setdefault("fleet", {})
         for rid in rec.get("arrived") or []:
             ann[rid] = (tick, now)
+        # Lossy transport (ISSUE 20): with the bus on ("transport" block
+        # present), a dispatched/redispatched marker is the router's
+        # SEND — the request is on the wire until its t_delivered
+        # marker, and those ticks are transport_wait. Inline zero-fault
+        # delivery puts both markers in the same record (0-tick
+        # segments), so faultless bus trails fold identically to direct.
+        bus = "transport" in rec
         for rid in rec.get("dispatched") or []:
             st = self._st("fleet", rid, tick, now, "router")
             if st.state == "router":
-                st.close(tick, now, "queued")
+                st.close(tick, now, "transport" if bus else "queued")
         for rid, name in rec.get("failed_over") or []:
             st = self._st("fleet", rid, tick, now, "replay")
             if st.state != "replay":
@@ -341,11 +355,24 @@ class BlameAccumulator:
                 st.close(tick, now, "replay")
         for rid in rec.get("redispatched") or []:
             st = self._st("fleet", rid, tick, now, "replay")
-            if st.state != "replay":
+            if bus:
+                if st.state != "transport":
+                    st.close(tick, now, "transport")
+            elif st.state != "replay":
                 # Defensive: a redispatch always follows a failed_over
                 # marker; an out-of-order trail still folds, it just
                 # starts the replay here.
                 st.close(tick, now, "replay")
+        # Wire deliveries LAST: a same-tick send+delivery (the inline
+        # zero-fault path) must close its 0-tick transport segment
+        # after the send opened it. st.replica (set by failed_over)
+        # discriminates a redispatch delivery — the re-prefill ahead is
+        # crash-caused work, so it re-enters replay, not queued.
+        for rid, _name in rec.get("t_delivered") or []:
+            st = self._st("fleet", rid, tick, now, "queued")
+            if st.state == "transport":
+                st.close(tick, now,
+                         "replay" if st.replica is not None else "queued")
 
     def ingest_tick(self, rec: dict) -> None:
         mode = rec.get("mode", "?")

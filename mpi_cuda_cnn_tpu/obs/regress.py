@@ -134,7 +134,16 @@ _SERVE_KEYS = ("tokens_per_s", "decode_ticks", "prefill_chunks",
                # exact equality (zeros/empty-CRC on a hash-routed or
                # fixed-size fleet).
                "route_hits", "route_misses", "route_hit_tokens",
-               "scale_ups", "scale_downs", "replica_ticks", "scale_crc")
+               "scale_ups", "scale_downs", "replica_ticks", "scale_crc",
+               # Lossy transport (ISSUE 20): bus wire accounting,
+               # lease refusals, partition count, and the transport-
+               # wait blame category — the fleet/transport determinism
+               # gates pin them at exact equality (zeros with the bus
+               # off).
+               "msgs_sent", "msgs_delivered", "msgs_dropped",
+               "msgs_duped", "msgs_delayed", "msgs_deduped",
+               "retransmits", "lease_refusals", "partitions",
+               "blame_transport_wait")
 
 # Per-tenant summary keys (ISSUE 8): the "tenants" block of a serve
 # summary flattens to serve.<mode>.tenant.<name>.<key> (statuses to

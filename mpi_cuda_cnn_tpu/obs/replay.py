@@ -46,6 +46,7 @@ from collections import deque
 from ..serve.pool import pages_for
 from ..serve.router import fence_chain, fleet_state_digest
 from ..serve.scheduler import _rid_sig, state_digest
+from ..serve.transport import COUNTER_KEYS, transport_digest_tuple
 from .schema import fmt_cell as _fmt
 from .schema import iter_runs
 
@@ -469,6 +470,15 @@ class FleetMirror:
         self.pending = len(reqinfo)
         self.redispatch: deque[int] = deque()
         self.terminal: set[int] = set()
+        # Lossy transport (ISSUE 20): the latest adopted per-tick bus
+        # block (None = bus off), and the dispatches granted but not
+        # yet wire-delivered — rid -> (replica name, resume outlen).
+        # The bus's internals (retransmit timers, dedup stores) are not
+        # event-sourced; the mirror adopts the producer's block after
+        # AUDITING its invariants (conservation + counter monotonicity)
+        # and folds it through the SAME transport_digest_tuple spelling.
+        self.transport: dict | None = None
+        self._inflight: dict[int, tuple[str, int]] = {}
         pools = config.get("pools")
         n = int(config.get("replicas_initial") or config.get("replicas", 0))
         phases: list[str | None] = [None] * n
@@ -534,6 +544,11 @@ class FleetMirror:
             for rid in ev.get("stranded") or []:
                 self._revoke(rid)
                 self.redispatch.append(rid)
+                # A dispatch still on the wire to the dead incarnation
+                # can never produce a t_delivered marker (deliveries
+                # are stamped for CURRENT incarnations only) — the
+                # harvest strands it and re-dispatch will re-stash it.
+                self._inflight.pop(rid, None)
             self.members.pop(name, None)
         elif kind == "restart":
             if self.members.get(name) is None:
@@ -569,8 +584,39 @@ class FleetMirror:
                 stream="fleet")
         return m
 
+    def _adopt_transport(self, rec: dict) -> None:
+        """Audit + adopt the record's bus block (ISSUE 20). The audits
+        are what make adoption more than trust: conservation must hold
+        bitwise (sent == delivered + deduped + dropped + inflight) and
+        every counter must be monotone vs the previous tick's block —
+        a truncated/tampered/nondeterministic trail trips one of them
+        before the digest would even be compared."""
+        t = rec.get("transport")
+        if t is None:
+            return
+        tick = rec.get("tick")
+        c = {k: int(t[k]) for k in COUNTER_KEYS}
+        wire = c["sent"] - c["delivered"] - c["deduped"] - c["dropped"]
+        if wire != int(t["inflight"]):
+            raise DriftError(
+                f"fleet: tick {tick}: transport conservation violated — "
+                f"sent {c['sent']} != delivered {c['delivered']} + "
+                f"deduped {c['deduped']} + dropped {c['dropped']} + "
+                f"inflight {t['inflight']}", tick=tick, stream="fleet")
+        if self.transport is not None:
+            for k in COUNTER_KEYS:
+                if c[k] < int(self.transport[k]):
+                    raise DriftError(
+                        f"fleet: tick {tick}: transport counter {k} "
+                        f"went backwards ({self.transport[k]} -> "
+                        f"{c[k]})", tick=tick, stream="fleet")
+        self.transport = t
+
     def apply_fleet(self, rec: dict) -> None:
         tick = rec.get("tick")
+        self._adopt_transport(rec)
+        for t in rec.get("t_terminal") or []:
+            self.terminal.add(t["id"])
         for rid, reason in rec.get("handoff_aborted") or []:
             ho = self._handoff(rid, tick, "handoff abort")
             del self.handoffs[rid]
@@ -606,6 +652,7 @@ class FleetMirror:
             self._grant(rid, dst)
             if self._live(ho.src, ho.src_gen):
                 self.members[ho.src].sched.free += ho.private
+        bus = "transport" in rec
         for rid, name, outl in rec.get("redispatched_to") or []:
             if not self.redispatch or self.redispatch[0] != rid:
                 raise DriftError(
@@ -613,14 +660,35 @@ class FleetMirror:
                     "queue order", tick=tick, stream="fleet", rids=[rid])
             self.redispatch.popleft()
             self._grant(rid, name)
-            sched = self._member(name, tick, "re-dispatch").sched
-            sched.outlen[rid] = outl
-            sched.q_append(rid)
+            if bus:
+                # The grant is the SEND; queue membership waits for the
+                # wire (the t_delivered marker, same tick when inline).
+                self._inflight[rid] = (name, outl)
+            else:
+                sched = self._member(name, tick, "re-dispatch").sched
+                sched.outlen[rid] = outl
+                sched.q_append(rid)
         for rid, name in rec.get("dispatched_to") or []:
             self.pending -= 1
             self._grant(rid, name)
-            sched = self._member(name, tick, "dispatch").sched
-            sched.outlen[rid] = 0
+            if bus:
+                self._inflight[rid] = (name, 0)
+            else:
+                sched = self._member(name, tick, "dispatch").sched
+                sched.outlen[rid] = 0
+                sched.q_append(rid)
+        # Wire deliveries LAST: an inline zero-fault delivery rides the
+        # same record as its send, and must pop the stash it just made.
+        for rid, name in rec.get("t_delivered") or []:
+            ent = self._inflight.pop(rid, None)
+            if ent is None or ent[0] != name:
+                raise DriftError(
+                    f"fleet: tick {tick}: wire delivery of rid {rid} to "
+                    f"{name} without a matching in-flight dispatch "
+                    f"(stashed: {ent})", tick=tick, stream="fleet",
+                    rids=[rid])
+            sched = self._member(name, tick, "wire delivery").sched
+            sched.outlen[rid] = ent[1]
             sched.q_append(rid)
 
     def fleet_digest(self) -> int:
@@ -629,7 +697,9 @@ class FleetMirror:
              for m in sorted(self.members.values(), key=lambda m: m.name)),
             ((rid, ho.state, ho.src, ho.dst or "")
              for rid, ho in sorted(self.handoffs.items())),
-            self.pending, tuple(self.redispatch), self.fence_crc)
+            self.pending, tuple(self.redispatch), self.fence_crc,
+            transport=(transport_digest_tuple(self.transport)
+                       if self.transport is not None else None))
 
     def check_fleet(self, rec: dict) -> None:
         tick = rec.get("tick")
@@ -652,6 +722,7 @@ class FleetMirror:
                 self.terminal.add(rid)
             self.pending = 0
             self.redispatch.clear()
+            self._inflight.clear()
             self.check_fleet(rec)
             return
         m = self.members.get(name)
@@ -712,6 +783,9 @@ class FleetMirror:
             "fence_crc": self.fence_crc,
             "replicas": {m.name: m.sched.snapshot()
                          for m in self.members.values()},
+            **({"transport": dict(self.transport),
+                "wire_inflight": sorted(self._inflight)}
+               if self.transport is not None else {}),
         }
 
 

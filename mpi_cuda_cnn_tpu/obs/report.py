@@ -333,6 +333,27 @@ def summarize(records: Iterable[dict], *,
             "route_last": last.get("route"),
         }
 
+    # Lossy transport (ISSUE 20): the bus's cumulative message counters
+    # from the run summary (present on every --transport run, faults or
+    # not), plus partition open/heal lifecycle counts from the
+    # `transport` event records.
+    t_serve = next((r for r in ev.get("serve", [])
+                    if r.get("msgs_sent") is not None), None)
+    t_events = ev.get("transport", [])
+    if t_serve is not None or t_events:
+        t_kinds: dict[str, int] = {}
+        for r in t_events:
+            k = r.get("kind", "?")
+            t_kinds[k] = t_kinds.get(k, 0) + 1
+        summary["transport"] = {
+            **({k: t_serve.get(k) for k in
+                ("msgs_sent", "msgs_delivered", "msgs_dropped",
+                 "msgs_duped", "msgs_delayed", "msgs_deduped",
+                 "retransmits", "lease_refusals", "partitions",
+                 "lease_ticks")} if t_serve is not None else {}),
+            "events": dict(sorted(t_kinds.items())),
+        }
+
     handoffs = ev.get("handoff", [])
     if handoffs:
         # Disaggregated KV handoffs (ISSUE 13): lifecycle counts by
@@ -690,6 +711,29 @@ def render_markdown(summary: dict, title: str = "Run report") -> str:
                 lines.append(
                     f"| {name} | {_fmt(hits)} | {_fmt(disp)} | {rate} |")
             lines.append("")
+    if "transport" in summary:
+        # Lossy transport (ISSUE 20): bus message totals + lease
+        # refusals — the exactly-once machinery's visible work.
+        tr = summary["transport"]
+        lines += [
+            "| transport | sent | delivered | dropped | duped | delayed "
+            "| deduped | retransmits | lease refused | partitions |",
+            "|---|---|---|---|---|---|---|---|---|---|",
+            f"| {'lease %st' % _fmt(tr.get('lease_ticks')) if tr.get('lease_ticks') else 'lease off'} "
+            f"| {_fmt(tr.get('msgs_sent'))} "
+            f"| {_fmt(tr.get('msgs_delivered'))} "
+            f"| {_fmt(tr.get('msgs_dropped'))} "
+            f"| {_fmt(tr.get('msgs_duped'))} "
+            f"| {_fmt(tr.get('msgs_delayed'))} "
+            f"| {_fmt(tr.get('msgs_deduped'))} "
+            f"| {_fmt(tr.get('retransmits'))} "
+            f"| {_fmt(tr.get('lease_refusals'))} "
+            f"| {_fmt(tr.get('partitions'))} |",
+        ]
+        if tr.get("events"):
+            lines.append("partition lifecycle: " + "  ".join(
+                f"{k}:{v}" for k, v in tr["events"].items()))
+        lines.append("")
     if "handoffs" in summary:
         # Disaggregated KV handoffs (ISSUE 13).
         ho = summary["handoffs"]

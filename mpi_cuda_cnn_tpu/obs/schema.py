@@ -91,7 +91,21 @@ EVENT_KEYS: dict[str, tuple[str, ...]] = {
     # "handoff_done" ([[rid, dst]]), "handoff_aborted" ([[rid, reason]])
     # and "handoffs_inflight" — the prefill->decode KV transfer markers,
     # ordered in the JSONL before any replica record of the same tick.
+    # Lossy transport (ISSUE 20, --transport only): "transport" (the
+    # bus's pre-step counter/link/partition block the replay mirror
+    # folds into fleet_digest), "t_delivered" ([[rid, replica]] —
+    # dispatches DELIVERED over the wire this tick, distinct from
+    # dispatched_to which marks the router's send), "t_terminal"
+    # (terminal details harvested from bus messages between ticks),
+    # "t_retransmits" ([[kind, dst, rid]]) and "lease_refused"
+    # ([[rid, replica]] — commits a replica refused past its lease).
     "fleet": ("tick", "now", "replicas"),
+    # One transport-bus lifecycle moment (serve/transport.py, ISSUE 20):
+    # kind is partition_open / partition_heal; "name" the isolated
+    # replica, "tick"/"heal" the window. Message-level faults stay
+    # un-evented as records (they'd rival the tick volume) — the
+    # per-tick fleet "transport" block carries the counters.
+    "transport": ("kind",),
     # One prefill->decode KV handoff lifecycle moment (serve/fleet.py,
     # ISSUE 13): "state" is started / done / aborted (aborted carries
     # "reason": sender_dead / receiver_dead / dropped / kv_corrupt /

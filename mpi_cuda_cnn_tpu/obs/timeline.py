@@ -96,8 +96,17 @@ class Lifecycle:
                 n += 1
             elif e[2] == "decode":
                 n += e[3][1] if isinstance(e[3], list) else 1
-            elif e[2] == "redispatched" and e[3] == "discard":
-                n = 0
+            elif e[2] == "redispatched":
+                d = e[3]
+                if isinstance(d, list):
+                    # [policy, outlen] (ISSUE 20 trail): reset to the
+                    # authoritative committed count — discard throws
+                    # everything away; resume replays from outlen, and
+                    # any tokens the trail emitted past it were lost
+                    # undelivered commits the new replica re-emits.
+                    n = 0 if d[0] == "discard" else d[1]
+                elif d == "discard":
+                    n = 0
         return n
 
     @property
@@ -143,10 +152,30 @@ def reconstruct(records: list[dict]) -> dict[str, dict[int, Lifecycle]]:
             # emits it before stepping replicas), so the lifecycle
             # stays ordered across the failover.
             tick, now = rec.get("tick"), rec.get("now")
+            # redispatched_to (ISSUE 15) carries the authoritative
+            # committed-token count at failover — under the lossy bus
+            # (ISSUE 20) that can be SMALLER than the tokens the dead
+            # replica's trail emitted (undelivered commits are lost and
+            # re-emitted), so the token account resets to it.
+            outls = {rid: outl
+                     for rid, _n, outl in rec.get("redispatched_to") or []}
             for rid in rec.get("redispatched") or []:
                 lc = life("fleet", rid)
+                policy = rec.get("redispatch", "resume")
                 lc.events.append((tick, now, "redispatched",
-                                  rec.get("redispatch", "resume")))
+                                  [policy, outls.get(rid, 0)]
+                                  if rid in outls else policy))
+            # Lossy-transport lifecycle markers (ISSUE 20): a
+            # retransmitted dispatch/commit/terminal for the rid, and a
+            # commit the replica refused past its lease — display rows
+            # that explain a wire gap in the surrounding segments.
+            for kind, _dst, rid in rec.get("t_retransmits") or []:
+                if rid >= 0:
+                    life("fleet", rid).events.append(
+                        (tick, now, "retransmit", kind))
+            for rid, name in rec.get("lease_refused") or []:
+                life("fleet", rid).events.append(
+                    (tick, now, "lease_refused", name))
             # Cache-aware routing marker (ISSUE 18): the router placed
             # rid on `name` expecting `matched` hot prefix tokens —
             # ordered before the replica's first emission for the rid

@@ -102,6 +102,13 @@ class TopState:
         # order, plus the newest frontier summary record.
         self.goodput_cands: deque = deque(maxlen=8)
         self.goodput_frontier: dict | None = None
+        # TRANSPORT panel (ISSUE 20): newest per-tick bus block from
+        # the fleet records (cumulative counters + live partitions),
+        # running lease-refusal/retransmit-marker totals, and the
+        # partition open/heal lifecycle counts.
+        self.transport: dict | None = None
+        self.lease_refused = 0
+        self.transport_kinds: dict[str, int] = {}
         self._history = history
 
     def reset(self) -> None:
@@ -142,6 +149,9 @@ class TopState:
             self.fleet = rec
             self.pending_hist.append(rec.get("pending", 0))
             self.replicas_hist.append(rec.get("replicas", 0))
+            if rec.get("transport") is not None:
+                self.transport = rec["transport"]
+            self.lease_refused += len(rec.get("lease_refused") or [])
             if rec.get("route") is not None:
                 self.route = rec["route"]
             for name, triple in (rec.get("load") or {}).items():
@@ -152,6 +162,10 @@ class TopState:
         elif ev == "replica":
             kind = rec.get("kind", "?")
             self.replica_kinds[kind] = self.replica_kinds.get(kind, 0) + 1
+        elif ev == "transport":
+            kind = rec.get("kind", "?")
+            self.transport_kinds[kind] = \
+                self.transport_kinds.get(kind, 0) + 1
         elif ev == "goodput":
             if rec.get("kind") == "frontier":
                 self.goodput_frontier = rec
@@ -328,6 +342,45 @@ def render(state: TopState, path: str, width: int = 96) -> str:
                     f"{sparkline(state.replicas_hist)} "
                     f"now {_fmt(fl.get('replicas'))}"
                 )
+        sv0 = state.serve.get("fleet") or {}
+        if state.transport is not None or sv0.get("msgs_sent") is not None:
+            # TRANSPORT panel (ISSUE 20): the lossy bus live — per-tick
+            # cumulative counters from the fleet records (full log),
+            # falling back to the run summary's msgs_* totals.
+            t = state.transport or {
+                "sent": sv0.get("msgs_sent"),
+                "delivered": sv0.get("msgs_delivered"),
+                "dropped": sv0.get("msgs_dropped"),
+                "duped": sv0.get("msgs_duped"),
+                "deduped": sv0.get("msgs_deduped"),
+                "retransmits": sv0.get("retransmits"),
+                "partitions": sv0.get("partitions"),
+                "inflight": 0, "unacked": 0, "links": [],
+                "partitioned": [],
+            }
+            lines.append(
+                f"  TRANSPORT  sent {_fmt(t['sent'])}  "
+                f"delivered {_fmt(t['delivered'])}  "
+                f"dropped {_fmt(t['dropped'])}  duped {_fmt(t['duped'])}  "
+                f"deduped {_fmt(t['deduped'])}  "
+                f"retransmits {_fmt(t['retransmits'])}"
+            )
+            open_p = t.get("partitioned") or []
+            lines.append(
+                f"    wire inflight {_fmt(t['inflight'])}  "
+                f"unacked {_fmt(t['unacked'])}  "
+                f"links {len(t.get('links') or [])}  "
+                f"partitions {_fmt(t['partitions'])}"
+                + ("  OPEN: " + ", ".join(f"{n} heals@{u}"
+                                          for n, u in open_p)
+                   if open_p else "")
+                + f"  lease refused "
+                  f"{state.lease_refused or sv0.get('lease_refusals') or 0}"
+            )
+            if state.transport_kinds:
+                lines.append("    lifecycle: " + "  ".join(
+                    f"{k}:{v}"
+                    for k, v in sorted(state.transport_kinds.items())))
         snap = state.metrics.get("fleet", {})
         if snap.get("counters"):
             lines.append(

@@ -940,6 +940,25 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--heartbeat-miss", type=int, default=3,
                     help="consecutive missed heartbeat ticks before a "
                          "replica is declared dead")
+    ap.add_argument("--transport", action="store_true",
+                    help="route the control plane over the simulated "
+                         "lossy message bus (ISSUE 20): dispatch, "
+                         "commits, terminals, and heartbeats become "
+                         "sequenced messages with at-least-once "
+                         "retransmission + receiver dedup; fences gain "
+                         "lease expiries and failure detection becomes "
+                         "fallible (late != dead). Zero-fault runs stay "
+                         "bitwise-equal to the direct-call fleet; "
+                         "unlocks the fleet.transport fault site")
+    ap.add_argument("--lease-ticks", type=int, default=0,
+                    help="commit-lease lifetime in fleet ticks "
+                         "(--transport; 0 = heartbeat_miss + 2; must "
+                         "exceed --heartbeat-miss so a live replica's "
+                         "heartbeats renew faster than its lease decays)")
+    ap.add_argument("--rto-base", type=float, default=2.0,
+                    help="retransmission-timeout base in fleet ticks "
+                         "(--transport; utils/retry.backoff_delay-paced "
+                         "exponential, deterministic zero-jitter)")
     ap.add_argument("--max-flaps", type=int, default=3,
                     help="crashes before a flapping replica's circuit "
                          "opens (it never rejoins)")
@@ -1150,6 +1169,15 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
               "performs no KV handoffs)", file=sys.stderr)
         return 2
 
+    if args.lease_ticks and not args.transport:
+        print("error: --lease-ticks needs --transport (leases pace the "
+              "bus's commit fences; the direct-call fleet has no wire "
+              "to lease against)", file=sys.stderr)
+        return 2
+    if args.rto_base != 2.0 and not args.transport:
+        print("error: --rto-base needs --transport (there are no "
+              "retransmissions without the bus)", file=sys.stderr)
+        return 2
     if args.spill and not args.prefix_cache:
         print("error: --spill needs --prefix-cache (the host tier "
               "spills prefix-cache pages; there is nothing to spill)",
@@ -1376,6 +1404,8 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
                 spec_ngram=args.spec_ngram,
                 pools=pools, handoff_ticks=args.handoff_ticks,
                 autoscale=autoscaler,
+                transport=args.transport, lease_ticks=args.lease_ticks,
+                rto_base=args.rto_base,
                 # The per-transfer lifecycle log is only ever emitted at
                 # --log full; at summary-mode storm scale retaining it
                 # would be pure GC ballast (the counters still stamp).
@@ -1407,6 +1437,8 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
         registry.emit(metrics, mode="fleet", final=True)
         for rec in result.replica_log:
             metrics.log("replica", **rec)
+        for rec in result.transport_log:
+            metrics.log("transport", **rec)
         for ev in result.events:
             metrics.log("fault", **{"mode": "fleet", **ev})
         if metrics.jsonl_enabled and args.log == "full":
@@ -1447,7 +1479,12 @@ def fleet_bench_main(argv: list[str] | None = None) -> int:
             "prefix_cache": bool(args.prefix_cache),
             # Host-tier geometry (ISSUE 17): the replay mirror extends
             # each replica's digest with the tier tuple iff > 0.
-            "host_pages": host_pages, **s,
+            "host_pages": host_pages,
+            # Transport mode (ISSUE 20): the replay mirror folds the
+            # per-tick transport block into fleet_digest iff enabled;
+            # lease_ticks is the EFFECTIVE value (0 flag -> default).
+            "transport": bool(args.transport),
+            "lease_ticks": fleet.lease_ticks, **s,
         })
         print(json.dumps({"bench": "fleet", "compute": args.compute,
                           "policy": args.policy, **s}))

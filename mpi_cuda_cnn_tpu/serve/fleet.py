@@ -86,6 +86,7 @@ from .pool import PagePool
 from .prefix_cache import PrefixCache, empty_prefix_fields
 from .router import CircuitOpen, Router, fleet_state_digest
 from .spec import LookupProposer, empty_spec_fields, run_round
+from .transport import TRANSPORT_SITE, TransportBus, transport_digest_tuple
 from .scheduler import (
     ContinuousScheduler,
     Request,
@@ -113,7 +114,20 @@ __all__ = [
 # chaos.episode's try/finally, and the chaos search must both FIND the
 # violation and shrink it to a minimal plan (pinning that the sampler
 # reaches the failover site and the shrinker converges).
+#
+# "skip-dedup" (ISSUE 20) is the transport twin: the bus skips the
+# receiver-side seen-check for COMMIT keys, so a duplicated commit
+# message applies twice and the authoritative output diverges from the
+# SimCompute closed form — the exactly-once canary a single sampled
+# msg_dup must expose.
 CHAOS_PLANT: str | None = None
+
+
+def _chaos_plant() -> str | None:
+    """Late-bound CHAOS_PLANT read for the transport bus (the chaos
+    harness flips the module global AFTER the Fleet — and its bus — is
+    constructed)."""
+    return CHAOS_PLANT
 
 
 class SimCompute:
@@ -494,6 +508,13 @@ class Replica:
         self.alive = True
         self.zombie_until = -1   # fleet tick a partitioned zombie stops at
         self.pending_dispatches = 0
+        # Lossy-transport incarnation identity + lease (ISSUE 20): gen
+        # distinguishes this object's bus endpoint ("<name>#<gen>")
+        # from a restarted successor's; the replica refuses its OWN
+        # commits once the fleet tick passes lease_until (renewed by
+        # every hb_ack). Both are inert with the bus off.
+        self.gen = 0
+        self.lease_until = -1
 
     def _gauge(self, name: str) -> float:
         g = self.registry.gauges.get(name)
@@ -576,6 +597,9 @@ class FleetResult:
     dispatch_trace: list[tuple] = dataclasses.field(default_factory=list)
     events: list[dict] = dataclasses.field(default_factory=list)
     replica_log: list[dict] = dataclasses.field(default_factory=list)
+    # Transport lifecycle records (ISSUE 20, bus on): partition
+    # open/heal moments, logged as the obs `transport` event family.
+    transport_log: list[dict] = dataclasses.field(default_factory=list)
     # Fleet-wide prefix-cache structural counters (ISSUE 9): summed
     # across every replica incarnation; zeros with sharing off so the
     # gated metrics exist in every fleet-bench run.
@@ -605,6 +629,21 @@ class FleetResult:
     scale_downs: int = 0
     scale_crc: int = 0
     replica_ticks: int = 0
+    # Lossy-transport counters (ISSUE 20): the message bus's wire
+    # accounting plus the lease-refusal count (commits/terminals a
+    # replica refused to SEND past its own lease — the isolated-replica
+    # proof obligation). All stamped (zeros) with the bus off so the
+    # transport gate can pin them in every fleet-bench run.
+    msgs_sent: int = 0
+    msgs_delivered: int = 0
+    msgs_dropped: int = 0
+    msgs_duped: int = 0
+    msgs_delayed: int = 0
+    msgs_deduped: int = 0
+    retransmits: int = 0
+    lease_refusals: int = 0
+    partitions: int = 0
+    lease_ticks: int = 0
 
     @property
     def output_tokens(self) -> int:
@@ -698,6 +737,19 @@ class FleetResult:
             "scale_downs": self.scale_downs,
             "scale_crc": self.scale_crc,
             "replica_ticks": self.replica_ticks,
+            # Lossy-transport counters (ISSUE 20): flat keys the
+            # transport determinism gate pins at exact equality; zeros
+            # with the bus off so they exist in every fleet-bench run.
+            "msgs_sent": self.msgs_sent,
+            "msgs_delivered": self.msgs_delivered,
+            "msgs_dropped": self.msgs_dropped,
+            "msgs_duped": self.msgs_duped,
+            "msgs_delayed": self.msgs_delayed,
+            "msgs_deduped": self.msgs_deduped,
+            "retransmits": self.retransmits,
+            "lease_refusals": self.lease_refusals,
+            "partitions": self.partitions,
+            "lease_ticks": self.lease_ticks,
             **({"pools": dict(self.pools)} if self.pools else {}),
             # Prefix-sharing counters (ISSUE 9): flat keys the fleet
             # determinism gate pins at exact equality.
@@ -735,7 +787,9 @@ class Fleet:
                  sched_policy=None, pools: dict[str, int] | str | None = None,
                  handoff_ticks: int = 1, log_handoffs: bool = True,
                  spec: str = "off", spec_k: int = 8, spec_ngram: int = 2,
-                 host_pages: int = 0, autoscale=None):
+                 host_pages: int = 0, autoscale=None,
+                 transport: bool = False, lease_ticks: int = 0,
+                 rto_base: float = 2.0):
         if isinstance(pools, str):
             pools = parse_pools(pools)
         if pools is not None:
@@ -791,6 +845,42 @@ class Fleet:
                 "(--prefix-cache) — without the prefix tree there are "
                 "no cache keys to route on"
             )
+        if transport and pools is not None:
+            # Scope cut (ISSUE 20): the handoff control messages of a
+            # disaggregated fleet are not bus-routed yet — running both
+            # would silently leave the handoff path on the perfect
+            # in-process channel, so the combination is refused loudly.
+            raise ValueError(
+                "transport=True (--transport) does not compose with "
+                "--pools yet — the prefill->decode handoff control "
+                "plane still uses direct calls"
+            )
+        if not transport and faults is not None:
+            # Inert-fault contract, transport leg: the fleet.transport
+            # site is only polled when the message bus exists — with
+            # the bus off the fault would validate and silently never
+            # fire.
+            inert = [f"{f.kind}@{f.site}"
+                     for f in faults.pending(TRANSPORT_SITE)]
+            if inert:
+                raise ValueError(
+                    f"fault(s) {', '.join(sorted(set(inert)))} need the "
+                    "lossy transport (--transport) — without the "
+                    "message bus they would silently never fire"
+                )
+        if transport:
+            if lease_ticks == 0:
+                # Default: a lease outlives the detection window by two
+                # ticks, so a replica never refuses its own commits
+                # while the router still trusts its heartbeats.
+                lease_ticks = heartbeat_miss + 2
+            if lease_ticks <= heartbeat_miss:
+                raise ValueError(
+                    f"lease_ticks ({lease_ticks}) must exceed "
+                    f"heartbeat_miss ({heartbeat_miss}): a lease "
+                    "shorter than the detection window makes a healthy "
+                    "replica refuse its own commits"
+                )
         if redispatch == "discard" and faults is not None \
                 and faults.pending("fleet.resume"):
             # Same contract, resume leg: discard re-dispatches never
@@ -853,6 +943,7 @@ class Fleet:
         self.replica_ticks = 0
         self.events: list[dict] = []       # obs `fault` field dicts
         self.replica_log: list[dict] = []  # obs `replica` field dicts
+        self.transport_log: list[dict] = []  # obs `transport` dicts
         self.dispatch_trace: list[tuple] = []
         self.dispatches = 0
         self.redispatches = 0
@@ -909,6 +1000,43 @@ class Fleet:
         self._pending_restarts: list[tuple[float, str]] = []
         self._next_idx = 0
         self._tick = 0
+        # Lossy transport (ISSUE 20): the deterministic message bus the
+        # whole control plane speaks over when transport=True. All the
+        # state below is inert (bus None, zeros) on a direct-call
+        # fleet.
+        self.lease_ticks = lease_ticks if transport else 0
+        self.bus: TransportBus | None = None
+        if transport:
+            self.bus = TransportBus(faults=faults, rto_base=rto_base,
+                                    plant=_chaos_plant,
+                                    on_event=self._on_bus_event)
+            self.bus.register("router", self._router_msg)
+        self.lease_refusals = 0
+        # Incarnation counter per NAME (the bus endpoint "<name>#<gen>"
+        # — a restarted replica is a different destination).
+        self._gen_of: dict[str, int] = {}
+        # rid -> (epoch, {pos: (tok, now)}): commits that arrived ahead
+        # of a gap (reordered/delayed); drained in order as the gap
+        # fills. rid -> (epoch, payload): terminal claims waiting for
+        # their trailing commits.
+        self._commit_stash: dict[int, tuple[int, dict]] = {}
+        self._pending_terms: dict[int, tuple[int, dict]] = {}
+        # Terminal applications since the last drain (the bus delivers
+        # inline mid-step; the loop drains these where the direct path
+        # would have called _sync_terminal).
+        self._synced_now: list[Request] = []
+        # This tick's [rid, name] dispatch deliveries to CURRENT
+        # incarnations — the fleet-record marker the replay mirror
+        # sources queue membership from under transport.
+        self._t_delivered: list[list] = []
+        # False-positive failovers (ISSUE 20): (replica, name) pairs
+        # declared dead by heartbeat staleness while actually ALIVE
+        # behind a partition. They keep stepping off-trail (like
+        # post-failover zombies) until their lease lapses — every
+        # commit they attempt must be lease/fence-refused.
+        self._isolated: list[tuple[Replica, str]] = []
+        self._partition_events: list[dict] = []
+        self._lease_refused_tick: list[list] = []
         if pools is None:
             phases: list[str | None] = [None] * replicas
         else:
@@ -936,6 +1064,16 @@ class Fleet:
                       tier_fault_poll=poll, **self.geometry)
         rep.core.on_emit = self._make_emit(rep)
         rep.core.on_prefill_done = self._make_prefill_done(rep)
+        if self.bus is not None:
+            # Fresh incarnation, fresh bus endpoint: a message in
+            # flight to the previous incarnation can never reach this
+            # one. The initial lease covers the joining tick (renewed
+            # by the first hb_ack).
+            rep.gen = self._gen_of.get(name, -1) + 1
+            self._gen_of[name] = rep.gen
+            rep.lease_until = self._tick + self.lease_ticks
+            self.bus.register(self._endpoint(rep),
+                              self._make_replica_msg(rep))
         return rep
 
     def _join(self, *, tick: int, now: float, log: bool = True,
@@ -966,6 +1104,28 @@ class Fleet:
         name = replica.name
 
         def emit(local: Request, tok: int, now: float) -> None:
+            if self.bus is not None:
+                # Lease fence, sender side (ISSUE 20): past its lease a
+                # replica refuses its OWN commit — it does not even
+                # send. ReplicaCore._emit appended tok to local.out
+                # before calling us, so the commit's position is
+                # len-1; the router applies commits in position order
+                # (gap-stashed), so reordered delivery cannot misfile
+                # a token.
+                if self._tick >= replica.lease_until:
+                    self.lease_refusals += 1
+                    self._lease_refused_tick.append([local.rid, name])
+                    return
+                self.bus.send(
+                    "commit", self._endpoint(replica), "router",
+                    {"rid": local.rid, "epoch": local._fleet_epoch,
+                     "pos": len(local.out) - 1, "tok": tok, "now": now,
+                     "name": name},
+                    tick=self._tick,
+                    key=(local.rid, "c", local._fleet_epoch,
+                         len(local.out) - 1),
+                    reliable=True)
+                return
             if self.router.fence_ok(local.rid, name, local._fleet_epoch):
                 auth = self._auth[local.rid]
                 auth.out.append(tok)
@@ -1011,6 +1171,180 @@ class Fleet:
             self._holder.pop(local.rid, None)
             synced.append(auth)
         return synced
+
+    # -- lossy transport (ISSUE 20) ------------------------------------
+
+    @staticmethod
+    def _endpoint(rep: Replica) -> str:
+        return f"{rep.name}#{rep.gen}"
+
+    def _on_bus_event(self, kind: str, fields: dict) -> None:
+        # Partition open/heal markers, drained onto the replica log (+
+        # registry) by the run loop once it knows the tick's `now`.
+        self._partition_events.append({"kind": kind, **fields})
+
+    def _router_msg(self, msg, tick: int) -> None:
+        """The router's bus endpoint: heartbeats, commits, terminal
+        claims. Commits and terminals pass the SAME generation fence
+        the direct path uses — the lease (sender side) and the fence
+        (receiver side) together are the exactly-once proof."""
+        kind, p = msg.kind, msg.payload
+        if kind == "hb":
+            member = self.router.members.get(p["name"])
+            if member is None:
+                return  # unknown / failed-over sender: no ack, no renewal
+            rep = member.replica
+            if rep.gen != p["gen"] or not rep.alive:
+                return
+            # Guard against reordered/delayed heartbeats moving
+            # last_beat backwards.
+            if p["tick"] > member.last_beat:
+                self.router.beat(p["name"], p["tick"])
+            self.bus.send("hb_ack", "router", msg.src,
+                          {"until": tick + self.lease_ticks}, tick=tick)
+            return
+        if kind == "commit":
+            rid, epoch = p["rid"], p["epoch"]
+            if not self.router.fence_ok(rid, p["name"], epoch):
+                self.fenced_discards += 1
+                return
+            auth = self._auth[rid]
+            if auth.terminal:
+                # Post-terminal straggler (its dedup keys were
+                # released): the request already left the system.
+                return
+            pos = p["pos"]
+            if pos > len(auth.out):
+                # Reordered ahead of a gap: stash until the gap fills.
+                # (pos < len can only happen when dedup is bypassed —
+                # the skip-dedup canary — and then the duplicate
+                # append below is exactly the double-generation the
+                # chaos oracle must catch: dedup is load-bearing.)
+                ep0, stash = self._commit_stash.get(rid, (epoch, None))
+                if stash is None or ep0 != epoch:
+                    stash = {}
+                    self._commit_stash[rid] = (epoch, stash)
+                stash[pos] = (p["tok"], p["now"])
+                return
+            self._apply_commit(auth, p["tok"], p["now"])
+            ep0, stash = self._commit_stash.get(rid, (epoch, None))
+            if stash is not None and ep0 == epoch:
+                while True:
+                    nxt = stash.pop(len(auth.out), None)
+                    if nxt is None:
+                        break
+                    self._apply_commit(auth, nxt[0], nxt[1])
+                if not stash:
+                    del self._commit_stash[rid]
+            self._try_pending_term(rid, epoch)
+            return
+        if kind == "terminal":
+            rid, epoch = p["rid"], p["epoch"]
+            if not self.router.fence_ok(rid, p["name"], epoch):
+                self.fenced_discards += 1
+                return
+            if self._auth[rid].terminal:
+                return
+            if len(self._auth[rid].out) < p["outlen"]:
+                # Trailing commits still in flight: exactly-once means
+                # the terminal waits for them (retransmission
+                # guarantees they arrive while the fence holds).
+                self._pending_terms[rid] = (epoch, p)
+                return
+            self._apply_terminal_msg(p)
+
+    @staticmethod
+    def _apply_commit(auth: Request, tok: int, now: float) -> None:
+        auth.out.append(tok)
+        if auth.first_token_at is None:
+            auth.first_token_at = now
+
+    def _try_pending_term(self, rid: int, epoch: int) -> None:
+        held = self._pending_terms.get(rid)
+        if held is None or held[0] != epoch:
+            return
+        p = held[1]
+        if len(self._auth[rid].out) < p["outlen"]:
+            return
+        del self._pending_terms[rid]
+        # The fence can have moved while the terminal waited (a
+        # failover re-dispatched the rid): re-check before applying.
+        if not self.router.fence_ok(rid, p["name"], epoch):
+            self.fenced_discards += 1
+            return
+        self._apply_terminal_msg(p)
+
+    def _apply_terminal_msg(self, p: dict) -> None:
+        """The bus twin of one _sync_terminal iteration (fence already
+        checked): fold the replica-local terminal outcome into the
+        authoritative record, exactly once."""
+        auth = self._auth[p["rid"]]
+        auth.status = p["status"]
+        auth.fail_reason = p["fail_reason"]
+        auth.finished_at = p["finished_at"]
+        auth.preemptions += p["preemptions"]
+        auth.quota_wait_s += p["quota_wait_s"]
+        if auth.admitted_at is None:
+            auth.admitted_at = p["admitted_at"]
+        if self.registry is not None:
+            from .engine import _observe_request
+            _observe_request(self.registry, auth)
+        self._holder.pop(p["rid"], None)
+        self._commit_stash.pop(p["rid"], None)
+        # Terminal rid: its dedup keys are dead weight (the
+        # auth.terminal guard above catches post-release stragglers).
+        self.bus.release_keys(p["rid"])
+        self._synced_now.append(auth)
+
+    def _drain_synced(self) -> list[Request]:
+        synced, self._synced_now = self._synced_now, []
+        return synced
+
+    def _make_replica_msg(self, rep: Replica):
+        def handle(msg, tick: int) -> None:
+            if msg.kind == "hb_ack":
+                rep.lease_until = max(rep.lease_until,
+                                      msg.payload["until"])
+                return
+            if msg.kind == "dispatch":
+                local = msg.payload
+                rep.core.submit(local)
+                if local.cancel_requested:
+                    # A cancel that landed while the dispatch was in
+                    # flight re-arms the sweep at delivery (the
+                    # send-time flag was consumed by earlier steps).
+                    rep.core.flag_cancel()
+                member = self.router.members.get(rep.name)
+                if member is not None and member.replica is rep:
+                    # Delivery marker for the replay mirror — CURRENT
+                    # incarnations only: a delivery to an isolated
+                    # stale incarnation is off-trail (its records
+                    # never sink), like a post-failover zombie's work.
+                    self._t_delivered.append([local.rid, rep.name])
+        return handle
+
+    def _send_terminals(self, rep: Replica, locals_, tick: int) -> None:
+        """Bus twin of the _sync_terminal CALL: each newly terminal
+        local becomes a reliable terminal claim — unless the sender's
+        lease lapsed, in which case it refuses to claim at all (the
+        failover will re-dispatch the rid; lease refusal is what makes
+        the false-positive path double-generation-free)."""
+        for local in locals_:
+            if tick >= rep.lease_until:
+                self.lease_refusals += 1
+                self._lease_refused_tick.append([local.rid, rep.name])
+                continue
+            self.bus.send(
+                "terminal", self._endpoint(rep), "router",
+                {"rid": local.rid, "epoch": local._fleet_epoch,
+                 "name": rep.name, "outlen": len(local.out),
+                 "status": local.status, "fail_reason": local.fail_reason,
+                 "finished_at": local.finished_at,
+                 "preemptions": local.preemptions,
+                 "quota_wait_s": local.quota_wait_s,
+                 "admitted_at": local.admitted_at},
+                tick=tick, key=(local.rid, "t", local._fleet_epoch),
+                reliable=True)
 
     # -- prefill->decode KV handoff (ISSUE 13) -------------------------
 
@@ -1383,7 +1717,18 @@ class Fleet:
         # arrival when the fleet already served tokens for it.
         local.admitted_at = req.admitted_at
         local._fleet_epoch = epoch
-        member.replica.core.submit(local)
+        if self.bus is not None:
+            # Bus-routed dispatch (ISSUE 20): a reliable keyed message
+            # to the target's CURRENT incarnation endpoint. Inline
+            # delivery at zero faults is the direct submit(); under
+            # faults the message can be dropped (retransmitted),
+            # delayed, or duplicated (deduped at the endpoint).
+            self.bus.send("dispatch", "router",
+                          self._endpoint(member.replica), local,
+                          tick=tick, key=(req.rid, "d", epoch),
+                          reliable=True)
+        else:
+            member.replica.core.submit(local)
         member.replica.pending_dispatches += 1
         self._holder[req.rid] = (member.replica, local)
         if req.cancel_requested:
@@ -1431,8 +1776,24 @@ class Fleet:
         (fence revoked here — a zombie loses commit rights the moment
         failover begins, before the re-dispatch is even placed)."""
         sched = replica.core.sched
-        locals_ = [s.req for s in sched.slots if s.req is not None]
-        locals_ += list(sched.queue)
+        if self.bus is not None:
+            # Holder-based harvest (ISSUE 20): under the lossy bus a
+            # dispatch can still be IN FLIGHT to the dead/isolated
+            # incarnation (delayed, or dropped and awaiting
+            # retransmit) — it exists in no slot or queue, but its rid
+            # is stranded all the same. The holder map is the
+            # authoritative "who serves rid" record, written at send
+            # time; at zero faults it names exactly the slot+queue set
+            # the direct path harvests. Undelivered-terminal rids (the
+            # local finished but the claim never landed) are stranded
+            # too: their holder entry survives because only a
+            # fence-accepted terminal apply pops it.
+            locals_ = [local for _rid, (rep2, local)
+                       in sorted(self._holder.items())
+                       if rep2 is replica]
+        else:
+            locals_ = [s.req for s in sched.slots if s.req is not None]
+            locals_ += list(sched.queue)
         stranded = []
         for local in locals_:
             auth = self._auth[local.rid]
@@ -1456,6 +1817,13 @@ class Fleet:
                    else stranded)
         for auth in revoked:
             self.router.revoke(auth.rid)
+        if self.bus is not None:
+            for auth in stranded:
+                # Reordered commits / deferred terminals stashed under
+                # the just-revoked epoch can never apply — drop them
+                # (a live epoch's stash is rebuilt by retransmission).
+                self._commit_stash.pop(auth.rid, None)
+                self._pending_terms.pop(auth.rid, None)
         return stranded
 
     def _fail_over(self, member, *, tick: int, now: float,
@@ -1473,6 +1841,24 @@ class Fleet:
         self._log_replica(name, "dead", tick, now,
                           stranded=[r.rid for r in stranded],
                           **({"draining": True} if member.draining else {}))
+        if self.bus is not None:
+            rep = member.replica
+            if rep.alive:
+                # Failure detection is fallible under a lossy transport
+                # (late != dead): this member's heartbeats stopped
+                # arriving but the replica itself is fine — a
+                # FALSE-POSITIVE death declaration. It keeps stepping
+                # off-trail until its lease lapses; the lease (sender
+                # side) + the revoked fence (receiver side) guarantee
+                # none of its commits ever land again.
+                self._isolated.append((rep, name))
+                self._log_replica(name, "isolated", tick, now,
+                                  lease_until=rep.lease_until)
+            elif rep not in self._zombies:
+                # Truly dead and done stepping: tear down the
+                # incarnation's endpoint (pending retransmits TO it are
+                # purged — nobody is listening, ever again).
+                self.bus.unregister(self._endpoint(rep))
         if member.draining:
             # The operator already asked this replica to leave; its
             # crash completes the departure (in-flight work was just
@@ -1659,6 +2045,34 @@ class Fleet:
                     self._apply_fault(f, tick=tick, now=now,
                                       redispatch_q=redispatch_q)
                 self.events.extend(self.faults.drain_events())
+            pump_synced: list[Request] = []
+            if self.bus is not None:
+                # Transport tick (ISSUE 20): poll fleet.transport
+                # (partitions open/heal, message effects arm), then
+                # pump — due retransmits go back on the wire and due
+                # delayed copies deliver. A delivery can complete a
+                # request (a deferred terminal whose trailing commits
+                # just landed): those count toward run completion here,
+                # and ride the fleet record's t_terminal marker.
+                self.bus.apply_tick_faults(tick)
+                if self.faults is not None:
+                    self.events.extend(self.faults.drain_events())
+                for ev in self._partition_events:
+                    self._log_replica(ev["name"], ev["kind"], tick, now,
+                                      **({"heal": ev["heal"]}
+                                         if "heal" in ev else {}))
+                    self.transport_log.append(
+                        {"kind": ev["kind"], "name": ev["name"],
+                         "tick": tick, "now": round(now, 6),
+                         **({"heal": ev["heal"]} if "heal" in ev else {})})
+                self._partition_events.clear()
+                self.bus.pump(tick)
+                pump_synced = self._drain_synced()
+                n_done += len(pump_synced)
+                if self.autoscaler is not None:
+                    for r in pump_synced:
+                        self.autoscaler.observe_terminal(
+                            terminal_fields(r), now)
             # Restarts whose backoff elapsed rejoin with fresh state.
             while self._pending_restarts and self._pending_restarts[0][0] <= now:
                 _, name = self._pending_restarts.pop(0)
@@ -1673,11 +2087,20 @@ class Fleet:
             for member in self.router.stale(tick):
                 self._fail_over(member, tick=tick, now=now,
                                 redispatch_q=redispatch_q)
-            # Graceful leave completes when the drain empties.
+            # Graceful leave completes when the drain empties — under
+            # the bus, only once every terminal CLAIM also landed (an
+            # unacked terminal still retransmitting would be lost with
+            # the endpoint).
             for member in list(self.router.members.values()):
-                if member.draining and member.replica.core.unfinished == 0:
+                if member.draining and member.replica.core.unfinished == 0 \
+                        and (self.bus is None
+                             or not any(rep2 is member.replica
+                                        for rep2, _l in
+                                        self._holder.values())):
                     self.router.deregister(member.name)
                     self._retire_counts(member.replica)
+                    if self.bus is not None:
+                        self.bus.unregister(self._endpoint(member.replica))
                     self._log_replica(member.name, "drain_complete", tick,
                                       now)
             # Online autoscaling (ISSUE 18): AFTER drain completions
@@ -1736,6 +2159,18 @@ class Fleet:
                 self._handoff_unplaced_tick, []
             route_hits_tick, self._route_hits_tick = \
                 self._route_hits_tick, []
+            # Transport markers (ISSUE 20): this tick's bus state and
+            # delivery/retransmit events, drained for the fleet record
+            # (pump + dispatch-phase deliveries both happened above).
+            transport_fields = None
+            t_delivered: list[list] = []
+            t_retransmits: list[list] = []
+            lease_refused, self._lease_refused_tick = \
+                self._lease_refused_tick, []
+            if self.bus is not None:
+                transport_fields = self.bus.record_fields()
+                t_delivered, self._t_delivered = self._t_delivered, []
+                t_retransmits = self.bus.drain_retransmits()
             # Flight recorder (ISSUE 15): the router/fleet state digest
             # at record-emission time — membership, in-flight handoff
             # states, dispatch backlog, and the running fence chain —
@@ -1755,6 +2190,8 @@ class Fleet:
                 mparts, hparts, len(pending),
                 [r.rid for r in redispatch_q] if redispatch_q else (),
                 self.router.fence_crc,
+                transport=(transport_digest_tuple(transport_fields)
+                           if transport_fields is not None else None),
             )
             self.state_chain = zlib.crc32(fleet_crc.to_bytes(4, "little"),
                                           self.state_chain)
@@ -1804,6 +2241,20 @@ class Fleet:
                         "route": {n: list(st) for n, st in
                                   sorted(self._route_by.items())}}
                        if self.router.policy == "cache_aware" else {}),
+                    # Lossy-transport fields (ISSUE 20), bus runs only:
+                    # the digested bus state block, dispatch deliveries
+                    # to current incarnations (the mirror's queue-
+                    # membership source), pump-applied terminals (the
+                    # blame/oracle fold reads them next to the replica
+                    # records' fence-accepted sets), and the tick's
+                    # retransmit / lease-refusal display markers.
+                    **({"transport": transport_fields,
+                        "t_delivered": t_delivered,
+                        "t_terminal": [terminal_fields(r)
+                                       for r in pump_synced],
+                        "t_retransmits": t_retransmits,
+                        "lease_refused": lease_refused}
+                       if self.bus is not None else {}),
                     "load": {m.name: [len(m.replica.core.sched.queue),
                                       sum(1 for s in
                                           m.replica.core.sched.slots
@@ -1827,8 +2278,22 @@ class Fleet:
                 # autoscaled acceptance compares. Zombies excluded
                 # (their steps serve nobody the fence accepts).
                 self.replica_ticks += 1
-                self.router.beat(member.name, tick)
-                synced = self._sync_terminal(rep, new_fin + new_drop, now)
+                if self.bus is None:
+                    self.router.beat(member.name, tick)
+                    synced = self._sync_terminal(rep, new_fin + new_drop,
+                                                 now)
+                else:
+                    # Heartbeat as a MESSAGE (ISSUE 20): liveness is
+                    # now whatever the router can observe over the
+                    # lossy channel — a partition starves last_beat
+                    # and staleness declares this member dead even
+                    # though it is fine (the false-positive path). The
+                    # hb_ack carries the lease renewal back.
+                    self.bus.send("hb", self._endpoint(rep), "router",
+                                  {"name": member.name, "gen": rep.gen,
+                                   "tick": tick}, tick=tick)
+                    self._send_terminals(rep, new_fin + new_drop, tick)
+                    synced = self._drain_synced()
                 n_done += len(synced)
                 if self.autoscaler is not None and synced:
                     # Burn-rate pressure feed (ISSUE 18): the SAME
@@ -1867,13 +2332,29 @@ class Fleet:
             for rep in list(self._zombies):
                 if tick >= rep.zombie_until:
                     self._zombies.remove(rep)
+                    if self.bus is not None:
+                        member = self.router.members.get(rep.name)
+                        if member is None or member.replica is not rep:
+                            # Already failed over: the incarnation is
+                            # done stepping — tear down its endpoint.
+                            # (Pre-failover expiry keeps it: the
+                            # failover's unregister handles it.)
+                            self.bus.unregister(self._endpoint(rep))
                     continue
                 rec, new_fin, new_drop = rep.step(now)
                 # Terminal claims from a zombie are fenced like tokens:
                 # before failover revokes its fences the zombie's
                 # completions are authoritative commits and must count
                 # toward n_done; after revocation they are discarded.
-                synced = self._sync_terminal(rep, new_fin + new_drop, now)
+                if self.bus is None:
+                    synced = self._sync_terminal(rep, new_fin + new_drop,
+                                                 now)
+                else:
+                    # A zombie never heartbeats (alive=False), so its
+                    # lease starves and its late claims are first
+                    # lease-refused, then fence-refused — both counted.
+                    self._send_terminals(rep, new_fin + new_drop, tick)
+                    synced = self._drain_synced()
                 n_done += len(synced)
                 if self.autoscaler is not None and synced:
                     # Fence-accepted only — same feed as live members.
@@ -1914,6 +2395,33 @@ class Fleet:
                            if "spec" in rec else {}),
                         "terminal": [terminal_fields(r) for r in synced],
                     })
+            # False-positive failovers (ISSUE 20): an isolated replica
+            # does not know it was declared dead — it keeps stepping,
+            # heartbeating into the partition, and trying to commit.
+            # Off-trail like a post-failover zombie (no records, no
+            # state chain: the fleet's trail covers what the router
+            # TRUSTS). Every commit it sends is fence-refused; once
+            # its lease lapses it refuses its own sends
+            # (lease_refusals), and after a grace window it is torn
+            # down.
+            for rep, name in list(self._isolated):
+                if (rep.core.unfinished == 0
+                        or tick >= rep.lease_until + self.lease_ticks):
+                    self._isolated.remove((rep, name))
+                    self.bus.unregister(self._endpoint(rep))
+                    self._log_replica(name, "isolated_end", tick, now)
+                    continue
+                _rec, new_fin, new_drop = rep.step(now)
+                self.bus.send("hb", self._endpoint(rep), "router",
+                              {"name": name, "gen": rep.gen,
+                               "tick": tick}, tick=tick)
+                self._send_terminals(rep, new_fin + new_drop, tick)
+                synced = self._drain_synced()
+                n_done += len(synced)
+                if self.autoscaler is not None and synced:
+                    for r in synced:
+                        self.autoscaler.observe_terminal(
+                            terminal_fields(r), now)
             if self.registry is not None:
                 self.registry.set("fleet.replicas",
                                   len(self.router.members))
@@ -1930,6 +2438,14 @@ class Fleet:
                 # jump the clock to the next event, or — with no
                 # replicas and none restarting — fail what remains
                 # terminally (requests must always leave).
+                if self.bus is not None and (self.bus.busy()
+                                             or self._isolated):
+                    # The WIRE still holds work (a delayed dispatch, an
+                    # unacked retransmitting send) or an isolated
+                    # replica is still lapsing — neither shows up as
+                    # replica work, but jumping the clock past it would
+                    # strand the run.
+                    continue
                 if any(not m.replica.alive
                        for m in self.router.members.values()):
                     continue
@@ -1974,7 +2490,10 @@ class Fleet:
                         # flight-recorder chain reflects the transition
                         # (the synthetic record below carries it too).
                         router_crc = fleet_state_digest(
-                            (), (), 0, (), self.router.fence_crc)
+                            (), (), 0, (), self.router.fence_crc,
+                            transport=(self.bus.digest_tuple()
+                                       if self.bus is not None
+                                       else None))
                         self.state_chain = zlib.crc32(
                             router_crc.to_bytes(4, "little"),
                             self.state_chain)
@@ -2050,12 +2569,24 @@ class Fleet:
             degraded_unified=len(self._degraded_rids), pools=self.pools,
             handoff_log=self.handoff_log,
             dispatch_trace=self.dispatch_trace, events=self.events,
-            replica_log=self.replica_log, prefix=prefix_totals,
+            replica_log=self.replica_log,
+            transport_log=self.transport_log, prefix=prefix_totals,
             spec=spec_totals, state_crc=self.state_chain,
             route_hits=self.route_hits, route_misses=self.route_misses,
             route_hit_tokens=self.route_hit_tokens,
             scale_ups=self.scale_ups, scale_downs=self.scale_downs,
             scale_crc=self.scale_crc, replica_ticks=self.replica_ticks,
+            lease_refusals=self.lease_refusals,
+            lease_ticks=self.lease_ticks,
+            **({"msgs_sent": self.bus.counters["sent"],
+                "msgs_delivered": self.bus.counters["delivered"],
+                "msgs_dropped": self.bus.counters["dropped"],
+                "msgs_duped": self.bus.counters["duped"],
+                "msgs_delayed": self.bus.counters["delayed"],
+                "msgs_deduped": self.bus.counters["deduped"],
+                "retransmits": self.bus.counters["retransmits"],
+                "partitions": self.bus.counters["partitions"]}
+               if self.bus is not None else {}),
         )
 
 

@@ -70,7 +70,7 @@ def fence_chain(crc: int, *op) -> int:
 
 
 def fleet_state_digest(members, handoffs, pending: int, redispatch,
-                       fence_crc: int) -> int:
+                       fence_crc: int, transport=None) -> int:
     """THE canonical fleet/router state digest (ISSUE 15), shared by
     serve/fleet.py (producer) and obs/replay.py (reconstruction):
     `members` is an iterable of (name, phase, draining, alive) in name
@@ -79,9 +79,16 @@ def fleet_state_digest(members, handoffs, pending: int, redispatch,
     rids in order, and `fence_crc` the router's running generation-fence
     chain (Router.fence_crc — every grant/revoke in commit order, so the
     whole epoch history folds into one number without serializing the
-    O(total rids) fence map per tick)."""
-    return zlib.crc32(repr((tuple(members), tuple(handoffs), pending,
-                            tuple(redispatch), fence_crc)).encode())
+    O(total rids) fence map per tick). `transport` is
+    `serve.transport.transport_digest_tuple` of the message bus's
+    record block when the fleet runs over the lossy bus (ISSUE 20) —
+    None (transport off) preserves the historical 5-tuple spelling
+    bit-for-bit."""
+    parts = (tuple(members), tuple(handoffs), pending,
+             tuple(redispatch), fence_crc)
+    if transport is not None:
+        parts = parts + (transport,)
+    return zlib.crc32(repr(parts).encode())
 
 
 def stable_hash(*parts) -> int:

@@ -172,8 +172,14 @@ def build_fleet():
     sample carries `fleet` records with the `route`/`route_hits`
     fields (the ROUTER top panel + report routing tables + trace
     routed markers), and scale_up/scale_down replica lifecycle
-    markers (the SCALE sparkline + autoscale table)."""
-    from mpi_cuda_cnn_tpu.faults import FakeClock
+    markers (the SCALE sparkline + autoscale table). ISSUE 20 runs
+    the same storm over the lossy message bus (--transport) with a
+    small delay/partition/dup plan, so the sample carries non-zero
+    wire counters (msgs_* / retransmits / lease_refusals), per-tick
+    fleet `transport` blocks, and `transport` partition-lifecycle
+    records — the report's transport table and the trace/top wire
+    surfaces render real numbers, not stamped zeros."""
+    from mpi_cuda_cnn_tpu.faults import FakeClock, FaultInjector
     from mpi_cuda_cnn_tpu.obs.causal import BlameAccumulator
     from mpi_cuda_cnn_tpu.obs.metrics import MetricsRegistry
     from mpi_cuda_cnn_tpu.obs.schema import make_record, validate_record
@@ -209,12 +215,20 @@ def build_fleet():
         out_max=8, rate=300.0, seed=7, sessions=6, prefix_mix=0.7,
         templates=4, turns_dist="uniform:2-3", turn_gap_s=0.01,
         diurnal_amp=0.8, diurnal_period_s=0.15)
+    # ISSUE 20: a short delay/partition/dup schedule — enough to put
+    # retransmits, dedup hits, a false-positive failover, and (via the
+    # partitioned replica's post-lease commits) lease refusals into
+    # the checked-in sample without swamping the 24-request run.
+    faults = FaultInjector(
+        "msg_delay@fleet.transport:6?kind=dispatch&count=2&ticks=3;"
+        "partition@fleet.transport:18?replica=0&ticks=6;"
+        "msg_dup@fleet.transport:40?count=2", clock=clock)
     fleet = Fleet(
         lambda name: SimCompute(vocab=13, chunk=8, salt=7),
         replicas=1, slots=2, num_pages=9, page_size=4, max_len=24,
         policy="cache_aware", prefix=True, host_pages=6, clock=clock,
         registry=registry, fleet_sink=fleet_sink,
-        replica_tick_sink=tick_sink,
+        replica_tick_sink=tick_sink, transport=True, faults=faults,
         autoscale=Autoscaler(parse_autoscale(
             "min=1,max=3,high=2,low=0.2,up=2,down=40,cooldown=0.02")))
     res = fleet.run(reqs)
@@ -225,12 +239,14 @@ def build_fleet():
         registry.snapshot(mode="fleet", final=True)))
     for rec in res.replica_log:
         emit("replica", **rec)
+    for rec in res.transport_log:
+        emit("transport", **rec)
     for rec in res.request_records():
         emit("request", **rec)
     emit("serve", bench="fleet", policy="cache_aware", autoscale=True,
          redispatch="resume", spec="off", replicas_initial=1,
          rate=300.0, slots=2, page_size=4, pages=9, compute="sim",
-         prefix_cache=True, host_pages=6, **s)
+         prefix_cache=True, host_pages=6, transport=True, **s)
     print(f"fleet: statuses={s['statuses']} "
           f"route_hits={s['route_hits']}/{s['route_hits'] + s['route_misses']} "
           f"ups={s['scale_ups']} downs={s['scale_downs']} "

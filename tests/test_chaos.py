@@ -15,12 +15,15 @@ THE acceptance tests live here:
 - plant-a-bug: with the test-only skip-revoke toggle armed the search
   FINDS an invariant violation and ddmin-SHRINKS it to a <=2-entry
   minimal plan whose failure really is the plant (the same minimal
-  plan passes with the plant off);
+  plan passes with the plant off); the ISSUE 20 skip-dedup twin does
+  the same for the transport bus's commit dedup, shrinking to a
+  ONE-entry msg_dup plan;
 - trace-driven replay (ROADMAP item 4): `--trace FILE` rebuilds a
   recorded request trail geometry-exact (ids, budgets, arrivals,
   tenants) on both benches, deterministically.
 """
 
+import dataclasses
 import json
 import random
 from pathlib import Path
@@ -111,7 +114,8 @@ def test_sampler_seed_stable_and_covers_axes_matrix():
     # CI run is 50 episodes — this pins that scale actually reaches
     # every axis).
     seen = {"pools": False, "unified": False, "prefix": False,
-            "spill": False, "spec": False, "autoscale": False}
+            "spill": False, "spec": False, "autoscale": False,
+            "transport": False}
     for ep in range(50):
         axes = sample_axes(random.Random(f"mctpu-chaos:7:{ep}"))
         seen["pools"] |= axes.pools is not None
@@ -120,8 +124,13 @@ def test_sampler_seed_stable_and_covers_axes_matrix():
         seen["spill"] |= axes.spill
         seen["spec"] |= axes.spec != "off"
         seen["autoscale"] |= axes.autoscale
+        seen["transport"] |= axes.transport
         if axes.spill:
             assert axes.prefix  # spill without the prefix tree is inert
+        if axes.transport:
+            # transport + pools is a Fleet constructor error (the
+            # handoff plane is not bus-routed) — never samplable.
+            assert axes.pools is None
     assert all(seen.values()), seen
 
 
@@ -137,6 +146,15 @@ def test_sampler_gates_sites_on_topology():
         for f in plan:
             assert f.site == "fleet.tick" or f.site == "fleet.resume"
             assert f.kind != "pool_crash"
+    # Transport-off axes never draw fleet.transport faults (inert at
+    # construction); transport-on axes do reach the site.
+    reached = False
+    for seed in range(30):
+        rng = random.Random(f"tgate:{seed}")
+        plan = parse_plan(sample_plan(
+            rng, EpisodeAxes(pools=None, transport=True), replicas=3))
+        reached |= any(f.site == "fleet.transport" for f in plan)
+    assert reached
 
 
 # ------------------------------------------------------------- the oracle
@@ -223,16 +241,46 @@ def test_planted_bug_found_and_shrunk_to_minimal_plan(tmp_path):
     assert (trails / "chaos_min_a.jsonl").exists()
     assert (trails / "chaos_min_b.jsonl").exists()
     # The violation is the plant's, not the schedule's: the SAME
-    # minimal episode passes with the toggle off ...
+    # minimal episode (same sampled axes, recomputed from the same
+    # per-ordinal stream the CLI uses) passes with the toggle off ...
     ep = summary["failed_episode"]
-    cfg = EpisodeConfig(seed=7 * 100003 + ep, plan=min_plan,
-                        spec="lookup")
+    axes = sample_axes(random.Random(f"mctpu-chaos:7:{ep}"))
+    cfg = config_for(7 * 100003 + ep, min_plan, axes)
     assert run_episode(cfg).ok
     # ... and fails (replay drift) with it on.
-    planted = run_episode(
-        EpisodeConfig(seed=7 * 100003 + ep, plan=min_plan,
-                      spec="lookup", plant="skip-revoke"))
+    planted = run_episode(dataclasses.replace(cfg, plant="skip-revoke"))
     assert {v["check"] for v in planted.violations} == {"replay"}
+
+
+def test_transport_canary_found_and_shrunk_to_one_entry(tmp_path):
+    """The ISSUE 20 plant-a-bug acceptance: with the skip-dedup toggle
+    armed (the bus stops deduplicating commit messages), the seeded
+    search must catch the exactly-once violation on a transport
+    episode — a duplicated commit applies twice, so the authoritative
+    output diverges from the SimCompute closed form — and ddmin-shrink
+    it to a ONE-entry msg_dup plan. The same minimal plan passes the
+    full oracle with the plant off: dedup is load-bearing, and this
+    canary proves the oracle would see it break."""
+    out = tmp_path / "chaos.jsonl"
+    rc = chaos_main(["--episodes", "1", "--seed", "7",
+                     "--plant", "skip-dedup",
+                     "--metrics-jsonl", str(out)])
+    assert rc == 1
+    rows = [json.loads(line) for line in out.read_text().splitlines()
+            if not line.startswith("#")]
+    summary = rows[-1]
+    assert summary["violations"] >= 1
+    min_plan = parse_plan(summary["min_plan"])
+    assert len(min_plan) == 1
+    assert min_plan[0].kind == "msg_dup"
+    assert min_plan[0].site == "fleet.transport"
+    ep = summary["failed_episode"]
+    axes = sample_axes(random.Random(f"mctpu-chaos:7:{ep}"))
+    assert axes.transport
+    cfg = config_for(7 * 100003 + ep, summary["min_plan"], axes)
+    assert run_episode(cfg).ok
+    planted = run_episode(dataclasses.replace(cfg, plant="skip-dedup"))
+    assert "outputs" in {v["check"] for v in planted.violations}
 
 
 # ------------------------------------------------- trace-driven replay (b)

@@ -680,3 +680,156 @@ def test_engine_fleet_crash_outputs_match_crash_free(redispatch):
     outs_crash, outs_clean = crash.outputs(), clean.outputs()
     for rid, out in outs_clean.items():
         assert outs_crash[rid] == out, f"request {rid}"
+
+
+# ------------------------------------------------- lossy transport (ISSUE 20)
+
+
+def transport_fleet(*, replicas=4, plan=None, seed=0, **kw):
+    kw.setdefault("transport", True)
+    return sim_fleet(replicas=replicas, plan=plan, seed=seed, **kw)
+
+
+def test_transport_zero_fault_bus_matches_direct_fleet_bitwise():
+    """The parity acceptance: with zero transport faults the bus-routed
+    fleet is BITWISE-equal to the direct-call fleet per request —
+    dispatch trace, statuses, every authoritative output, tick count.
+    Zero-fault delivery is inline (send() invokes the handler
+    synchronously), so this holds by construction, and the wire
+    counters prove no message ever queued. state_crc legitimately
+    differs (the bus folds its digest as a 6th component); trace_crc
+    is the request-level criterion."""
+    direct = sim_fleet().run(workload())
+    bus = transport_fleet().run(workload())
+    assert bus.dispatch_trace == direct.dispatch_trace
+    assert bus.status_counts() == direct.status_counts()
+    assert bus.outputs() == direct.outputs()
+    assert bus.trace_crc == direct.trace_crc
+    assert bus.ticks == direct.ticks
+    s = bus.summary()
+    assert s["msgs_sent"] > 0 and s["msgs_sent"] == s["msgs_delivered"]
+    for k in ("msgs_dropped", "msgs_duped", "msgs_delayed",
+              "msgs_deduped", "retransmits", "lease_refusals",
+              "partitions"):
+        assert s[k] == 0, k
+    # Direct-mode summaries carry the same keys, pinned to zero.
+    assert all(direct.summary()[k] == 0 for k in
+               ("msgs_sent", "retransmits", "lease_refusals"))
+
+
+PARTITION_PLAN = (
+    "msg_delay@fleet.transport:10?count=4&ticks=5&kind=dispatch;"
+    "partition@fleet.transport:30?replica=1&ticks=12;"
+    "msg_dup@fleet.transport:60?count=2;"
+    "msg_drop@fleet.transport:70?count=3&kind=commit;"
+    "replica_crash@fleet.tick:90?replica=2&zombie_ticks=3")
+
+
+def test_partition_false_positive_death_heals_exactly_once():
+    """The partition e2e at tier-1 scale: a 12-tick window isolates a
+    LIVE replica (heartbeat_miss=3, so the router declares it dead —
+    failure detection is fallible, late is not dead), its in-flight
+    work is re-dispatched, the isolated replica keeps serving into the
+    void until its lease expires and then REFUSES its own commits, and
+    on heal every stale commit is lease/fence-refused: every request
+    terminal exactly once, every finished output token-for-token equal
+    to the SimCompute closed form, zero double generation. Composed
+    with message delay / dup / drop and a real zombie crash so the
+    false-positive path is proven against the true-positive one."""
+    results = []
+    for _ in range(2):
+        res = transport_fleet(plan=PARTITION_PLAN).run(workload())
+        results.append(res)
+    a, b = results
+    assert all(r.terminal for r in a.requests)
+    assert len(a.requests) == 300
+    for r in a.finished_requests():
+        assert r.out == expected_out(r)
+    # The false positive really happened: r1 was declared dead (and
+    # torn down / restarted) without ever crashing...
+    r1 = [e["kind"] for e in a.replica_log if e.get("name") == "r1"]
+    assert "dead" in r1 and "crash" not in r1
+    # ...while it was ISOLATED, not gone — and its post-lease commit
+    # attempts were refused, which is the zero-double-generation
+    # mechanism under partitions.
+    assert "isolated" in r1 and "isolated_end" in r1
+    assert a.lease_refusals > 0
+    assert a.redispatches > 0
+    # Partition lifecycle reached the transport log (open then heal).
+    kinds = [e["kind"] for e in a.transport_log]
+    assert kinds.count("partition_open") == 1
+    assert kinds.count("partition_heal") == 1
+    # Wire accounting: messages really dropped (partition + msg_drop),
+    # duplicated (msg_dup), delayed (msg_delay), deduplicated, and
+    # retransmitted — with conservation at quiesce.
+    s = a.summary()
+    for k in ("msgs_dropped", "msgs_duped", "msgs_delayed",
+              "msgs_deduped", "retransmits"):
+        assert s[k] > 0, k
+    assert s["partitions"] == 1
+    assert (s["msgs_sent"] == s["msgs_delivered"] + s["msgs_deduped"]
+            + s["msgs_dropped"])
+    # The true-positive leg still holds alongside.
+    assert a.crashes == 1
+    # Bitwise determinism across the identical-seed twin.
+    assert a.dispatch_trace == b.dispatch_trace
+    assert a.status_counts() == b.status_counts()
+    assert a.outputs() == b.outputs()
+    assert a.trace_crc == b.trace_crc
+    assert a.summary()["state_crc"] == b.summary()["state_crc"]
+    assert a.lease_refusals == b.lease_refusals
+
+
+def test_transport_storm_100k_partition_scale():
+    """The full 10^5-request transport acceptance storm (slow; CI runs
+    the same shape twice through `mctpu fleet-bench --transport` +
+    `mctpu compare` at 0% tolerance): one partition + heal isolating a
+    live replica, one false-positive death, one zombie crash — all
+    terminal exactly once, zero double generation at scale."""
+    reqs = workload(n=100_000, rate=2000.0)
+    plan = ("partition@fleet.transport:4000?replica=1&ticks=12;"
+            "msg_dup@fleet.transport:12000?count=3;"
+            "replica_crash@fleet.tick:20000?replica=2&zombie_ticks=4")
+    res = transport_fleet(replicas=4, slots=8, plan=plan,
+                          check_every=256).run(reqs)
+    assert len(res.requests) == 100_000
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r)
+    assert res.lease_refusals > 0
+    assert res.crashes == 1 and res.partitions == 1
+    assert res.redispatches > 0
+    s = res.summary()
+    assert (s["msgs_sent"] == s["msgs_delivered"] + s["msgs_deduped"]
+            + s["msgs_dropped"])
+
+
+def test_heartbeat_detection_is_derived_from_message_loss():
+    """The heartbeat bugfix satellite: under the bus, `dead` is a
+    DERIVED effect of heartbeat messages not arriving — not a
+    privileged side channel. (a) A real crash is detected with exactly
+    the same lag as the direct-call fleet (the back-compat the
+    existing detection-lag test pins); (b) dropping ONLY r1's hb
+    messages on the wire produces a false-positive death of a healthy
+    replica — pure message loss, no fault at the replica — and the
+    run still ends exactly-once with closed-form outputs."""
+    miss = 5
+    fleet = transport_fleet(
+        plan="replica_crash@fleet.tick:30?replica=1", heartbeat_miss=miss)
+    res = fleet.run(workload(n=120))
+    crash = next(e for e in res.replica_log if e["kind"] == "crash")
+    dead = next(e for e in res.replica_log if e["kind"] == "dead")
+    assert crash["tick"] == 30
+    assert dead["tick"] == 30 + miss
+    # (b) targeted drop: enough consecutive hb losses to cross the
+    # staleness window kill a replica that never stopped working.
+    lossy = transport_fleet(
+        plan="msg_drop@fleet.transport:30?kind=hb&replica=1&count=10",
+        heartbeat_miss=3)
+    res = lossy.run(workload(n=200))
+    r1 = [e["kind"] for e in res.replica_log if e.get("name") == "r1"]
+    assert "dead" in r1 and "crash" not in r1 and "isolated" in r1
+    assert all(r.terminal for r in res.requests)
+    for r in res.finished_requests():
+        assert r.out == expected_out(r)
+    assert res.summary()["msgs_dropped"] >= 10

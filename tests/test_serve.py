@@ -219,6 +219,15 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     its waiting work back while in-flight slots run to completion —
     every member's pool under the same every-step check(), and every
     drained member's pool must hand back every page.
+    ISSUE 20 puts a REAL TransportBus under part of the traffic:
+    a bus-dispatch op sends requests to scheduler A over the wire
+    (some copies DELAYED in flight — a request on the wire is in no
+    scheduler, so the every-step check() proves wire state never
+    leaks into a pool), a harvest op reports terminal requests back
+    over the bus with DUPLICATED copies the receiver must dedup, and
+    two sampled PARTITION windows open and heal mid-walk — reliable
+    sends retransmit through them and every bus-dispatched request
+    still arrives exactly once.
     The fleet's re-dispatch and disaggregated-handoff paths
     (serve/fleet.py) drive these exact scheduler+pool+prefix triples
     per replica, so they inherit the guarantee."""
@@ -467,6 +476,66 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
         for m in members:
             m["sched"].check()
 
+    # Lossy-transport ops (ISSUE 20): a real TransportBus carries part
+    # of the dispatch traffic into scheduler A and harvest reports
+    # back out, with delayed dispatches, duplicated harvest reports
+    # and two partition windows armed on the bus's own fault injector.
+    from mpi_cuda_cnn_tpu.faults import FaultInjector
+    from mpi_cuda_cnn_tpu.serve.transport import TransportBus
+
+    bus_tick = [0]
+    wire = {"dispatched": 0, "harvests": 0}
+    wire_rids: set = set()
+    harvest_seen: set = set()
+
+    def _router_msg(msg, tick):
+        # Receiver-side dedup makes the duplicated harvest report a
+        # single logical delivery.
+        assert msg.key not in harvest_seen, "bus dedup failed"
+        harvest_seen.add(msg.key)
+
+    def _member_msg(msg, tick):
+        req = msg.payload
+        assert req.rid not in wire_rids, "duplicate dispatch delivery"
+        wire_rids.add(req.rid)
+        sched.submit([req])
+
+    bus = TransportBus(faults=FaultInjector(
+        "msg_delay@fleet.transport:8?kind=dispatch&count=3&ticks=4;"
+        "msg_dup@fleet.transport:30?kind=commit&count=3;"
+        "partition@fleet.transport:60?replica=0&ticks=10;"
+        "partition@fleet.transport:150?replica=0&ticks=8"))
+    bus.register("router", _router_msg)
+    bus.register("r0#0", _member_msg)
+
+    def bus_dispatch_op():
+        nonlocal next_rid
+        prompt = rng.integers(0, 13, (int(rng.integers(2, 12)),))
+        req = Request(rid=next_rid, prompt=prompt,
+                      max_new_tokens=int(rng.integers(2, 14)),
+                      arrival=now)
+        next_rid += 1
+        submitted.append(req)
+        wire["dispatched"] += 1
+        bus.send("dispatch", "router", "r0#0", req, tick=bus_tick[0],
+                 key=(req.rid, "d", 0), reliable=True)
+
+    def bus_harvest_op():
+        done = [r for r in submitted if r.terminal]
+        if not done:
+            return
+        r = done[int(rng.integers(len(done)))]
+        wire["harvests"] += 1
+        bus.send("commit", "r0#0", "router",
+                 {"rid": r.rid, "outlen": len(r.out)},
+                 tick=bus_tick[0], key=(r.rid, "c", 0, len(r.out)),
+                 reliable=True)
+
+    def bus_step():
+        bus_tick[0] += 1
+        bus.apply_tick_faults(bus_tick[0])
+        bus.pump(bus_tick[0])
+
     ops = [submit_one, lambda: sched.admit(now), prefill_step,
            decode_step_op, preempt_op, cancel_op,
            lambda: sched.sweep(now), reclaim_op, handoff_op,
@@ -476,20 +545,27 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
            spec_decode_op,
            lambda: spec_decode_op(sched_b, engine_b),
            corrupt_op,
-           join_op, member_dispatch_op, member_step_op, drain_op]
+           join_op, member_dispatch_op, member_step_op, drain_op,
+           bus_dispatch_op, bus_harvest_op]
     weights = np.array([0.16, 0.14, 0.15, 0.06, 0.06, 0.04, 0.04, 0.04,
                         0.09, 0.04, 0.03, 0.03, 0.06, 0.04, 0.02,
-                        0.02, 0.04, 0.05, 0.02])
+                        0.02, 0.04, 0.05, 0.02,
+                        0.05, 0.04])
     weights = weights / weights.sum()
     for _ in range(340):
         now += float(rng.uniform(0.0, 0.02))  # deadlines really expire
+        bus_step()
         ops[int(rng.choice(len(ops), p=weights))]()
         check_both()
-    # Drain every scheduler: the surviving work must complete and hand
-    # every page of every pool back — including the autoscaler-joined
-    # members', draining or not.
+    # Drain every scheduler AND the wire: the surviving work must
+    # complete and hand every page of every pool back — including the
+    # autoscaler-joined members', draining or not — and every delayed
+    # or unacked bus message must deliver or drop (a bus-dispatched
+    # request still on the wire is in no scheduler yet).
     while (sched.unfinished or sched_b.unfinished
-           or any(m["sched"].unfinished for m in members)):
+           or any(m["sched"].unfinished for m in members)
+           or bus.busy()):
+        bus_step()
         for sc, en in ((sched, engine), (sched_b, engine_b),
                        *((m["sched"], m["engine"]) for m in members)):
             sc.sweep(now)
@@ -539,6 +615,23 @@ def test_pagepool_randomized_op_sequence_invariant(dtype):
     assert tier.stats["spills"] > 0
     assert tier.stats["readmits"] > 0
     assert tier.stats["refusals"] > 0
+    # The lossy-transport surface (ISSUE 20): dispatches crossed the
+    # wire and every one arrived exactly once (delayed copies and
+    # partition retransmissions included); the duplicated harvest
+    # report was collapsed by receiver dedup; both partition windows
+    # opened and healed; conservation holds at quiesce.
+    f = bus.record_fields()
+    assert (f["sent"] == f["delivered"] + f["deduped"] + f["dropped"]
+            + f["inflight"])
+    assert wire["dispatched"] > 0
+    assert len(wire_rids) == wire["dispatched"]
+    assert wire["harvests"] > 0
+    assert bus.counters["delayed"] > 0
+    assert bus.counters["duped"] > 0
+    assert bus.counters["deduped"] > 0
+    assert bus.counters["retransmits"] > 0
+    assert bus.counters["partitions"] == 2
+    assert not bus.partitions and not bus.busy()
 
 
 def test_engine_preemption_recovers_and_completes():

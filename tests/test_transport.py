@@ -1,0 +1,317 @@
+"""Transport-bus unit laws (ISSUE 20).
+
+The laws the exactly-once proof leans on, each pinned in isolation
+against a bare TransportBus (jax-free — no fleet, no engine):
+
+- zero-fault delivery is INLINE: the handler runs synchronously inside
+  send(), the ack clears the retransmit entry in the same call, and
+  the wire is idle afterwards — the mechanism behind the bus-on ==
+  direct-call bitwise-parity acceptance;
+- at-least-once retransmission paces on `utils.retry.backoff_delay`
+  (jitter pinned to zero, whole-tick ceilings, attempt plateau) and
+  stops on ack;
+- receiver-side dedup drops repeats by (rid, kind0, epoch[, pos]) key
+  and RE-ACKS them (the retransmit means the first ack was lost);
+- the skip-dedup chaos plant really disables the commit seen-check —
+  the canary the chaos search must catch is load-bearing;
+- a partition drops traffic in BOTH directions, at send and at delayed
+  delivery, and heals on schedule with retransmits completing
+  delivery exactly once;
+- unregister purges unacked entries touching the endpoint while
+  delayed copies stay in flight and count dropped at delivery;
+- the conservation invariant `sent == delivered + deduped + dropped +
+  inflight` holds through a seeded random fault walk (the same audit
+  the replay mirror runs every tick);
+- the fleet-level lease/transport config laws: lease_ticks defaults to
+  heartbeat_miss + 2, must exceed heartbeat_miss, transport refuses
+  --pools, and fleet.transport faults without the bus are inert-loud.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_cnn_tpu.faults import FaultInjector
+from mpi_cuda_cnn_tpu.serve.transport import (
+    COUNTER_KEYS,
+    TRANSPORT_SITE,
+    TransportBus,
+    transport_digest_tuple,
+)
+from mpi_cuda_cnn_tpu.utils.retry import backoff_delay
+
+
+def _conserved(bus: TransportBus) -> bool:
+    f = bus.record_fields()
+    return (f["sent"]
+            == f["delivered"] + f["deduped"] + f["dropped"] + f["inflight"])
+
+
+def _bus(plan: str | None = None, **kw) -> TransportBus:
+    faults = FaultInjector(plan) if plan else None
+    return TransportBus(faults=faults, **kw)
+
+
+def test_zero_fault_delivery_is_inline_and_acked():
+    bus = _bus()
+    got = []
+    bus.register("router", lambda m, t: got.append((m.kind, m.payload)))
+    bus.register("r0#0", lambda m, t: got.append((m.kind, m.payload)))
+    bus.send("dispatch", "router", "r0#0", {"rid": 7}, tick=1,
+             key=(7, "d", 0), reliable=True)
+    # Handler ran synchronously inside send(); the inline ack already
+    # cleared the retransmit entry — nothing left on the wire.
+    assert got == [("dispatch", {"rid": 7})]
+    assert not bus.busy()
+    f = bus.record_fields()
+    assert f["sent"] == 2 and f["delivered"] == 2  # dispatch + ack
+    assert f["unacked"] == 0 and f["inflight"] == 0
+    assert _conserved(bus)
+    # Unreliable kinds skip the ack machinery entirely.
+    bus.send("hb", "r0#0", "router", {"load": 1}, tick=2)
+    assert got[-1] == ("hb", {"load": 1})
+    assert not bus.busy()
+
+
+def test_reliable_send_requires_a_key():
+    bus = _bus()
+    bus.register("router", lambda m, t: None)
+    with pytest.raises(ValueError, match="dedup key"):
+        bus.send("commit", "r0#0", "router", {}, tick=0, reliable=True)
+
+
+def test_retransmit_paces_on_backoff_delay_and_stops_on_ack():
+    bus = _bus(rto_base=2.0)
+    bus.register("router", lambda m, t: None)
+    # Destination not registered: every wire attempt drops, the sender
+    # keeps retrying on the backoff schedule with no cap.
+    bus.send("dispatch", "router", "r0#0", {"rid": 1}, tick=0,
+             key=(1, "d", 0), reliable=True)
+    assert bus.busy()
+    due = []
+    for tick in range(1, 40):
+        before = bus.counters["retransmits"]
+        bus.pump(tick)
+        if bus.counters["retransmits"] > before:
+            due.append(tick)
+    # Attempt k retransmits _rto(k-1) ticks after attempt k-1, where
+    # _rto is the jitterless backoff_delay ceiling'd to whole ticks.
+    def rto(a):
+        return min(32, max(1, -int(-backoff_delay(
+            min(a, 5), base=2.0, jitter=lambda: 0.0) // 1)))
+
+    expect, t = [], 0
+    for a in range(len(due)):
+        t += rto(a)
+        expect.append(t)
+    assert due == expect
+    # Late registration: the next retransmit delivers, the ack lands,
+    # and the wire goes quiet — at-least-once became exactly-once.
+    got = []
+    bus.register("r0#0", lambda m, t: got.append(m.payload["rid"]))
+    for tick in range(40, 80):
+        bus.pump(tick)
+    assert got == [1]
+    assert not bus.busy()
+    assert _conserved(bus)
+
+
+def test_dedup_drops_repeats_and_reacks():
+    bus = _bus("msg_dup@fleet.transport:1?kind=commit&count=1")
+    hits = []
+    bus.register("router", lambda m, t: hits.append(m.key))
+    bus.register("r0#0", lambda m, t: None)
+    bus.apply_tick_faults(1)
+    bus.send("commit", "r0#0", "router", {"tok": 3}, tick=1,
+             key=(4, "c", 0, 0), reliable=True)
+    # The dup delivered two wire copies; dedup let exactly one through
+    # and RE-ACKED the repeat, so the sender's entry is still cleared.
+    assert hits == [(4, "c", 0, 0)]
+    c = bus.counters
+    assert c["duped"] == 1 and c["deduped"] == 1
+    assert not bus.busy()
+    assert _conserved(bus)
+    # A later send with the SAME key (a retransmit crossing its ack)
+    # dedups again — and the re-ack clears the re-armed entry.
+    bus.send("commit", "r0#0", "router", {"tok": 3}, tick=2,
+             key=(4, "c", 0, 0), reliable=True)
+    assert hits == [(4, "c", 0, 0)]
+    assert bus.counters["deduped"] == 2
+    assert not bus.busy()
+    # release_keys drops the rid's store: the guard downstream (the
+    # fleet's req.terminal check) takes over from there.
+    bus.release_keys(4)
+    bus.send("commit", "r0#0", "router", {"tok": 3}, tick=3,
+             key=(4, "c", 0, 0), reliable=True)
+    assert len(hits) == 2
+
+
+def test_skip_dedup_plant_disables_commit_dedup_only():
+    bus = _bus("msg_dup@fleet.transport:1?count=2",
+               plant=lambda: "skip-dedup")
+    hits = []
+    bus.register("router", lambda m, t: hits.append(m.key))
+    bus.register("r0#0", lambda m, t: None)
+    bus.apply_tick_faults(1)
+    bus.send("commit", "r0#0", "router", {}, tick=1,
+             key=(1, "c", 0, 0), reliable=True)
+    bus.send("terminal", "r0#0", "router", {}, tick=1,
+             key=(1, "t", 0), reliable=True)
+    # The plant bypasses the seen-check for COMMIT keys only: the duped
+    # commit applies twice (the planted bug), the duped terminal still
+    # dedups — the canary is scoped to the exactly-once token path.
+    assert hits.count((1, "c", 0, 0)) == 2
+    assert hits.count((1, "t", 0)) == 1
+
+
+def test_partition_blocks_both_directions_then_heals():
+    events = []
+    bus = _bus("partition@fleet.transport:2?replica=0&ticks=3",
+               on_event=lambda k, f: events.append((k, f["name"])))
+    got = []
+    bus.register("router", lambda m, t: got.append(("router", m.kind)))
+    bus.register("r0#0", lambda m, t: got.append(("r0", m.kind)))
+    bus.apply_tick_faults(2)
+    assert bus.counters["partitions"] == 1
+    assert events == [("partition_open", "r0")]
+    bus.send("dispatch", "router", "r0#0", {}, tick=2,
+             key=(9, "d", 0), reliable=True)
+    bus.send("hb", "r0#0", "router", {}, tick=2)
+    # Both directions dropped at the wire; the unreliable hb is gone
+    # for good, the reliable dispatch waits on retransmission.
+    assert got == []
+    assert bus.counters["dropped"] == 2
+    assert bus.busy()
+    for tick in range(3, 16):
+        bus.apply_tick_faults(tick)
+        bus.pump(tick)
+    # Healed at tick 5 (2 + 3): the first retransmit after the heal
+    # (backoff-paced, tick 8) delivered the dispatch exactly once.
+    assert ("partition_heal", "r0") in events
+    assert got == [("r0", "dispatch")]
+    assert not bus.busy()
+    assert _conserved(bus)
+
+
+def test_partition_drops_delayed_copy_at_delivery_time():
+    bus = _bus("msg_delay@fleet.transport:1?ticks=3;"
+               "partition@fleet.transport:2?replica=0&ticks=4")
+    got = []
+    bus.register("router", lambda m, t: None)
+    bus.register("r0#0", lambda m, t: got.append(m.kind))
+    bus.apply_tick_faults(1)
+    bus.send("hb_ack", "router", "r0#0", {}, tick=1)
+    assert got == [] and len(bus._delayed) == 1
+    # The window opened while the copy was in flight: pump re-checks
+    # partitions at the due tick and drops it there.
+    bus.apply_tick_faults(2)
+    for tick in range(2, 7):
+        bus.pump(tick)
+    assert got == []
+    assert bus.counters["dropped"] == 1
+    assert _conserved(bus)
+
+
+def test_unregister_purges_unacked_but_not_delayed():
+    bus = _bus("msg_delay@fleet.transport:1?kind=dispatch&ticks=2;"
+               "msg_drop@fleet.transport:1?kind=commit")
+    bus.register("router", lambda m, t: None)
+    bus.register("r0#0", lambda m, t: None)
+    bus.apply_tick_faults(1)
+    bus.send("dispatch", "router", "r0#0", {}, tick=1,
+             key=(1, "d", 0), reliable=True)   # delayed copy parked
+    bus.send("commit", "r0#0", "router", {}, tick=1,
+             key=(1, "c", 0, 0), reliable=True)  # dropped, unacked
+    assert len(bus._delayed) == 1 and len(bus._unacked) == 2
+    bus.unregister("r0#0")
+    # Unacked entries touching the endpoint purged (as sender AND as
+    # destination); the delayed copy stays — the network does not know
+    # the process died — and drops at delivery for want of a handler.
+    assert len(bus._unacked) == 0
+    assert len(bus._delayed) == 1
+    for tick in range(2, 5):
+        bus.pump(tick)
+    assert not bus.busy()
+    assert bus.record_fields()["inflight"] == 0
+    assert _conserved(bus)
+
+
+def test_conservation_invariant_through_seeded_fault_walk():
+    plan = ";".join(
+        f"msg_{k}@fleet.transport:{t}?count=2"
+        for t, k in enumerate(["drop", "dup", "delay", "drop", "dup"],
+                              start=2))
+    plan += ";partition@fleet.transport:6?replica=1&ticks=4"
+    bus = _bus(plan)
+    bus.register("router", lambda m, t: None)
+    for name in ("r0#0", "r1#0", "r2#1"):
+        bus.register(name, lambda m, t: None)
+    rng = np.random.default_rng(20)
+    kinds = ["dispatch", "commit", "terminal", "hb"]
+    for tick in range(1, 30):
+        bus.apply_tick_faults(tick)
+        for _ in range(int(rng.integers(0, 4))):
+            kind = kinds[int(rng.integers(len(kinds)))]
+            dst = ["r0#0", "r1#0", "r2#1"][int(rng.integers(3))]
+            rid = int(rng.integers(6))
+            if kind == "hb":
+                bus.send("hb", dst, "router", {}, tick=tick)
+            elif kind == "dispatch":
+                bus.send("dispatch", "router", dst, {}, tick=tick,
+                         key=(rid, "d", 0), reliable=True)
+            else:
+                k0 = "c" if kind == "commit" else "t"
+                key = ((rid, k0, 0, tick) if k0 == "c"
+                       else (rid, k0, 0))
+                bus.send(kind, dst, "router", {}, tick=tick,
+                         key=key, reliable=True)
+        bus.pump(tick)
+        assert _conserved(bus), f"conservation broken at tick {tick}"
+    for tick in range(30, 120):
+        bus.apply_tick_faults(tick)
+        bus.pump(tick)
+        if not bus.busy():
+            break
+    assert not bus.busy()
+    c = bus.counters
+    assert c["dropped"] > 0 and c["duped"] > 0 and c["delayed"] > 0
+    assert c["retransmits"] > 0 and c["partitions"] == 1
+    assert _conserved(bus)
+    # The digest folds every counter plus wire/link/partition state —
+    # the spelling fleet_state_digest and the replay mirror share.
+    d = transport_digest_tuple(bus.record_fields())
+    assert d[0] == tuple(c[k] for k in COUNTER_KEYS)
+    assert d[1] == 0 and d[3] and d[4] == ()
+
+
+def test_rto_base_validates():
+    with pytest.raises(ValueError, match="rto_base"):
+        TransportBus(rto_base=0)
+
+
+def test_fleet_lease_and_transport_config_laws():
+    from mpi_cuda_cnn_tpu.serve.fleet import Fleet, SimCompute
+
+    def factory(name):
+        return SimCompute(vocab=32, chunk=8, salt=0)
+
+    common = dict(slots=2, num_pages=9, page_size=4, max_len=24,
+                  heartbeat_miss=3)
+    # Default lease outlives the detection window by two ticks.
+    f = Fleet(factory, replicas=2, transport=True, **common)
+    assert f.lease_ticks == 5
+    # A lease inside the detection window is refused loudly.
+    with pytest.raises(ValueError, match="lease_ticks"):
+        Fleet(factory, replicas=2, transport=True, lease_ticks=3,
+              **common)
+    # Scope cut: the handoff control plane is not bus-routed.
+    with pytest.raises(ValueError, match="pools"):
+        Fleet(factory, replicas=2, transport=True,
+              pools="prefill:1,decode:1", **common)
+    # Inert-fault contract: fleet.transport faults need the bus.
+    with pytest.raises(ValueError, match="--transport"):
+        Fleet(factory, replicas=2, transport=False,
+              faults=FaultInjector(
+                  "msg_drop@fleet.transport:3?count=1"),
+              **common)
+    # With the bus off lease bookkeeping is fully disabled.
+    assert Fleet(factory, replicas=2, **common).lease_ticks == 0
